@@ -1,0 +1,31 @@
+"""fairify_tpu — a TPU-native individual-fairness verification framework.
+
+A ground-up JAX/XLA re-design of the capabilities of the Fairify artifact
+(ICSE 2023, reference at /root/reference): given a trained MLP classifier,
+a tabular attribute domain, and a set of protected attributes, decide for
+each box of a partitioned input domain whether a pair (x, x') exists that
+agrees on all non-protected attributes, differs on a protected one, and is
+classified differently (SAT), or prove no such pair exists (UNSAT).
+
+Architectural stance (TPU-first, not a port):
+
+* Every numeric stage of the reference — simulation forward passes
+  (``utils/prune.py:168-222``), interval bound propagation
+  (``utils/prune.py:105-164``), counterexample replay and accuracy parity
+  (``utils/verif_utils.py:1040-1047``) — is a batched, `vmap`/`jit`-compiled
+  XLA kernel over *static shapes*.  Pruned neurons are masks, never ragged
+  deletes, so partitions × models × samples batch onto the MXU.
+* The reference's decision procedure (Z3 SMT, ``src/GC/Verify-GC.py:145-214``)
+  is replaced by a native complete verifier: batched CROWN/IBP bounds on a
+  *pair network* drive an input-space branch-and-bound over the integer
+  attribute lattice (complete because the lattice is finite), with a
+  device-side counterexample attack for fast SAT certificates.  A gated Z3
+  backend is retained for environments that have `z3-solver` installed.
+* The partition sweep — the reference's outer loop
+  (``src/GC/Verify-GC.py:106``) — shards over a `jax.sharding.Mesh`
+  (ICI within a pod, DCN across hosts).
+"""
+
+__version__ = "0.1.0"
+
+from fairify_tpu.models.mlp import MLP  # noqa: F401

@@ -1,0 +1,3 @@
+from fairify_tpu.data.domains import DOMAINS, DomainSpec, get_domain
+
+__all__ = ["DOMAINS", "DomainSpec", "get_domain"]

@@ -1,0 +1,339 @@
+"""Dataset loaders: CSV → encoded numpy matrices with a fixed seed-42 split.
+
+Re-implements the reference's six loaders (``utils/verif_utils.py:46-482``)
+with identical semantics — same column sets, same label/ordinal encodings,
+same 85/15 split at ``random_state=42`` — so verdicts and metrics are
+comparable row-for-row.  Loaders return ``LoadedDataset`` instead of bare
+tuples and keep the fitted encoders for counterexample decoding
+(``src/AC/Verify-AC-experiment-new2.py:344-407``).
+
+Data files are read from a configurable root (default: the read-only
+reference checkout).  ``bank-additional-full.csv`` is missing from the
+reference checkout (git-LFS stub, ``.MISSING_LARGE_BLOBS``); the bank loader
+falls back to the committed ``bank-additional.csv`` sample and records which
+file it used.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Optional
+
+import numpy as np
+import pandas as pd
+from sklearn.model_selection import train_test_split
+from sklearn.preprocessing import KBinsDiscretizer, LabelEncoder, MinMaxScaler, OneHotEncoder
+
+DEFAULT_DATA_ROOT = os.environ.get("FAIRIFY_TPU_DATA_ROOT", "/root/reference/data")
+SPLIT_SEED = 42  # utils/verif_utils.py:187 — fixed across every loader
+TEST_FRACTION = 0.15
+
+
+@dataclass
+class LoadedDataset:
+    name: str
+    df: pd.DataFrame
+    X_train: np.ndarray
+    y_train: np.ndarray
+    X_test: np.ndarray
+    y_test: np.ndarray
+    label: str
+    encoders: Dict[str, object] = field(default_factory=dict)
+    notes: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def feature_columns(self):
+        return [c for c in self.df.columns if c != self.label]
+
+    @property
+    def X(self) -> np.ndarray:
+        return np.concatenate([self.X_train, self.X_test], axis=0)
+
+
+def _split(X: pd.DataFrame, y: pd.Series):
+    X_train, X_test, y_train, y_test = train_test_split(
+        X, y, test_size=TEST_FRACTION, random_state=SPLIT_SEED
+    )
+    return (
+        X_train.to_numpy().astype(np.float64),
+        y_train.to_numpy().astype("int"),
+        X_test.to_numpy().astype(np.float64),
+        y_test.to_numpy().astype("int"),
+    )
+
+
+def _root(root) -> Path:
+    return Path(root or DEFAULT_DATA_ROOT)
+
+
+# ---------------------------------------------------------------------------
+# German Credit  (utils/verif_utils.py:193-241 + utils/standard_data.py:4-65)
+# ---------------------------------------------------------------------------
+
+_GERMAN_COLUMNS = [
+    "status", "month", "credit_history", "purpose", "credit_amount", "savings",
+    "employment", "investment_as_income_percentage", "personal_status",
+    "other_debtors", "residence_since", "property", "age", "installment_plans",
+    "housing", "number_of_credits", "skill_level", "people_liable_for",
+    "telephone", "foreign_worker", "credit",
+]
+
+
+def _german_preprocess(df: pd.DataFrame) -> pd.DataFrame:
+    """Semantic grouping of German-credit codes (``utils/standard_data.py:4-65``):
+    credit-history/savings/employment collapsed to coarse categories, ``sex``
+    derived from ``personal_status``, label 1/2 → 1/0."""
+    # 1 = male, 0 = female (utils/standard_data.py:48-51)
+    status_map = {"A91": 1, "A93": 1, "A94": 1, "A92": 0, "A95": 0}
+    df["sex"] = df["personal_status"].map(status_map)
+
+    group_maps = {
+        "credit_history": {"A30": "None/Paid", "A31": "None/Paid", "A32": "None/Paid",
+                           "A33": "Delay", "A34": "Other"},
+        "savings": {"A61": "<500", "A62": "<500", "A63": "500+", "A64": "500+", "A65": "Unknown/None"},
+        "employment": {"A71": "Unemployed", "A72": "1-4 years", "A73": "1-4 years",
+                       "A74": "4+ years", "A75": "4+ years"},
+        "status": {"A11": "<200", "A12": "<200", "A13": "200+", "A14": "None"},
+    }
+    for col, mapping in group_maps.items():
+        df[col] = df[col].map(mapping)
+    df["credit"] = df["credit"].replace({1: 1, 2: 0})
+    return df
+
+
+def load_german(root=None) -> LoadedDataset:
+    path = _root(root) / "german" / "german.data"
+    df = pd.read_csv(path, sep=" ", header=None, names=_GERMAN_COLUMNS)
+    df["age"] = (df["age"] >= 26).astype(float)  # binarized PA, verif_utils.py:204
+    df = _german_preprocess(df)
+    df = df.drop(columns=["personal_status"])
+
+    encoders: Dict[str, object] = {}
+    cat_feat = ["status", "credit_history", "purpose", "savings", "employment",
+                "other_debtors", "property", "installment_plans", "housing",
+                "skill_level", "telephone", "foreign_worker"]
+    for f in cat_feat:
+        le = LabelEncoder()
+        df[f] = le.fit_transform(df[f])
+        encoders[f] = le
+
+    label = "credit"
+    X = df.drop(columns=[label])
+    y = df[label]
+    X_train, y_train, X_test, y_test = _split(X, y)
+    return LoadedDataset("german", df, X_train, y_train, X_test, y_test, label, encoders)
+
+
+# ---------------------------------------------------------------------------
+# Adult Census, label-encoded 13-feature form  (utils/verif_utils.py:119-190)
+# ---------------------------------------------------------------------------
+
+_ADULT_COLUMNS = [
+    "age", "workclass", "fnlwgt", "education", "education-num", "marital-status",
+    "occupation", "relationship", "race", "sex", "capital-gain", "capital-loss",
+    "hours-per-week", "native-country", "income-per-year",
+]
+
+
+def load_adult(root=None) -> LoadedDataset:
+    """The AC drivers' loader (``load_adult_ac1``): label-encode categoricals,
+    20-bin-discretize capital gain/loss, binary label on >50K."""
+    base = _root(root) / "adult"
+    train = pd.read_csv(base / "adult.data", header=None, names=_ADULT_COLUMNS,
+                        skipinitialspace=True, na_values=["?"])
+    test = pd.read_csv(base / "adult.test", header=0, names=_ADULT_COLUMNS,
+                       skipinitialspace=True, na_values=["?"])
+    df = pd.concat([test, train], ignore_index=True)
+    df = df.drop(columns=["fnlwgt"]).dropna()
+
+    encoders: Dict[str, object] = {}
+    for f in ["sex", "workclass", "education", "marital-status", "occupation",
+              "relationship", "native-country", "race"]:
+        le = LabelEncoder()
+        df[f] = le.fit_transform(df[f])
+        encoders[f] = le
+    for f in ["capital-gain", "capital-loss"]:
+        kb = KBinsDiscretizer(n_bins=20, encode="ordinal", strategy="uniform")
+        df[f] = kb.fit_transform(df[[f]])
+        encoders[f] = kb
+
+    label = "income-per-year"
+    fav = df[label].isin([">50K", ">50K."])
+    df[label] = np.where(fav, 1, 0)
+    X = df.drop(columns=[label])
+    y = df[label]
+    X_train, y_train, X_test, y_test = _split(X, y)
+    return LoadedDataset("adult", df, X_train, y_train, X_test, y_test, label, encoders)
+
+
+# ---------------------------------------------------------------------------
+# Bank Marketing  (utils/verif_utils.py:309-366)
+# ---------------------------------------------------------------------------
+
+_BANK_COLUMNS = [
+    "age", "job", "marital", "education", "default", "housing", "loan", "contact",
+    "month", "day_of_week", "duration", "emp.var.rate", "campaign", "pdays",
+    "previous", "poutcome", "y",
+]
+
+
+def load_bank(root=None) -> LoadedDataset:
+    base = _root(root) / "bank"
+    notes = {}
+    path = base / "bank-additional-full.csv"
+    if not path.is_file():  # LFS-missing in the reference checkout
+        path = base / "bank-additional.csv"
+        notes["data_file"] = "bank-additional.csv (full file unavailable)"
+    df = pd.read_csv(path, sep=";", na_values=["unknown"]).dropna()
+
+    df["age"] = (df["age"] >= 25).astype(float)  # binarized PA, verif_utils.py:325
+    encoders: Dict[str, object] = {}
+    for f in ["job", "marital", "education", "default", "housing", "loan",
+              "contact", "month", "day_of_week", "poutcome"]:
+        le = LabelEncoder()
+        df[f] = le.fit_transform(df[f])
+        encoders[f] = le
+
+    df = df[_BANK_COLUMNS]
+    label = "y"
+    df[label] = np.where(df[label].isin(["yes"]), 1, 0)
+    X = df.drop(columns=[label])
+    y = df[label]
+    X_train, y_train, X_test, y_test = _split(X, y)
+    return LoadedDataset("bank", df, X_train, y_train, X_test, y_test, label, encoders, notes)
+
+
+# ---------------------------------------------------------------------------
+# Compas  (utils/verif_utils.py:243-265)
+# ---------------------------------------------------------------------------
+
+
+def load_compass(root=None) -> LoadedDataset:
+    path = _root(root) / "compass" / "compas_preprocessed_full.csv"
+    df = pd.read_csv(path)
+    encoders: Dict[str, object] = {}
+    for f in ["Two_yr_Recidivism", "Number_of_Priors", "Age", "Race", "Female", "Misdemeanor"]:
+        le = LabelEncoder()
+        df[f] = le.fit_transform(df[f])
+        encoders[f] = le
+    label = "score_factor"
+    X = df.drop(columns=[label])
+    y = df[label]
+    X_train, y_train, X_test, y_test = _split(X, y)
+    return LoadedDataset("compass", df, X_train, y_train, X_test, y_test, label, encoders)
+
+
+# ---------------------------------------------------------------------------
+# Default Credit  (utils/verif_utils.py:267-307)
+# ---------------------------------------------------------------------------
+
+
+def load_default(root=None) -> LoadedDataset:
+    path = _root(root) / "default" / "default.csv"
+    df = pd.read_csv(path)
+    df = df.rename(columns={"PAY_0": "PAY_1"}).drop(columns=["ID"])
+
+    cat_oh = ["SEX", "EDUCATION", "MARRIAGE"]
+    oh = OneHotEncoder(drop="first", sparse_output=False)
+    encoded = oh.fit_transform(df[cat_oh])
+    encoded_df = pd.DataFrame(encoded, columns=oh.get_feature_names_out(cat_oh))
+    df = df.drop(columns=cat_oh).reset_index(drop=True).join(encoded_df)
+
+    mms_cols = ["PAY_1", "PAY_2", "PAY_3", "PAY_4", "PAY_5", "PAY_6"]
+    mms = MinMaxScaler()
+    df[mms_cols] = mms.fit_transform(df[mms_cols])
+
+    label = "default.payment.next.month"
+    X = df.drop(columns=[label])
+    y = df[label]
+    X_train, y_train, X_test, y_test = _split(X, y)
+    encoders = {"onehot": oh, "minmax": mms}
+    return LoadedDataset("default", df, X_train, y_train, X_test, y_test, label, encoders)
+
+
+# ---------------------------------------------------------------------------
+# Adult, one-hot 42-feature form  (utils/verif_utils.py:369-482; used by the
+# experimentData notebooks rather than the main drivers)
+# ---------------------------------------------------------------------------
+
+
+def load_adult_onehot(root=None) -> LoadedDataset:
+    base = _root(root) / "adult"
+    train = pd.read_csv(base / "adult.data", header=None, names=_ADULT_COLUMNS,
+                        skipinitialspace=True, na_values=["?"])
+    test = pd.read_csv(base / "adult.test", header=0, names=_ADULT_COLUMNS,
+                       skipinitialspace=True, na_values=["?"])
+    df = pd.concat([test, train], ignore_index=True)
+
+    for col in ["workclass", "occupation", "native-country"]:
+        mode = df[col].mode(dropna=True)[0]
+        df[col] = df[col].fillna(mode)
+
+    df["education"] = df["education"].replace(
+        {"11th": "HS-grad", "10th": "HS-grad", "9th": "HS-grad", "12th": "HS-grad"})
+    df["education"] = df["education"].replace(
+        {"1st-4th": "elementary_school", "5th-6th": "elementary_school", "7th-8th": "elementary_school"})
+    df["marital-status"] = df["marital-status"].replace(
+        {"Married-spouse-absent": "Married", "Married-civ-spouse": "Married", "Married-AF-spouse": "Married",
+         "Separated": "Separated", "Divorced": "Separated"})
+    df["workclass"] = df["workclass"].replace(
+        {"Self-emp-not-inc": "Self_employed", "Self-emp-inc": "Self_employed",
+         "Local-gov": "Govt_employees", "State-gov": "Govt_employees", "Federal-gov": "Govt_employees"})
+
+    df = df.drop(columns=["education-num", "fnlwgt"]).dropna()
+    df = pd.get_dummies(
+        df, columns=["sex", "workclass", "education", "marital-status",
+                     "occupation", "relationship", "native-country"], prefix_sep="=")
+    le = LabelEncoder()
+    df["race"] = le.fit_transform(df["race"])
+
+    columns = [
+        "education=Assoc-acdm", "education=Assoc-voc", "education=Bachelors",
+        "education=Doctorate", "education=HS-grad", "education=Masters",
+        "education=Preschool", "education=Prof-school", "education=elementary_school",
+        "sex=Female", "marital-status=Married", "marital-status=Separated",
+        "marital-status=Widowed", "occupation=Adm-clerical", "occupation=Armed-Forces",
+        "occupation=Craft-repair", "occupation=Exec-managerial", "occupation=Farming-fishing",
+        "occupation=Handlers-cleaners", "occupation=Machine-op-inspct",
+        "occupation=Priv-house-serv", "occupation=Prof-specialty",
+        "occupation=Protective-serv", "occupation=Sales", "occupation=Tech-support",
+        "occupation=Transport-moving", "relationship=Husband", "relationship=Not-in-family",
+        "relationship=Other-relative", "relationship=Own-child", "relationship=Unmarried",
+        "relationship=Wife", "workclass=Govt_employees", "workclass=Never-worked",
+        "workclass=Private", "workclass=Self_employed", "workclass=Without-pay",
+        "race", "age", "capital-gain", "capital-loss", "hours-per-week", "income-per-year",
+    ]
+    df = df[[c for c in columns if c in df.columns]]
+    label = "income-per-year"
+    fav = df[label].isin([">50K", ">50K."])
+    df[label] = np.where(fav, 1, 0)
+    for c in df.columns:
+        if df[c].dtype == bool:
+            df[c] = df[c].astype(int)
+    X = df.drop(columns=[label])
+    y = df[label]
+    X_train, y_train, X_test, y_test = _split(X, y)
+    return LoadedDataset("adult_onehot", df, X_train, y_train, X_test, y_test, label, {"race": le})
+
+
+LOADERS = {
+    "german": load_german,
+    "adult": load_adult,
+    "bank": load_bank,
+    "compass": load_compass,
+    "default": load_default,
+    "adult_onehot": load_adult_onehot,
+}
+
+_CACHE: Dict[str, LoadedDataset] = {}
+
+
+def load(name: str, root=None, cache: bool = True) -> LoadedDataset:
+    key = f"{name}:{root or DEFAULT_DATA_ROOT}"
+    if cache and key in _CACHE:
+        return _CACHE[key]
+    ds = LOADERS[name](root)
+    if cache:
+        _CACHE[key] = ds
+    return ds

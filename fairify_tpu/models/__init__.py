@@ -1,0 +1,5 @@
+from fairify_tpu.models.mlp import MLP, forward, layer_outputs, predict
+from fairify_tpu.models.ingest import load_keras_h5
+from fairify_tpu.models import zoo
+
+__all__ = ["MLP", "forward", "layer_outputs", "predict", "load_keras_h5", "zoo"]

@@ -1,0 +1,93 @@
+"""Keras HDF5 → weight pytree ingestion, with no TensorFlow dependency.
+
+The reference loads every ``.h5`` through ``tensorflow.keras.load_model`` and
+strips weights layer by layer (``utils/verif_utils.py:486-499``,
+``src/GC/Verify-GC.py:92-96``).  Here the HDF5 file is parsed directly with
+``h5py``: the ``model_config`` attribute gives the layer order and activations,
+``model_weights/<name>/<name>/{kernel,bias}:0`` the parameters.  This avoids
+dragging the TF runtime into the verification path and works for every model
+in the reference zoo (all are Sequential stacks of Dense layers).
+"""
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+
+from fairify_tpu.models.mlp import MLP, from_numpy
+
+
+class IngestError(ValueError):
+    pass
+
+
+def _layer_configs(cfg: dict) -> list:
+    layers = cfg["config"]["layers"]
+    out = []
+    for layer in layers:
+        cls = layer["class_name"]
+        if cls == "InputLayer":
+            continue
+        if cls != "Dense":
+            raise IngestError(f"unsupported layer class {cls!r}")
+        out.append(layer["config"])
+    return out
+
+
+def _weight_arrays(h5file, layer_name: str):
+    import h5py  # local import keeps module importable without h5py
+
+    grp = h5file["model_weights"][layer_name]
+    # Keras nests one more group level named after the layer.
+    while isinstance(grp, h5py.Group) and "kernel:0" not in grp:
+        inner = [k for k in grp.keys()]
+        if len(inner) != 1:
+            raise IngestError(f"ambiguous weight group for {layer_name}: {inner}")
+        grp = grp[inner[0]]
+    return np.array(grp["kernel:0"]), np.array(grp["bias:0"])
+
+
+def load_keras_h5(path) -> MLP:
+    """Load a Keras Sequential/Functional Dense-only ``.h5`` model as an MLP.
+
+    Validates the reference architecture contract: ReLU hidden layers and a
+    single sigmoid (or linear) output unit — the class of networks Fairify
+    verifies (``README.md``; every zoo model satisfies it).  The returned MLP
+    computes the pre-sigmoid logit, as the reference's ``net`` does.
+    """
+    import h5py
+
+    path = Path(path)
+    with h5py.File(path, "r") as f:
+        raw = f.attrs.get("model_config")
+        if raw is None:
+            raise IngestError(f"{path}: no model_config attribute")
+        if isinstance(raw, bytes):
+            raw = raw.decode("utf-8")
+        cfg = json.loads(raw)
+        layer_cfgs = _layer_configs(cfg)
+        if not layer_cfgs:
+            raise IngestError(f"{path}: no Dense layers")
+        weights, biases = [], []
+        for lc in layer_cfgs:
+            k, b = _weight_arrays(f, lc["name"])
+            weights.append(k.astype(np.float32))
+            biases.append(b.astype(np.float32))
+
+    for i, lc in enumerate(layer_cfgs[:-1]):
+        if lc.get("activation") != "relu":
+            raise IngestError(
+                f"{path}: hidden layer {i} activation {lc.get('activation')!r}, expected relu"
+            )
+    last = layer_cfgs[-1]
+    if last.get("activation") not in ("sigmoid", "linear"):
+        raise IngestError(f"{path}: output activation {last.get('activation')!r}")
+    if weights[-1].shape[1] != 1:
+        raise IngestError(f"{path}: output width {weights[-1].shape[1]}, expected 1")
+
+    for i in range(len(weights) - 1):
+        if weights[i].shape[1] != weights[i + 1].shape[0]:
+            raise IngestError(f"{path}: inconsistent layer shapes at {i}")
+
+    return from_numpy(weights, biases)

@@ -1,0 +1,143 @@
+"""Static-shape masked MLP — the single network representation of the framework.
+
+The reference keeps 53 hand-written per-model ``utils/*-Model-Functions.py``
+files, each duplicating ``net``/``layer_net``/``z3_net`` for one architecture
+(e.g. ``utils/GC-1-Model-Functions.py:16-44``).  Here one depth-generic pytree
+covers every model; the per-model symbolic encoders are unnecessary because
+bounds and decisions are computed from the same weight pytree.
+
+Pruning is represented as per-layer *alive masks* instead of the reference's
+``np.delete`` excision (``utils/prune.py:950-977``): a pruned (provably dead)
+hidden neuron never activates, so zeroing its post-activation is numerically
+identical to removing it, and keeps all shapes static for XLA.  Dense excision
+for reporting/compression lives in :mod:`fairify_tpu.ops.masks`.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fairify_tpu.utils.num import matmul
+
+
+class MLP(NamedTuple):
+    """A fully-connected ReLU network with a linear final layer.
+
+    ``weights[i]`` has shape ``(in_i, out_i)`` (Keras kernel layout),
+    ``biases[i]`` shape ``(out_i,)``, ``masks[i]`` shape ``(out_i,)`` with
+    1.0 = alive, 0.0 = pruned.  The final layer's mask is all-ones (the
+    reference never prunes the output layer, ``utils/prune.py:235-236``).
+    """
+
+    weights: tuple
+    biases: tuple
+    masks: tuple
+
+    @property
+    def depth(self) -> int:
+        return len(self.weights)
+
+    @property
+    def layer_sizes(self) -> tuple:
+        return tuple(int(w.shape[1]) for w in self.weights)
+
+    @property
+    def in_dim(self) -> int:
+        return int(self.weights[0].shape[0])
+
+    def with_masks(self, masks: Sequence[jax.Array]) -> "MLP":
+        return MLP(self.weights, self.biases, tuple(masks))
+
+    def unmasked(self) -> "MLP":
+        return MLP(
+            self.weights,
+            self.biases,
+            tuple(jnp.ones_like(b) for b in self.biases),
+        )
+
+
+def from_numpy(weights, biases, masks=None) -> MLP:
+    """Build an :class:`MLP` from host weight/bias lists (float32)."""
+    ws = tuple(jnp.asarray(np.asarray(w), dtype=jnp.float32) for w in weights)
+    bs = tuple(jnp.asarray(np.asarray(b), dtype=jnp.float32) for b in biases)
+    if masks is None:
+        ms = tuple(jnp.ones_like(b) for b in bs)
+    else:
+        ms = tuple(jnp.asarray(np.asarray(m), dtype=jnp.float32) for m in masks)
+    return MLP(ws, bs, ms)
+
+
+def forward(params: MLP, x: jax.Array) -> jax.Array:
+    """Logit of the network for a single input or a batch.
+
+    Matches the reference's ``net`` (``utils/GC-1-Model-Functions.py:25-30``):
+    ReLU hidden layers, raw logit output (no sigmoid).  ``x`` may be ``(d,)``
+    or ``(..., d)``; the output drops the size-1 logit axis.
+    """
+    h = x
+    n = len(params.weights)
+    for i, (w, b, m) in enumerate(zip(params.weights, params.biases, params.masks)):
+        z = matmul(h, w) + b
+        h = z if i == n - 1 else jax.nn.relu(z) * m
+    return jnp.squeeze(h, axis=-1)
+
+
+def layer_outputs(params: MLP, x: jax.Array) -> list:
+    """Post-activation outputs of every layer (final layer linear).
+
+    Mirrors the reference's ``layer_net`` (``utils/GC-1-Model-Functions.py:16-23``)
+    which drives dead-neuron candidate counting (``utils/prune.py:168-192``).
+    """
+    outs = []
+    h = x
+    n = len(params.weights)
+    for i, (w, b, m) in enumerate(zip(params.weights, params.biases, params.masks)):
+        z = matmul(h, w) + b
+        h = z if i == n - 1 else jax.nn.relu(z) * m
+        outs.append(h)
+    return outs
+
+
+def preactivations(params: MLP, x: jax.Array) -> list:
+    """Pre-activation (weighted-sum) values of every layer."""
+    outs = []
+    h = x
+    n = len(params.weights)
+    for i, (w, b, m) in enumerate(zip(params.weights, params.biases, params.masks)):
+        z = matmul(h, w) + b
+        outs.append(z)
+        h = z if i == n - 1 else jax.nn.relu(z) * m
+    return outs
+
+
+def predict(params: MLP, x: jax.Array) -> jax.Array:
+    """Boolean class decision: sigmoid(logit) > 0.5, i.e. logit > 0.
+
+    The reference thresholds the sigmoid at 0.5 (``utils/verif_utils.py:1040-1047``);
+    on logits that is exactly a sign test, which is also how the fairness
+    property is phrased on logits (``src/GC/Verify-GC.py:154``).
+    """
+    return forward(params, x) > 0.0
+
+
+def excise(params: MLP) -> MLP:
+    """Materialize masks as a dense smaller network (host-side only).
+
+    The result is numerically identical to ``forward`` on the masked network;
+    used for reporting and for feeding an external SMT backend the same small
+    matrices the reference produces with ``prune_neurons`` (``utils/prune.py:950-977``).
+    """
+    ws = [np.asarray(w) for w in params.weights]
+    bs = [np.asarray(b) for b in params.biases]
+    ms = [np.asarray(m) for m in params.masks]
+    n = len(ws)
+    for i in range(n):
+        keep = ms[i] > 0.5
+        ws[i] = ws[i][:, keep]
+        bs[i] = bs[i][keep]
+        if i + 1 < n:
+            ws[i + 1] = ws[i + 1][keep, :]
+    return from_numpy(ws, bs)

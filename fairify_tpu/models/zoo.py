@@ -1,0 +1,51 @@
+"""Registry of the benchmark model zoo.
+
+The reference ships 62 pretrained ``.h5`` MLPs under ``models/{adult,german,
+bank,compass,default}`` (SURVEY.md §2.4); drivers iterate a directory listing
+(``src/GC/Verify-GC.py:78-80``).  The registry resolves the same families from
+a configurable root so the suite runs against the read-only reference assets
+or a local copy.
+"""
+from __future__ import annotations
+
+import os
+import re
+from pathlib import Path
+
+from fairify_tpu.models.ingest import load_keras_h5
+
+DEFAULT_ROOT = os.environ.get("FAIRIFY_TPU_MODEL_ROOT", "/root/reference/models")
+
+# dataset key -> (subdirectory, model-name prefix)
+FAMILIES = {
+    "adult": ("adult", "AC"),
+    "german": ("german", "GC"),
+    "bank": ("bank", "BM"),
+    "compass": ("compass", "CP"),
+    "default": ("default", "DF"),
+}
+
+
+def _natural_key(name: str):
+    return [int(t) if t.isdigit() else t for t in re.split(r"(\d+)", name)]
+
+
+def model_paths(dataset: str, root=None) -> list:
+    """Sorted ``.h5`` paths for a dataset family (AC-1, AC-2, ... order)."""
+    sub, _ = FAMILIES[dataset]
+    root = Path(root or DEFAULT_ROOT)
+    d = root / sub
+    if not d.is_dir():
+        return []
+    return sorted(d.glob("*.h5"), key=lambda p: _natural_key(p.stem))
+
+
+def load(dataset: str, name: str, root=None):
+    """Load one zoo model by name, e.g. ``load('german', 'GC-1')``."""
+    sub, _ = FAMILIES[dataset]
+    root = Path(root or DEFAULT_ROOT)
+    return load_keras_h5(root / sub / f"{name}.h5")
+
+
+def load_family(dataset: str, root=None) -> dict:
+    return {p.stem: load_keras_h5(p) for p in model_paths(dataset, root)}

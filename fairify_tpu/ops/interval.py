@@ -1,0 +1,109 @@
+"""Batched interval bound propagation (IBP) over input boxes.
+
+The reference computes per-neuron pre-activation (WS) and post-ReLU (PL)
+bounds with a triple Python loop over layers × neurons × inputs
+(``utils/prune.py:105-164``).  Here the same sign-split interval arithmetic is
+two matmuls per layer — ``lb @ W⁺ + ub @ W⁻`` and ``ub @ W⁺ + lb @ W⁻`` — and
+`vmap` lifts it over a batch of boxes (one box per input partition), so the
+whole partition grid's bounds are a single MXU-friendly kernel launch.
+
+Soundness note: the reference evaluates these expressions in float64 numpy
+(and re-checks them in exact rationals via per-neuron Z3 queries,
+``utils/prune.py:276-364``).  On TPU we compute in float32 and widen each
+bound by ``SOUND_SLACK`` (relative + absolute outward rounding); the exact
+certification pass in :mod:`fairify_tpu.ops.exact` re-derives the final dead
+masks in rational arithmetic, so pruning soundness never rests on floats.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from fairify_tpu.models.mlp import MLP
+from fairify_tpu.utils.num import matmul
+
+# Outward widening applied to computed bounds to absorb f32 round-off.
+SOUND_SLACK_REL = 1e-5
+SOUND_SLACK_ABS = 1e-6
+
+
+class LayerBounds(NamedTuple):
+    """Bounds per layer: ws = pre-activation, pl = post-activation."""
+
+    ws_lb: tuple
+    ws_ub: tuple
+    pl_lb: tuple
+    pl_ub: tuple
+
+
+def affine_interval(w: jax.Array, b: jax.Array, lb: jax.Array, ub: jax.Array):
+    """Interval image of ``x @ w + b`` for ``x`` in ``[lb, ub]``.
+
+    Exact (up to rounding) for an affine map: split ``w`` by sign.
+    Supports leading batch axes on ``lb``/``ub``.
+    """
+    wp = jnp.maximum(w, 0.0)
+    wn = jnp.minimum(w, 0.0)
+    lo = matmul(lb, wp) + matmul(ub, wn) + b
+    hi = matmul(ub, wp) + matmul(lb, wn) + b
+    return lo, hi
+
+
+def _widen(lo: jax.Array, hi: jax.Array):
+    slack = SOUND_SLACK_REL * jnp.maximum(jnp.abs(lo), jnp.abs(hi)) + SOUND_SLACK_ABS
+    return lo - slack, hi + slack
+
+
+def network_bounds(params: MLP, lb: jax.Array, ub: jax.Array, widen: bool = True) -> LayerBounds:
+    """WS/PL interval bounds for every layer given an input box.
+
+    ``lb``/``ub`` may carry leading batch axes (e.g. ``(P, d)`` for P
+    partitions).  Masked (pruned) neurons propagate a [0, 0] interval, exactly
+    like an excised neuron.  The final layer is linear: its PL bounds equal
+    its WS bounds (the reference never applies ReLU there,
+    ``utils/GC-1-Model-Functions.py:20``).
+    """
+    ws_lb, ws_ub, pl_lb, pl_ub = [], [], [], []
+    lo, hi = lb, ub
+    n = params.depth
+    for i, (w, b, m) in enumerate(zip(params.weights, params.biases, params.masks)):
+        zlo, zhi = affine_interval(w, b, lo, hi)
+        if widen:
+            zlo, zhi = _widen(zlo, zhi)
+        ws_lb.append(zlo)
+        ws_ub.append(zhi)
+        if i == n - 1:
+            plo, phi = zlo, zhi
+        else:
+            plo = jax.nn.relu(zlo) * m
+            phi = jax.nn.relu(zhi) * m
+        pl_lb.append(plo)
+        pl_ub.append(phi)
+        lo, hi = plo, phi
+    return LayerBounds(tuple(ws_lb), tuple(ws_ub), tuple(pl_lb), tuple(pl_ub))
+
+
+def output_bounds(params: MLP, lb: jax.Array, ub: jax.Array):
+    """Interval bounds of the output logit only."""
+    bounds = network_bounds(params, lb, ub)
+    return bounds.ws_lb[-1][..., 0], bounds.ws_ub[-1][..., 0]
+
+
+def dead_from_ws_ub(bounds: LayerBounds) -> list:
+    """Provably-dead masks from WS upper bounds (1 = dead).
+
+    A hidden neuron with ``ws_ub <= 0`` can never activate anywhere in the
+    box — the reference's interval-based pruning criterion
+    (``utils/prune.py:226-251``).  The output layer is skipped (all-alive),
+    matching ``utils/prune.py:235-236``.
+    """
+    deads = []
+    n = len(bounds.ws_ub)
+    for i, ub in enumerate(bounds.ws_ub):
+        if i == n - 1:
+            deads.append(jnp.zeros_like(ub))
+        else:
+            deads.append((ub <= 0.0).astype(ub.dtype))
+    return deads

@@ -1,0 +1,71 @@
+"""Dead-neuron mask algebra: derive, guard, merge, report, excise.
+
+Masks are per-layer float vectors with 1 = dead (matching the reference's
+convention in ``utils/prune.py:168-192``), converted to *alive* masks
+(1 = alive) when attached to an :class:`~fairify_tpu.models.mlp.MLP`.
+
+The reference's excision (``prune_neurons``, ``utils/prune.py:950-977``)
+mutates array shapes per partition; on TPU that would force a recompile per
+partition, so the framework applies masks inside static-shape kernels and
+only materializes dense matrices host-side for reporting and external
+solvers (``fairify_tpu.models.mlp.excise``).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from fairify_tpu.models.mlp import MLP
+from fairify_tpu.ops.interval import LayerBounds
+
+
+def intersect_with_candidates(dead: Sequence, candidates: Sequence) -> list:
+    """A neuron is only prunable if simulation also never saw it activate
+    (the reference requires candidacy before bound-pruning,
+    ``utils/prune.py:241-242``)."""
+    return [jnp.asarray(d) * jnp.asarray(c) for d, c in zip(dead, candidates)]
+
+
+def keep_one_alive(dead: Sequence) -> list:
+    """Guard: never prune an entire layer — if every neuron of a layer is
+    dead, revive neuron 0 (``utils/prune.py:689-691`` ``if not 0 in l: l[0]=0``).
+    Fully-dead layers would otherwise collapse the network to a constant in a
+    shape-breaking way for the excised form."""
+    out = []
+    for d in dead:
+        d = jnp.asarray(d)
+        all_dead = jnp.all(d > 0.5)
+        revive = jnp.zeros_like(d).at[0].set(1.0)
+        out.append(jnp.where(all_dead, d - revive, d))
+    return out
+
+
+def merge_dead(a: Sequence, b: Sequence) -> list:
+    """Union of two dead-mask sets (``merge_dead_nodes``, ``utils/prune.py:941-948``)."""
+    return [jnp.maximum(jnp.asarray(x), jnp.asarray(y)) for x, y in zip(a, b)]
+
+
+def compression_ratio(dead: Sequence) -> float:
+    """Fraction of neurons removed (``compression_ratio``, ``utils/prune.py:194-203``).
+
+    Note: the reference computes this over *all* layers including the output
+    layer; kept identical for CSV parity.
+    """
+    total = sum(int(np.asarray(d).size) for d in dead)
+    dead_n = sum(int(np.asarray(d).sum()) for d in dead)
+    return dead_n / total if total else 0.0
+
+
+def alive_masks(dead: Sequence) -> list:
+    """Convert dead masks (1 = dead) to alive masks (1 = alive)."""
+    return [1.0 - jnp.asarray(d) for d in dead]
+
+
+def apply_dead_masks(params: MLP, dead: Sequence) -> MLP:
+    return params.with_masks(tuple(alive_masks(dead)))
+
+
+def zero_dead_masks(params: MLP) -> list:
+    return [jnp.zeros_like(b) for b in params.biases]

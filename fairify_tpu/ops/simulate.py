@@ -1,0 +1,66 @@
+"""Keyed uniform-integer simulation + activation statistics, fully batched.
+
+The reference draws 1000 random integer rows per partition with a Python
+double loop (``simluate_data``, ``utils/prune.py:205-222``) and then counts
+per-neuron activations by running a per-sample numpy forward pass in a
+triple-nested loop (``candidate_dead_nodes``, ``utils/prune.py:168-192``) —
+the hottest loop of the whole pipeline (SURVEY.md §3.1).  Here both stages
+are single XLA kernels: one `jax.random.randint` draw per box and one batched
+forward pass whose activation counts are a reduction over the sample axis.
+
+Keyed PRNG replaces the reference's global `np.random` so a sweep is
+reproducible per (seed, partition) regardless of execution order or sharding.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from fairify_tpu.models.mlp import MLP
+from fairify_tpu.utils.num import matmul
+
+
+class ActivationStats(NamedTuple):
+    candidates: tuple  # per layer, (out,) 1.0 = never activated on samples
+    positive_prob: tuple  # per layer, (out,) fraction of samples activating
+
+
+def simulate_box(key: jax.Array, lo: jax.Array, hi: jax.Array, size: int) -> jax.Array:
+    """``size`` uniform integer samples from the inclusive box [lo, hi].
+
+    Returns float32 ``(size, d)``.  Bounds may carry a leading batch axis
+    (vmap over partitions).
+    """
+    shape = (size,) + lo.shape
+    return jax.random.randint(
+        key, shape, lo.astype(jnp.int32), hi.astype(jnp.int32) + 1
+    ).astype(jnp.float32)
+
+
+def activation_stats(params: MLP, x: jax.Array) -> ActivationStats:
+    """Per-neuron activation frequency over a sample batch ``x`` (N, d).
+
+    A neuron that never produces a non-zero output on any sample is a
+    *candidate* dead neuron — the reference's criterion
+    (``utils/prune.py:176-187``), which includes the (linear) output layer;
+    downstream pruning skips the output layer when converting candidates to
+    dead masks.
+    """
+    n = params.depth
+    h = x
+    candidates, pos_prob = [], []
+    for i, (w, b, m) in enumerate(zip(params.weights, params.biases, params.masks)):
+        z = matmul(h, w) + b
+        h = z if i == n - 1 else jax.nn.relu(z) * m
+        active_frac = jnp.mean((h != 0.0).astype(jnp.float32), axis=0)
+        candidates.append((active_frac == 0.0).astype(jnp.float32))
+        pos_prob.append(active_frac)
+    return ActivationStats(tuple(candidates), tuple(pos_prob))
+
+
+def simulate_and_stats(params: MLP, key: jax.Array, lo: jax.Array, hi: jax.Array, size: int):
+    """One fused step: sample a box and compute activation stats + samples."""
+    sim = simulate_box(key, lo, hi, size)
+    return activation_stats(params, sim), sim

@@ -1,0 +1,19 @@
+from fairify_tpu.partition.grid import (
+    partition_attributes,
+    partitioned_ranges,
+    partition_attributes_capped,
+    partitioned_ranges_capped,
+    partition_density,
+    boxes_from_partitions,
+    coverage_fraction,
+)
+
+__all__ = [
+    "partition_attributes",
+    "partitioned_ranges",
+    "partition_attributes_capped",
+    "partitioned_ranges_capped",
+    "partition_density",
+    "boxes_from_partitions",
+    "coverage_fraction",
+]

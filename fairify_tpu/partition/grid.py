@@ -1,0 +1,170 @@
+"""Input-domain partitioning: the scalability axis of the framework.
+
+Semantics match the reference engine (``utils/input_partition.py``):
+
+* ``partition_attributes`` chunks every attribute whose inclusive integer
+  range is wider than the threshold into consecutive sub-ranges
+  (``utils/input_partition.py:17-46``).
+* ``partitioned_ranges`` takes the cartesian product of the chunked
+  attributes, leaving narrow attributes at full range
+  (``utils/input_partition.py:48-76``).
+* the capped variant bounds combinatorial blow-up, partitioning protected
+  attributes first and sampling excess combinations
+  (``utils/input_partition.py:78-182``).
+* ``partition_density`` is the dataset-coverage weight of each partition
+  (``utils/input_partition.py:184-218``), vectorized here from a per-row
+  Python scan to one broadcast comparison.
+
+The output of the grid is a pair of integer arrays ``(lo, hi)`` of shape
+``(P, d)`` — the box tensor that every downstream TPU kernel (IBP, CROWN,
+simulation, branch-and-bound) consumes directly; partitions are rows, so
+sharding the sweep over a device mesh is slicing this tensor along axis 0.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+Range = Tuple[int, int]
+RangeDict = Dict[str, Sequence[int]]
+
+
+def partition_attributes(range_dict: RangeDict, partition_size: int) -> Dict[str, List[Range]]:
+    """Chunk each attribute range wider than ``partition_size`` (inclusive width)."""
+    out: Dict[str, List[Range]] = {}
+    for col, (low, high) in range_dict.items():
+        width = high - low + 1
+        if width <= partition_size:
+            continue
+        parts = []
+        cur = low
+        while cur <= high:
+            parts.append((cur, min(cur + partition_size - 1, high)))
+            cur += partition_size
+        out[col] = parts
+    return out
+
+
+def partitioned_ranges(
+    attrs: Sequence[str],
+    p_dict: Dict[str, List[Range]],
+    range_dict: RangeDict,
+) -> List[RangeDict]:
+    """Cartesian product of chunked attributes → list of box range-dicts."""
+    base = {a: tuple(range_dict[a]) for a in attrs if a not in p_dict}
+    chunked = list(p_dict.keys())
+    boxes: List[RangeDict] = []
+    for combo in itertools.product(*(p_dict[a] for a in chunked)):
+        box = dict(base)
+        for attr, rng in zip(chunked, combo):
+            box[attr] = tuple(rng)
+        boxes.append(box)
+    return boxes
+
+
+def partition_attributes_capped(range_dict: RangeDict, partition_size: int) -> Dict[str, List[Range]]:
+    """Capped-variant chunking: width measured exclusively (``high - low``),
+    as the DF driver does (``utils/input_partition.py:91-95``)."""
+    out: Dict[str, List[Range]] = {}
+    for col, (low, high) in range_dict.items():
+        if high - low <= partition_size:
+            continue
+        parts = []
+        cur = low
+        while cur < high:
+            parts.append((cur, min(cur + partition_size - 1, high)))
+            cur = parts[-1][1] + 1
+        if parts:
+            out[col] = parts
+    return out
+
+
+def partitioned_ranges_capped(
+    attrs: Sequence[str],
+    protected: Sequence[str],
+    p_dict: Dict[str, List[Range]],
+    range_dict: RangeDict,
+    max_partitions: int = 100,
+    rng: np.random.Generator | None = None,
+) -> List[RangeDict]:
+    """Capped cartesian expansion, protected attributes first.
+
+    Mirrors ``partitioned_ranges_df`` (``utils/input_partition.py:111-182``):
+    include PA chunkings unconditionally, then add other chunked attributes
+    while the product stays within ``max_partitions``; attributes left out
+    keep their full range; if the product still overflows, sample
+    ``max_partitions`` combinations (seeded generator here, not global
+    ``random``, for reproducibility).
+    """
+    rng = rng or np.random.default_rng(0)
+    base = {a: tuple(range_dict[a]) for a in attrs if a not in p_dict}
+    if not p_dict:
+        return [dict(base)]
+
+    priority = [a for a in protected if a in p_dict]
+    others = [a for a in p_dict if a not in priority]
+
+    chosen: List[str] = []
+    estimated = 1
+    for a in priority:
+        estimated *= len(p_dict[a])
+        chosen.append(a)
+    for a in others:
+        if estimated * len(p_dict[a]) <= max_partitions:
+            estimated *= len(p_dict[a])
+            chosen.append(a)
+        else:
+            base[a] = tuple(range_dict[a])
+
+    if not chosen:
+        return [dict(base)]
+
+    combos = list(itertools.product(*(p_dict[a] for a in chosen)))
+    if len(combos) > max_partitions:
+        idx = rng.choice(len(combos), size=max_partitions, replace=False)
+        combos = [combos[i] for i in sorted(idx)]
+
+    boxes = []
+    for combo in combos:
+        box = dict(base)
+        for attr, rngpair in zip(chosen, combo):
+            box[attr] = tuple(rngpair)
+        boxes.append(box)
+    return boxes
+
+
+def boxes_from_partitions(p_list: Sequence[RangeDict], columns: Sequence[str]):
+    """Stack a partition list into ``(lo, hi)`` int32 arrays of shape (P, d)."""
+    lo = np.array([[p[c][0] for c in columns] for p in p_list], dtype=np.int32)
+    hi = np.array([[p[c][1] for c in columns] for p in p_list], dtype=np.int32)
+    return lo, hi
+
+
+def partition_density(p_list: Sequence[RangeDict], X: np.ndarray, columns: Sequence[str]) -> np.ndarray:
+    """Fraction of dataset rows falling inside each partition box.
+
+    Vectorized replacement for the reference's per-row × per-partition Python
+    scan (``utils/input_partition.py:198-218``): one broadcast comparison of
+    the (N, d) data matrix against the (P, d) box tensor.
+    """
+    lo, hi = boxes_from_partitions(p_list, columns)
+    Xv = np.asarray(X, dtype=np.float64)[None, :, :]  # (1, N, d)
+    inside = (Xv >= lo[:, None, :]) & (Xv <= hi[:, None, :])  # (P, N, d)
+    return inside.all(axis=2).mean(axis=1)
+
+
+def coverage_fraction(p_list: Sequence[RangeDict], range_dict: RangeDict) -> float:
+    """Fraction of the integer input domain covered by the partitions.
+
+    Used for the Cov% column of the baseline table (BASELINE.md).
+    """
+    def box_volume(box: RangeDict) -> float:
+        v = 1.0
+        for lo, hi in box.values():
+            v *= hi - lo + 1
+        return v
+
+    total = box_volume({k: tuple(v) for k, v in range_dict.items()})
+    return float(sum(box_volume(p) for p in p_list) / total)

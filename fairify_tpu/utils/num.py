@@ -1,0 +1,21 @@
+"""Numeric policy: matmul precision for verification kernels.
+
+On TPU the MXU's default matmul path accumulates in bfloat16-multiplied
+passes; that is fine for training but not for *verification* arithmetic,
+where bounds and counterexample replays must track the reference's float32
+numpy semantics (and stay inside the exact-rational certification slack).
+Every verification matmul therefore requests ``Precision.HIGHEST``
+(6-pass f32 emulation on the MXU).  The matrices involved are tiny
+(≤ a few hundred wide), so the cost is irrelevant next to HBM traffic;
+training/repair kernels keep the default fast path.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+PRECISION = jax.lax.Precision.HIGHEST
+
+
+def matmul(a: jax.Array, b: jax.Array) -> jax.Array:
+    return jnp.matmul(a, b, precision=PRECISION)

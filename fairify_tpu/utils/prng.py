@@ -1,0 +1,25 @@
+"""Deterministic keyed PRNG plumbing.
+
+The reference's randomness is global and order-dependent: partition-list
+shuffle (``src/GC/Verify-GC.py:73``), per-partition ``np.random.randint``
+simulation (``utils/prune.py:216``), and Z3's internal seeds.  For a sharded
+sweep to be reproducible regardless of device count or execution order, each
+partition derives its own key from (run seed, partition index).
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def run_key(seed: int) -> jax.Array:
+    return jax.random.key(seed)
+
+
+def partition_key(seed: int, partition_index: int) -> jax.Array:
+    return jax.random.fold_in(jax.random.key(seed), partition_index)
+
+
+def shuffled_order(n: int, seed: int) -> np.ndarray:
+    """Deterministic sweep order (replaces the reference's global shuffle)."""
+    return np.random.default_rng(seed).permutation(n)

@@ -1,0 +1,106 @@
+"""IBP soundness and exact certification tests (oracle: brute force on tiny domains)."""
+import itertools
+
+import jax.numpy as jnp
+import numpy as np
+
+from fairify_tpu.models import mlp as M
+from fairify_tpu.ops import exact, interval
+from tests.test_mlp import numpy_forward, random_mlp
+
+
+def brute_force_preacts(ws, bs, lo, hi):
+    """All pre-activations over every integer point of the box."""
+    points = list(itertools.product(*[range(l, h + 1) for l, h in zip(lo, hi)]))
+    X = np.array(points, dtype=np.float64)
+    pres = []
+    h = X
+    for i, (w, b) in enumerate(zip(ws, bs)):
+        z = h @ w + b
+        pres.append(z)
+        h = z if i == len(ws) - 1 else np.maximum(z, 0.0)
+    return pres
+
+
+def test_ibp_contains_all_reachable_values():
+    rng = np.random.default_rng(7)
+    params = random_mlp(rng, [3, 8, 5, 1])
+    ws = [np.asarray(w, dtype=np.float64) for w in params.weights]
+    bs = [np.asarray(b, dtype=np.float64) for b in params.biases]
+    lo, hi = [0, 0, 1], [2, 3, 4]
+    pres = brute_force_preacts(ws, bs, lo, hi)
+    bounds = interval.network_bounds(
+        params, jnp.asarray(lo, jnp.float32), jnp.asarray(hi, jnp.float32)
+    )
+    for l in range(len(ws)):
+        np.testing.assert_array_less(
+            np.asarray(bounds.ws_lb[l]) - 1e-4, pres[l].min(axis=0) + 1e-9
+        )
+        np.testing.assert_array_less(
+            pres[l].max(axis=0) - 1e-9, np.asarray(bounds.ws_ub[l]) + 1e-4
+        )
+
+
+def test_ibp_batched_over_boxes():
+    rng = np.random.default_rng(8)
+    params = random_mlp(rng, [4, 6, 1])
+    lo = jnp.asarray([[0, 0, 0, 0], [1, 1, 1, 1]], jnp.float32)
+    hi = jnp.asarray([[2, 2, 2, 2], [3, 3, 3, 3]], jnp.float32)
+    bounds = interval.network_bounds(params, lo, hi)
+    assert bounds.ws_lb[0].shape == (2, 6)
+    # batch row 0 must equal the unbatched computation
+    single = interval.network_bounds(params, lo[0], hi[0])
+    np.testing.assert_allclose(
+        np.asarray(bounds.ws_ub[0][0]), np.asarray(single.ws_ub[0]), rtol=1e-6
+    )
+
+
+def test_dead_from_ws_ub_skips_output_layer():
+    rng = np.random.default_rng(9)
+    params = random_mlp(rng, [3, 5, 1])
+    bounds = interval.network_bounds(
+        params, jnp.zeros(3, jnp.float32), jnp.ones(3, jnp.float32)
+    )
+    deads = interval.dead_from_ws_ub(bounds)
+    assert float(deads[-1].sum()) == 0.0
+
+
+def test_exact_certification_agrees_with_brute_force():
+    rng = np.random.default_rng(10)
+    params = random_mlp(rng, [3, 10, 4, 1])
+    ws = [np.asarray(w) for w in params.weights]
+    bs = [np.asarray(b) for b in params.biases]
+    lo, hi = [0, 0, 0], [3, 3, 3]
+    pres = brute_force_preacts(
+        [w.astype(np.float64) for w in ws], [b.astype(np.float64) for b in bs], lo, hi
+    )
+    # propose everything dead; certification must keep only truly-dead neurons
+    proposed = [np.ones_like(b) for b in bs]
+    certified = exact.certify_dead_masks(ws, bs, lo, hi, proposed)
+    for l in range(len(ws) - 1):
+        true_dead = pres[l].max(axis=0) <= 0
+        got_dead = certified[l] > 0.5
+        # certified ⇒ truly dead (soundness, must hold exactly)
+        assert not np.any(got_dead & ~true_dead)
+        # on these tiny nets the exact IBP bound is tight enough to find all
+        # first-layer dead neurons (affine over the input box ⇒ exact)
+        if l == 0:
+            np.testing.assert_array_equal(got_dead, true_dead)
+
+
+def test_exact_bounds_match_float_ibp_closely():
+    rng = np.random.default_rng(11)
+    params = random_mlp(rng, [4, 7, 1])
+    ws = [np.asarray(w) for w in params.weights]
+    bs = [np.asarray(b) for b in params.biases]
+    lo, hi = [0, 1, 0, 2], [5, 4, 3, 6]
+    ws_lb, ws_ub, _, _ = exact.exact_network_bounds(ws, bs, lo, hi)
+    bounds = interval.network_bounds(
+        params, jnp.asarray(lo, jnp.float32), jnp.asarray(hi, jnp.float32), widen=False
+    )
+    for l in range(len(ws)):
+        np.testing.assert_allclose(
+            np.asarray(bounds.ws_ub[l]),
+            np.array([float(v) for v in ws_ub[l]]),
+            rtol=1e-4, atol=1e-4,
+        )

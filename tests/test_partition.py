@@ -1,0 +1,83 @@
+"""Partition-grid semantics vs the reference algorithm."""
+import numpy as np
+
+from fairify_tpu.data.domains import GERMAN
+from fairify_tpu.partition import (
+    boxes_from_partitions,
+    coverage_fraction,
+    partition_attributes,
+    partition_attributes_capped,
+    partition_density,
+    partitioned_ranges,
+    partitioned_ranges_capped,
+)
+
+
+def test_partition_chunks_wide_attributes_only():
+    p = partition_attributes({"a": (0, 9), "b": (0, 100)}, 10)
+    assert "a" not in p  # width 10 <= threshold
+    assert p["b"] == [(0, 9), (10, 19), (20, 29), (30, 39), (40, 49),
+                      (50, 59), (60, 69), (70, 79), (80, 89), (90, 99), (100, 100)]
+
+
+def test_partition_chunks_cover_range_disjointly():
+    p = partition_attributes({"x": (3, 47)}, 7)["x"]
+    covered = []
+    for lo, hi in p:
+        covered.extend(range(lo, hi + 1))
+    assert covered == list(range(3, 48))
+
+
+def test_german_partition_count_matches_reference():
+    # GC driver: threshold 100 chunks only credit_amount (0..20000 → 201
+    # chunks); every other attribute is narrower. src/GC/Verify-GC.py:70-72
+    # and Appendix Table V (GC3/GC4: 201 partitions, 100% coverage).
+    p_dict = partition_attributes(GERMAN.ranges, 100)
+    assert list(p_dict.keys()) == ["credit_amount"]
+    p_list = partitioned_ranges(GERMAN.columns, p_dict, GERMAN.ranges)
+    assert len(p_list) == 201
+    assert abs(coverage_fraction(p_list, GERMAN.ranges) - 1.0) < 1e-12
+
+
+def test_boxes_tensor_roundtrip():
+    p_dict = partition_attributes({"a": (0, 5), "b": (0, 25)}, 10)
+    p_list = partitioned_ranges(["a", "b"], p_dict, {"a": (0, 5), "b": (0, 25)})
+    lo, hi = boxes_from_partitions(p_list, ["a", "b"])
+    assert lo.shape == hi.shape == (len(p_list), 2)
+    assert (lo <= hi).all()
+    # every point of the domain lands in exactly one box
+    for a in range(6):
+        for b in range(26):
+            inside = ((lo <= [a, b]) & ([a, b] <= hi)).all(axis=1)
+            assert inside.sum() == 1
+
+
+def test_capped_partitioning_caps_product():
+    ranges = {"pa": (0, 1), "big": (0, 10_000), "med": (0, 50)}
+    p_dict = partition_attributes_capped(ranges, 8)
+    p_list = partitioned_ranges_capped(
+        ["pa", "big", "med"], ["pa"], p_dict, ranges, max_partitions=100
+    )
+    assert len(p_list) <= 100
+    # 'big' (1251 chunks) cannot fit in the 100-partition budget, so it keeps
+    # its full range in every partition; 'med' (7 chunks) gets partitioned.
+    assert all(p["big"] == (0, 10_000) for p in p_list)
+    assert all(p["med"] != (0, 50) for p in p_list)
+    # pa (width 2 <= 8) is never chunked, so the product is just med's 7 chunks
+    assert len(p_list) == 7
+    assert all(p["pa"] == (0, 1) for p in p_list)
+
+
+def test_partition_density_matches_manual_count():
+    ranges = {"a": (0, 3), "b": (0, 3)}
+    p_dict = partition_attributes(ranges, 2)
+    p_list = partitioned_ranges(["a", "b"], p_dict, ranges)
+    X = np.array([[0, 0], [1, 1], [2, 2], [3, 3], [0, 3]])
+    dens = partition_density(p_list, X, ["a", "b"])
+    np.testing.assert_allclose(dens.sum(), 1.0)
+    for p, d in zip(p_list, dens):
+        manual = np.mean([
+            (p["a"][0] <= x[0] <= p["a"][1]) and (p["b"][0] <= x[1] <= p["b"][1])
+            for x in X
+        ])
+        assert abs(d - manual) < 1e-12
