@@ -1,0 +1,66 @@
+"""Headline benchmark: verified partitions/sec on the GC-1 German sweep.
+
+Reference baseline (BASELINE.md, Appendix Table V, GC1/Age): 46 partitions
+attempted in the 30-minute budget at a mean 43.19 s/partition on CPU —
+0.02315 verified partitions/sec.  This benchmark runs the same query
+(German Credit, PA=age, partition threshold 100 → 201 partitions) through
+the TPU-native engine end-to-end (sound pruning, stage-0 certificates +
+attack, branch-and-bound refinement) and reports decided partitions/sec.
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+REFERENCE_PARTITIONS_PER_SEC = 46 / (46 * 43.19)  # GC1/Age, Table V
+
+
+def main() -> None:
+    import numpy as np
+
+    from fairify_tpu.verify import engine, presets, sweep
+    from __graft_entry__ import _flagship_net
+
+    cfg = presets.get("GC").with_(
+        result_dir="/tmp/fairify_tpu_bench",
+        soft_timeout_s=10.0,
+        hard_timeout_s=10 * 60.0,
+        exact_certify_masks=False,  # parity pass off for the timing run
+        engine=engine.EngineConfig(frontier_size=512, attack_samples=128,
+                                   bab_attack_samples=16, soft_timeout_s=10.0),
+    )
+    net = _flagship_net()
+
+    import shutil
+
+    shutil.rmtree("/tmp/fairify_tpu_bench", ignore_errors=True)
+    # Warm-up: compile the stage-0 kernels on a 2-partition slice.
+    warm = cfg.with_(hard_timeout_s=1e-9, result_dir="/tmp/fairify_tpu_bench_warm")
+    shutil.rmtree("/tmp/fairify_tpu_bench_warm", ignore_errors=True)
+    try:
+        sweep.verify_model(net, warm, model_name="warmup", resume=False)
+    except Exception:
+        pass
+
+    t0 = time.perf_counter()
+    report = sweep.verify_model(net, cfg, model_name="GC-1", resume=False)
+    elapsed = time.perf_counter() - t0
+
+    counts = report.counts
+    decided = counts["sat"] + counts["unsat"]
+    pps = decided / elapsed if elapsed > 0 else 0.0
+    print(json.dumps({
+        "metric": "verified_partitions_per_sec_per_chip (GC-1, PA=age, 201 partitions; "
+                  f"sat={counts['sat']} unsat={counts['unsat']} unk={counts['unknown']})",
+        "value": round(pps, 4),
+        "unit": "partitions/sec",
+        "vs_baseline": round(pps / REFERENCE_PARTITIONS_PER_SEC, 2),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,3 @@
+from fairify_tpu.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,7 @@
+"""Post-verification analysis & repair (the reference's L4 layer).
+
+Covers SURVEY.md §2.3: group fairness metrics (an AIF360-equivalent suite in
+numpy/jax — the reference imports ``aif360``), the causal-discrimination
+black-box tester, biased-neuron localization, masked gradient repair,
+two-stage counterexample retraining, and the hybrid fair/original router.
+"""

@@ -1,0 +1,58 @@
+"""Counterexample decoding: encoded integer vectors → raw category values.
+
+Re-implements ``decode_counterexample``
+(``src/AC/Verify-AC-experiment-new2.py:344-407``): verification operates on
+label-encoded/discretized integers; for reporting, each coordinate is mapped
+back through the loader's fitted encoder (LabelEncoder classes, KBins bin
+edges, passthrough for numeric columns).
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from fairify_tpu.data.loaders import LoadedDataset
+
+
+def decode_point(ds: LoadedDataset, x: np.ndarray) -> Dict[str, object]:
+    """Decode one encoded feature vector to raw values, column by column."""
+    out: Dict[str, object] = {}
+    cols = ds.feature_columns
+    for i, col in enumerate(cols):
+        v = x[i]
+        enc = ds.encoders.get(col)
+        if enc is None:
+            out[col] = float(v) if float(v) != int(v) else int(v)
+            continue
+        if hasattr(enc, "classes_"):  # LabelEncoder
+            idx = int(round(float(v)))
+            if 0 <= idx < len(enc.classes_):
+                out[col] = enc.classes_[idx]
+            else:  # outside the fitted range (e.g. RA-shifted x')
+                out[col] = f"<{col}:{idx}>"
+        elif hasattr(enc, "bin_edges_"):  # KBinsDiscretizer
+            edges = enc.bin_edges_[0]
+            idx = int(np.clip(round(float(v)), 0, len(edges) - 2))
+            out[col] = f"[{edges[idx]:.0f}, {edges[idx + 1]:.0f})"
+        else:
+            out[col] = float(v)
+    return out
+
+
+def decode_pair(ds: LoadedDataset, x: np.ndarray, xp: np.ndarray) -> List[dict]:
+    return [decode_point(ds, np.asarray(x)), decode_point(ds, np.asarray(xp))]
+
+
+def counterexample_table(ds: LoadedDataset, pairs) -> "object":
+    """DataFrame of decoded pairs (rows alternate x / x'), as the reference's
+    decoded counterexample CSV (``Verify-AC-experiment-new2.py:383-407``)."""
+    import pandas as pd
+
+    rows = []
+    for k, (x, xp) in enumerate(pairs):
+        for role, vec in (("x", x), ("x'", xp)):
+            rec = {"pair": k, "role": role}
+            rec.update(decode_point(ds, np.asarray(vec)))
+            rows.append(rec)
+    return pd.DataFrame(rows)

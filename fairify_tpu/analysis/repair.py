@@ -1,0 +1,164 @@
+"""Fairness repair: masked gradient repair and two-stage retraining.
+
+Re-implements the reference's two repair pipelines TPU-first with optax:
+
+* **Masked repair** (``src/AC/detect_bias.py:304-437``): freeze everything
+  except the localized biased neurons — the reference builds per-layer
+  kernel/bias masks (``create_neuron_masks:320-347``) and multiplies
+  gradients inside a custom train step (``masked_train_step:350-378``).
+  Here the mask lives in the optax chain, the step is one jitted update.
+* **Two-stage retraining** (``src/AC/new_model.py:179-263``): stage 1
+  fine-tunes on original data; stage 2 trains on counterexample batches at
+  low LR with an accuracy floor (0.80) early stop.
+
+Training math runs in f32 (these are 6-30-feature MLPs; bf16 would add
+noise with no MXU payoff at this size), one jitted step per epoch loop.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fairify_tpu.models.mlp import MLP, forward
+
+
+def bce_loss(net: MLP, x: jax.Array, y: jax.Array) -> jax.Array:
+    """Binary cross-entropy on logits (the reference trains sigmoid+BCE)."""
+    logits = forward(net, x)
+    return optax.sigmoid_binary_cross_entropy(logits, y.astype(jnp.float32)).mean()
+
+
+def neuron_gradient_masks(net: MLP, targets: Sequence[Tuple[int, int]]) -> MLP:
+    """Masks selecting only the (layer, neuron) targets' incoming weights.
+
+    Mirrors ``create_neuron_masks`` (``src/AC/detect_bias.py:320-347``): for a
+    target neuron j of layer l, unfreeze column j of ``weights[l]`` and
+    ``biases[l][j]``; everything else gets gradient 0.
+    """
+    wmasks = [np.zeros_like(np.asarray(w)) for w in net.weights]
+    bmasks = [np.zeros_like(np.asarray(b)) for b in net.biases]
+    for l, j in targets:
+        wmasks[l][:, j] = 1.0
+        bmasks[l][j] = 1.0
+    return MLP(
+        tuple(jnp.asarray(m) for m in wmasks),
+        tuple(jnp.asarray(m) for m in bmasks),
+        net.masks,
+    )
+
+
+@dataclass
+class RepairResult:
+    net: MLP
+    history: List[dict]
+
+
+def _fit(net: MLP, X, y, optimizer, epochs: int, batch_size: int, seed: int,
+         grad_mask: MLP | None = None, trainable=None):
+    X = jnp.asarray(np.asarray(X), jnp.float32)
+    y = jnp.asarray(np.asarray(y), jnp.float32)
+    params = (net.weights, net.biases)
+    opt_state = optimizer.init(params)
+
+    @jax.jit
+    def step(params, opt_state, xb, yb):
+        def loss_fn(p):
+            return bce_loss(MLP(p[0], p[1], net.masks), xb, yb)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        if grad_mask is not None:
+            grads = (
+                tuple(g * m for g, m in zip(grads[0], grad_mask.weights)),
+                tuple(g * m for g, m in zip(grads[1], grad_mask.biases)),
+            )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    rng = np.random.default_rng(seed)
+    n = X.shape[0]
+    history = []
+    for epoch in range(epochs):
+        order = rng.permutation(n)
+        losses = []
+        for s in range(0, n, batch_size):
+            idx = order[s : s + batch_size]
+            params, opt_state, loss = step(params, opt_state, X[idx], y[idx])
+            losses.append(float(loss))
+        history.append({"epoch": epoch, "loss": float(np.mean(losses))})
+    return MLP(params[0], params[1], net.masks), history
+
+
+def masked_repair(
+    net: MLP,
+    targets: Sequence[Tuple[int, int]],
+    X, y,
+    epochs: int = 5,
+    lr: float = 1e-3,
+    batch_size: int = 32,
+    seed: int = 0,
+) -> RepairResult:
+    """Gradient-masked fine-tune updating only the biased neurons
+    (``masked_train_step``, ``src/AC/detect_bias.py:350-405``)."""
+    mask = neuron_gradient_masks(net, targets)
+    repaired, history = _fit(
+        net, X, y, optax.adam(lr), epochs, batch_size, seed, grad_mask=mask
+    )
+    return RepairResult(repaired, history)
+
+
+def counterexample_retrain(
+    net: MLP,
+    X, y,
+    ce_pairs: Sequence[Tuple[np.ndarray, np.ndarray]],
+    X_val, y_val,
+    stage1_epochs: int = 3,
+    stage2_epochs: int = 10,
+    stage1_lr: float = 1e-3,
+    stage2_lr: float = 1e-4,
+    accuracy_floor: float = 0.80,
+    batch_size: int = 32,
+    seed: int = 0,
+) -> RepairResult:
+    """Two-stage fairness retraining (``src/AC/new_model.py:179-263``).
+
+    Counterexample pairs get the *same* target label (the original model's
+    majority prediction for the pair), teaching the net to treat them alike;
+    stage 2 stops early if validation accuracy drops below the floor.
+    """
+    stage1, hist1 = _fit(net, X, y, optax.adam(stage1_lr), stage1_epochs, batch_size, seed)
+
+    # Build the counterexample batch: both points, shared label from the
+    # current model's prediction on x (conservative same-label relabeling,
+    # ``detect_bias.py:412-433`` / ``new_model.py:192-241``).
+    if ce_pairs:
+        xs = np.stack([p[0] for p in ce_pairs]).astype(np.float32)
+        xps = np.stack([p[1] for p in ce_pairs]).astype(np.float32)
+        labels = np.asarray(forward(stage1, jnp.asarray(xs)) > 0.0).astype(np.float32)
+        ce_X = np.concatenate([xs, xps], axis=0)
+        ce_y = np.concatenate([labels, labels], axis=0)
+    else:
+        ce_X = np.zeros((0, net.in_dim), np.float32)
+        ce_y = np.zeros((0,), np.float32)
+
+    current = stage1
+    history = list(hist1)
+    Xv = jnp.asarray(np.asarray(X_val), jnp.float32)
+    for epoch in range(stage2_epochs):
+        if ce_X.shape[0] == 0:
+            break
+        current, h = _fit(
+            current, ce_X, ce_y, optax.adam(stage2_lr), 1, batch_size, seed + 1 + epoch
+        )
+        acc = float(
+            (np.asarray(forward(current, Xv) > 0.0).astype(int) == np.asarray(y_val)).mean()
+        )
+        history.append({"epoch": f"stage2-{epoch}", "loss": h[0]["loss"], "val_acc": acc})
+        if acc < accuracy_floor:  # accuracy floor early stop, new_model.py:233-241
+            break
+    return RepairResult(current, history)

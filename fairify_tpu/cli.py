@@ -1,0 +1,111 @@
+"""Command-line driver: one entry point replacing the 21 reference scripts.
+
+The reference is launched as ``./fairify.sh GC`` → ``python3 Verify-GC.py
+[soft_timeout]`` (``src/fairify.sh:1-8``, ``INSTALL.md:36-49``).  Here:
+
+    python -m fairify_tpu run GC                 # base German sweep
+    python -m fairify_tpu run stress-BM --models BM-1 BM-2
+    python -m fairify_tpu run relaxed-AC --soft-timeout 200
+    python -m fairify_tpu list                   # preset inventory
+    python -m fairify_tpu bench                  # headline benchmark
+
+The positional soft-timeout override of the reference
+(``src/GC/Verify-GC.py:146-147``) is the ``--soft-timeout`` flag.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _cmd_list(_args) -> int:
+    from fairify_tpu.verify import presets
+
+    for name in presets.names():
+        cfg = presets.get(name)
+        extras = []
+        if cfg.relaxed:
+            extras.append(f"RA={cfg.relaxed}@eps{cfg.relax_eps}")
+        if cfg.domain_overrides:
+            extras.append(f"targeted={cfg.domain_overrides}")
+        print(f"{name:14s} dataset={cfg.dataset:8s} PA={cfg.protected} "
+              f"thr={cfg.partition_threshold} {' '.join(extras)}")
+    return 0
+
+
+def _cmd_run(args) -> int:
+    from fairify_tpu.verify import presets, sweep
+
+    cfg = presets.get(args.preset)
+    overrides = {}
+    if args.soft_timeout is not None:
+        overrides["soft_timeout_s"] = float(args.soft_timeout)
+    if args.hard_timeout is not None:
+        overrides["hard_timeout_s"] = float(args.hard_timeout)
+    if args.models:
+        overrides["models"] = tuple(args.models)
+    if args.result_dir:
+        overrides["result_dir"] = args.result_dir
+    if args.seed is not None:
+        overrides["seed"] = args.seed
+    if overrides:
+        cfg = cfg.with_(**overrides)
+
+    mesh = None
+    if args.mesh:
+        from fairify_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh()
+
+    reports = sweep.run_sweep(cfg, model_root=args.model_root, data_root=args.data_root,
+                              mesh=mesh)
+    if not reports:
+        print(f"no models found for dataset {cfg.dataset!r} "
+              f"(set --model-root or FAIRIFY_TPU_MODEL_ROOT)", file=sys.stderr)
+        return 1
+    for rep in reports:
+        c = rep.counts
+        print(json.dumps({
+            "model": rep.model, "dataset": rep.dataset,
+            "partitions": rep.partitions_total, "attempted": len(rep.outcomes),
+            "sat": c["sat"], "unsat": c["unsat"], "unknown": c["unknown"],
+            "original_acc": round(rep.original_acc, 4),
+            "total_time_s": round(rep.total_time_s, 2),
+        }))
+    return 0
+
+
+def _cmd_bench(_args) -> int:
+    import bench
+
+    bench.main()
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="fairify_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("list", help="list sweep presets")
+
+    run = sub.add_parser("run", help="run a verification sweep preset")
+    run.add_argument("preset", help="preset name (see `list`)")
+    run.add_argument("--models", nargs="*", help="restrict to these model names")
+    run.add_argument("--soft-timeout", type=float, default=None)
+    run.add_argument("--hard-timeout", type=float, default=None)
+    run.add_argument("--result-dir", default=None)
+    run.add_argument("--seed", type=int, default=None)
+    run.add_argument("--model-root", default=None)
+    run.add_argument("--data-root", default=None)
+    run.add_argument("--mesh", action="store_true",
+                     help="shard stage 0 over all visible devices")
+
+    sub.add_parser("bench", help="run the headline benchmark")
+
+    args = ap.parse_args(argv)
+    return {"list": _cmd_list, "run": _cmd_run, "bench": _cmd_bench}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

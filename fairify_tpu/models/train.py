@@ -1,0 +1,69 @@
+"""From-scratch MLP training for synthetic zoo models.
+
+The reference generates several models at runtime rather than shipping them:
+GC-6..8 come from synthetic-data comparison pipelines
+(``src/GC/Verify-GC-experiment.py:88-107``) and AC-13..16 from the repair
+pipelines (``src/AC/detect_bias.py:408``).  This trainer produces
+equivalently-shaped ReLU/sigmoid MLPs with optax so the full model-family
+surface exists without TensorFlow.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fairify_tpu.models.mlp import MLP, from_numpy
+from fairify_tpu.analysis.repair import bce_loss
+
+
+def init_mlp(sizes: Sequence[int], seed: int = 0, scale: float = 0.1) -> MLP:
+    rng = np.random.default_rng(seed)
+    ws, bs = [], []
+    for i in range(len(sizes) - 1):
+        # He-style fan-in scaling, standard for ReLU stacks.
+        std = scale * np.sqrt(2.0 / sizes[i])
+        ws.append(rng.normal(scale=std, size=(sizes[i], sizes[i + 1])).astype(np.float32))
+        bs.append(np.zeros(sizes[i + 1], dtype=np.float32))
+    return from_numpy(ws, bs)
+
+
+def train_mlp(
+    X,
+    y,
+    hidden: Sequence[int],
+    epochs: int = 20,
+    lr: float = 1e-3,
+    batch_size: int = 128,
+    seed: int = 0,
+) -> MLP:
+    """Train a binary classifier MLP (ReLU hidden, logit output)."""
+    X = np.asarray(X, dtype=np.float32)
+    y = np.asarray(y, dtype=np.float32)
+    sizes = [X.shape[1], *hidden, 1]
+    net = init_mlp(sizes, seed)
+    params = (net.weights, net.biases)
+    opt = optax.adam(lr)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, xb, yb):
+        def loss_fn(p):
+            return bce_loss(MLP(p[0], p[1], net.masks), xb, yb)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    rng = np.random.default_rng(seed)
+    n = X.shape[0]
+    Xj, yj = jnp.asarray(X), jnp.asarray(y)
+    for _ in range(epochs):
+        order = rng.permutation(n)
+        for s in range(0, n - batch_size + 1, batch_size):
+            idx = order[s : s + batch_size]
+            params, opt_state, _ = step(params, opt_state, Xj[idx], yj[idx])
+    return MLP(params[0], params[1], net.masks)

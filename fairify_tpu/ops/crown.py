@@ -1,0 +1,147 @@
+"""Batched CROWN backward linear-relaxation bounds for masked ReLU MLPs.
+
+The reference's only bounding device is interval arithmetic
+(``utils/prune.py:105-164``); its decision procedure then leans on Z3 to
+close the gap.  The native TPU engine instead tightens bounds with CROWN
+(backward propagation of linear relaxations, Zhang et al. 2018 — public
+algorithm), which is typically 2-10x tighter than IBP on small MLPs and
+turns most partitions into one-kernel UNSAT certificates instead of SMT
+queries.
+
+Design notes (TPU-first):
+
+* Fully batched: every function takes ``lb``/``ub`` with arbitrary leading
+  batch axes (partitions × PA-assignments × roles) and is `vmap`/`jit`
+  compatible — the whole branch-and-bound frontier is bounded in one XLA
+  launch, all matmuls on the MXU at ``Precision.HIGHEST``.
+* Static shapes: pruned neurons participate with slope 0 via the MLP's
+  alive masks, never as ragged deletes.
+* Soundness: computed in f32 and widened outward like the IBP kernel; the
+  engine treats bound-certified verdicts as sound-with-slack and leaf
+  evaluations are exact (``fairify_tpu.ops.exact``).
+
+The layer-k pre-activation bounds are computed by a backward pass through
+layers k-1..0, each hidden layer relaxed with the standard CROWN ReLU
+envelope: upper line ``u/(u-l)·(z-l)``, lower line ``α·z`` with adaptive
+``α = 1 if u ≥ |l| else 0``.  Intermediate-layer bounds come from the same
+procedure applied depth-by-depth (full backward CROWN, O(L²) small matmuls
+— irrelevant next to HBM traffic for these ≤100-wide nets).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from fairify_tpu.models.mlp import MLP
+from fairify_tpu.ops.interval import LayerBounds, SOUND_SLACK_ABS, SOUND_SLACK_REL, affine_interval
+from fairify_tpu.utils.num import matmul
+
+
+def _widen(lo: jax.Array, hi: jax.Array):
+    slack = SOUND_SLACK_REL * jnp.maximum(jnp.abs(lo), jnp.abs(hi)) + SOUND_SLACK_ABS
+    return lo - slack, hi + slack
+
+
+def _relu_relaxation(lo: jax.Array, hi: jax.Array, mask: jax.Array):
+    """Per-neuron CROWN ReLU envelope coefficients.
+
+    Returns (upper_slope, upper_intercept, lower_slope); lower intercept is 0.
+    Stable-active neurons get slope 1 / intercept 0, stable-dead (or pruned)
+    get 0/0, unstable the triangle relaxation.
+    """
+    unstable = (lo < 0.0) & (hi > 0.0)
+    denom = jnp.where(unstable, hi - lo, 1.0)
+    us = jnp.where(unstable, hi / denom, (lo >= 0.0).astype(lo.dtype))
+    ui = jnp.where(unstable, -hi * lo / denom, 0.0)
+    ls = jnp.where(unstable, (hi >= -lo).astype(lo.dtype), us)
+    us = us * mask
+    ui = ui * mask
+    ls = ls * mask
+    return us, ui, ls
+
+
+def _backward_bounds(params: MLP, k: int, pre_lbs, pre_ubs, in_lb, in_ub):
+    """CROWN bounds on layer-k pre-activations given bounds for layers < k.
+
+    ``in_lb``/``in_ub``: (..., d) input box.  ``pre_lbs[j]``/``pre_ubs[j]``:
+    (..., n_j) pre-activation bounds of hidden layer j.  Returns (lo, hi) of
+    shape (..., n_k).
+    """
+    w_k = params.weights[k]
+    batch = in_lb.shape[:-1]
+    n_k = w_k.shape[1]
+    # Linear forms: z_k ≥ h_j @ A_low + c_low and z_k ≤ h_j @ A_up + c_up.
+    A_low = jnp.broadcast_to(w_k, batch + w_k.shape)
+    A_up = A_low
+    c_low = jnp.broadcast_to(params.biases[k], batch + (n_k,))
+    c_up = c_low
+    for j in range(k - 1, -1, -1):
+        us, ui, ls = _relu_relaxation(pre_lbs[j], pre_ubs[j], params.masks[j])
+        # Pass through h_j = relu(z_j): pick relaxation per coefficient sign.
+        Ap = jnp.maximum(A_low, 0.0)
+        An = jnp.minimum(A_low, 0.0)
+        c_low = c_low + matmul(jnp.expand_dims(ui, -2), An)[..., 0, :]
+        A_low = Ap * ls[..., :, None] + An * us[..., :, None]
+        Ap = jnp.maximum(A_up, 0.0)
+        An = jnp.minimum(A_up, 0.0)
+        c_up = c_up + matmul(jnp.expand_dims(ui, -2), Ap)[..., 0, :]
+        A_up = Ap * us[..., :, None] + An * ls[..., :, None]
+        # Pass through z_j = h_{j-1} @ w_j + b_j.
+        w_j, b_j = params.weights[j], params.biases[j]
+        c_low = c_low + matmul(jnp.expand_dims(b_j, -2), A_low)[..., 0, :]
+        c_up = c_up + matmul(jnp.expand_dims(b_j, -2), A_up)[..., 0, :]
+        A_low = matmul(jnp.broadcast_to(w_j, batch + w_j.shape), A_low)
+        A_up = matmul(jnp.broadcast_to(w_j, batch + w_j.shape), A_up)
+    # Concretize over the input box.
+    lo = (
+        matmul(jnp.expand_dims(in_lb, -2), jnp.maximum(A_low, 0.0))[..., 0, :]
+        + matmul(jnp.expand_dims(in_ub, -2), jnp.minimum(A_low, 0.0))[..., 0, :]
+        + c_low
+    )
+    hi = (
+        matmul(jnp.expand_dims(in_ub, -2), jnp.maximum(A_up, 0.0))[..., 0, :]
+        + matmul(jnp.expand_dims(in_lb, -2), jnp.minimum(A_up, 0.0))[..., 0, :]
+        + c_up
+    )
+    return lo, hi
+
+
+def crown_bounds(params: MLP, lb: jax.Array, ub: jax.Array, widen: bool = True) -> LayerBounds:
+    """Full-network CROWN pre-activation bounds (tightened against IBP).
+
+    Layer 0 is affine over the box (exact); each deeper layer runs a backward
+    pass using the already-computed shallower bounds, then intersects with
+    the plain interval bound (CROWN is not uniformly tighter per-neuron, so
+    take the elementwise min/max of both).
+    """
+    n = params.depth
+    ws_lb, ws_ub, pl_lb, pl_ub = [], [], [], []
+    lo_run, hi_run = lb, ub
+    for k in range(n):
+        zlo_i, zhi_i = affine_interval(params.weights[k], params.biases[k], lo_run, hi_run)
+        if k == 0:
+            zlo, zhi = zlo_i, zhi_i
+        else:
+            zlo_c, zhi_c = _backward_bounds(params, k, ws_lb, ws_ub, lb, ub)
+            zlo = jnp.maximum(zlo_i, zlo_c)
+            zhi = jnp.minimum(zhi_i, zhi_c)
+        if widen:
+            zlo, zhi = _widen(zlo, zhi)
+        ws_lb.append(zlo)
+        ws_ub.append(zhi)
+        if k == n - 1:
+            plo, phi = zlo, zhi
+        else:
+            m = params.masks[k]
+            plo = jax.nn.relu(zlo) * m
+            phi = jax.nn.relu(zhi) * m
+        pl_lb.append(plo)
+        pl_ub.append(phi)
+        lo_run, hi_run = plo, phi
+    return LayerBounds(tuple(ws_lb), tuple(ws_ub), tuple(pl_lb), tuple(pl_ub))
+
+
+def crown_output_bounds(params: MLP, lb: jax.Array, ub: jax.Array, widen: bool = True):
+    """CROWN bounds of the scalar output logit over a batch of boxes."""
+    bounds = crown_bounds(params, lb, ub, widen=widen)
+    return bounds.ws_lb[-1][..., 0], bounds.ws_ub[-1][..., 0]

@@ -1,0 +1,1 @@
+"""Device-mesh parallelism for the partition sweep (ICI/DCN scaling)."""

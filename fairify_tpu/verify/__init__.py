@@ -1,0 +1,20 @@
+"""Decision engine: native, complete individual-fairness verification.
+
+The reference decides each partition with a host-side Z3 SMT query over the
+pruned network (``src/GC/Verify-GC.py:128-214``).  This package replaces SMT
+with a TPU-native complete procedure:
+
+* :mod:`fairify_tpu.verify.property` — the pair property (PA ``neq``, RA
+  ``|Δ|≤ε``, others ``eq``, both points in the domain box, strict logit sign
+  flip) as enumerated protected-assignment *roles* with static shapes.
+* :mod:`fairify_tpu.verify.engine` — per-box certificates: batched
+  CROWN/IBP bound certificates for UNSAT, batched sampling attack for SAT,
+  input-space branch-and-bound over the integer lattice for the rest
+  (complete because the lattice is finite), exact rational leaf evaluation.
+* :mod:`fairify_tpu.verify.sweep` — the partition sweep: stage-1 whole-grid
+  kernels, per-partition refinement, verdict ledger with resume, timing and
+  CSV output in the reference's 24-column schema.
+
+A gated Z3 backend (:mod:`fairify_tpu.verify.smt`) is retained for
+environments with ``z3-solver`` installed; it is not required.
+"""

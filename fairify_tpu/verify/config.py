@@ -1,0 +1,52 @@
+"""Declarative sweep configuration — the replacement for 21 driver scripts.
+
+Every reference driver (``src/{GC,AC,BM,CP,DF}``, ``stress/*``, ``relaxed/*``,
+``targeted/*``, ``targeted2/*``) is an instance of :class:`SweepConfig`; the
+variants differ only in these fields (SURVEY.md §2.2).  Presets live in
+:mod:`fairify_tpu.verify.presets`.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Dict, Optional, Tuple
+
+from fairify_tpu.data.domains import get_domain
+from fairify_tpu.verify.engine import EngineConfig
+from fairify_tpu.verify.property import FairnessQuery
+
+
+@dataclass(frozen=True)
+class SweepConfig:
+    name: str
+    dataset: str  # key into data.domains / data.loaders / models.zoo
+    protected: Tuple[str, ...]
+    relaxed: Tuple[str, ...] = ()
+    relax_eps: int = 0
+    partition_threshold: int = 100  # PARTITION_THRESHOLD
+    capped_partitions: bool = False  # DF's partition_df path
+    max_partitions: int = 100
+    domain_overrides: Dict[str, Tuple[int, int]] = field(default_factory=dict)
+    soft_timeout_s: float = 100.0  # per-partition decision budget
+    hard_timeout_s: float = 30 * 60.0  # per-model cumulative budget
+    sim_size: int = 1000
+    heuristic_threshold: float = 5.0  # HEURISTIC_PRUNE_THRESHOLD (percentile)
+    models: Optional[Tuple[str, ...]] = None  # None = whole family
+    seed: int = 42
+    exact_certify_masks: bool = True
+    engine: EngineConfig = field(default_factory=EngineConfig)
+    result_dir: str = "res"
+
+    def query(self) -> FairnessQuery:
+        domain = get_domain(self.dataset)
+        if self.domain_overrides:
+            domain = domain.override(**self.domain_overrides)
+        # Attributes named as PA/RA but absent from the dataset's columns are
+        # dropped, matching the reference where constraint builders match by
+        # column name and silently skip misses (e.g. the phantom
+        # 'marital-status' PA of relaxed/GC, ``relaxed/GC/Verify-GC.py:58``).
+        pa = tuple(a for a in self.protected if a in domain.ranges)
+        ra = tuple(a for a in self.relaxed if a in domain.ranges)
+        return FairnessQuery(domain=domain, protected=pa, relaxed=ra, relax_eps=self.relax_eps)
+
+    def with_(self, **kw) -> "SweepConfig":
+        return replace(self, **kw)

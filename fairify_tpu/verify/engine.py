@@ -1,0 +1,368 @@
+"""Native complete decision engine for the pair property.
+
+Replaces the reference's per-partition Z3 query (``src/GC/Verify-GC.py:145-214``)
+with a TPU-first procedure:
+
+1. **Bound certificate (UNSAT)** — batched CROWN/IBP logit bounds for every
+   protected-assignment role box; a box is certified fair iff for every
+   valid assignment pair (a, b) both flip directions are impossible
+   (``ub ≤ 0`` on one side or ``lb ≥ 0`` on the other).  One XLA launch for
+   the whole batch.
+2. **Sampling attack (SAT)** — batched integer sampling of shared
+   coordinates, PA assignments enumerated, RA deltas sampled; any strict
+   sign flip yields a counterexample pair, exactness-checked on host.
+3. **Branch-and-bound** — undecided boxes split along the widest shared
+   dimension into an on-device frontier (static shapes, padded); leaves
+   (all shared dims collapsed to a point) are decided *exactly* in rational
+   arithmetic (RA ball enumerated), so the procedure is complete on the
+   integer lattice.  Budget exhaustion → UNKNOWN, like the reference's
+   solver timeout.
+
+Soundness: device bounds are outward-widened f32; leaf decisions and
+counterexample validation are exact (``fairify_tpu.ops.exact``).  A
+float-certified UNSAT can optionally be re-derived with exact IBP
+(``exact_certify=True``) at extra host cost.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from fractions import Fraction
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fairify_tpu.models.mlp import MLP
+from fairify_tpu.ops import crown as crown_ops
+from fairify_tpu.ops import interval as interval_ops
+from fairify_tpu.verify.property import PairEncoding
+
+# ---------------------------------------------------------------------------
+# Device kernels (jitted; net pytree is a traced argument, so one compile per
+# model architecture × batch shape)
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _role_logit_bounds(net: MLP, x_lo, x_hi, xp_lo, xp_hi, use_crown: bool):
+    """Logit bounds of both roles; inputs (..., V, d) → four (..., V) arrays."""
+
+    def bounds(lo, hi):
+        return jax.lax.cond(
+            use_crown,
+            lambda: crown_ops.crown_output_bounds(net, lo, hi),
+            lambda: interval_ops.output_bounds(net, lo, hi),
+        )
+
+    lb_x, ub_x = bounds(x_lo, x_hi)
+    lb_p, ub_p = bounds(xp_lo, xp_hi)
+    return lb_x, ub_x, lb_p, ub_p
+
+
+def no_flip_certified(
+    lb_x, ub_x, lb_p, ub_p, valid_assign: np.ndarray, valid_pair: np.ndarray
+) -> np.ndarray:
+    """Per-box fairness certificate from role logit bounds (all numpy).
+
+    For a valid pair (a, b): flip x⁺/x'⁻ impossible iff ``ub_x[a] ≤ 0`` or
+    ``lb_p[b] ≥ 0``; flip x⁻/x'⁺ impossible iff ``lb_x[a] ≥ 0`` or
+    ``ub_p[b] ≤ 0``.  Certified iff impossible for every valid pair.  This is
+    strictly finer than requiring a uniform output sign over the box.
+    """
+    lb_x, ub_x, lb_p, ub_p = (np.asarray(v) for v in (lb_x, ub_x, lb_p, ub_p))
+    pair_ok = valid_pair & valid_assign[..., :, None] & valid_assign[..., None, :]
+    t1_dead = (ub_x[..., :, None] <= 0.0) | (lb_p[..., None, :] >= 0.0)
+    t2_dead = (lb_x[..., :, None] >= 0.0) | (ub_p[..., None, :] <= 0.0)
+    possible = pair_ok & ~(t1_dead & t2_dead)
+    return ~possible.any(axis=(-2, -1))
+
+
+@jax.jit
+def _attack_logits(net: MLP, x_roles, xp_roles):
+    """Forward logits for attack candidates; shapes (..., V, d) → (..., V)."""
+    from fairify_tpu.models.mlp import forward
+
+    return forward(net, x_roles), forward(net, xp_roles)
+
+
+def build_attack_candidates(
+    enc: PairEncoding, rng: np.random.Generator, lo: np.ndarray, hi: np.ndarray, n_samples: int
+):
+    """Integer attack samples for a batch of boxes.
+
+    Returns ``(x_roles, xp_roles)`` of shape (B, S, V, d): shared coordinates
+    drawn uniformly per box, PA dims overwritten by each assignment, RA dims
+    of the x' role shifted by a uniform delta in [-ε, ε] (unclamped, see
+    ``property.role_boxes``).
+    """
+    lo = np.asarray(lo, dtype=np.int64)
+    hi = np.asarray(hi, dtype=np.int64)
+    B, d = lo.shape
+    V = enc.n_assign
+    shared = rng.integers(lo[:, None, :], hi[:, None, :] + 1, size=(B, n_samples, d))
+    x_roles = np.repeat(shared[:, :, None, :], V, axis=2).astype(np.float32)
+    if len(enc.pa_idx):
+        x_roles[..., enc.pa_idx] = enc.assignments.astype(np.float32)
+    xp_roles = x_roles.copy()
+    if len(enc.ra_idx) and enc.eps:
+        delta = rng.integers(-enc.eps, enc.eps + 1, size=(B, n_samples, 1, len(enc.ra_idx)))
+        xp_roles[..., enc.ra_idx] = xp_roles[..., enc.ra_idx] + delta.astype(np.float32)
+    return x_roles, xp_roles
+
+
+def find_flips(
+    enc: PairEncoding,
+    logit_x: np.ndarray,
+    logit_p: np.ndarray,
+    valid_assign: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Locate strict sign flips among attack candidates.
+
+    ``logit_x``/``logit_p``: (B, S, V).  ``valid_assign``: (B, V).
+    Returns (found (B,), witness (B, 3) of [sample, a, b]).
+    """
+    va = valid_assign[:, None, :]
+    pos_x = (logit_x > 0.0) & va
+    neg_x = (logit_x < 0.0) & va
+    pos_p = (logit_p > 0.0) & va
+    neg_p = (logit_p < 0.0) & va
+    flips = (pos_x[..., :, None] & neg_p[..., None, :]) | (
+        neg_x[..., :, None] & pos_p[..., None, :]
+    )
+    flips &= enc.valid_pair[None, None, :, :]
+    B, S, V, _ = flips.shape
+    flat = flips.reshape(B, -1)
+    found = flat.any(axis=1)
+    idx = flat.argmax(axis=1)
+    s, rem = np.divmod(idx, V * V)
+    a, b = np.divmod(rem, V)
+    return found, np.stack([s, a, b], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Exact host-side checks
+# ---------------------------------------------------------------------------
+
+
+def exact_logit_sign(weights, biases, x: np.ndarray) -> int:
+    """Sign of the network logit at integer point x, exact when ambiguous.
+
+    Float64 forward first; if the result is within 1e-6 of zero, re-evaluate
+    in rational arithmetic (f32 weights are dyadic rationals, so this is the
+    true sign — the quantity Z3 would have reasoned about,
+    ``utils/GC-1-Model-Functions.py:32-44``).
+    """
+    h = np.asarray(x, dtype=np.float64)
+    for i, (w, b) in enumerate(zip(weights, biases)):
+        z = h @ np.asarray(w, dtype=np.float64) + np.asarray(b, dtype=np.float64)
+        h = z if i == len(weights) - 1 else np.maximum(z, 0.0)
+    v = float(h[0])
+    if abs(v) > 1e-6:
+        return 1 if v > 0 else -1
+    hf = [Fraction(int(t)) for t in np.asarray(x, dtype=np.int64)]
+    for i, (w, b) in enumerate(zip(weights, biases)):
+        wf = np.asarray(w, dtype=np.float64)
+        bf = np.asarray(b, dtype=np.float64)
+        nxt = []
+        for j in range(wf.shape[1]):
+            acc = Fraction(float(bf[j]))
+            for t in range(wf.shape[0]):
+                acc += Fraction(float(wf[t, j])) * hf[t]
+            if i < len(weights) - 1 and acc < 0:
+                acc = Fraction(0)
+            nxt.append(acc)
+        hf = nxt
+    v = hf[0]
+    return 0 if v == 0 else (1 if v > 0 else -1)
+
+
+def validate_pair(weights, biases, x: np.ndarray, xp: np.ndarray) -> bool:
+    """Exact strict-flip check for a candidate counterexample pair."""
+    sx = exact_logit_sign(weights, biases, x)
+    sp = exact_logit_sign(weights, biases, xp)
+    return (sx > 0 and sp < 0) or (sx < 0 and sp > 0)
+
+
+def decide_leaf(enc: PairEncoding, weights, biases, point: np.ndarray, lo, hi):
+    """Exactly decide a leaf box (all shared dims collapsed to one point).
+
+    Enumerates PA assignment pairs and, for RA dims, the full delta lattice
+    [-ε, ε]^|RA|.  Returns ('sat', (x, xp)) or ('unsat', None).
+    """
+    import itertools as it
+
+    lo = np.asarray(lo)
+    hi = np.asarray(hi)
+    valid = [
+        i
+        for i in range(enc.n_assign)
+        if all(
+            lo[enc.pa_idx[k]] <= enc.assignments[i, k] <= hi[enc.pa_idx[k]]
+            for k in range(len(enc.pa_idx))
+        )
+    ]
+    deltas = (
+        list(it.product(range(-enc.eps, enc.eps + 1), repeat=len(enc.ra_idx)))
+        if (len(enc.ra_idx) and enc.eps)
+        else [()]
+    )
+    sign_x = {}
+    for a in valid:
+        x = np.array(point, dtype=np.int64)
+        x[enc.pa_idx] = enc.assignments[a]
+        sign_x[a] = exact_logit_sign(weights, biases, x)
+    for a in valid:
+        if sign_x[a] == 0:
+            continue
+        for b in valid:
+            if not enc.valid_pair[a, b]:
+                continue
+            for dl in deltas:
+                xp = np.array(point, dtype=np.int64)
+                xp[enc.pa_idx] = enc.assignments[b]
+                for k, dv in enumerate(dl):
+                    xp[enc.ra_idx[k]] += dv
+                sp = (
+                    sign_x[b]
+                    if not dl or all(v == 0 for v in dl)
+                    else exact_logit_sign(weights, biases, xp)
+                )
+                if (sign_x[a] > 0 and sp < 0) or (sign_x[a] < 0 and sp > 0):
+                    x = np.array(point, dtype=np.int64)
+                    x[enc.pa_idx] = enc.assignments[a]
+                    return "sat", (x, xp)
+    return "unsat", None
+
+
+# ---------------------------------------------------------------------------
+# Branch-and-bound
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class EngineConfig:
+    use_crown: bool = True
+    attack_samples: int = 128
+    bab_attack_samples: int = 16
+    frontier_size: int = 512
+    max_nodes: int = 200_000
+    soft_timeout_s: float = 100.0
+    seed: int = 0
+
+
+@dataclass
+class Decision:
+    verdict: str  # 'sat' | 'unsat' | 'unknown'
+    counterexample: Optional[Tuple[np.ndarray, np.ndarray]] = None
+    nodes: int = 0
+    leaves: int = 0
+    elapsed_s: float = 0.0
+    stats: dict = field(default_factory=dict)
+
+
+def _branch_dims(enc: PairEncoding, d: int) -> np.ndarray:
+    """Shared dims eligible for splitting: everything except PA (enumerated)."""
+    mask = np.ones(d, dtype=bool)
+    if len(enc.pa_idx):
+        mask[enc.pa_idx] = False
+    return np.where(mask)[0]
+
+
+def _pad(arr: np.ndarray, n: int) -> np.ndarray:
+    if arr.shape[0] == n:
+        return arr
+    pad = np.repeat(arr[-1:], n - arr.shape[0], axis=0)
+    return np.concatenate([arr, pad], axis=0)
+
+
+def decide_box(
+    net: MLP,
+    enc: PairEncoding,
+    lo: np.ndarray,
+    hi: np.ndarray,
+    cfg: EngineConfig,
+) -> Decision:
+    """Complete decision for one partition box via batched branch-and-bound."""
+    from fairify_tpu.verify.property import role_boxes
+
+    t0 = time.perf_counter()
+    rng = np.random.default_rng(cfg.seed)
+    weights = [np.asarray(w) for w in net.weights]
+    biases = [np.asarray(b) for b in net.biases]
+    branch_dims = _branch_dims(enc, len(lo))
+
+    frontier_lo = [np.asarray(lo, dtype=np.int64)]
+    frontier_hi = [np.asarray(hi, dtype=np.int64)]
+    nodes = 0
+    leaves = 0
+    F = cfg.frontier_size
+
+    while frontier_lo:
+        if nodes > cfg.max_nodes or (time.perf_counter() - t0) > cfg.soft_timeout_s:
+            return Decision(
+                "unknown", nodes=nodes, leaves=leaves, elapsed_s=time.perf_counter() - t0
+            )
+        batch = min(F, len(frontier_lo))
+        blo = np.stack(frontier_lo[:batch])
+        bhi = np.stack(frontier_hi[:batch])
+        del frontier_lo[:batch], frontier_hi[:batch]
+        nodes += batch
+
+        # Pad to the compiled frontier width to avoid shape churn.
+        plo = _pad(blo, F).astype(np.float32)
+        phi = _pad(bhi, F).astype(np.float32)
+        x_lo, x_hi, xp_lo, xp_hi, valid = role_boxes(enc, plo, phi)
+        lb_x, ub_x, lb_p, ub_p = _role_logit_bounds(
+            net, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo), jnp.asarray(xp_hi),
+            cfg.use_crown,
+        )
+        certified = no_flip_certified(lb_x, ub_x, lb_p, ub_p, valid, enc.valid_pair)[:batch]
+
+        undecided = np.where(~certified)[0]
+        if undecided.size == 0:
+            continue
+
+        # Attack the undecided boxes (padded to the frontier width so the
+        # jitted forward compiles once, not per undecided-count).
+        ulo, uhi = _pad(blo[undecided], F), _pad(bhi[undecided], F)
+        xr, pr = build_attack_candidates(enc, rng, ulo, uhi, cfg.bab_attack_samples)
+        lx, lp = _attack_logits(net, jnp.asarray(xr), jnp.asarray(pr))
+        found, wit = find_flips(
+            enc, np.asarray(lx), np.asarray(lp), _pad(valid[undecided], F)
+        )
+        found = found[: undecided.size]
+        for i in np.where(found)[0]:
+            s, a, b = wit[i]
+            x = xr[i, s, a].astype(np.int64)
+            xp = pr[i, s, b].astype(np.int64)
+            if validate_pair(weights, biases, x, xp):
+                return Decision(
+                    "sat", (x, xp), nodes=nodes, leaves=leaves,
+                    elapsed_s=time.perf_counter() - t0,
+                )
+
+        # Split or exactly decide leaves.
+        for i in undecided:
+            l, h = blo[i], bhi[i]
+            widths = h[branch_dims] - l[branch_dims]
+            if widths.size == 0 or widths.max() == 0:
+                leaves += 1
+                verdict, ce = decide_leaf(enc, weights, biases, l.copy(), l, h)
+                if verdict == "sat":
+                    return Decision(
+                        "sat", ce, nodes=nodes, leaves=leaves,
+                        elapsed_s=time.perf_counter() - t0,
+                    )
+                continue
+            dim = branch_dims[int(widths.argmax())]
+            mid = (l[dim] + h[dim]) // 2
+            left_hi = h.copy()
+            left_hi[dim] = mid
+            right_lo = l.copy()
+            right_lo[dim] = mid + 1
+            frontier_lo.extend([l, right_lo])
+            frontier_hi.extend([left_hi, h])
+
+    return Decision("unsat", nodes=nodes, leaves=leaves, elapsed_s=time.perf_counter() - t0)

@@ -1,0 +1,101 @@
+"""The 21 reference drivers as declarative presets.
+
+Every entry reproduces one reference driver's configuration constants
+(SURVEY.md §2.2; extracted from the driver headers, e.g.
+``src/GC/Verify-GC.py:29-68``, ``stress/GC/Verify-GC.py:31-35``,
+``relaxed/AC/Verify-AC.py:23-51``, ``targeted/BM/Verify-BM.py:23-54``,
+``targeted2/GC/Verify-GC.py:23-58``).  The reference spreads these over 21
+near-identical scripts; here a variant is a config delta.
+
+Notes kept faithful:
+
+* relaxed/GC and targeted2/GC name a ``marital-status`` protected attribute
+  that does not exist in the German feature set; the reference's constraint
+  builders match by column name and silently skip it
+  (``utils/verif_utils.py:659-685``), so it is dropped at query build time.
+* The experiment drivers (``src/*/Verify-*-experiment*.py``) share these
+  base configs; their extra analysis stages live in
+  :mod:`fairify_tpu.analysis`.
+"""
+from __future__ import annotations
+
+from fairify_tpu.verify.config import SweepConfig
+
+_BASE = dict(soft_timeout_s=100.0, hard_timeout_s=30 * 60.0, sim_size=1000)
+_HOUR = dict(hard_timeout_s=60 * 60.0)
+
+PRESETS = {
+    # ----- base drivers (src/) -----
+    "GC": SweepConfig(name="GC", dataset="german", protected=("age",),
+                      partition_threshold=100, heuristic_threshold=5, **_BASE),
+    "AC": SweepConfig(name="AC", dataset="adult", protected=("sex",),
+                      partition_threshold=10, heuristic_threshold=5, **_BASE),
+    "BM": SweepConfig(name="BM", dataset="bank", protected=("age",),
+                      partition_threshold=100, heuristic_threshold=5, **_BASE),
+    "CP": SweepConfig(name="CP", dataset="compass", protected=("Race",),
+                      partition_threshold=5, heuristic_threshold=50, **_BASE),
+    "DF": SweepConfig(name="DF", dataset="default", protected=("SEX_2",),
+                      partition_threshold=8, heuristic_threshold=100,
+                      capped_partitions=True, max_partitions=100,
+                      soft_timeout_s=100.0, sim_size=1000, **_HOUR),
+    # ----- stress/ -----
+    "stress-GC": SweepConfig(name="stress-GC", dataset="german", protected=("age",),
+                             partition_threshold=10, heuristic_threshold=20,
+                             soft_timeout_s=200.0, sim_size=1000, **_HOUR),
+    "stress-AC": SweepConfig(name="stress-AC", dataset="adult", protected=("sex",),
+                             partition_threshold=6, heuristic_threshold=20,
+                             soft_timeout_s=200.0, sim_size=1000, **_HOUR),
+    "stress-BM": SweepConfig(name="stress-BM", dataset="bank", protected=("age",),
+                             partition_threshold=10, heuristic_threshold=20,
+                             soft_timeout_s=200.0, sim_size=1000, **_HOUR),
+    # ----- relaxed/ -----
+    "relaxed-GC": SweepConfig(name="relaxed-GC", dataset="german",
+                              protected=("sex", "marital-status"),
+                              partition_threshold=10, heuristic_threshold=20,
+                              soft_timeout_s=100.0, sim_size=1000, **_HOUR),
+    "relaxed-AC": SweepConfig(name="relaxed-AC", dataset="adult", protected=("race",),
+                              relaxed=("age",), relax_eps=5,
+                              partition_threshold=6, heuristic_threshold=20,
+                              soft_timeout_s=100.0, sim_size=1000, **_HOUR),
+    "relaxed-BM": SweepConfig(name="relaxed-BM", dataset="bank", protected=("age",),
+                              relaxed=("duration",), relax_eps=5,
+                              partition_threshold=10, heuristic_threshold=20,
+                              soft_timeout_s=100.0, sim_size=1000, **_HOUR),
+    # ----- targeted/ (sub-population domains) -----
+    "targeted-GC": SweepConfig(name="targeted-GC", dataset="german", protected=("sex",),
+                               domain_overrides={"number_of_credits": (2, 2)},
+                               partition_threshold=10, heuristic_threshold=20,
+                               soft_timeout_s=100.0, sim_size=1000, **_HOUR),
+    "targeted-AC": SweepConfig(name="targeted-AC", dataset="adult", protected=("race",),
+                               domain_overrides={"age": (30, 35)},
+                               partition_threshold=6, heuristic_threshold=20,
+                               soft_timeout_s=100.0, sim_size=1000, **_HOUR),
+    "targeted-BM": SweepConfig(name="targeted-BM", dataset="bank", protected=("age",),
+                               relaxed=("duration",), relax_eps=5,
+                               domain_overrides={"job": (2, 2), "loan": (1, 1)},
+                               partition_threshold=10, heuristic_threshold=20,
+                               soft_timeout_s=100.0, sim_size=1000, **_HOUR),
+    # ----- targeted2/ (different sub-populations) -----
+    "targeted2-GC": SweepConfig(name="targeted2-GC", dataset="german",
+                                protected=("sex", "marital-status"),
+                                domain_overrides={"purpose": (7, 7), "foreign_worker": (0, 0)},
+                                partition_threshold=10, heuristic_threshold=20,
+                                soft_timeout_s=100.0, sim_size=1000, **_HOUR),
+    "targeted2-AC": SweepConfig(name="targeted2-AC", dataset="adult", protected=("race",),
+                                domain_overrides={"education": (9, 10)},
+                                partition_threshold=6, heuristic_threshold=20,
+                                soft_timeout_s=100.0, sim_size=1000, **_HOUR),
+    "targeted2-BM": SweepConfig(name="targeted2-BM", dataset="bank", protected=("age",),
+                                relaxed=("duration",), relax_eps=5,
+                                domain_overrides={"poutcome": (2, 2)},
+                                partition_threshold=10, heuristic_threshold=20,
+                                soft_timeout_s=100.0, sim_size=1000, **_HOUR),
+}
+
+
+def get(name: str) -> SweepConfig:
+    return PRESETS[name]
+
+
+def names() -> list:
+    return sorted(PRESETS)

@@ -1,0 +1,125 @@
+"""Per-partition sound pruning, batched over the whole partition grid.
+
+Mirrors the reference's ``sound_prune_*`` pipeline (``utils/prune.py:671-859``)
+— simulate → candidate dead neurons → IBP bounds → bound-dead → exact
+verification → merge, keep-one guard — but every numeric stage runs once for
+*all* partitions as a batched XLA kernel, and the reference's per-neuron Z3
+"singular verification" (``utils/prune.py:276-644``) is the closed-form exact
+rational pass of :mod:`fairify_tpu.ops.exact` (see that module's equivalence
+argument).
+
+The derived masks do not gate the decision engine's soundness (bounds treat
+dead neurons identically with or without masks); they feed the compression /
+parity stats of the CSV schema and the pruned-network replay (C-check).
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fairify_tpu.models.mlp import MLP
+from fairify_tpu.ops import exact as exact_ops
+from fairify_tpu.ops import interval as interval_ops
+from fairify_tpu.ops import masks as mask_ops
+from fairify_tpu.ops import simulate as sim_ops
+from fairify_tpu.utils.prng import partition_key
+
+
+@dataclass
+class PruneResult:
+    """Per-partition masks and stats (arrays have leading partition axis P)."""
+
+    candidates: List[np.ndarray]  # (P, n_l) 1 = never activated in simulation
+    surviving: List[np.ndarray]  # candidates not proven dead (s_candidates)
+    b_deads: List[np.ndarray]  # bound-proven dead (IBP criterion)
+    s_deads: List[np.ndarray]  # exact-pass-proven dead beyond b_deads
+    st_deads: List[np.ndarray]  # merged sound dead, keep-one guarded
+    pos_prob: List[np.ndarray]  # activation frequency per neuron
+    ws_lb: List[np.ndarray]
+    ws_ub: List[np.ndarray]
+    sim: np.ndarray  # (P, sim_size, d) simulated samples
+    sv_time_s: float  # exact-verification phase (analog of SV solver time)
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("sim_size",))
+def _sim_and_bounds(net: MLP, keys, lo, hi, sim_size: int):
+    stats, sim = jax.vmap(
+        lambda k, l, h: sim_ops.simulate_and_stats(net, k, l, h, sim_size)
+    )(keys, lo, hi)
+    bounds = interval_ops.network_bounds(net, lo, hi)
+    return stats, sim, bounds
+
+
+def sound_prune_grid(
+    net: MLP,
+    lo: np.ndarray,
+    hi: np.ndarray,
+    sim_size: int,
+    seed: int,
+    exact_certify: bool = True,
+) -> PruneResult:
+    """Sound pruning for a (P, d) box grid in one device pass.
+
+    ``exact_certify=False`` skips the host-side rational pass (masks then
+    rest on widened-f32 IBP only — still what the engine uses; the exact
+    pass is the parity anchor and the analog of singular verification).
+    """
+    P = lo.shape[0]
+    keys = jnp.stack([partition_key(seed, i) for i in range(P)])
+    stats, sim, bounds = _sim_and_bounds(
+        net, keys, jnp.asarray(lo, jnp.float32), jnp.asarray(hi, jnp.float32), sim_size
+    )
+    candidates = [np.asarray(c) for c in stats.candidates]
+    pos_prob = [np.asarray(p) for p in stats.positive_prob]
+    ws_lb = [np.asarray(b) for b in bounds.ws_lb]
+    ws_ub = [np.asarray(b) for b in bounds.ws_ub]
+
+    ibp_dead = [np.asarray(d) for d in interval_ops.dead_from_ws_ub(bounds)]
+    # Bound-dead requires simulation candidacy, as in the reference
+    # (``utils/prune.py:241-242``).
+    b_deads = [c * d for c, d in zip(candidates, ibp_dead)]
+
+    t0 = time.perf_counter()
+    s_deads = [np.zeros_like(c) for c in candidates]
+    certified = b_deads
+    if exact_certify:
+        weights = [np.asarray(w) for w in net.weights]
+        biases = [np.asarray(b) for b in net.biases]
+        certified = []
+        for p in range(P):
+            cert = exact_ops.certify_dead_masks(
+                weights, biases, lo[p], hi[p], [c[p] for c in candidates]
+            )
+            certified.append(cert)
+        certified = [np.stack([certified[p][l] for p in range(P)]) for l in range(len(candidates))]
+        s_deads = [np.maximum(c - b, 0.0) for c, b in zip(certified, b_deads)]
+    sv_time = time.perf_counter() - t0
+
+    merged = [np.maximum(b, s) for b, s in zip(b_deads, s_deads)]
+    st_deads = [np.asarray(d) for d in mask_ops.keep_one_alive(merged)]
+    surviving = [np.maximum(c - m, 0.0) for c, m in zip(candidates, certified)]
+    return PruneResult(
+        candidates=candidates,
+        surviving=surviving,
+        b_deads=b_deads,
+        s_deads=s_deads,
+        st_deads=st_deads,
+        pos_prob=pos_prob,
+        ws_lb=ws_lb,
+        ws_ub=ws_ub,
+        sim=np.asarray(sim),
+        sv_time_s=sv_time,
+    )
+
+
+def partition_masks(prune: PruneResult, p: int) -> list:
+    """Dead masks of one partition (list of (n_l,) arrays)."""
+    return [layer[p] for layer in prune.st_deads]

@@ -1,0 +1,97 @@
+"""Gated Z3 SMT backend (optional; the native engine does not need it).
+
+The reference's decision procedure is a Z3 query over the pruned network
+(``src/GC/Verify-GC.py:128-214``; generic encoder pattern in
+``utils/DF-1-Model-Functions.py:62-137``).  ``z3-solver`` is not part of
+this framework's environment, so the module is import-gated: when Z3 *is*
+available, :func:`decide_box_smt` offers a drop-in second opinion for
+cross-checking native verdicts (useful for parity audits against the
+reference); otherwise :data:`HAVE_Z3` is False and callers fall back to
+:func:`fairify_tpu.verify.engine.decide_box`.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+try:  # pragma: no cover - exercised only where z3-solver is installed
+    import z3  # type: ignore
+
+    HAVE_Z3 = True
+except ImportError:
+    z3 = None
+    HAVE_Z3 = False
+
+from fairify_tpu.models.mlp import MLP, excise
+from fairify_tpu.verify.property import PairEncoding
+
+
+def _require_z3():
+    if not HAVE_Z3:
+        raise RuntimeError("z3-solver is not installed; use the native engine "
+                           "(fairify_tpu.verify.engine.decide_box)")
+
+
+def _z3_net(x, weights, biases):
+    """Depth-generic symbolic forward: ToReal input, ReLU hidden, linear out
+    (one encoder replaces the reference's 53 per-model files)."""
+    h = [z3.ToReal(v) if isinstance(v, z3.ArithRef) and v.is_int() else v for v in x]
+    n = len(weights)
+    for i, (w, b) in enumerate(zip(weights, biases)):
+        w = np.asarray(w, dtype=np.float64)
+        bb = np.asarray(b, dtype=np.float64)
+        z = [
+            sum(float(w[t, j]) * h[t] for t in range(w.shape[0])) + float(bb[j])
+            for j in range(w.shape[1])
+        ]
+        h = z if i == n - 1 else [z3.If(v >= 0, v, 0) for v in z]
+    return h[0]
+
+
+def decide_box_smt(
+    net: MLP,
+    enc: PairEncoding,
+    lo: np.ndarray,
+    hi: np.ndarray,
+    soft_timeout_s: float = 100.0,
+) -> Tuple[str, Optional[Tuple[np.ndarray, np.ndarray]]]:
+    """Z3 verdict for one partition box (masked net is excised first)."""
+    _require_z3()
+    small = excise(net)
+    weights = [np.asarray(w) for w in small.weights]
+    biases = [np.asarray(b) for b in small.biases]
+    d = len(lo)
+    x = [z3.Int(f"x{i}") for i in range(d)]
+    xp = [z3.Int(f"x_{i}") for i in range(d)]
+    s = z3.Solver()
+    s.set("timeout", int(soft_timeout_s * 1000))
+
+    pa = set(int(i) for i in enc.pa_idx)
+    ra = set(int(i) for i in enc.ra_idx)
+    for i in range(d):
+        s.add(x[i] >= int(lo[i]), x[i] <= int(hi[i]))
+        if i in pa:
+            s.add(xp[i] >= int(lo[i]), xp[i] <= int(hi[i]))
+            s.add(x[i] != xp[i])
+        elif i in ra:
+            diff = x[i] - xp[i]
+            s.add(z3.If(diff >= 0, diff, -diff) <= enc.eps)
+        else:
+            s.add(x[i] == xp[i])
+    y = _z3_net(x, weights, biases)
+    yp = _z3_net(xp, weights, biases)
+    s.add(z3.Or(z3.And(y < 0, yp > 0), z3.And(y > 0, yp < 0)))
+
+    res = s.check()
+    if res == z3.sat:
+        m = s.model()
+
+        def val(v):
+            return int(m.eval(v, model_completion=True).as_long())
+
+        return "sat", (np.array([val(v) for v in x], dtype=np.int64),
+                       np.array([val(v) for v in xp], dtype=np.int64))
+    if res == z3.unsat:
+        return "unsat", None
+    return "unknown", None

@@ -10,7 +10,24 @@ import os
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The host image may pre-register an accelerator PJRT plugin (e.g. the
+# tunnelled TPU backend) via sitecustomize; if its relay is unreachable,
+# *any* backend initialization — even with JAX_PLATFORMS=cpu — blocks
+# forever.  Tests are CPU-only by design, so drop every non-CPU backend
+# factory before the first jax use.
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+_BUILTIN = {"cpu", "tpu", "gpu", "cuda", "rocm", "metal"}
+for _name in [n for n in _xb._backend_factories if n not in _BUILTIN]:
+    _xb._backend_factories.pop(_name, None)
+
+import jax  # noqa: E402
+
+# The plugin's registration may have pinned jax_platforms to itself via
+# jax.config, which overrides the env var — pin it back to CPU.
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

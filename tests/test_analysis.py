@@ -1,0 +1,194 @@
+"""L4 analysis suite: metrics, causal tester, localization, repair, hybrid."""
+import numpy as np
+import pytest
+
+from fairify_tpu.analysis import causal, hybrid, localize, metrics, repair
+from fairify_tpu.models import mlp
+
+
+# ---------------------------------------------------------------------------
+# Group metrics (hand-computed oracle values)
+# ---------------------------------------------------------------------------
+
+
+def test_group_metrics_hand_example():
+    #          priv (pa=1): preds 1,1,0,0   unpriv (pa=0): preds 1,0,0,0
+    prot = np.array([1, 1, 1, 1, 0, 0, 0, 0])
+    y_pred = np.array([1, 1, 0, 0, 1, 0, 0, 0])
+    y_true = np.array([1, 0, 1, 0, 1, 0, 1, 0])
+    assert metrics.statistical_parity_difference(y_pred, prot) == pytest.approx(0.25 - 0.5)
+    assert metrics.disparate_impact(y_pred, prot) == pytest.approx(0.5)
+    # TPR priv: y=1 at idx 0,2 → preds 1,0 → 0.5 ; unpriv: idx 4,6 → 1,0 → 0.5
+    assert metrics.equal_opportunity_difference(y_true, y_pred, prot) == pytest.approx(0.0)
+    # FPR priv: y=0 at idx 1,3 → preds 1,0 → 0.5; unpriv idx 5,7 → 0,0 → 0.0
+    assert metrics.average_odds_difference(y_true, y_pred, prot) == pytest.approx(
+        0.5 * ((0.0 - 0.5) + 0.0))
+    err_p = np.mean(y_pred[:4] != y_true[:4])
+    err_u = np.mean(y_pred[4:] != y_true[4:])
+    assert metrics.error_rate_difference(y_true, y_pred, prot) == pytest.approx(err_u - err_p)
+
+
+def test_theil_index_zero_for_perfect():
+    y = np.array([1, 0, 1, 0])
+    assert metrics.theil_index(y, y) == pytest.approx(0.0)
+
+
+def test_consistency_identical_neighbors():
+    X = np.array([[0.0], [0.01], [10.0], [10.01]])
+    y_pred = np.array([1, 1, 0, 0])
+    assert metrics.consistency(X, y_pred, n_neighbors=2) == pytest.approx(1.0)
+
+
+def test_group_report_runs():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(50, 4))
+    rep = metrics.group_report(X, rng.integers(0, 2, 50), rng.integers(0, 2, 50),
+                               rng.integers(0, 2, 50))
+    assert 0.0 <= rep.accuracy <= 1.0
+    assert np.isfinite(rep.theil_index)
+
+
+# ---------------------------------------------------------------------------
+# Causal discrimination
+# ---------------------------------------------------------------------------
+
+
+def _net_pa_biased(d, pa):
+    """Logit = 2*pa - 1: flips with the protected attribute everywhere."""
+    w = np.zeros((d, 1), dtype=np.float32)
+    w[pa, 0] = 2.0
+    return mlp.from_numpy([w], [np.array([-1.0], dtype=np.float32)])
+
+
+def _net_fair(d):
+    w = np.zeros((d, 1), dtype=np.float32)
+    return mlp.from_numpy([w], [np.array([1.0], dtype=np.float32)])
+
+
+def _predictor(net):
+    import jax.numpy as jnp
+
+    return lambda X: np.asarray(mlp.predict(net, jnp.asarray(X, jnp.float32)))
+
+
+def test_causal_rate_biased_net_is_one():
+    net = _net_pa_biased(4, 2)
+    res = causal.causal_discrimination(_predictor(net), [0, 0, 0, 0], [5, 5, 1, 5], 2,
+                                       min_samples=200, max_samples=2000)
+    assert res.rate == pytest.approx(1.0)
+    assert res.examples
+
+
+def test_causal_rate_fair_net_is_zero():
+    net = _net_fair(4)
+    res = causal.causal_discrimination(_predictor(net), [0, 0, 0, 0], [5, 5, 1, 5], 2,
+                                       min_samples=200, max_samples=2000)
+    assert res.rate == pytest.approx(0.0)
+    assert res.interval[1] <= 0.05
+
+
+# ---------------------------------------------------------------------------
+# Localization + masked repair
+# ---------------------------------------------------------------------------
+
+
+def _net_with_pa_neuron(d=4, h=6, pa=1, carrier=3):
+    """Hidden neuron `carrier` reads only the PA; others ignore it."""
+    rng = np.random.default_rng(0)
+    w0 = rng.normal(scale=0.2, size=(d, h)).astype(np.float32)
+    w0[pa, :] = 0.0
+    w0[pa, carrier] = 5.0
+    b0 = np.zeros(h, dtype=np.float32)
+    w1 = rng.normal(scale=0.2, size=(h, 1)).astype(np.float32)
+    w1[carrier, 0] = 5.0
+    return mlp.from_numpy([w0, w1], [b0, np.zeros(1, dtype=np.float32)])
+
+
+def test_localize_finds_carrier_neuron():
+    net = _net_with_pa_neuron()
+    rng = np.random.default_rng(1)
+    pairs = []
+    for _ in range(20):
+        x = rng.integers(0, 4, size=4)
+        xp = x.copy()
+        x[1], xp[1] = 0, 1
+        pairs.append((x, xp))
+    loc = localize.localize(net, pairs, pa_idx=[1], top_k=3)
+    assert loc.skipped_pairs == 0
+    layer, neuron, score = loc.ranked[0]
+    assert (layer, neuron) == (0, 3)
+    assert score > 0
+
+
+def test_localize_skips_malformed_pairs():
+    net = _net_with_pa_neuron()
+    bad = (np.array([0, 0, 0, 0]), np.array([1, 1, 0, 0]))  # differs off-PA too
+    loc = localize.localize(net, [bad], pa_idx=[1])
+    assert loc.skipped_pairs == 1
+
+
+def test_masked_repair_touches_only_target_columns():
+    net = _net_with_pa_neuron()
+    rng = np.random.default_rng(2)
+    X = rng.integers(0, 4, size=(64, 4)).astype(np.float32)
+    y = rng.integers(0, 2, size=64)
+    res = repair.masked_repair(net, [(0, 3)], X, y, epochs=2, lr=1e-2)
+    w0_old, w0_new = np.asarray(net.weights[0]), np.asarray(res.net.weights[0])
+    w1_old, w1_new = np.asarray(net.weights[1]), np.asarray(res.net.weights[1])
+    changed = np.abs(w0_new - w0_old) > 1e-7
+    assert changed[:, 3].any()  # target column moved
+    assert not changed[:, [0, 1, 2, 4, 5]].any()  # others frozen
+    assert np.allclose(w1_old, w1_new)  # output layer frozen
+
+
+def test_counterexample_retrain_respects_floor():
+    net = _net_with_pa_neuron()
+    rng = np.random.default_rng(3)
+    X = rng.integers(0, 4, size=(128, 4)).astype(np.float32)
+    import jax.numpy as jnp
+
+    y = np.asarray(mlp.predict(net, jnp.asarray(X))).astype(int)  # learnable labels
+    pairs = []
+    for _ in range(8):
+        x = rng.integers(0, 4, size=4)
+        xp = x.copy()
+        x[1], xp[1] = 0, 1
+        pairs.append((x.astype(np.float32), xp.astype(np.float32)))
+    res = repair.counterexample_retrain(net, X, y, pairs, X, y,
+                                        stage1_epochs=1, stage2_epochs=2)
+    assert res.net.layer_sizes == net.layer_sizes
+    assert any(str(h["epoch"]).startswith("stage2") for h in res.history)
+
+
+# ---------------------------------------------------------------------------
+# Hybrid routing
+# ---------------------------------------------------------------------------
+
+
+def test_hybrid_routes_by_verdict():
+    d = 2
+    lo = np.array([[0, 0], [5, 0]])
+    hi = np.array([[4, 9], [9, 9]])
+    verdicts = ["sat", "unsat"]
+    original = _net_fair(d)  # always predicts 1
+    w = np.zeros((d, 1), dtype=np.float32)
+    fairer = mlp.from_numpy([w], [np.array([-1.0], dtype=np.float32)])  # always 0
+    X = np.array([[1, 1], [7, 1], [20, 20]])  # sat box, unsat box, miss
+    rep = hybrid.hybrid_predict(X, original, fairer, lo, hi, verdicts)
+    assert rep.predictions.tolist() == [0, 1, 1]
+    assert rep.routed_fair == 1 and rep.routed_original == 1 and rep.routed_miss == 1
+
+
+def test_evaluate_hybrid_report_keys():
+    d = 2
+    lo = np.array([[0, 0]])
+    hi = np.array([[9, 9]])
+    original = _net_fair(d)
+    fairer = _net_fair(d)
+    rng = np.random.default_rng(5)
+    X = rng.integers(0, 10, size=(40, d))
+    y = rng.integers(0, 2, size=40)
+    out = hybrid.evaluate_hybrid(X, y, 1, original, fairer, lo, hi, ["sat"])
+    assert set(out) == {"original", "fairer", "hybrid"}
+    for v in out.values():
+        assert "consistency" in v and "disparate_impact" in v

@@ -1,0 +1,89 @@
+"""CROWN bounds: soundness vs brute-force enumeration, tightness vs IBP."""
+import itertools
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fairify_tpu.models import mlp
+from fairify_tpu.ops import crown, interval
+
+
+def random_net(rng, sizes):
+    ws, bs = [], []
+    for i in range(len(sizes) - 1):
+        ws.append(rng.normal(size=(sizes[i], sizes[i + 1])).astype(np.float32))
+        bs.append(rng.normal(size=(sizes[i + 1],)).astype(np.float32))
+    return mlp.from_numpy(ws, bs)
+
+
+def grid_points(lo, hi):
+    axes = [np.arange(l, h + 1) for l, h in zip(lo, hi)]
+    return np.array(list(itertools.product(*axes)), dtype=np.float32)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("sizes", [(3, 8, 1), (3, 6, 6, 1), (4, 10, 5, 1)])
+def test_crown_sound_and_tighter_than_ibp(seed, sizes):
+    rng = np.random.default_rng(seed)
+    net = random_net(rng, sizes)
+    lo = np.zeros(sizes[0], dtype=np.float32)
+    hi = np.full(sizes[0], 2.0, dtype=np.float32)
+
+    pts = grid_points(lo, hi)
+    logits = np.asarray(mlp.forward(net, jnp.asarray(pts)))
+    true_min, true_max = logits.min(), logits.max()
+
+    ilb, iub = interval.output_bounds(net, jnp.asarray(lo), jnp.asarray(hi))
+    clb, cub = crown.crown_output_bounds(net, jnp.asarray(lo), jnp.asarray(hi))
+
+    # Soundness: both bound the true extrema (grid points are a subset of the box).
+    assert float(ilb) <= true_min + 1e-4 and float(iub) >= true_max - 1e-4
+    assert float(clb) <= true_min + 1e-4 and float(cub) >= true_max - 1e-4
+    # CROWN is never looser than IBP (intersected by construction).
+    assert float(clb) >= float(ilb) - 1e-4
+    assert float(cub) <= float(iub) + 1e-4
+
+
+def test_crown_batched_matches_single():
+    rng = np.random.default_rng(3)
+    net = random_net(rng, (3, 7, 5, 1))
+    los = np.array([[0, 0, 0], [1, 0, 2], [0, 2, 1]], dtype=np.float32)
+    his = np.array([[2, 2, 2], [3, 1, 4], [2, 5, 2]], dtype=np.float32)
+    blb, bub = crown.crown_output_bounds(net, jnp.asarray(los), jnp.asarray(his))
+    for i in range(3):
+        slb, sub = crown.crown_output_bounds(net, jnp.asarray(los[i]), jnp.asarray(his[i]))
+        np.testing.assert_allclose(np.asarray(blb)[i], np.asarray(slb), rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(bub)[i], np.asarray(sub), rtol=1e-5, atol=1e-5)
+
+
+def test_crown_respects_masks():
+    rng = np.random.default_rng(4)
+    net = random_net(rng, (3, 8, 1))
+    # Kill half the hidden layer; bounds must equal those of the excised net.
+    dead = np.zeros(8, dtype=np.float32)
+    dead[:4] = 1.0
+    masked = net.with_masks((jnp.asarray(1.0 - dead), net.masks[1]))
+    excised = mlp.excise(masked)
+    lo = jnp.zeros(3)
+    hi = jnp.full((3,), 3.0)
+    mlb, mub = crown.crown_output_bounds(masked, lo, hi)
+    elb, eub = crown.crown_output_bounds(excised, lo, hi)
+    np.testing.assert_allclose(float(mlb), float(elb), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(float(mub), float(eub), rtol=1e-4, atol=1e-4)
+
+
+def test_crown_stable_layers_exact_for_linear_region():
+    # With inputs confined where all hidden neurons are provably active,
+    # CROWN should be (near-)exact: the net is affine there.
+    ws = [np.array([[1.0, -1.0], [1.0, 1.0]], dtype=np.float32),
+          np.array([[1.0], [2.0]], dtype=np.float32)]
+    bs = [np.array([5.0, 5.0], dtype=np.float32), np.array([-1.0], dtype=np.float32)]
+    net = mlp.from_numpy(ws, bs)
+    lo = jnp.asarray(np.array([0.0, 0.0], dtype=np.float32))
+    hi = jnp.asarray(np.array([1.0, 1.0], dtype=np.float32))
+    clb, cub = crown.crown_output_bounds(net, lo, hi)
+    pts = grid_points([0, 0], [1, 1])
+    logits = np.asarray(mlp.forward(net, jnp.asarray(pts)))
+    assert abs(float(clb) - logits.min()) < 1e-3
+    assert abs(float(cub) - logits.max()) < 1e-3

@@ -1,0 +1,158 @@
+"""Decision engine vs a brute-force oracle on small integer domains.
+
+The oracle enumerates every legal (x, x') pair of the property — the ground
+truth the reference would obtain from Z3 (``src/GC/Verify-GC.py:134-154``) —
+and the engine's verdict must match, with SAT counterexamples validated
+exactly.
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+from fairify_tpu.data.domains import DomainSpec
+from fairify_tpu.models import mlp
+from fairify_tpu.verify import engine, property as prop
+
+
+def tiny_domain(ranges):
+    return DomainSpec(name="tiny", label="y", ranges=dict(ranges))
+
+
+def random_net(rng, sizes, scale=1.0):
+    ws, bs = [], []
+    for i in range(len(sizes) - 1):
+        ws.append((scale * rng.normal(size=(sizes[i], sizes[i + 1]))).astype(np.float32))
+        bs.append((scale * rng.normal(size=(sizes[i + 1],))).astype(np.float32))
+    return mlp.from_numpy(ws, bs)
+
+
+def np_sign(net, x):
+    return engine.exact_logit_sign(
+        [np.asarray(w) for w in net.weights], [np.asarray(b) for b in net.biases], x
+    )
+
+
+def oracle(net, query, lo, hi):
+    """Exhaustive pair enumeration: 'sat' iff any legal pair strictly flips."""
+    enc = prop.encode(query)
+    cols = query.columns
+    d = len(cols)
+    shared_dims = [i for i in range(d) if i not in set(enc.pa_idx.tolist())]
+    axes = [range(int(lo[i]), int(hi[i]) + 1) for i in shared_dims]
+    deltas = (
+        list(itertools.product(range(-enc.eps, enc.eps + 1), repeat=len(enc.ra_idx)))
+        if (len(enc.ra_idx) and enc.eps)
+        else [()]
+    )
+    valid = [
+        i for i in range(enc.n_assign)
+        if all(lo[enc.pa_idx[k]] <= enc.assignments[i, k] <= hi[enc.pa_idx[k]]
+               for k in range(len(enc.pa_idx)))
+    ]
+    for combo in itertools.product(*axes):
+        point = np.zeros(d, dtype=np.int64)
+        point[shared_dims] = combo
+        signs = {}
+        for a in valid:
+            x = point.copy()
+            x[enc.pa_idx] = enc.assignments[a]
+            signs[a] = np_sign(net, x)
+        for a in valid:
+            for b in valid:
+                if not enc.valid_pair[a, b]:
+                    continue
+                for dl in deltas:
+                    xp = point.copy()
+                    xp[enc.pa_idx] = enc.assignments[b]
+                    for k, dv in enumerate(dl):
+                        xp[enc.ra_idx[k]] += dv
+                    sp = signs[b] if (not dl or all(v == 0 for v in dl)) else np_sign(net, xp)
+                    if (signs[a] > 0 and sp < 0) or (signs[a] < 0 and sp > 0):
+                        return "sat"
+    return "unsat"
+
+
+CFG = engine.EngineConfig(frontier_size=64, attack_samples=32, bab_attack_samples=8,
+                          soft_timeout_s=60.0, max_nodes=50_000)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_engine_matches_oracle_basic(seed):
+    rng = np.random.default_rng(seed)
+    dom = tiny_domain({"a": (0, 3), "b": (0, 2), "pa": (0, 1), "c": (0, 2)})
+    query = prop.FairnessQuery(domain=dom, protected=("pa",))
+    net = random_net(rng, (4, 6, 1))
+    enc = prop.encode(query)
+    lo, hi = dom.lo_hi()
+    want = oracle(net, query, lo.astype(np.int64), hi.astype(np.int64))
+    got = engine.decide_box(net, enc, lo.astype(np.int64), hi.astype(np.int64), CFG)
+    assert got.verdict == want
+    if got.verdict == "sat":
+        x, xp = got.counterexample
+        ws = [np.asarray(w) for w in net.weights]
+        bs = [np.asarray(b) for b in net.biases]
+        assert engine.validate_pair(ws, bs, x, xp)
+        # Pair is legal: equal off-PA, differing on PA, inside box on x.
+        pa = set(enc.pa_idx.tolist())
+        for i in range(len(x)):
+            if i in pa:
+                assert x[i] != xp[i]
+            else:
+                assert x[i] == xp[i]
+        assert (x >= lo.astype(np.int64)).all() and (x <= hi.astype(np.int64)).all()
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_engine_matches_oracle_relaxed(seed):
+    rng = np.random.default_rng(100 + seed)
+    dom = tiny_domain({"a": (0, 3), "pa": (0, 1), "r": (0, 4)})
+    query = prop.FairnessQuery(domain=dom, protected=("pa",), relaxed=("r",), relax_eps=2)
+    net = random_net(rng, (3, 5, 1))
+    enc = prop.encode(query)
+    lo, hi = dom.lo_hi()
+    want = oracle(net, query, lo.astype(np.int64), hi.astype(np.int64))
+    got = engine.decide_box(net, enc, lo.astype(np.int64), hi.astype(np.int64), CFG)
+    assert got.verdict == want
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_engine_matches_oracle_multi_pa(seed):
+    rng = np.random.default_rng(200 + seed)
+    dom = tiny_domain({"a": (0, 2), "pa1": (0, 1), "b": (0, 2), "pa2": (0, 2)})
+    query = prop.FairnessQuery(domain=dom, protected=("pa1", "pa2"))
+    net = random_net(rng, (4, 5, 1))
+    enc = prop.encode(query)
+    lo, hi = dom.lo_hi()
+    want = oracle(net, query, lo.astype(np.int64), hi.astype(np.int64))
+    got = engine.decide_box(net, enc, lo.astype(np.int64), hi.astype(np.int64), CFG)
+    assert got.verdict == want
+
+
+def test_engine_constant_positive_net_unsat():
+    # Output weight 0, bias +1: logit ≡ 1 > 0 everywhere → provably fair.
+    ws = [np.zeros((3, 4), dtype=np.float32), np.zeros((4, 1), dtype=np.float32)]
+    bs = [np.zeros(4, dtype=np.float32), np.ones(1, dtype=np.float32)]
+    net = mlp.from_numpy(ws, bs)
+    dom = tiny_domain({"a": (0, 50), "pa": (0, 1), "b": (0, 50)})
+    query = prop.FairnessQuery(domain=dom, protected=("pa",))
+    enc = prop.encode(query)
+    lo, hi = dom.lo_hi()
+    got = engine.decide_box(net, enc, lo.astype(np.int64), hi.astype(np.int64), CFG)
+    assert got.verdict == "unsat"
+    assert got.nodes == 1  # certified at the root, no splitting
+
+
+def test_engine_pa_direct_dependence_sat():
+    # Logit = +1 if pa=1 else -1 → every shared point is a counterexample.
+    ws = [np.array([[0.0], [2.0], [0.0]], dtype=np.float32)]
+    bs = [np.array([-1.0], dtype=np.float32)]
+    net = mlp.from_numpy(ws, bs)
+    dom = tiny_domain({"a": (0, 10), "pa": (0, 1), "b": (0, 10)})
+    query = prop.FairnessQuery(domain=dom, protected=("pa",))
+    enc = prop.encode(query)
+    lo, hi = dom.lo_hi()
+    got = engine.decide_box(net, enc, lo.astype(np.int64), hi.astype(np.int64), CFG)
+    assert got.verdict == "sat"
+    x, xp = got.counterexample
+    assert x[1] != xp[1]

@@ -1,0 +1,104 @@
+"""End-to-end sweep: verdicts vs oracle, CSV/ledger output, resume, mesh."""
+import csv
+import os
+
+import numpy as np
+import pytest
+
+from fairify_tpu.data import domains as dom_mod
+from fairify_tpu.data.domains import DomainSpec
+from fairify_tpu.models import mlp
+from fairify_tpu.verify import engine, presets, property as prop, sweep
+from fairify_tpu.verify.config import SweepConfig
+from tests.test_engine import oracle, random_net
+
+
+@pytest.fixture()
+def tiny_registered(monkeypatch):
+    dom = DomainSpec(name="tinysweep", label="y",
+                     ranges={"a": (0, 9), "pa": (0, 1), "b": (0, 4)})
+    monkeypatch.setitem(dom_mod.DOMAINS, "tinysweep", dom)
+    return dom
+
+
+def make_cfg(tmp_path, **kw):
+    base = dict(
+        name="tiny", dataset="tinysweep", protected=("pa",),
+        partition_threshold=5, sim_size=64, soft_timeout_s=30.0,
+        hard_timeout_s=600.0, result_dir=str(tmp_path),
+        engine=engine.EngineConfig(frontier_size=64, attack_samples=32,
+                                   bab_attack_samples=8, soft_timeout_s=30.0),
+    )
+    base.update(kw)
+    return SweepConfig(**base)
+
+
+def test_sweep_matches_oracle_and_writes_outputs(tmp_path, tiny_registered):
+    rng = np.random.default_rng(7)
+    net = random_net(rng, (3, 6, 1))
+    cfg = make_cfg(tmp_path)
+    report = sweep.verify_model(net, cfg, model_name="tiny-1")
+
+    p_list, lo, hi = sweep.build_partitions(cfg)
+    assert report.partitions_total == len(p_list) == 2  # 'a' chunked in two
+    query = cfg.query()
+    for out, l, h in zip(report.outcomes, lo, hi):
+        assert out.verdict == oracle(net, query, l, h)
+        if out.verdict == "sat":
+            assert out.v_accurate == 1
+
+    csv_path = os.path.join(str(tmp_path), "tiny-1.csv")
+    with open(csv_path) as fp:
+        rows = list(csv.reader(fp))
+    assert rows[0] == sweep.csvio.RES_COLS
+    assert len(rows) == 1 + len(report.outcomes)
+
+    # Resume: a second run replays the ledger, adds no CSV rows.
+    report2 = sweep.verify_model(net, cfg, model_name="tiny-1")
+    assert [o.verdict for o in report2.outcomes] == [o.verdict for o in report.outcomes]
+    with open(csv_path) as fp:
+        assert len(list(csv.reader(fp))) == len(rows)
+
+
+def test_sweep_verdicts_mesh_invariant(tmp_path, tiny_registered):
+    import jax
+
+    rng = np.random.default_rng(11)
+    net = random_net(rng, (3, 5, 1))
+    cfg = make_cfg(tmp_path, result_dir=str(tmp_path / "single"))
+    rep1 = sweep.verify_model(net, cfg, model_name="m")
+
+    from fairify_tpu.parallel import mesh as mesh_mod
+
+    assert len(jax.devices()) == 8  # conftest forces the virtual CPU mesh
+    mesh = mesh_mod.make_mesh(n_parts=8, n_models=1)
+    cfg2 = make_cfg(tmp_path, result_dir=str(tmp_path / "mesh"))
+    rep2 = sweep.verify_model(net, cfg2, model_name="m", mesh=mesh)
+    assert sorted(o.verdict for o in rep1.outcomes) == sorted(o.verdict for o in rep2.outcomes)
+
+
+def test_presets_cover_all_drivers():
+    names = presets.names()
+    assert len(names) == 17  # 5 base + 3 stress + 3 relaxed + 3+3 targeted
+    for n in names:
+        cfg = presets.get(n)
+        q = cfg.query()  # builds without error, drops phantom attributes
+        assert len(q.protected) >= 1
+        enc = prop.encode(q)
+        assert enc.valid_pair.any()
+
+
+def test_partition_counts_match_reference_shapes():
+    # German base config: credit_amount (0..20000) is the only attribute wider
+    # than 100 → ceil(20001/100) = 201 partitions (src/GC/Verify-GC.py:63).
+    cfg = presets.get("GC")
+    p_list, lo, hi = sweep.build_partitions(cfg)
+    assert len(p_list) == 201
+    # Compas: Number_of_Priors 0..38 at threshold 5 → 8 chunks.
+    cfg = presets.get("CP")
+    p_list, _, _ = sweep.build_partitions(cfg)
+    assert len(p_list) == 8
+    # DF capped: at most max_partitions boxes.
+    cfg = presets.get("DF")
+    p_list, _, _ = sweep.build_partitions(cfg)
+    assert len(p_list) <= 100
