@@ -1,0 +1,77 @@
+"""Multi-host (DCN) scaling of the partition sweep.
+
+The reference has no distributed runtime (SURVEY.md §0, §5.8) — its cluster
+story is provisioning notebooks that run independent processes.  The rebuild
+treats multi-host as a first-class axis:
+
+* **Inside a host/pod**: the ``(parts, models)`` mesh of
+  :mod:`fairify_tpu.parallel.mesh`; XLA collectives ride ICI.
+* **Across hosts**: `jax.distributed` + a global mesh; each process feeds
+  its addressable shard of the partition grid, and per-partition verdict
+  summaries are combined with a device all-gather over DCN (below), while
+  the JSONL ledger (one per host) provides the crash-resume story.
+
+With one process this degrades to the single-host path, so everything here
+is exercised in CI; the multi-process path follows jax's standard
+initialize() contract.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join (or no-op into) a jax distributed runtime.
+
+    Call once per process before device use; with no arguments jax reads the
+    standard cluster env vars. Single-process callers may skip entirely.
+    """
+    import jax
+
+    if num_processes is not None and num_processes <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def host_slice(n_partitions: int, process_index: Optional[int] = None,
+               process_count: Optional[int] = None) -> Tuple[int, int]:
+    """Contiguous [start, stop) slice of the partition grid owned by this host.
+
+    Deterministic balanced split so any host can recompute every other
+    host's assignment (needed to merge ledgers after a crash).
+    """
+    import jax
+
+    pi = jax.process_index() if process_index is None else process_index
+    pc = jax.process_count() if process_count is None else process_count
+    base, rem = divmod(n_partitions, pc)
+    start = pi * base + min(pi, rem)
+    stop = start + base + (1 if pi < rem else 0)
+    return start, stop
+
+
+def allgather_verdicts(local_codes: np.ndarray, mesh=None) -> np.ndarray:
+    """All-gather per-partition verdict codes across the mesh (DCN/ICI).
+
+    ``local_codes``: int8 array (local_P,) with 0=unknown, 1=sat, 2=unsat.
+    Returns the concatenated global array on every host.  Uses
+    `jax.experimental.multihost_utils` when running multi-process; identity
+    on one process.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return np.asarray(local_codes)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(
+        multihost_utils.process_allgather(np.asarray(local_codes), tiled=True)
+    )

@@ -1,0 +1,67 @@
+"""Observability: XLA profiler hooks + partition-throughput counters.
+
+The reference's only tracing is wall-clock subtraction per CSV row
+(``utils/verif_utils.py:562-565``; SURVEY.md §5.1).  The rebuild keeps that
+schema (:mod:`fairify_tpu.utils.timing`) and adds what a TPU deployment
+actually needs: optional XLA device traces (viewable in TensorBoard/XProf)
+around the hot kernels, and a throughput counter for the north-star metric
+(verified partitions/sec/chip, BASELINE.json).
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@contextlib.contextmanager
+def xla_trace(trace_dir: Optional[str]):
+    """Wrap a region in a jax profiler trace when ``trace_dir`` is set."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield
+
+
+@dataclass
+class ThroughputCounter:
+    """Decided-partitions/sec accounting, per phase and per chip."""
+
+    started_at: float = field(default_factory=time.perf_counter)
+    decided: int = 0
+    stage0_decided: int = 0
+    bab_decided: int = 0
+    unknown: int = 0
+    n_devices: int = 1
+
+    def record(self, verdict: str, via_stage0: bool) -> None:
+        if verdict in ("sat", "unsat"):
+            self.decided += 1
+            if via_stage0:
+                self.stage0_decided += 1
+            else:
+                self.bab_decided += 1
+        else:
+            self.unknown += 1
+
+    def summary(self) -> Dict[str, float]:
+        elapsed = max(time.perf_counter() - self.started_at, 1e-9)
+        pps = self.decided / elapsed
+        return {
+            "elapsed_s": round(elapsed, 3),
+            "decided": self.decided,
+            "stage0_decided": self.stage0_decided,
+            "bab_decided": self.bab_decided,
+            "unknown": self.unknown,
+            "partitions_per_sec": round(pps, 4),
+            "partitions_per_sec_per_chip": round(pps / max(self.n_devices, 1), 4),
+        }
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as fp:
+            json.dump(self.summary(), fp, indent=2)

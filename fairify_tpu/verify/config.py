@@ -35,6 +35,7 @@ class SweepConfig:
     exact_certify_masks: bool = True
     engine: EngineConfig = field(default_factory=EngineConfig)
     result_dir: str = "res"
+    profile_dir: Optional[str] = None  # XLA trace output (TensorBoard/XProf)
 
     def query(self) -> FairnessQuery:
         domain = get_domain(self.dataset)

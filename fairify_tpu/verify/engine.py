@@ -277,40 +277,85 @@ def _pad(arr: np.ndarray, n: int) -> np.ndarray:
     return np.concatenate([arr, pad], axis=0)
 
 
-def decide_box(
+def decide_many(
     net: MLP,
     enc: PairEncoding,
-    lo: np.ndarray,
-    hi: np.ndarray,
+    roots_lo: np.ndarray,
+    roots_hi: np.ndarray,
     cfg: EngineConfig,
-) -> Decision:
-    """Complete decision for one partition box via batched branch-and-bound."""
+    deadline_s: Optional[float] = None,
+) -> list:
+    """Branch-and-bound over MANY root boxes sharing one device frontier.
+
+    The reference decides partitions serially, one Z3 call each
+    (``src/GC/Verify-GC.py:106``).  Here every undecided partition
+    contributes sub-boxes to a single padded frontier, so one CROWN launch
+    and one attack forward serve all of them — sub-boxes of easy and hard
+    partitions ride the same MXU batch.  Per root: verdict 'sat' retires
+    all its sub-boxes immediately; exceeding ``max_nodes`` (per root) or the
+    global deadline marks it 'unknown'; an emptied sub-tree is 'unsat'.
+
+    ``deadline_s`` defaults to ``soft_timeout_s × n_roots`` — the same total
+    budget the reference would spend, but shared work-conservingly.
+    """
     from fairify_tpu.verify.property import role_boxes
 
     t0 = time.perf_counter()
+    R = roots_lo.shape[0]
+    if deadline_s is None:
+        deadline_s = cfg.soft_timeout_s * max(R, 1)
     rng = np.random.default_rng(cfg.seed)
     weights = [np.asarray(w) for w in net.weights]
     biases = [np.asarray(b) for b in net.biases]
-    branch_dims = _branch_dims(enc, len(lo))
-
-    frontier_lo = [np.asarray(lo, dtype=np.int64)]
-    frontier_hi = [np.asarray(hi, dtype=np.int64)]
-    nodes = 0
-    leaves = 0
+    branch_dims = _branch_dims(enc, roots_lo.shape[1])
     F = cfg.frontier_size
 
-    while frontier_lo:
-        if nodes > cfg.max_nodes or (time.perf_counter() - t0) > cfg.soft_timeout_s:
-            return Decision(
-                "unknown", nodes=nodes, leaves=leaves, elapsed_s=time.perf_counter() - t0
-            )
-        batch = min(F, len(frontier_lo))
-        blo = np.stack(frontier_lo[:batch])
-        bhi = np.stack(frontier_hi[:batch])
-        del frontier_lo[:batch], frontier_hi[:batch]
-        nodes += batch
+    from collections import deque
 
-        # Pad to the compiled frontier width to avoid shape churn.
+    frontier = deque(
+        (np.asarray(roots_lo[r], dtype=np.int64), np.asarray(roots_hi[r], dtype=np.int64), r)
+        for r in range(R)
+    )
+
+    verdicts: list = [None] * R
+    ces: list = [None] * R
+    nodes = np.zeros(R, dtype=np.int64)
+    leaves = np.zeros(R, dtype=np.int64)
+    open_boxes = np.ones(R, dtype=np.int64)  # root boxes still in the frontier
+    cost_s = np.zeros(R, dtype=np.float64)  # per-root attributed batch time
+
+    def settle(r: int, verdict: str, ce=None):
+        if verdicts[r] is None:
+            verdicts[r] = verdict
+            ces[r] = ce
+
+    while frontier:
+        timed_out = (time.perf_counter() - t0) > deadline_s
+        if timed_out:
+            for _, _, r in frontier:
+                settle(r, "unknown")
+            break
+
+        t_iter = time.perf_counter()
+        # Pop a batch, dropping sub-boxes of roots that settled meanwhile.
+        blo_l, bhi_l, broot_l = [], [], []
+        while frontier and len(blo_l) < F:
+            l, h, r = frontier.popleft()
+            if verdicts[r] is not None:
+                continue
+            blo_l.append(l)
+            bhi_l.append(h)
+            broot_l.append(r)
+        if not blo_l:
+            break
+        batch = len(blo_l)
+        blo, bhi, broot = np.stack(blo_l), np.stack(bhi_l), np.array(broot_l)
+        for r in broot:
+            open_boxes[r] -= 1
+        np.add.at(nodes, broot, 1)
+
+        live = np.array([verdicts[r] is None for r in broot])
+
         plo = _pad(blo, F).astype(np.float32)
         phi = _pad(bhi, F).astype(np.float32)
         x_lo, x_hi, xp_lo, xp_hi, valid = role_boxes(enc, plo, phi)
@@ -320,49 +365,81 @@ def decide_box(
         )
         certified = no_flip_certified(lb_x, ub_x, lb_p, ub_p, valid, enc.valid_pair)[:batch]
 
-        undecided = np.where(~certified)[0]
-        if undecided.size == 0:
-            continue
+        undecided = np.where(~certified & live)[0]
+        if undecided.size:
+            # Attack the undecided boxes (padded so the forward compiles once).
+            ulo, uhi = _pad(blo[undecided], F), _pad(bhi[undecided], F)
+            xr, pr = build_attack_candidates(enc, rng, ulo, uhi, cfg.bab_attack_samples)
+            lx, lp = _attack_logits(net, jnp.asarray(xr), jnp.asarray(pr))
+            found, wit = find_flips(
+                enc, np.asarray(lx), np.asarray(lp), _pad(valid[undecided], F)
+            )
+            found = found[: undecided.size]
+            for k in np.where(found)[0]:
+                r = int(broot[undecided[k]])
+                if verdicts[r] is not None:
+                    continue
+                s, a, b = wit[k]
+                x = xr[k, s, a].astype(np.int64)
+                xp = pr[k, s, b].astype(np.int64)
+                if validate_pair(weights, biases, x, xp):
+                    settle(r, "sat", (x, xp))
 
-        # Attack the undecided boxes (padded to the frontier width so the
-        # jitted forward compiles once, not per undecided-count).
-        ulo, uhi = _pad(blo[undecided], F), _pad(bhi[undecided], F)
-        xr, pr = build_attack_candidates(enc, rng, ulo, uhi, cfg.bab_attack_samples)
-        lx, lp = _attack_logits(net, jnp.asarray(xr), jnp.asarray(pr))
-        found, wit = find_flips(
-            enc, np.asarray(lx), np.asarray(lp), _pad(valid[undecided], F)
-        )
-        found = found[: undecided.size]
-        for i in np.where(found)[0]:
-            s, a, b = wit[i]
-            x = xr[i, s, a].astype(np.int64)
-            xp = pr[i, s, b].astype(np.int64)
-            if validate_pair(weights, biases, x, xp):
-                return Decision(
-                    "sat", (x, xp), nodes=nodes, leaves=leaves,
-                    elapsed_s=time.perf_counter() - t0,
-                )
+            for k in undecided:
+                r = int(broot[k])
+                if verdicts[r] is not None:
+                    continue
+                if nodes[r] > cfg.max_nodes:
+                    settle(r, "unknown")
+                    continue
+                l, h = blo[k], bhi[k]
+                widths = h[branch_dims] - l[branch_dims]
+                if widths.size == 0 or widths.max() == 0:
+                    leaves[r] += 1
+                    verdict, ce = decide_leaf(enc, weights, biases, l.copy(), l, h)
+                    if verdict == "sat":
+                        settle(r, "sat", ce)
+                    continue
+                dim = branch_dims[int(widths.argmax())]
+                mid = (l[dim] + h[dim]) // 2
+                left_hi = h.copy()
+                left_hi[dim] = mid
+                right_lo = l.copy()
+                right_lo[dim] = mid + 1
+                frontier.append((l, left_hi, r))
+                frontier.append((right_lo, h, r))
+                open_boxes[r] += 2
 
-        # Split or exactly decide leaves.
-        for i in undecided:
-            l, h = blo[i], bhi[i]
-            widths = h[branch_dims] - l[branch_dims]
-            if widths.size == 0 or widths.max() == 0:
-                leaves += 1
-                verdict, ce = decide_leaf(enc, weights, biases, l.copy(), l, h)
-                if verdict == "sat":
-                    return Decision(
-                        "sat", ce, nodes=nodes, leaves=leaves,
-                        elapsed_s=time.perf_counter() - t0,
-                    )
-                continue
-            dim = branch_dims[int(widths.argmax())]
-            mid = (l[dim] + h[dim]) // 2
-            left_hi = h.copy()
-            left_hi[dim] = mid
-            right_lo = l.copy()
-            right_lo[dim] = mid + 1
-            frontier_lo.extend([l, right_lo])
-            frontier_hi.extend([left_hi, h])
+        # Attribute this iteration's wall time to its roots, per sub-box, so
+        # per-root costs are additive (sum ≈ total phase time).
+        iter_dt = time.perf_counter() - t_iter
+        np.add.at(cost_s, broot, iter_dt / batch)
 
-    return Decision("unsat", nodes=nodes, leaves=leaves, elapsed_s=time.perf_counter() - t0)
+        # Roots whose sub-tree emptied without a counterexample are fair.
+        for r in set(int(x) for x in broot):
+            if verdicts[r] is None and open_boxes[r] == 0:
+                settle(r, "unsat")
+
+    for r in range(R):
+        if verdicts[r] is None:
+            settle(r, "unsat" if open_boxes[r] == 0 else "unknown")
+
+    return [
+        Decision(verdicts[r], ces[r], nodes=int(nodes[r]), leaves=int(leaves[r]),
+                 elapsed_s=float(cost_s[r]))
+        for r in range(R)
+    ]
+
+
+def decide_box(
+    net: MLP,
+    enc: PairEncoding,
+    lo: np.ndarray,
+    hi: np.ndarray,
+    cfg: EngineConfig,
+) -> Decision:
+    """Complete decision for one partition box (single-root wrapper)."""
+    return decide_many(
+        net, enc, np.asarray(lo)[None, :], np.asarray(hi)[None, :], cfg,
+        deadline_s=cfg.soft_timeout_s,
+    )[0]

@@ -131,6 +131,73 @@ def _stage0_certify_and_attack(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
     return unsat, sat, witnesses
 
 
+def _stage0_family(stacked, enc: PairEncoding, lo, hi, cfg: SweepConfig, mesh=None):
+    """Stage 0 for a whole same-architecture model family in one kernel.
+
+    The reference iterates models serially (``src/GC/Verify-GC.py:79``); here
+    the family is a stacked weight pytree and `vmap` lifts the role-bound and
+    attack kernels over the model axis, so the MXU sees one
+    (models × partitions × assignments) batch.  Returns per-model
+    (unsat, sat, witnesses) tuples.
+    """
+    import jax
+
+    from fairify_tpu.models.mlp import MLP, forward
+
+    M = stacked.weights[0].shape[0]
+    x_lo, x_hi, xp_lo, xp_hi, valid = role_boxes(
+        enc, lo.astype(np.float32), hi.astype(np.float32)
+    )
+    if mesh is not None:
+        from fairify_tpu.parallel import mesh as mesh_mod
+
+        x_lo, x_hi, xp_lo, xp_hi = mesh_mod.shard_parts(mesh, x_lo, x_hi, xp_lo, xp_hi)
+        stacked = mesh_mod.replicated(mesh, stacked)
+
+    @jax.jit
+    def family_bounds(stacked, a, b, c, d, use_crown):
+        return jax.vmap(
+            lambda net: engine._role_logit_bounds.__wrapped__(net, a, b, c, d, use_crown)
+        )(MLP(stacked.weights, stacked.biases, stacked.masks))
+
+    lb_x, ub_x, lb_p, ub_p = family_bounds(
+        stacked, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
+        jnp.asarray(xp_hi), cfg.engine.use_crown,
+    )
+    lb_x, ub_x, lb_p, ub_p = (np.asarray(v)[:, : lo.shape[0]] for v in (lb_x, ub_x, lb_p, ub_p))
+
+    rng = np.random.default_rng(cfg.engine.seed)
+    xr, pr = engine.build_attack_candidates(enc, rng, lo, hi, cfg.engine.attack_samples)
+
+    @jax.jit
+    def family_logits(stacked, xr, pr):
+        net = MLP(stacked.weights, stacked.biases, stacked.masks)
+        return jax.vmap(lambda n: (forward(n, xr), forward(n, pr)))(net)
+
+    lx, lp = family_logits(stacked, jnp.asarray(xr), jnp.asarray(pr))
+    lx, lp = np.asarray(lx), np.asarray(lp)
+
+    results = []
+    for m in range(M):
+        unsat = engine.no_flip_certified(
+            lb_x[m], ub_x[m], lb_p[m], ub_p[m], valid, enc.valid_pair
+        )
+        found, wit = engine.find_flips(enc, lx[m], lp[m], valid)
+        weights = [np.asarray(w[m]) for w in stacked.weights]
+        biases = [np.asarray(b[m]) for b in stacked.biases]
+        witnesses = {}
+        for i in np.where(found)[0]:
+            s, a, b = wit[i]
+            x = xr[i, s, a].astype(np.int64)
+            xp = pr[i, s, b].astype(np.int64)
+            if engine.validate_pair(weights, biases, x, xp):
+                witnesses[int(i)] = (x, xp)
+        sat = np.zeros(lo.shape[0], dtype=bool)
+        sat[list(witnesses)] = True
+        results.append((unsat, sat, witnesses))
+    return results
+
+
 def _pruned_accuracy(net, masked_net, sim: np.ndarray) -> float:
     """Prediction parity of masked vs original net on simulated inputs
     (``pruned_acc``, ``src/GC/Verify-GC.py:265-270``)."""
@@ -171,6 +238,7 @@ def verify_model(
     dataset: Optional[loaders.LoadedDataset] = None,
     mesh=None,
     resume: bool = True,
+    stage0=None,
 ) -> ModelReport:
     """Run the full sweep for one model; write CSV + ledger rows as we go."""
     timer = PhaseTimer()
@@ -184,24 +252,50 @@ def verify_model(
     done = _load_ledger(ledger_path) if resume else {}
     csv_path = os.path.join(cfg.result_dir, f"{model_name}.csv")
 
-    with timer.phase("stage0_prune"):
-        prune = pruning.sound_prune_grid(
-            net, lo, hi, cfg.sim_size, cfg.seed, exact_certify=cfg.exact_certify_masks
-        )
-    with timer.phase("stage0_decide"):
-        unsat0, sat0, witnesses = _stage0_certify_and_attack(net, enc, lo, hi, cfg, mesh=mesh)
-    stage0_per_part = (timer.get("stage0_prune") + timer.get("stage0_decide")) / max(P, 1)
+    from fairify_tpu.utils.profiling import ThroughputCounter, xla_trace
+
+    counter = ThroughputCounter(n_devices=1 if mesh is None else int(np.prod(list(mesh.shape.values()))))
+    with xla_trace(cfg.profile_dir):
+        with timer.phase("stage0_prune"):
+            prune = pruning.sound_prune_grid(
+                net, lo, hi, cfg.sim_size, cfg.seed, exact_certify=cfg.exact_certify_masks
+            )
+        with timer.phase("stage0_decide"):
+            if stage0 is not None:  # precomputed by the stacked family kernel
+                unsat0, sat0, witnesses = stage0
+            else:
+                unsat0, sat0, witnesses = _stage0_certify_and_attack(
+                    net, enc, lo, hi, cfg, mesh=mesh)
+        stage0_per_part = (timer.get("stage0_prune") + timer.get("stage0_decide")) / max(P, 1)
+
+        outcomes: List[PartitionOutcome] = []
+        sat_count = unsat_count = unk_count = 0
+        weights = [np.asarray(w) for w in net.weights]
+        biases = [np.asarray(b) for b in net.biases]
+
+        # Batched refinement: every stage-0 leftover shares one BaB frontier
+        # (engine.decide_many).  The hard budget caps the phase after stage-0
+        # spend — the reference's cumulative-break semantics
+        # (``src/GC/Verify-GC.py:312-314``) applied to actual solver work;
+        # verdicts already computed are always reported (the reporting loop
+        # itself is cheap and never discards work).
+        pending = [p for p in range(P)
+                   if (p + 1) not in done and not sat0[p] and not unsat0[p]]
+        bab: Dict[int, engine.Decision] = {}
+        if pending:
+            hard_left = max(cfg.hard_timeout_s - timer.total(), 1.0)
+            deadline = min(cfg.soft_timeout_s * len(pending), hard_left)
+            with timer.phase("bab"):
+                decisions = engine.decide_many(
+                    net, enc, lo[pending], hi[pending], cfg.engine, deadline_s=deadline
+                )
+            bab = dict(zip(pending, decisions))
+    cumulative = timer.total()
 
     orig_acc = 0.0
     if dataset is not None:
         pred = np.asarray(mlp_mod.predict(net, jnp.asarray(dataset.X_test, jnp.float32)))
         orig_acc = float((pred.astype(int) == dataset.y_test).mean())
-
-    outcomes: List[PartitionOutcome] = []
-    sat_count = unsat_count = unk_count = 0
-    cumulative = 0.0
-    weights = [np.asarray(w) for w in net.weights]
-    biases = [np.asarray(b) for b in net.biases]
 
     for p in range(P):
         pid = p + 1
@@ -226,16 +320,11 @@ def verify_model(
         elif unsat0[p]:
             verdict = "unsat"
         else:
-            ecfg = cfg.engine
-            budget = cfg.soft_timeout_s
-            dec = engine.decide_box(
-                net, enc, lo[p], hi[p],
-                engine.EngineConfig(**{**ecfg.__dict__, "soft_timeout_s": budget}),
-            )
-            sv_time = dec.elapsed_s
+            dec = bab[p]
+            sv_time = dec.elapsed_s  # per-root attributed cost (engine.decide_many)
             nodes = dec.nodes
             verdict, ce = dec.verdict, dec.counterexample
-            if verdict == "unknown":
+            if verdict == "unknown" and cumulative <= cfg.hard_timeout_s:
                 # Heuristic retry: kill borderline-quiet neurons, re-decide on
                 # the masked net (``src/GC/Verify-GC.py:172-211``).
                 h_attempt = 1
@@ -248,7 +337,9 @@ def verify_model(
                 h_net = mask_ops.apply_dead_masks(net, [jnp.asarray(d) for d in merged])
                 dec2 = engine.decide_box(
                     h_net, enc, lo[p], hi[p],
-                    engine.EngineConfig(**{**ecfg.__dict__, "soft_timeout_s": budget}),
+                    engine.EngineConfig(
+                        **{**cfg.engine.__dict__, "soft_timeout_s": cfg.soft_timeout_s}
+                    ),
                 )
                 hv_time = dec2.elapsed_s
                 h_time = time.perf_counter() - t_h
@@ -275,9 +366,12 @@ def verify_model(
             unsat_count += 1
         else:
             unk_count += 1
+        counter.record(verdict, via_stage0=bool(sat0[p] or unsat0[p]))
 
-        total_time = stage0_per_part + (time.perf_counter() - t_part)
-        cumulative += total_time
+        # Per-row accounting: amortized stage-0 share + this row's attributed
+        # BaB cost (sv_time) + its own loop work (heuristic retry, replay).
+        total_time = stage0_per_part + sv_time + (time.perf_counter() - t_part)
+        cumulative += time.perf_counter() - t_part
         comp = {
             "b": mask_ops.compression_ratio([l[p] for l in prune.b_deads]),
             "s": mask_ops.compression_ratio([l[p] for l in prune.s_deads]),
@@ -312,8 +406,9 @@ def verify_model(
                 "time_s": round(total_time, 4),
             }) + "\n")
 
-        if cumulative > cfg.hard_timeout_s:  # per-model budget, :312-314
-            break
+        # Hard budget is enforced where work happens: the BaB deadline above
+        # and the heuristic-retry guard.  Verdicts already computed are always
+        # reported — no work is discarded by a reporting-loop break.
 
     # Counterexample CSV, encoded form (``src/CP/Verify-CP.py:310-326``);
     # decoded form available via analysis.decode.counterexample_table.
@@ -332,21 +427,62 @@ def verify_model(
                 wr.writerow([pid, "x"] + [int(v) for v in x])
                 wr.writerow([pid, "x'"] + [int(v) for v in xp])
 
+    counter.dump(os.path.join(cfg.result_dir, f"{cfg.name}-{model_name}.throughput.json"))
     return ModelReport(
         model=model_name, dataset=cfg.dataset, outcomes=outcomes,
         original_acc=orig_acc, total_time_s=timer.total(), partitions_total=P,
     )
 
 
-def run_sweep(cfg: SweepConfig, model_root=None, data_root=None, mesh=None) -> List[ModelReport]:
-    """Sweep every model of the configured family (the drivers' outer loop)."""
+def run_sweep(
+    cfg: SweepConfig, model_root=None, data_root=None, mesh=None, stack: bool = True
+) -> List[ModelReport]:
+    """Sweep every model of the configured family (the drivers' outer loop).
+
+    With ``stack=True``, models sharing an architecture get their stage-0
+    certificates and attacks from one vmapped family kernel (e.g. the eleven
+    32-32-1 CP nets run as a single batch) before per-model refinement.
+    """
+    import sys
+
     dataset = loaders.load(cfg.dataset, root=data_root)
-    reports = []
+    n_attrs = len(cfg.query().columns)
+    nets = {}
     for path in zoo.model_paths(cfg.dataset, root=model_root):
         if cfg.models is not None and path.stem not in cfg.models:
             continue
         net = zoo.load(cfg.dataset, path.stem, root=model_root)
+        if net.in_dim != n_attrs:
+            # e.g. the 12-input CP notebook models vs the 6-attribute domain.
+            print(f"skipping {path.stem}: input dim {net.in_dim} != "
+                  f"domain dim {n_attrs}", file=sys.stderr)
+            continue
+        nets[path.stem] = net
+    if not nets:
+        return []
+
+    stage0_by_model = {}
+    if stack:
+        from collections import defaultdict
+
+        from fairify_tpu.parallel.mesh import stack_models
+
+        groups = defaultdict(list)
+        for name, net in nets.items():
+            groups[(net.in_dim,) + net.layer_sizes].append(name)
+        enc = encode(cfg.query())
+        _, lo, hi = build_partitions(cfg)
+        for names in groups.values():
+            if len(names) < 2:
+                continue
+            stacked = stack_models([nets[n] for n in names])
+            for name, s0 in zip(names, _stage0_family(stacked, enc, lo, hi, cfg, mesh=mesh)):
+                stage0_by_model[name] = s0
+
+    reports = []
+    for name, net in nets.items():
         reports.append(
-            verify_model(net, cfg, model_name=path.stem, dataset=dataset, mesh=mesh)
+            verify_model(net, cfg, model_name=name, dataset=dataset, mesh=mesh,
+                         stage0=stage0_by_model.get(name))
         )
     return reports

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Reproduction harness — the rebuild's analog of the reference's
+# reproduce.sh / reproduce-experiment.sh (SURVEY.md §1 L5): run every base
+# sweep preset over its model zoo and collect the per-model CSVs + ledgers
+# + throughput counters under ./res/.
+#
+# Usage: scripts/reproduce.sh [results_dir] [soft_timeout_s]
+set -euo pipefail
+RES="${1:-res}"
+SOFT="${2:-100}"
+
+for preset in GC AC BM CP DF; do
+  echo "=== preset $preset"
+  python -m fairify_tpu run "$preset" \
+    --soft-timeout "$SOFT" --result-dir "$RES/$preset"
+done
+
+echo "=== stress / relaxed / targeted variants"
+for preset in stress-GC stress-AC stress-BM relaxed-GC relaxed-AC relaxed-BM \
+              targeted-GC targeted-AC targeted-BM targeted2-GC targeted2-AC targeted2-BM; do
+  echo "=== preset $preset"
+  python -m fairify_tpu run "$preset" \
+    --soft-timeout "$SOFT" --result-dir "$RES/$preset"
+done
+
+echo "=== headline benchmark"
+python -m fairify_tpu bench | tee "$RES/bench.json"

@@ -1,0 +1,58 @@
+"""Mesh helpers, multihost slicing, throughput counters."""
+import numpy as np
+import pytest
+
+from fairify_tpu.parallel import mesh as mesh_mod
+from fairify_tpu.parallel import multihost
+from fairify_tpu.utils.profiling import ThroughputCounter, xla_trace
+
+
+def test_host_slice_partitions_balanced():
+    n = 23
+    slices = [multihost.host_slice(n, pi, 4) for pi in range(4)]
+    assert slices[0][0] == 0 and slices[-1][1] == n
+    covered = []
+    for s, e in slices:
+        covered.extend(range(s, e))
+    assert covered == list(range(n))
+    widths = [e - s for s, e in slices]
+    assert max(widths) - min(widths) <= 1
+
+
+def test_allgather_single_process_identity():
+    codes = np.array([0, 1, 2, 1], dtype=np.int8)
+    out = multihost.allgather_verdicts(codes)
+    np.testing.assert_array_equal(out, codes)
+
+
+def test_pad_to_multiple():
+    a = np.arange(10).reshape(5, 2)
+    padded, n = mesh_mod.pad_to_multiple(a, 4)
+    assert n == 5 and padded.shape == (8, 2)
+    np.testing.assert_array_equal(padded[5:], np.tile(a[-1:], (3, 1)))
+    same, n2 = mesh_mod.pad_to_multiple(a, 5)
+    assert n2 == 5 and same.shape == (5, 2)
+
+
+def test_stack_models_rejects_mixed_archs():
+    from fairify_tpu.models import train
+
+    a = train.init_mlp([4, 8, 1], seed=0)
+    b = train.init_mlp([4, 6, 1], seed=1)
+    with pytest.raises(ValueError):
+        mesh_mod.stack_models([a, b])
+
+
+def test_throughput_counter():
+    c = ThroughputCounter(n_devices=2)
+    for v, s0 in [("sat", True), ("unsat", True), ("sat", False), ("unknown", False)]:
+        c.record(v, via_stage0=s0)
+    s = c.summary()
+    assert s["decided"] == 3 and s["stage0_decided"] == 2
+    assert s["unknown"] == 1
+    assert s["partitions_per_sec_per_chip"] == pytest.approx(s["partitions_per_sec"] / 2)
+
+
+def test_xla_trace_noop():
+    with xla_trace(None):
+        pass
