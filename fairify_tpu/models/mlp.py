@@ -113,6 +113,32 @@ def preactivations(params: MLP, x: jax.Array) -> list:
     return outs
 
 
+def forward_np(weights, biases, x: np.ndarray, dead=None) -> np.ndarray:
+    """Host-side float64 logit replay (no device dispatch).
+
+    Per-partition bookkeeping — counterexample replay (C-check/V-accurate,
+    ``src/GC/Verify-GC.py:225-250``) and heuristic-retry parity — runs on a
+    handful of points per partition; a device round-trip per call costs ~200ms
+    of dispatch for a microsecond of math, so these paths stay in numpy.
+    ``dead`` is an optional list of per-hidden-layer dead masks (1 = dead).
+    """
+    h = np.asarray(x, dtype=np.float64)
+    n = len(weights)
+    for i, (w, b) in enumerate(zip(weights, biases)):
+        z = h @ np.asarray(w, dtype=np.float64) + np.asarray(b, dtype=np.float64)
+        if i < n - 1:
+            z = np.maximum(z, 0.0)
+            if dead is not None:
+                z = z * (1.0 - np.asarray(dead[i], dtype=np.float64))
+        h = z
+    return h[..., 0]
+
+
+def predict_np(weights, biases, x: np.ndarray, dead=None) -> np.ndarray:
+    """Host-side class decision (logit sign test), matching :func:`predict`."""
+    return forward_np(weights, biases, x, dead=dead) > 0.0
+
+
 def predict(params: MLP, x: jax.Array) -> jax.Array:
     """Boolean class decision: sigmoid(logit) > 0.5, i.e. logit > 0.
 

@@ -1,0 +1,38 @@
+"""Persistent XLA compilation cache.
+
+The sweep's kernels are shape-stable across runs (frontier and attack
+batches are padded to fixed sizes), so every compile is reusable.  The
+first TPU compile of the CROWN/attack kernels costs tens of seconds
+(SURVEY.md §6 budget is 30 minutes *total* per model in the reference);
+a persistent cache makes every run after the first pay ~0 compile time.
+Disable with ``FAIRIFY_TPU_NO_CACHE=1``.
+"""
+from __future__ import annotations
+
+import os
+
+_ENABLED = False
+
+
+def enable_persistent_cache(path: str | None = None) -> str | None:
+    global _ENABLED
+    if _ENABLED or os.environ.get("FAIRIFY_TPU_NO_CACHE"):
+        return None
+    import jax
+
+    # Separate caches per platform selection: an axon/TPU-tunnel process may
+    # AOT-compile host kernels with different machine features than a plain
+    # JAX_PLATFORMS=cpu process, and loading the other's executables risks
+    # SIGILL (XLA warns about exactly this).
+    platform = os.environ.get("JAX_PLATFORMS") or "default"
+    path = path or os.environ.get(
+        "FAIRIFY_TPU_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "fairify_tpu",
+                     f"xla-{platform}"),
+    )
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    _ENABLED = True
+    return path

@@ -117,15 +117,9 @@ def _stage0_certify_and_attack(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
     xr, pr = engine.build_attack_candidates(enc, rng, lo, hi, cfg.engine.attack_samples)
     lx, lp = engine._attack_logits(net, jnp.asarray(xr), jnp.asarray(pr))
     found, wit = engine.find_flips(enc, np.asarray(lx), np.asarray(lp), valid)
-    witnesses = {}
     weights = [np.asarray(w) for w in net.weights]
     biases = [np.asarray(b) for b in net.biases]
-    for i in np.where(found)[0]:
-        s, a, b = wit[i]
-        x = xr[i, s, a].astype(np.int64)
-        xp = pr[i, s, b].astype(np.int64)
-        if engine.validate_pair(weights, biases, x, xp):
-            witnesses[int(i)] = (x, xp)
+    witnesses = engine.extract_witnesses(found, wit, xr, pr, weights, biases)
     sat = np.zeros(lo.shape[0], dtype=bool)
     sat[list(witnesses)] = True
     return unsat, sat, witnesses
@@ -185,33 +179,42 @@ def _stage0_family(stacked, enc: PairEncoding, lo, hi, cfg: SweepConfig, mesh=No
         found, wit = engine.find_flips(enc, lx[m], lp[m], valid)
         weights = [np.asarray(w[m]) for w in stacked.weights]
         biases = [np.asarray(b[m]) for b in stacked.biases]
-        witnesses = {}
-        for i in np.where(found)[0]:
-            s, a, b = wit[i]
-            x = xr[i, s, a].astype(np.int64)
-            xp = pr[i, s, b].astype(np.int64)
-            if engine.validate_pair(weights, biases, x, xp):
-                witnesses[int(i)] = (x, xp)
+        witnesses = engine.extract_witnesses(found, wit, xr, pr, weights, biases)
         sat = np.zeros(lo.shape[0], dtype=bool)
         sat[list(witnesses)] = True
         results.append((unsat, sat, witnesses))
     return results
 
 
-def _pruned_accuracy(net, masked_net, sim: np.ndarray) -> float:
-    """Prediction parity of masked vs original net on simulated inputs
-    (``pruned_acc``, ``src/GC/Verify-GC.py:265-270``)."""
-    a = np.asarray(mlp_mod.predict(net, jnp.asarray(sim, jnp.float32)))
-    b = np.asarray(mlp_mod.predict(masked_net, jnp.asarray(sim, jnp.float32)))
-    return float((a == b).mean())
+import jax
 
 
-def _c_check(net, masked_net, ce) -> tuple:
-    """C-check / V-accurate replay (``src/GC/Verify-GC.py:225-250``)."""
-    x, xp = ce
-    pts = jnp.asarray(np.stack([x, xp]), jnp.float32)
-    pruned_cls = np.asarray(mlp_mod.predict(masked_net, pts))
-    orig_cls = np.asarray(mlp_mod.predict(net, pts))
+@jax.jit
+def _parity_grid(net, sim, alive):
+    """Pruned-vs-original prediction parity for the WHOLE grid in one kernel.
+
+    ``sim``: (P, S, d) simulated inputs; ``alive``: per-layer (P, n_l) alive
+    masks.  Replaces the reference's per-partition ``pruned_acc`` loop
+    (``src/GC/Verify-GC.py:265-270``) — and the per-partition device dispatch
+    that a naive port would pay — with one vmapped forward pair.
+    """
+
+    def one(s, masks):
+        orig = mlp_mod.forward(net, s) > 0.0
+        masked = mlp_mod.forward(net.with_masks(masks), s) > 0.0
+        return jnp.mean((orig == masked).astype(jnp.float32))
+
+    return jax.vmap(one)(sim, alive)
+
+
+def _c_check_np(weights, biases, dead, ce) -> tuple:
+    """C-check / V-accurate replay (``src/GC/Verify-GC.py:225-250``), host-side.
+
+    Two points through two tiny nets — numpy, not a device round-trip.
+    """
+    pts = np.stack(ce)
+    orig_cls = mlp_mod.predict_np(weights, biases, pts)
+    pruned_cls = mlp_mod.predict_np(weights, biases, pts, dead=dead)
     v_accurate = int(orig_cls[0] != orig_cls[1])
     c_check = int((pruned_cls == orig_cls).all())
     return c_check, v_accurate
@@ -241,6 +244,9 @@ def verify_model(
     stage0=None,
 ) -> ModelReport:
     """Run the full sweep for one model; write CSV + ledger rows as we go."""
+    from fairify_tpu.utils.cache import enable_persistent_cache
+
+    enable_persistent_cache()
     timer = PhaseTimer()
     query = cfg.query()
     enc = encode(query)
@@ -266,7 +272,11 @@ def verify_model(
             else:
                 unsat0, sat0, witnesses = _stage0_certify_and_attack(
                     net, enc, lo, hi, cfg, mesh=mesh)
-        stage0_per_part = (timer.get("stage0_prune") + timer.get("stage0_decide")) / max(P, 1)
+        with timer.phase("stage0_parity"):
+            alive = tuple(jnp.asarray(1.0 - d, jnp.float32) for d in prune.st_deads)
+            parity = np.asarray(_parity_grid(
+                net, jnp.asarray(prune.sim, jnp.float32), alive))
+        stage0_per_part = 0.0  # finalized (incl. the PGD phase) below
 
         outcomes: List[PartitionOutcome] = []
         sat_count = unsat_count = unk_count = 0
@@ -281,6 +291,24 @@ def verify_model(
         # itself is cheap and never discards work).
         pending = [p for p in range(P)
                    if (p + 1) not in done and not sat0[p] and not unsat0[p]]
+        # Gradient attack on the stage-0 leftovers: counterexamples the
+        # random sampler misses (logit zero-crossings on thin slabs) are
+        # found by batched PGD in one jit, sparing those roots the BaB tree.
+        if pending:
+            with timer.phase("stage0_pgd"):
+                pgd_wit = engine.pgd_attack(
+                    net, enc, lo[pending], hi[pending],
+                    np.random.default_rng(cfg.engine.seed + 1),
+                )
+            for i, ce in pgd_wit.items():
+                p = pending[i]
+                sat0[p] = True
+                witnesses[p] = ce
+            pending = [p for p in pending if not sat0[p]]
+        stage0_per_part = sum(
+            timer.get(ph) for ph in
+            ("stage0_prune", "stage0_decide", "stage0_parity", "stage0_pgd")
+        ) / max(P, 1)
         bab: Dict[int, engine.Decision] = {}
         if pending:
             hard_left = max(cfg.hard_timeout_s - timer.total(), 1.0)
@@ -309,7 +337,6 @@ def verify_model(
             continue
         t_part = time.perf_counter()
         dead = pruning.partition_masks(prune, p)
-        masked_net = mask_ops.apply_dead_masks(net, [jnp.asarray(d) for d in dead])
 
         h_attempt = h_success = 0
         sv_time = hv_time = h_time = 0.0
@@ -356,9 +383,14 @@ def verify_model(
 
         c_check = v_accurate = 0
         if verdict == "sat" and ce is not None:
-            masked_net = mask_ops.apply_dead_masks(net, [jnp.asarray(d) for d in dead])
-            c_check, v_accurate = _c_check(net, masked_net, ce)
-        pruned_acc = _pruned_accuracy(net, masked_net, prune.sim[p])
+            c_check, v_accurate = _c_check_np(weights, biases, dead, ce)
+        if h_attempt:  # masks changed after the batched parity pass
+            pruned_acc = float((
+                mlp_mod.predict_np(weights, biases, prune.sim[p])
+                == mlp_mod.predict_np(weights, biases, prune.sim[p], dead=dead)
+            ).mean())
+        else:
+            pruned_acc = float(parity[p])
 
         if verdict == "sat":
             sat_count += 1
@@ -444,6 +476,10 @@ def run_sweep(
     32-32-1 CP nets run as a single batch) before per-model refinement.
     """
     import sys
+
+    from fairify_tpu.utils.cache import enable_persistent_cache
+
+    enable_persistent_cache()  # before the stacked-family compiles below
 
     dataset = loaders.load(cfg.dataset, root=data_root)
     n_attrs = len(cfg.query().columns)

@@ -156,3 +156,51 @@ def test_engine_pa_direct_dependence_sat():
     assert got.verdict == "sat"
     x, xp = got.counterexample
     assert x[1] != xp[1]
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_pgd_attack_witnesses_are_legal(seed):
+    """PGD witnesses must be exact strict flips, in-box, legal pairs."""
+    rng = np.random.default_rng(300 + seed)
+    dom = tiny_domain({"a": (0, 9), "pa": (0, 1), "b": (0, 9), "c": (0, 5)})
+    query = prop.FairnessQuery(domain=dom, protected=("pa",))
+    net = random_net(rng, (4, 8, 1))
+    enc = prop.encode(query)
+    lo, hi = dom.lo_hi()
+    los = np.stack([lo.astype(np.int64)] * 3)
+    his = np.stack([hi.astype(np.int64)] * 3)
+    wit = engine.pgd_attack(net, enc, los, his, np.random.default_rng(seed))
+    ws = [np.asarray(w) for w in net.weights]
+    bs = [np.asarray(b) for b in net.biases]
+    pa = set(enc.pa_idx.tolist())
+    for i, (x, xp) in wit.items():
+        assert 0 <= i < 3  # padded rows never leak out
+        assert engine.validate_pair(ws, bs, x, xp)
+        for k in range(len(x)):
+            if k in pa:
+                assert x[k] != xp[k]
+            else:
+                assert x[k] == xp[k]
+        assert (x >= los[i]).all() and (x <= his[i]).all()
+
+
+def test_pgd_attack_finds_thin_slab_flip():
+    """A flip confined to one shared point — random sampling odds ~1e-4 per
+    draw, but the logit gradient points straight at it."""
+    # logit = 40*pa - |a - 377|ish: positive only at a=377 (pa=1).
+    ws = [np.array([[1.0, -1.0, 0.0], [0.0, 0.0, 1.0]], dtype=np.float32),
+          np.array([[-1.0], [-1.0], [40.0]], dtype=np.float32)]
+    bs = [np.array([-377.0, 377.0, 0.0], dtype=np.float32),
+          np.array([-20.0], dtype=np.float32)]
+    net = mlp.from_numpy(ws, bs)
+    dom = tiny_domain({"a": (0, 1000), "pa": (0, 1)})
+    query = prop.FairnessQuery(domain=dom, protected=("pa",))
+    enc = prop.encode(query)
+    lo, hi = dom.lo_hi()
+    wit = engine.pgd_attack(
+        net, enc, lo[None].astype(np.int64), hi[None].astype(np.int64),
+        np.random.default_rng(0),
+    )
+    assert 0 in wit
+    x, xp = wit[0]
+    assert x[0] == 377 and xp[0] == 377
