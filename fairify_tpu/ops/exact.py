@@ -128,6 +128,11 @@ def certify_dead_masks(
 
     The output layer is never dead (``utils/prune.py:235-236``).
     """
+    from fairify_tpu.ops import exact_native
+
+    native = exact_native.certify_dead(weights, biases, lo, hi, proposed_dead)
+    if native is not None:
+        return native[: len(proposed_dead)]
     n = len(weights)
     certified = [np.zeros_like(np.asarray(d), dtype=np.float64) for d in proposed_dead]
     lb, ub = _input_box(lo, hi)

@@ -1,0 +1,276 @@
+"""ctypes loader for the native exact-arithmetic core (``native/exact_core.cc``).
+
+The C++ library computes exact dyadic-rational signs of network logits and
+neuron interval bounds — the same values as the ``fractions.Fraction`` paths
+in :mod:`fairify_tpu.ops.exact` and :mod:`fairify_tpu.verify.engine`, two to
+three orders of magnitude faster.  It is built from source with ``g++`` on
+first use (cached in ``native/build/``); every public helper here returns
+``None``-equivalent availability via :func:`available`, and callers fall back
+to the pure-Python exact path when the toolchain or library is missing.
+
+Set ``FAIRIFY_TPU_NO_NATIVE=1`` to force the fallback (used by the parity
+tests to compare both implementations).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_REPO_NATIVE = Path(__file__).resolve().parents[2] / "native"
+_SO_NAME = "libfairify_exact.so"
+_ABI = 1
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build(src: Path, out: Path) -> bool:
+    out.parent.mkdir(parents=True, exist_ok=True)
+    tmp = out.with_suffix(f".so.tmp.{os.getpid()}")  # unique per process; replace is atomic
+    base = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", str(tmp), str(src)]
+    for cmd in (base + ["-fopenmp"], base):
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        except (OSError, subprocess.SubprocessError):
+            continue
+        os.replace(tmp, out)
+        return True
+    return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("FAIRIFY_TPU_NO_NATIVE"):
+            return None
+        src = _REPO_NATIVE / "exact_core.cc"
+        so = _REPO_NATIVE / "build" / _SO_NAME
+        try:
+            stale = src.is_file() and (
+                not so.is_file() or so.stat().st_mtime < src.stat().st_mtime
+            )
+            if stale and not _build(src, so):
+                return None
+            if not so.is_file():
+                return None
+            lib = ctypes.CDLL(str(so))
+            if lib.ft_abi_version() != _ABI:
+                return None
+        except OSError:
+            return None
+        lib.ft_forward_signs.argtypes = [
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int8),
+        ]
+        lib.ft_certify_dead.argtypes = [
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.ft_certify_dead_batch.argtypes = [
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.ft_bound_signs.argtypes = [
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int8),
+            ctypes.POINTER(ctypes.c_int8),
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _pack(weights: Sequence[np.ndarray], biases: Sequence[np.ndarray]):
+    sizes = [np.asarray(weights[0]).shape[0]] + [np.asarray(w).shape[1] for w in weights]
+    sizes_c = np.ascontiguousarray(sizes, dtype=np.int32)
+    w_flat = np.ascontiguousarray(
+        np.concatenate([np.asarray(w, dtype=np.float32).ravel() for w in weights])
+    )
+    b_flat = np.ascontiguousarray(
+        np.concatenate([np.asarray(b, dtype=np.float32).ravel() for b in biases])
+    )
+    return sizes, sizes_c, w_flat, b_flat
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def forward_signs(
+    weights: Sequence[np.ndarray], biases: Sequence[np.ndarray], points: np.ndarray
+) -> Optional[np.ndarray]:
+    """Exact logit signs at integer points; (N, d_in) → int8 (N,), or None."""
+    lib = _load()
+    if lib is None:
+        return None
+    sizes, sizes_c, w_flat, b_flat = _pack(weights, biases)
+    pts = np.ascontiguousarray(np.asarray(points, dtype=np.int64).reshape(-1, sizes[0]))
+    out = np.zeros(pts.shape[0], dtype=np.int8)
+    lib.ft_forward_signs(
+        len(weights), _ptr(sizes_c, ctypes.c_int), _ptr(w_flat, ctypes.c_float),
+        _ptr(b_flat, ctypes.c_float), pts.shape[0], _ptr(pts, ctypes.c_int64),
+        _ptr(out, ctypes.c_int8),
+    )
+    return out
+
+
+def certify_dead(
+    weights: Sequence[np.ndarray],
+    biases: Sequence[np.ndarray],
+    lo: Sequence[int],
+    hi: Sequence[int],
+    proposed_dead: Sequence[np.ndarray],
+) -> Optional[List[np.ndarray]]:
+    """Native twin of :func:`fairify_tpu.ops.exact.certify_dead_masks`."""
+    lib = _load()
+    if lib is None:
+        return None
+    sizes, sizes_c, w_flat, b_flat = _pack(weights, biases)
+    lo_c = np.ascontiguousarray(np.asarray(lo, dtype=np.int64))
+    hi_c = np.ascontiguousarray(np.asarray(hi, dtype=np.int64))
+    hidden = sizes[1:-1]
+    prop = np.ascontiguousarray(
+        np.concatenate(
+            [np.asarray(proposed_dead[l], dtype=np.float64).ravel() > 0.5 for l in range(len(hidden))]
+        ).astype(np.uint8)
+        if hidden
+        else np.zeros(0, dtype=np.uint8)
+    )
+    cert = np.zeros_like(prop)
+    lib.ft_certify_dead(
+        len(weights), _ptr(sizes_c, ctypes.c_int), _ptr(w_flat, ctypes.c_float),
+        _ptr(b_flat, ctypes.c_float), _ptr(lo_c, ctypes.c_int64), _ptr(hi_c, ctypes.c_int64),
+        _ptr(prop, ctypes.c_uint8), _ptr(cert, ctypes.c_uint8),
+    )
+    out, off = [], 0
+    for l, n in enumerate(hidden):
+        out.append(cert[off : off + n].astype(np.float32))
+        off += n
+    out.append(np.zeros(sizes[-1], dtype=np.float32))  # output layer never dead
+    return out
+
+
+def certify_dead_batch(
+    weights: Sequence[np.ndarray],
+    biases: Sequence[np.ndarray],
+    lo: np.ndarray,
+    hi: np.ndarray,
+    proposed_dead: Sequence[np.ndarray],
+) -> Optional[List[np.ndarray]]:
+    """Batched exact certification over P boxes in one native call.
+
+    ``lo``/``hi``: (P, d_in) int boxes.  ``proposed_dead``: per weight layer,
+    (P, n_l) masks.  Returns per-layer (P, n_l) float32 certified masks (the
+    output layer all-zero), or None when the library is unavailable.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    sizes, sizes_c, w_flat, b_flat = _pack(weights, biases)
+    lo_c = np.ascontiguousarray(np.asarray(lo, dtype=np.int64).reshape(-1, sizes[0]))
+    hi_c = np.ascontiguousarray(np.asarray(hi, dtype=np.int64).reshape(-1, sizes[0]))
+    P = lo_c.shape[0]
+    hidden = sizes[1:-1]
+    if hidden:
+        prop = np.ascontiguousarray(
+            np.concatenate(
+                [
+                    (np.asarray(proposed_dead[l], dtype=np.float64).reshape(P, -1) > 0.5)
+                    for l in range(len(hidden))
+                ],
+                axis=1,
+            ).astype(np.uint8)
+        )
+    else:
+        prop = np.zeros((P, 0), dtype=np.uint8)
+    cert = np.zeros_like(prop)
+    lib.ft_certify_dead_batch(
+        len(weights), _ptr(sizes_c, ctypes.c_int), _ptr(w_flat, ctypes.c_float),
+        _ptr(b_flat, ctypes.c_float), P, _ptr(lo_c, ctypes.c_int64),
+        _ptr(hi_c, ctypes.c_int64), _ptr(prop, ctypes.c_uint8), _ptr(cert, ctypes.c_uint8),
+    )
+    out, off = [], 0
+    for n in hidden:
+        out.append(cert[:, off : off + n].astype(np.float32))
+        off += n
+    out.append(np.zeros((P, sizes[-1]), dtype=np.float32))
+    return out
+
+
+def bound_signs(
+    weights: Sequence[np.ndarray],
+    biases: Sequence[np.ndarray],
+    lo: Sequence[int],
+    hi: Sequence[int],
+    alive: Optional[Sequence[np.ndarray]] = None,
+) -> Optional[Tuple[List[np.ndarray], List[np.ndarray]]]:
+    """Exact per-neuron pre-activation bound signs over an integer box.
+
+    Returns (ws_lb_sign, ws_ub_sign) as per-layer int8 arrays, or None.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    sizes, sizes_c, w_flat, b_flat = _pack(weights, biases)
+    lo_c = np.ascontiguousarray(np.asarray(lo, dtype=np.int64))
+    hi_c = np.ascontiguousarray(np.asarray(hi, dtype=np.int64))
+    total = sum(sizes[1:])
+    lbs = np.zeros(total, dtype=np.int8)
+    ubs = np.zeros(total, dtype=np.int8)
+    alive_ptr = ctypes.c_void_p(0)
+    alive_arr = None
+    if alive is not None:
+        alive_arr = np.ascontiguousarray(
+            np.concatenate(
+                [np.asarray(alive[l], dtype=np.float64).ravel() > 0.5 for l in range(len(sizes) - 1)]
+            ).astype(np.uint8)
+        )
+        alive_ptr = ctypes.c_void_p(alive_arr.ctypes.data)
+    lib.ft_bound_signs(
+        len(weights), _ptr(sizes_c, ctypes.c_int), _ptr(w_flat, ctypes.c_float),
+        _ptr(b_flat, ctypes.c_float), _ptr(lo_c, ctypes.c_int64), _ptr(hi_c, ctypes.c_int64),
+        alive_ptr, _ptr(lbs, ctypes.c_int8), _ptr(ubs, ctypes.c_int8),
+    )
+    out_lb, out_ub, off = [], [], 0
+    for n in sizes[1:]:
+        out_lb.append(lbs[off : off + n].copy())
+        out_ub.append(ubs[off : off + n].copy())
+        off += n
+    return out_lb, out_ub

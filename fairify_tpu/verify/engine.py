@@ -28,6 +28,7 @@ from __future__ import annotations
 import time
 from dataclasses import dataclass, field
 from fractions import Fraction
+from functools import partial
 from typing import Optional, Tuple
 
 import jax
@@ -144,8 +145,6 @@ def find_flips(
 # ---------------------------------------------------------------------------
 # Gradient attack (PGD on the flip objective over shared coordinates)
 # ---------------------------------------------------------------------------
-
-from functools import partial
 
 
 @partial(jax.jit, static_argnames=("steps", "restarts"))
@@ -298,13 +297,16 @@ def exact_logit_sign(weights, biases, x: np.ndarray) -> int:
     true sign — the quantity Z3 would have reasoned about,
     ``utils/GC-1-Model-Functions.py:32-44``).
     """
-    h = np.asarray(x, dtype=np.float64)
-    for i, (w, b) in enumerate(zip(weights, biases)):
-        z = h @ np.asarray(w, dtype=np.float64) + np.asarray(b, dtype=np.float64)
-        h = z if i == len(weights) - 1 else np.maximum(z, 0.0)
-    v = float(h[0])
+    from fairify_tpu.models.mlp import forward_np
+
+    v = float(forward_np(weights, biases, np.asarray(x, dtype=np.float64)))
     if abs(v) > 1e-6:
         return 1 if v > 0 else -1
+    from fairify_tpu.ops import exact_native
+
+    nat = exact_native.forward_signs(weights, biases, np.asarray(x, dtype=np.int64)[None, :])
+    if nat is not None:
+        return int(nat[0])
     hf = [Fraction(int(t)) for t in np.asarray(x, dtype=np.int64)]
     for i, (w, b) in enumerate(zip(weights, biases)):
         wf = np.asarray(w, dtype=np.float64)

@@ -91,15 +91,23 @@ def sound_prune_grid(
     s_deads = [np.zeros_like(c) for c in candidates]
     certified = b_deads
     if exact_certify:
+        from fairify_tpu.ops import exact_native
+
         weights = [np.asarray(w) for w in net.weights]
         biases = [np.asarray(b) for b in net.biases]
-        certified = []
-        for p in range(P):
-            cert = exact_ops.certify_dead_masks(
-                weights, biases, lo[p], hi[p], [c[p] for c in candidates]
-            )
-            certified.append(cert)
-        certified = [np.stack([certified[p][l] for p in range(P)]) for l in range(len(candidates))]
+        batched = exact_native.certify_dead_batch(weights, biases, lo, hi, candidates)
+        if batched is not None:
+            certified = batched[: len(candidates)]
+        else:
+            certified = []
+            for p in range(P):
+                cert = exact_ops.certify_dead_masks(
+                    weights, biases, lo[p], hi[p], [c[p] for c in candidates]
+                )
+                certified.append(cert)
+            certified = [
+                np.stack([certified[p][l] for p in range(P)]) for l in range(len(candidates))
+            ]
         s_deads = [np.maximum(c - b, 0.0) for c, b in zip(certified, b_deads)]
     sv_time = time.perf_counter() - t0
 

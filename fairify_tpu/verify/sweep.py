@@ -27,6 +27,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -134,7 +135,6 @@ def _stage0_family(stacked, enc: PairEncoding, lo, hi, cfg: SweepConfig, mesh=No
     (models × partitions × assignments) batch.  Returns per-model
     (unsat, sat, witnesses) tuples.
     """
-    import jax
 
     from fairify_tpu.models.mlp import MLP, forward
 
@@ -184,9 +184,6 @@ def _stage0_family(stacked, enc: PairEncoding, lo, hi, cfg: SweepConfig, mesh=No
         sat[list(witnesses)] = True
         results.append((unsat, sat, witnesses))
     return results
-
-
-import jax
 
 
 @jax.jit

@@ -1,0 +1,133 @@
+"""Parity tests: native exact core vs the pure-Python Fraction path.
+
+The native library (``native/exact_core.cc``) must compute *identical* values
+to :mod:`fairify_tpu.ops.exact` — both are exact, so any disagreement is a
+bug in one of them.  Oracles here are the Fraction implementations and
+hand-built nets with known exact zeros.
+"""
+from __future__ import annotations
+
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+from fairify_tpu.ops import exact as exact_ops
+from fairify_tpu.ops import exact_native as en
+
+pytestmark = pytest.mark.skipif(not en.available(), reason="native core unavailable")
+
+
+def _random_net(rng, sizes):
+    ws = [
+        rng.normal(scale=0.4, size=(sizes[i], sizes[i + 1])).astype(np.float32)
+        for i in range(len(sizes) - 1)
+    ]
+    bs = [
+        rng.normal(scale=0.2, size=(sizes[i + 1],)).astype(np.float32)
+        for i in range(len(sizes) - 1)
+    ]
+    return ws, bs
+
+
+def _fraction_sign(ws, bs, x):
+    h = [Fraction(int(t)) for t in np.asarray(x, dtype=np.int64)]
+    for i, (w, b) in enumerate(zip(ws, bs)):
+        wf = np.asarray(w, dtype=np.float64)
+        bf = np.asarray(b, dtype=np.float64)
+        nxt = []
+        for j in range(wf.shape[1]):
+            acc = Fraction(float(bf[j]))
+            for t in range(wf.shape[0]):
+                acc += Fraction(float(wf[t, j])) * h[t]
+            if i < len(ws) - 1 and acc < 0:
+                acc = Fraction(0)
+            nxt.append(acc)
+        h = nxt
+    v = h[0]
+    return 0 if v == 0 else (1 if v > 0 else -1)
+
+
+def test_forward_signs_match_fractions():
+    rng = np.random.default_rng(7)
+    ws, bs = _random_net(rng, (9, 24, 12, 1))
+    pts = rng.integers(-6, 30, size=(64, 9))
+    nat = en.forward_signs(ws, bs, pts)
+    ref = np.array([_fraction_sign(ws, bs, p) for p in pts], dtype=np.int8)
+    assert np.array_equal(nat, ref)
+
+
+def test_forward_signs_exact_zero():
+    # f(x) = x0 - x1: sign is exactly 0 on the diagonal — float prefilters
+    # cannot see this; the dyadic core must.
+    w = np.array([[1.0], [-1.0]], dtype=np.float32)
+    b = np.array([0.0], dtype=np.float32)
+    out = en.forward_signs([w], [b], np.array([[5, 5], [6, 5], [4, 5]]))
+    assert out.tolist() == [0, 1, -1]
+
+
+def test_forward_signs_deep_subnormal_scales():
+    # Mixed tiny/huge weights exercise wide exponent alignment in dy_add.
+    rng = np.random.default_rng(3)
+    ws, bs = _random_net(rng, (4, 8, 8, 1))
+    ws[0] *= np.float32(1e-20)
+    ws[1] *= np.float32(1e18)
+    pts = rng.integers(0, 50, size=(16, 4))
+    nat = en.forward_signs(ws, bs, pts)
+    ref = np.array([_fraction_sign(ws, bs, p) for p in pts], dtype=np.int8)
+    assert np.array_equal(nat, ref)
+
+
+def test_certify_matches_python(monkeypatch):
+    rng = np.random.default_rng(11)
+    ws, bs = _random_net(rng, (6, 16, 10, 1))
+    # Engineer some genuinely dead neurons: large negative bias.
+    bs[0][:4] = -100.0
+    bs[1][:3] = -100.0
+    lo = np.zeros(6, dtype=np.int64)
+    hi = np.full(6, 8, dtype=np.int64)
+    proposed = [np.ones(16, np.float32), np.ones(10, np.float32), np.zeros(1, np.float32)]
+    nat = en.certify_dead(ws, bs, lo, hi, proposed)
+    # Force the Fraction path for the oracle.
+    monkeypatch.setattr(en, "certify_dead", lambda *a, **k: None)
+    ref = exact_ops.certify_dead_masks(ws, bs, lo, hi, proposed)
+    assert all(np.array_equal(a, b) for a, b in zip(nat, ref))
+    assert nat[0][:4].sum() == 4  # the engineered dead neurons are certified
+
+
+def test_certify_batch_matches_single():
+    rng = np.random.default_rng(13)
+    ws, bs = _random_net(rng, (5, 12, 1))
+    bs[0][:5] = -50.0
+    P = 7
+    lo = rng.integers(0, 3, size=(P, 5)).astype(np.int64)
+    hi = lo + rng.integers(1, 6, size=(P, 5))
+    proposed = [np.ones((P, 12), np.float32), np.zeros((P, 1), np.float32)]
+    batched = en.certify_dead_batch(ws, bs, lo, hi, proposed)
+    for p in range(P):
+        single = en.certify_dead(ws, bs, lo[p], hi[p], [c[p] for c in proposed])
+        for l in range(2):
+            assert np.array_equal(batched[l][p], single[l])
+
+
+def test_bound_signs_match_fractions():
+    rng = np.random.default_rng(17)
+    ws, bs = _random_net(rng, (5, 10, 6, 1))
+    lo = np.zeros(5, dtype=np.int64)
+    hi = np.full(5, 12, dtype=np.int64)
+    ws_lb, ws_ub, _, _ = exact_ops.exact_network_bounds(ws, bs, lo, hi)
+    nat_lb, nat_ub = en.bound_signs(ws, bs, lo, hi)
+    for l in range(3):
+        ref_lb = np.sign([float(v > 0) - float(v < 0) for v in ws_lb[l]]).astype(np.int8)
+        ref_ub = np.sign([float(v > 0) - float(v < 0) for v in ws_ub[l]]).astype(np.int8)
+        assert np.array_equal(nat_lb[l], ref_lb)
+        assert np.array_equal(nat_ub[l], ref_ub)
+
+
+def test_engine_sign_uses_native_on_ambiguity():
+    from fairify_tpu.verify import engine
+
+    w = np.array([[1.0], [-1.0]], dtype=np.float32)
+    b = np.array([0.0], dtype=np.float32)
+    assert engine.exact_logit_sign([w], [b], np.array([3, 3])) == 0
+    assert engine.exact_logit_sign([w], [b], np.array([4, 3])) == 1
