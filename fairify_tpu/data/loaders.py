@@ -167,6 +167,20 @@ def load_adult(root=None) -> LoadedDataset:
     return LoadedDataset("adult", df, X_train, y_train, X_test, y_test, label, encoders)
 
 
+def load_adult_adf(root=None) -> LoadedDataset:
+    """The ADF variant (``utils/verif_utils.py:46-116``): identical 13-feature
+    encoding to :func:`load_adult`, but the label is returned one-hot
+    (``pd.get_dummies(y)``, two columns) — the form the reference's ADF-style
+    consumers expect."""
+    base = load("adult", root)
+    y_train = np.stack([1 - base.y_train, base.y_train], axis=1).astype("int")
+    y_test = np.stack([1 - base.y_test, base.y_test], axis=1).astype("int")
+    return LoadedDataset(
+        "adult_adf", base.df, base.X_train, y_train, base.X_test, y_test,
+        base.label, base.encoders, dict(base.notes, label_encoding="one-hot"),
+    )
+
+
 # ---------------------------------------------------------------------------
 # Bank Marketing  (utils/verif_utils.py:309-366)
 # ---------------------------------------------------------------------------
@@ -324,6 +338,7 @@ LOADERS = {
     "compass": load_compass,
     "default": load_default,
     "adult_onehot": load_adult_onehot,
+    "adult_adf": load_adult_adf,
 }
 
 _CACHE: Dict[str, LoadedDataset] = {}

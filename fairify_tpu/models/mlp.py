@@ -139,6 +139,17 @@ def predict_np(weights, biases, x: np.ndarray, dead=None) -> np.ndarray:
     return forward_np(weights, biases, x, dead=dead) > 0.0
 
 
+def prediction_mismatch(weights, biases, X: np.ndarray, dead=None) -> np.ndarray:
+    """Indices where the dead-masked (pruned) net disagrees with the original.
+
+    The debugging helper ``y_pred_mismatch`` (``utils/verif_utils.py:1049-1063``)
+    as one batched comparison instead of a per-sample print loop.
+    """
+    orig = predict_np(weights, biases, X)
+    pruned = predict_np(weights, biases, X, dead=dead)
+    return np.where(orig != pruned)[0]
+
+
 def predict(params: MLP, x: jax.Array) -> jax.Array:
     """Boolean class decision: sigmoid(logit) > 0.5, i.e. logit > 0.
 

@@ -1,0 +1,163 @@
+"""Fused whole-network IBP as a single Pallas TPU kernel.
+
+The XLA path (:func:`fairify_tpu.ops.interval.network_bounds`) issues four
+``Precision.HIGHEST`` matmuls per layer (sign-split) plus elementwise widen/
+ReLU/mask stages; for the zoo's small layers the launch+HBM round-trips
+dominate.  This kernel computes the same bounds in the center–radius form —
+``z_c = c @ W``, ``z_r = r @ |W|``, ``[z_c - z_r + b, z_c + z_r + b]`` — which
+is algebraically identical to the sign-split interval image and needs only
+TWO matmuls per layer.  All layers run inside one ``pallas_call``: the whole
+(padded) weight stack lives in VMEM, activations never touch HBM, and one
+batch tile flows through every layer back-to-back on the MXU.
+
+Rounding: both forms are exact in real arithmetic; their f32 round-off
+differs, and both are absorbed by the same outward widening
+(``SOUND_SLACK_REL/ABS``) that the XLA path applies — and, as everywhere,
+pruning/UNSAT soundness is anchored by the exact-rational pass, not floats.
+Matmuls request ``Precision.HIGHEST`` so the MXU uses the full-f32 passes.
+
+Nets wider than the 128-lane pad (none in the reference zoo,
+``models/`` max width 100) fall back to the XLA path; on CPU backends the
+kernel runs in interpreter mode (tests) unless disabled.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from fairify_tpu.models.mlp import MLP
+from fairify_tpu.ops.interval import SOUND_SLACK_ABS, SOUND_SLACK_REL
+
+LANE = 128  # pad every layer width to one MXU tile
+_TILE_B = 256  # batch rows per grid step
+
+
+def _supported(params: MLP) -> bool:
+    # layer_sizes are the out-dims; include the input width too.  Uses static
+    # shape info only, so it works on traced nets.
+    d_in = int(params.weights[0].shape[0])
+    return max((d_in,) + tuple(params.layer_sizes)) <= LANE
+
+
+def padded_stack(params: MLP) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(L, 128, 128) weight stack, (L, 128) biases and post-ReLU masks.
+
+    Padded rows/cols are zero weights with zero mask, so a padded dim's
+    pre-activation is exactly its (zero) bias and its post-ReLU value is 0 —
+    it can never leak into live dims (their padded weight rows are zero).
+    Built with jnp scatter-writes so it traces under ``jit`` (the engine
+    passes the net as a traced argument); XLA hoists it when weights are
+    constants.
+    """
+    L = params.depth
+    w = jnp.zeros((L, LANE, LANE), jnp.float32)
+    b = jnp.zeros((L, LANE), jnp.float32)
+    m = jnp.zeros((L, LANE), jnp.float32)
+    for l, (wl, bl, ml) in enumerate(zip(params.weights, params.biases, params.masks)):
+        n_in, n_out = wl.shape
+        w = w.at[l, :n_in, :n_out].set(jnp.asarray(wl, jnp.float32))
+        b = b.at[l, :n_out].set(jnp.asarray(bl, jnp.float32))
+        m = m.at[l, :n_out].set(jnp.asarray(ml, jnp.float32))
+    return w, b, m
+
+
+def _ibp_kernel(w_ref, b_ref, m_ref, lo_ref, hi_ref, out_lo_ref, out_hi_ref, *, depth: int):
+    lo = lo_ref[:]
+    hi = hi_ref[:]
+    for l in range(depth):  # static unroll: activations stay in registers/VMEM
+        c = (lo + hi) * 0.5
+        r = (hi - lo) * 0.5
+        w = w_ref[l]
+        zc = jax.lax.dot_general(
+            c, w, (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST, preferred_element_type=jnp.float32,
+        )
+        zr = jax.lax.dot_general(
+            r, jnp.abs(w), (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST, preferred_element_type=jnp.float32,
+        )
+        zlo = zc - zr + b_ref[l][None, :]
+        zhi = zc + zr + b_ref[l][None, :]
+        slack = SOUND_SLACK_REL * jnp.maximum(jnp.abs(zlo), jnp.abs(zhi)) + SOUND_SLACK_ABS
+        zlo = zlo - slack
+        zhi = zhi + slack
+        out_lo_ref[l] = zlo
+        out_hi_ref[l] = zhi
+        if l < depth - 1:
+            mask = m_ref[l][None, :]
+            lo = jnp.maximum(zlo, 0.0) * mask
+            hi = jnp.maximum(zhi, 0.0) * mask
+    # (final layer is linear: no ReLU/mask, matching the XLA path)
+
+
+@functools.partial(jax.jit, static_argnames=("depth", "interpret"))
+def _ibp_call(w, b, m, lo, hi, depth: int, interpret: bool):
+    B = lo.shape[0]
+    grid = (pl.cdiv(B, _TILE_B),)
+    kernel = functools.partial(_ibp_kernel, depth=depth)
+    out_shape = [
+        jax.ShapeDtypeStruct((depth, B, LANE), jnp.float32),
+        jax.ShapeDtypeStruct((depth, B, LANE), jnp.float32),
+    ]
+    from jax.experimental.pallas import tpu as pltpu
+
+    space = pl.ANY if interpret else pltpu.VMEM
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((depth, LANE, LANE), lambda i: (0, 0, 0), memory_space=space),
+            pl.BlockSpec((depth, LANE), lambda i: (0, 0), memory_space=space),
+            pl.BlockSpec((depth, LANE), lambda i: (0, 0), memory_space=space),
+            pl.BlockSpec((_TILE_B, LANE), lambda i: (i, 0), memory_space=space),
+            pl.BlockSpec((_TILE_B, LANE), lambda i: (i, 0), memory_space=space),
+        ],
+        out_specs=[
+            pl.BlockSpec((depth, _TILE_B, LANE), lambda i: (0, i, 0), memory_space=space),
+            pl.BlockSpec((depth, _TILE_B, LANE), lambda i: (0, i, 0), memory_space=space),
+        ],
+        out_shape=out_shape,
+        interpret=interpret,
+    )(w, b, m, lo, hi)
+
+
+def available(params: MLP) -> bool:
+    return _supported(params)
+
+
+def network_ws_bounds(params: MLP, lb: jax.Array, ub: jax.Array):
+    """Pre-activation (ws) bounds for every layer via the fused kernel.
+
+    ``lb``/``ub``: (..., d_in).  Returns per-layer (..., n_l) ws_lb/ws_ub
+    tuples matching :func:`fairify_tpu.ops.interval.network_bounds` (widened).
+    """
+    if not _supported(params):
+        raise ValueError("layer width exceeds the 128-lane pallas pad")
+    w, b, m = padded_stack(params)
+    batch_shape = lb.shape[:-1]
+    d = lb.shape[-1]
+    B = int(np.prod(batch_shape)) if batch_shape else 1
+    lo = jnp.zeros((B, LANE), jnp.float32).at[:, :d].set(lb.reshape(B, d))
+    hi = jnp.zeros((B, LANE), jnp.float32).at[:, :d].set(ub.reshape(B, d))
+    pad_b = (-B) % _TILE_B
+    if pad_b:
+        lo = jnp.concatenate([lo, jnp.zeros((pad_b, LANE), jnp.float32)])
+        hi = jnp.concatenate([hi, jnp.zeros((pad_b, LANE), jnp.float32)])
+    interpret = jax.default_backend() != "tpu"
+    out_lo, out_hi = _ibp_call(w, b, m, lo, hi, int(params.depth), interpret)
+    ws_lb, ws_ub = [], []
+    for l, n in enumerate(params.layer_sizes):
+        ws_lb.append(out_lo[l, :B, :n].reshape(*batch_shape, n))
+        ws_ub.append(out_hi[l, :B, :n].reshape(*batch_shape, n))
+    return tuple(ws_lb), tuple(ws_ub)
+
+
+def output_bounds(params: MLP, lb: jax.Array, ub: jax.Array):
+    """Fused-kernel interval bounds of the output logit."""
+    ws_lb, ws_ub = network_ws_bounds(params, lb, ub)
+    return ws_lb[-1][..., 0], ws_ub[-1][..., 0]

@@ -14,6 +14,7 @@ parity stats of the CSV schema and the pruned-network replay (C-check).
 """
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass
 from typing import List
@@ -49,13 +50,23 @@ class PruneResult:
 from functools import partial
 
 
-@partial(jax.jit, static_argnames=("sim_size",))
-def _sim_and_bounds(net: MLP, keys, lo, hi, sim_size: int):
+@partial(jax.jit, static_argnames=("sim_size", "pallas"))
+def _sim_and_bounds(net: MLP, keys, lo, hi, sim_size: int, pallas: bool = False):
     stats, sim = jax.vmap(
         lambda k, l, h: sim_ops.simulate_and_stats(net, k, l, h, sim_size)
     )(keys, lo, hi)
-    bounds = interval_ops.network_bounds(net, lo, hi)
+    bounds_fn = interval_ops.network_bounds_pallas if pallas else interval_ops.network_bounds
+    bounds = bounds_fn(net, lo, hi)
     return stats, sim, bounds
+
+
+@partial(jax.jit, static_argnames=("sim_size",))
+def _sim_stats(net: MLP, keys, lo, hi, sim_size: int):
+    """Simulation statistics only — no IBP bounds (harsh prune needs none)."""
+    stats, _ = jax.vmap(
+        lambda k, l, h: sim_ops.simulate_and_stats(net, k, l, h, sim_size)
+    )(keys, lo, hi)
+    return stats
 
 
 def sound_prune_grid(
@@ -74,8 +85,14 @@ def sound_prune_grid(
     """
     P = lo.shape[0]
     keys = jnp.stack([partition_key(seed, i) for i in range(P)])
+    use_pallas = bool(int(os.environ.get("FAIRIFY_TPU_PALLAS_IBP", "0")))
+    if use_pallas:
+        from fairify_tpu.ops import pallas_ibp
+
+        use_pallas = pallas_ibp.available(net)  # wide nets fall back to XLA
     stats, sim, bounds = _sim_and_bounds(
-        net, keys, jnp.asarray(lo, jnp.float32), jnp.asarray(hi, jnp.float32), sim_size
+        net, keys, jnp.asarray(lo, jnp.float32), jnp.asarray(hi, jnp.float32), sim_size,
+        pallas=use_pallas,
     )
     candidates = [np.asarray(c) for c in stats.candidates]
     pos_prob = [np.asarray(p) for p in stats.positive_prob]
@@ -131,3 +148,38 @@ def sound_prune_grid(
 def partition_masks(prune: PruneResult, p: int) -> list:
     """Dead masks of one partition (list of (n_l,) arrays)."""
     return [layer[p] for layer in prune.st_deads]
+
+
+def harsh_prune_grid(net: MLP, lo: np.ndarray, hi: np.ndarray, sim_size: int, seed: int) -> list:
+    """Unsound candidate-only pruning (``harsh_prune``, ``utils/prune.py:89-102``).
+
+    Simulation candidates are taken as dead directly — no bound or exact
+    verification, and (faithfully to the reference) no keep-one guard.
+    Returns per-layer (P, n_l) dead masks for the box grid.
+    """
+    P = lo.shape[0]
+    keys = jnp.stack([partition_key(seed, i) for i in range(P)])
+    stats = _sim_stats(
+        net, keys, jnp.asarray(lo, jnp.float32), jnp.asarray(hi, jnp.float32), sim_size
+    )
+    return [np.asarray(c) for c in stats.candidates]
+
+
+def sound_prune_global(
+    net: MLP,
+    lo: np.ndarray,
+    hi: np.ndarray,
+    sim_size: int,
+    seed: int,
+    exact_certify: bool = True,
+) -> PruneResult:
+    """Whole-domain sound pruning (``sound_prune_global``, ``utils/prune.py:646-667``):
+    the grid pass on the single full-range box (P = 1)."""
+    return sound_prune_grid(
+        net,
+        np.asarray(lo)[None, :],
+        np.asarray(hi)[None, :],
+        sim_size,
+        seed,
+        exact_certify=exact_certify,
+    )

@@ -1,0 +1,79 @@
+"""Fused Pallas IBP kernel: parity with the XLA path and exact-bound soundness."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from fairify_tpu.models import train
+from fairify_tpu.ops import interval, pallas_ibp
+from fairify_tpu.ops.masks import apply_dead_masks
+
+
+def _boxes(rng, B, d, span=10):
+    lo = rng.integers(0, 5, size=(B, d)).astype(np.float32)
+    hi = lo + rng.integers(0, span, size=(B, d))
+    return jnp.asarray(lo), jnp.asarray(hi)
+
+
+def test_matches_xla_path():
+    rng = np.random.default_rng(0)
+    net = train.init_mlp([7, 40, 24, 1], seed=1)
+    lo, hi = _boxes(rng, 33, 7)  # non-multiple of the batch tile
+    ws_lb, ws_ub = pallas_ibp.network_ws_bounds(net, lo, hi)
+    ref = interval.network_bounds(net, lo, hi)
+    for l in range(3):
+        a, b = np.asarray(ws_lb[l]), np.asarray(ref.ws_lb[l])
+        tol = 1e-4 * np.maximum(np.abs(a), np.abs(b)).max() + 1e-5
+        np.testing.assert_allclose(a, b, atol=tol)
+        np.testing.assert_allclose(np.asarray(ws_ub[l]), np.asarray(ref.ws_ub[l]), atol=tol)
+
+
+def test_contains_exact_bounds():
+    from fairify_tpu.ops.exact import exact_network_bounds
+
+    net = train.init_mlp([5, 12, 8, 1], seed=2)
+    ws = [np.asarray(w) for w in net.weights]
+    bs = [np.asarray(b) for b in net.biases]
+    lo = np.zeros(5, dtype=np.int64)
+    hi = np.full(5, 7, dtype=np.int64)
+    ws_lb, ws_ub = pallas_ibp.network_ws_bounds(
+        net, jnp.asarray(lo, jnp.float32)[None], jnp.asarray(hi, jnp.float32)[None]
+    )
+    ex_lb, ex_ub, _, _ = exact_network_bounds(ws, bs, lo, hi)
+    for l in range(3):
+        for j in range(len(ex_lb[l])):
+            assert float(ws_lb[l][0, j]) <= float(ex_lb[l][j])
+            assert float(ws_ub[l][0, j]) >= float(ex_ub[l][j])
+
+
+def test_respects_dead_masks():
+    rng = np.random.default_rng(3)
+    net = train.init_mlp([6, 16, 10, 1], seed=4)
+    dead = [np.zeros(16, np.float32), np.zeros(10, np.float32), np.zeros(1, np.float32)]
+    dead[0][:6] = 1.0
+    masked = apply_dead_masks(net, dead)
+    lo, hi = _boxes(rng, 8, 6)
+    ws_lb, ws_ub = pallas_ibp.network_ws_bounds(masked, lo, hi)
+    ref = interval.network_bounds(masked, lo, hi)
+    for l in range(3):
+        a, b = np.asarray(ws_ub[l]), np.asarray(ref.ws_ub[l])
+        tol = 1e-4 * np.maximum(np.abs(a), np.abs(b)).max() + 1e-5
+        np.testing.assert_allclose(a, b, atol=tol)
+
+
+def test_output_bounds_shape():
+    net = train.init_mlp([4, 8, 1], seed=5)
+    rng = np.random.default_rng(6)
+    lo, hi = _boxes(rng, 5, 4)
+    lb, ub = pallas_ibp.output_bounds(net, lo, hi)
+    assert lb.shape == (5,) and ub.shape == (5,)
+    assert bool(jnp.all(lb <= ub))
+
+
+def test_wide_net_rejected():
+    net = train.init_mlp([4, 200, 1], seed=7)
+    assert not pallas_ibp.available(net)
+    with pytest.raises(ValueError):
+        pallas_ibp.network_ws_bounds(
+            net, jnp.zeros((1, 4), jnp.float32), jnp.ones((1, 4), jnp.float32)
+        )

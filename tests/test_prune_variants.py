@@ -1,0 +1,64 @@
+"""Pruning-variant and loader-variant parity (harsh/global/mismatch/adf)."""
+import numpy as np
+import pytest
+
+from fairify_tpu.models import mlp, train
+from fairify_tpu.verify import pruning
+
+
+def _net():
+    return train.init_mlp([6, 12, 8, 1], seed=5)
+
+
+def test_harsh_prune_equals_candidates():
+    net = _net()
+    lo = np.zeros((3, 6), dtype=np.int64)
+    hi = np.full((3, 6), 9, dtype=np.int64)
+    harsh = pruning.harsh_prune_grid(net, lo, hi, sim_size=128, seed=0)
+    sound = pruning.sound_prune_grid(net, lo, hi, sim_size=128, seed=0, exact_certify=False)
+    for h, c in zip(harsh, sound.candidates):
+        np.testing.assert_array_equal(h, c)
+
+
+def test_sound_prune_global_is_single_box_grid():
+    net = _net()
+    lo = np.zeros(6, dtype=np.int64)
+    hi = np.full(6, 9, dtype=np.int64)
+    glob = pruning.sound_prune_global(net, lo, hi, sim_size=128, seed=0)
+    grid = pruning.sound_prune_grid(net, lo[None], hi[None], 128, 0)
+    for a, b in zip(glob.st_deads, grid.st_deads):
+        np.testing.assert_array_equal(a, b)
+    assert glob.st_deads[0].shape[0] == 1
+    # Sound deads are always a subset of simulation candidates.
+    for d, c in zip(glob.st_deads, glob.candidates):
+        assert np.all(d <= c + 1e-6)
+
+
+def test_prediction_mismatch_finds_flips():
+    rng = np.random.default_rng(2)
+    net = _net()
+    ws = [np.asarray(w) for w in net.weights]
+    bs = [np.asarray(b) for b in net.biases]
+    X = rng.integers(0, 10, size=(64, 6)).astype(np.float64)
+    none_dead = [np.zeros(12), np.zeros(8), np.zeros(1)]
+    assert pruning and mlp.prediction_mismatch(ws, bs, X, dead=none_dead).size == 0
+    # Killing every hidden neuron forces the constant-bias prediction;
+    # mismatches must be exactly the points the original classifies otherwise.
+    all_dead = [np.ones(12), np.ones(8), np.zeros(1)]
+    mm = mlp.prediction_mismatch(ws, bs, X, dead=all_dead)
+    orig = mlp.predict_np(ws, bs, X)
+    pruned = mlp.predict_np(ws, bs, X, dead=all_dead)
+    np.testing.assert_array_equal(mm, np.where(orig != pruned)[0])
+
+
+def test_load_adult_adf_one_hot(reference_assets_available):
+    if not reference_assets_available:
+        pytest.skip("reference assets not mounted")
+    from fairify_tpu.data import loaders
+
+    base = loaders.load("adult")
+    adf = loaders.load("adult_adf")
+    assert adf.y_train.shape == (base.y_train.shape[0], 2)
+    np.testing.assert_array_equal(adf.y_train.sum(axis=1), np.ones(len(adf.y_train)))
+    np.testing.assert_array_equal(adf.y_train[:, 1], base.y_train)
+    np.testing.assert_array_equal(adf.X_train, base.X_train)
