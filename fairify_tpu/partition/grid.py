@@ -168,3 +168,23 @@ def coverage_fraction(p_list: Sequence[RangeDict], range_dict: RangeDict) -> flo
 
     total = box_volume({k: tuple(v) for k, v in range_dict.items()})
     return float(sum(box_volume(p) for p in p_list) / total)
+
+
+def chunk_spans(n: int, chunk: int):
+    """(step, [(start, stop), ...]) fixed-`chunk` spans over n rows (0 = one span).
+
+    Stage-0 kernels iterate the partition grid in these spans so device
+    memory stays bounded on huge grids; every consumer (pruning, certify/
+    attack, parity) must use the same spans.
+    """
+    step = min(chunk, n) if chunk > 0 else n
+    return step, [(s, min(n, s + step)) for s in range(0, max(n, 1), max(step, 1))]
+
+
+def pad_rows(arr: np.ndarray, step: int) -> np.ndarray:
+    """Repeat the last row so axis 0 reaches ``step`` (one static jit shape)."""
+    arr = np.asarray(arr)
+    if arr.shape[0] == step:
+        return arr
+    return np.concatenate(
+        [arr, np.repeat(arr[-1:], step - arr.shape[0], axis=0)], axis=0)

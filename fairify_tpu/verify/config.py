@@ -33,6 +33,9 @@ class SweepConfig:
     models: Optional[Tuple[str, ...]] = None  # None = whole family
     seed: int = 42
     exact_certify_masks: bool = True
+    # Stage-0 kernels process the grid in fixed-size partition chunks so HBM
+    # stays bounded on huge grids (adult: 16k partitions); 0 = whole grid.
+    grid_chunk: int = 2048
     engine: EngineConfig = field(default_factory=EngineConfig)
     result_dir: str = "res"
     profile_dir: Optional[str] = None  # XLA trace output (TensorBoard/XProf)

@@ -76,28 +76,55 @@ def sound_prune_grid(
     sim_size: int,
     seed: int,
     exact_certify: bool = True,
+    chunk: int = 0,
+    index_offset: int = 0,
 ) -> PruneResult:
-    """Sound pruning for a (P, d) box grid in one device pass.
+    """Sound pruning for a (P, d) box grid in batched device passes.
 
     ``exact_certify=False`` skips the host-side rational pass (masks then
     rest on widened-f32 IBP only — still what the engine uses; the exact
     pass is the parity anchor and the analog of singular verification).
+
+    ``chunk`` > 0 bounds device memory for huge grids (the adult domain is
+    16k partitions): the grid is processed in fixed-size chunks (final chunk
+    padded, so the kernel compiles once) and results concatenated.  Each
+    partition's PRNG key is derived from its *global* index
+    (``index_offset``), so verdicts are chunk-size invariant.
     """
+    from fairify_tpu.partition.grid import chunk_spans, pad_rows
+
     P = lo.shape[0]
-    keys = jnp.stack([partition_key(seed, i) for i in range(P)])
+    step, spans = chunk_spans(P, chunk)
     use_pallas = bool(int(os.environ.get("FAIRIFY_TPU_PALLAS_IBP", "0")))
     if use_pallas:
         from fairify_tpu.ops import pallas_ibp
 
         use_pallas = pallas_ibp.available(net)  # wide nets fall back to XLA
-    stats, sim, bounds = _sim_and_bounds(
-        net, keys, jnp.asarray(lo, jnp.float32), jnp.asarray(hi, jnp.float32), sim_size,
-        pallas=use_pallas,
-    )
-    candidates = [np.asarray(c) for c in stats.candidates]
-    pos_prob = [np.asarray(p) for p in stats.positive_prob]
-    ws_lb = [np.asarray(b) for b in bounds.ws_lb]
-    ws_ub = [np.asarray(b) for b in bounds.ws_ub]
+    lo_np, hi_np = np.asarray(lo), np.asarray(hi)
+    cand_c, pos_c, lb_c, ub_c, sim_c = [], [], [], [], []
+    for s, e in spans:
+        clo = pad_rows(lo_np[s:e], step)
+        chi = pad_rows(hi_np[s:e], step)
+        keys = jnp.stack(
+            [partition_key(seed, index_offset + s + i) for i in range(step)])
+        stats, sim, bounds = _sim_and_bounds(
+            net, keys, jnp.asarray(clo, jnp.float32), jnp.asarray(chi, jnp.float32),
+            sim_size, pallas=use_pallas,
+        )
+        n = e - s
+        cand_c.append([np.asarray(c)[:n] for c in stats.candidates])
+        pos_c.append([np.asarray(p) [:n] for p in stats.positive_prob])
+        lb_c.append([np.asarray(b)[:n] for b in bounds.ws_lb])
+        ub_c.append([np.asarray(b)[:n] for b in bounds.ws_ub])
+        sim_c.append(np.asarray(sim)[:n])
+
+    L = len(cand_c[0])
+    _cat = lambda parts: [np.concatenate([p[l] for p in parts]) for l in range(L)]
+    candidates, pos_prob = _cat(cand_c), _cat(pos_c)
+    ws_lb, ws_ub = _cat(lb_c), _cat(ub_c)
+    sim = np.concatenate(sim_c)
+    bounds = interval_ops.LayerBounds(
+        ws_lb=tuple(ws_lb), ws_ub=tuple(ws_ub), pl_lb=(), pl_ub=())
 
     ibp_dead = [np.asarray(d) for d in interval_ops.dead_from_ws_ub(bounds)]
     # Bound-dead requires simulation candidacy, as in the reference

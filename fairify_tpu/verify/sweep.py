@@ -98,8 +98,29 @@ def build_partitions(cfg: SweepConfig):
     return p_list, lo.astype(np.int64), hi.astype(np.int64)
 
 
+_chunk_spans = grid_mod.chunk_spans
+_pad_rows = grid_mod.pad_rows
+
+
 def _stage0_certify_and_attack(net, enc: PairEncoding, lo, hi, cfg: SweepConfig, mesh=None):
-    """Root certificates + attack for the whole grid in batched device calls."""
+    """Root certificates + attack for the whole grid, in grid-chunk blocks."""
+    P = lo.shape[0]
+    step, spans = _chunk_spans(P, cfg.grid_chunk)
+    if len(spans) == 1:
+        return _stage0_block(net, enc, lo, hi, cfg, mesh, cfg.engine.seed)
+    unsat = np.zeros(P, dtype=bool)
+    sat = np.zeros(P, dtype=bool)
+    witnesses: Dict[int, tuple] = {}
+    for s, e in spans:
+        u, sa, w = _stage0_block(
+            net, enc, _pad_rows(lo[s:e], step), _pad_rows(hi[s:e], step),
+            cfg, mesh, cfg.engine.seed + s)
+        unsat[s:e], sat[s:e] = u[: e - s], sa[: e - s]
+        witnesses.update({s + k: v for k, v in w.items() if k < e - s})
+    return unsat, sat, witnesses
+
+
+def _stage0_block(net, enc: PairEncoding, lo, hi, cfg: SweepConfig, mesh, rng_seed):
     x_lo, x_hi, xp_lo, xp_hi, valid = role_boxes(enc, lo.astype(np.float32), hi.astype(np.float32))
     if mesh is not None:
         from fairify_tpu.parallel import mesh as mesh_mod
@@ -114,7 +135,7 @@ def _stage0_certify_and_attack(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
     lb_x, ub_x, lb_p, ub_p = (np.asarray(v)[: lo.shape[0]] for v in (lb_x, ub_x, lb_p, ub_p))
     unsat = engine.no_flip_certified(lb_x, ub_x, lb_p, ub_p, valid, enc.valid_pair)
 
-    rng = np.random.default_rng(cfg.engine.seed)
+    rng = np.random.default_rng(rng_seed)
     xr, pr = engine.build_attack_candidates(enc, rng, lo, hi, cfg.engine.attack_samples)
     lx, lp = engine._attack_logits(net, jnp.asarray(xr), jnp.asarray(pr))
     found, wit = engine.find_flips(enc, np.asarray(lx), np.asarray(lp), valid)
@@ -133,8 +154,28 @@ def _stage0_family(stacked, enc: PairEncoding, lo, hi, cfg: SweepConfig, mesh=No
     the family is a stacked weight pytree and `vmap` lifts the role-bound and
     attack kernels over the model axis, so the MXU sees one
     (models × partitions × assignments) batch.  Returns per-model
-    (unsat, sat, witnesses) tuples.
+    (unsat, sat, witnesses) tuples.  Grids larger than ``cfg.grid_chunk``
+    are processed in fixed-size blocks (same scheme as the single-model
+    stage 0) so the model axis never multiplies an unbounded partition axis.
     """
+    P = lo.shape[0]
+    step, spans = _chunk_spans(P, cfg.grid_chunk)
+    if len(spans) > 1:
+        M = stacked.weights[0].shape[0]
+        unsat = [np.zeros(P, dtype=bool) for _ in range(M)]
+        sat = [np.zeros(P, dtype=bool) for _ in range(M)]
+        wits: List[Dict[int, tuple]] = [{} for _ in range(M)]
+        for s, e in spans:
+            block_cfg = cfg.with_(
+                grid_chunk=0,
+                engine=engine.EngineConfig(
+                    **{**cfg.engine.__dict__, "seed": cfg.engine.seed + s}))
+            for m, (u, sa, w) in enumerate(_stage0_family(
+                    stacked, enc, _pad_rows(lo[s:e], step),
+                    _pad_rows(hi[s:e], step), block_cfg, mesh=mesh)):
+                unsat[m][s:e], sat[m][s:e] = u[: e - s], sa[: e - s]
+                wits[m].update({s + k: v for k, v in w.items() if k < e - s})
+        return list(zip(unsat, sat, wits))
 
     from fairify_tpu.models.mlp import MLP, forward
 
@@ -261,7 +302,8 @@ def verify_model(
     with xla_trace(cfg.profile_dir):
         with timer.phase("stage0_prune"):
             prune = pruning.sound_prune_grid(
-                net, lo, hi, cfg.sim_size, cfg.seed, exact_certify=cfg.exact_certify_masks
+                net, lo, hi, cfg.sim_size, cfg.seed,
+                exact_certify=cfg.exact_certify_masks, chunk=cfg.grid_chunk,
             )
         with timer.phase("stage0_decide"):
             if stage0 is not None:  # precomputed by the stacked family kernel
@@ -270,9 +312,16 @@ def verify_model(
                 unsat0, sat0, witnesses = _stage0_certify_and_attack(
                     net, enc, lo, hi, cfg, mesh=mesh)
         with timer.phase("stage0_parity"):
-            alive = tuple(jnp.asarray(1.0 - d, jnp.float32) for d in prune.st_deads)
-            parity = np.asarray(_parity_grid(
-                net, jnp.asarray(prune.sim, jnp.float32), alive))
+            step, spans = _chunk_spans(P, cfg.grid_chunk)
+            parity = np.empty(P, dtype=np.float32)
+            for s, e in spans:
+                alive = tuple(
+                    jnp.asarray(_pad_rows(1.0 - d[s:e], step), jnp.float32)
+                    for d in prune.st_deads)
+                block = _parity_grid(
+                    net, jnp.asarray(_pad_rows(prune.sim[s:e], step), jnp.float32),
+                    alive)
+                parity[s:e] = np.asarray(block)[: e - s]
         stage0_per_part = 0.0  # finalized (incl. the PGD phase) below
 
         outcomes: List[PartitionOutcome] = []
@@ -293,10 +342,16 @@ def verify_model(
         # found by batched PGD in one jit, sparing those roots the BaB tree.
         if pending:
             with timer.phase("stage0_pgd"):
-                pgd_wit = engine.pgd_attack(
-                    net, enc, lo[pending], hi[pending],
-                    np.random.default_rng(cfg.engine.seed + 1),
-                )
+                pgd_wit = {}
+                step = min(cfg.grid_chunk, len(pending)) if cfg.grid_chunk > 0 \
+                    else len(pending)
+                for s in range(0, len(pending), step):
+                    blk = pending[s:s + step]
+                    w = engine.pgd_attack(
+                        net, enc, lo[blk], hi[blk],
+                        np.random.default_rng(cfg.engine.seed + 1 + s),
+                    )
+                    pgd_wit.update({s + k: v for k, v in w.items()})
             for i, ce in pgd_wit.items():
                 p = pending[i]
                 sat0[p] = True
