@@ -58,16 +58,24 @@ def _cmd_run(args) -> int:
 
         mesh = make_mesh()
 
+    # --host-count distributes the partition grid: this process sweeps only
+    # its contiguous slice (parallel.multihost.host_slice); span-qualified
+    # ledgers merge across hosts with parallel.multihost.merge_ledgers.
+    if (args.host_index is None) != (args.host_count is None):
+        print("--host-index and --host-count must be given together", file=sys.stderr)
+        return 2
     reports = sweep.run_sweep(cfg, model_root=args.model_root, data_root=args.data_root,
-                              mesh=mesh)
+                              mesh=mesh, host_index=args.host_index,
+                              host_count=args.host_count)
     if not reports:
         print(f"no models found for dataset {cfg.dataset!r} "
               f"(set --model-root or FAIRIFY_TPU_MODEL_ROOT)", file=sys.stderr)
         return 1
     for rep in reports:
         c = rep.counts
+        host = {} if args.host_count is None else {"host": args.host_index}
         print(json.dumps({
-            "model": rep.model, "dataset": rep.dataset,
+            "model": rep.model, "dataset": rep.dataset, **host,
             "partitions": rep.partitions_total, "attempted": len(rep.outcomes),
             "sat": c["sat"], "unsat": c["unsat"], "unknown": c["unknown"],
             "original_acc": round(rep.original_acc, 4),
@@ -98,6 +106,10 @@ def main(argv=None) -> int:
     run.add_argument("--seed", type=int, default=None)
     run.add_argument("--model-root", default=None)
     run.add_argument("--data-root", default=None)
+    run.add_argument("--host-index", type=int, default=None,
+                     help="this process's index for multi-host partition distribution")
+    run.add_argument("--host-count", type=int, default=None,
+                     help="total hosts; each sweeps its slice of the grid")
     run.add_argument("--mesh", action="store_true",
                      help="shard stage 0 over all visible devices")
 

@@ -175,12 +175,33 @@ DEFAULT_CREDIT = DomainSpec(
     },
 )
 
+# LSAC bar passage — asset shipped but never wired up by the reference
+# (``data/lsac``, SURVEY.md §2.4); ranges match ``loaders.load_lsac``'s
+# integer encoding (UGPA in tenths, LSAT in half-points ×2, race1
+# label-encoded alphabetically).
+LSAC = DomainSpec(
+    name="lsac",
+    label="pass_bar",
+    ranges={
+        "decile1b": (1, 10),
+        "decile3": (1, 10),
+        "lsat": (22, 96),
+        "ugpa": (15, 39),
+        "fulltime": (1, 2),
+        "fam_inc": (1, 5),
+        "male": (0, 1),
+        "race1": (0, 4),
+        "tier": (1, 6),
+    },
+)
+
 DOMAINS = {
     "german": GERMAN,
     "adult": ADULT,
     "bank": BANK,
     "compass": COMPAS,
     "default": DEFAULT_CREDIT,
+    "lsac": LSAC,
 }
 
 

@@ -331,6 +331,37 @@ def load_adult_onehot(root=None) -> LoadedDataset:
     return LoadedDataset("adult_onehot", df, X_train, y_train, X_test, y_test, label, {"race": le})
 
 
+# ---------------------------------------------------------------------------
+# LSAC (Law School Admission Council bar-passage study).  The reference ships
+# ``data/lsac/lsac.csv`` but no driver or loader ever reads it (SURVEY.md
+# §2.4) — this loader + the ``lsac`` domain make the asset usable: a
+# 9-feature integer-encodable subset (deciles, LSAT, UGPA×10, fulltime,
+# family income, sex, race, school tier) with the standard bar-passage label.
+# ---------------------------------------------------------------------------
+
+
+def load_lsac(root=None) -> LoadedDataset:
+    path = _root(root) / "lsac" / "lsac.csv"
+    cols = ["decile1b", "decile3", "lsat", "ugpa", "fulltime", "fam_inc",
+            "male", "race1", "tier"]
+    label = "pass_bar"
+    df = pd.read_csv(path)[cols + [label]].dropna().reset_index(drop=True)
+    # UGPA is reported in tenths (1.5-3.9) and LSAT in half-points (e.g.
+    # 14.5); scale both so the verification domain stays an integer lattice
+    # (like every other dataset) without collapsing distinct raw values.
+    df["ugpa"] = (df["ugpa"] * 10).round()
+    df["lsat"] = (df["lsat"] * 2).round()
+    le = LabelEncoder()
+    df["race1"] = le.fit_transform(df["race1"])
+    for c in df.columns:
+        df[c] = df[c].astype(int)
+    X = df.drop(columns=[label])
+    y = df[label]
+    X_train, y_train, X_test, y_test = _split(X, y)
+    return LoadedDataset("lsac", df, X_train, y_train, X_test, y_test, label,
+                         {"race1": le})
+
+
 LOADERS = {
     "german": load_german,
     "adult": load_adult,
@@ -339,6 +370,7 @@ LOADERS = {
     "default": load_default,
     "adult_onehot": load_adult_onehot,
     "adult_adf": load_adult_adf,
+    "lsac": load_lsac,
 }
 
 _CACHE: Dict[str, LoadedDataset] = {}

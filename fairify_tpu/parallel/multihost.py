@@ -65,13 +65,76 @@ def allgather_verdicts(local_codes: np.ndarray, mesh=None) -> np.ndarray:
     Returns the concatenated global array on every host.  Uses
     `jax.experimental.multihost_utils` when running multi-process; identity
     on one process.
+
+    ``process_allgather(tiled=True)`` requires identical shapes on every
+    process, but :func:`host_slice` spans legitimately differ by one row —
+    so each host pads its codes to the common ceiling with a -1 sentinel
+    and the padding is dropped after the gather.
     """
     import jax
 
+    local_codes = np.asarray(local_codes, dtype=np.int8)
     if jax.process_count() == 1:
-        return np.asarray(local_codes)
+        return local_codes
     from jax.experimental import multihost_utils
 
-    return np.asarray(
-        multihost_utils.process_allgather(np.asarray(local_codes), tiled=True)
-    )
+    pc = jax.process_count()
+    # Common padded width: every span is base or base+1 (host_slice), so the
+    # max across hosts is simply the max of the gathered lengths.
+    lengths = np.asarray(multihost_utils.process_allgather(
+        np.array([local_codes.shape[0]], dtype=np.int32), tiled=True))
+    width = int(lengths.max())
+    padded = np.full(width, -1, dtype=np.int8)
+    padded[: local_codes.shape[0]] = local_codes
+    gathered = np.asarray(
+        multihost_utils.process_allgather(padded, tiled=True)
+    ).reshape(pc, width)
+    return np.concatenate([gathered[i, : lengths[i]] for i in range(pc)])
+
+
+def merge_ledgers(paths) -> dict:
+    """Merge per-host JSONL verdict ledgers into one {partition_id: record}.
+
+    Hosts own disjoint partition-id spans (:func:`host_slice`), so a
+    collision can only come from re-running with a different host count;
+    later files win, matching single-host resume semantics.
+    """
+    import json
+    import os
+
+    from fairify_tpu.verify.sweep import _load_ledger
+
+    merged: dict = {}
+    for path in paths:
+        merged.update(_load_ledger(path))
+    return merged
+
+
+def sweep_host(net, cfg, model_name: str = "model", dataset=None, mesh=None,
+               process_index=None, process_count=None):
+    """Run this host's slice of the partition sweep and gather global counts.
+
+    The grid is split contiguously across processes (:func:`host_slice`);
+    each host runs the normal single-host sweep on its span.  Partition ids
+    and pruning PRNG keys are global, so masks and decided verdicts are
+    host-count invariant (attack streams are span-relative — see
+    ``verify_model``); sinks are span-qualified (``model@start-stop``) so
+    hosts can share ``cfg.result_dir`` on a network filesystem, and the
+    per-partition verdict codes are all-gathered over DCN.  Returns
+    ``(local_report, global_codes)`` where ``global_codes`` is the int8
+    verdict array for the whole grid (0=unknown, 1=sat, 2=unsat) on every
+    host.
+    """
+    import jax
+    import numpy as np
+
+    from fairify_tpu.verify import sweep as sweep_mod
+
+    pi = jax.process_index() if process_index is None else process_index
+    pc = jax.process_count() if process_count is None else process_count
+    report = sweep_mod.verify_model(
+        net, cfg, model_name=model_name, dataset=dataset, mesh=mesh,
+        host_index=pi, host_count=pc)
+    code = {"unknown": 0, "sat": 1, "unsat": 2}
+    local = np.array([code[o.verdict] for o in report.outcomes], dtype=np.int8)
+    return report, allgather_verdicts(local, mesh=mesh)

@@ -177,8 +177,10 @@ def chunk_spans(n: int, chunk: int):
     memory stays bounded on huge grids; every consumer (pruning, certify/
     attack, parity) must use the same spans.
     """
+    if n == 0:
+        return 0, []
     step = min(chunk, n) if chunk > 0 else n
-    return step, [(s, min(n, s + step)) for s in range(0, max(n, 1), max(step, 1))]
+    return step, [(s, min(n, s + step)) for s in range(0, n, step)]
 
 
 def pad_rows(arr: np.ndarray, step: int) -> np.ndarray:

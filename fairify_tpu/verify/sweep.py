@@ -24,7 +24,7 @@ from __future__ import annotations
 import json
 import os
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional
 
 import jax
@@ -102,19 +102,26 @@ _chunk_spans = grid_mod.chunk_spans
 _pad_rows = grid_mod.pad_rows
 
 
-def _stage0_certify_and_attack(net, enc: PairEncoding, lo, hi, cfg: SweepConfig, mesh=None):
-    """Root certificates + attack for the whole grid, in grid-chunk blocks."""
+def _stage0_certify_and_attack(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
+                               mesh=None, seed_offset: int = 0):
+    """Root certificates + attack for the whole grid, in grid-chunk blocks.
+
+    ``seed_offset`` ties the attack RNG to the grid's global start index
+    (multi-host spans), so spans aligned to ``grid_chunk`` draw the same
+    samples a single-host run would.
+    """
     P = lo.shape[0]
     step, spans = _chunk_spans(P, cfg.grid_chunk)
     if len(spans) == 1:
-        return _stage0_block(net, enc, lo, hi, cfg, mesh, cfg.engine.seed)
+        return _stage0_block(net, enc, lo, hi, cfg, mesh,
+                             cfg.engine.seed + seed_offset)
     unsat = np.zeros(P, dtype=bool)
     sat = np.zeros(P, dtype=bool)
     witnesses: Dict[int, tuple] = {}
     for s, e in spans:
         u, sa, w = _stage0_block(
             net, enc, _pad_rows(lo[s:e], step), _pad_rows(hi[s:e], step),
-            cfg, mesh, cfg.engine.seed + s)
+            cfg, mesh, cfg.engine.seed + seed_offset + s)
         unsat[s:e], sat[s:e] = u[: e - s], sa[: e - s]
         witnesses.update({s + k: v for k, v in w.items() if k < e - s})
     return unsat, sat, witnesses
@@ -168,8 +175,7 @@ def _stage0_family(stacked, enc: PairEncoding, lo, hi, cfg: SweepConfig, mesh=No
         for s, e in spans:
             block_cfg = cfg.with_(
                 grid_chunk=0,
-                engine=engine.EngineConfig(
-                    **{**cfg.engine.__dict__, "seed": cfg.engine.seed + s}))
+                engine=replace(cfg.engine, seed=cfg.engine.seed + s))
             for m, (u, sa, w) in enumerate(_stage0_family(
                     stacked, enc, _pad_rows(lo[s:e], step),
                     _pad_rows(hi[s:e], step), block_cfg, mesh=mesh)):
@@ -263,11 +269,16 @@ def _ledger_path(cfg: SweepConfig, model_name: str) -> str:
 
 
 def _load_ledger(path: str) -> Dict[int, dict]:
+    """Partition-id → record map; tolerates the truncated trailing line a
+    crashed run leaves behind (that is precisely the resume scenario)."""
     done = {}
     if os.path.isfile(path):
         with open(path) as fp:
             for line in fp:
-                rec = json.loads(line)
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
                 done[rec["partition_id"]] = rec
     return done
 
@@ -280,6 +291,9 @@ def verify_model(
     mesh=None,
     resume: bool = True,
     stage0=None,
+    partition_span=None,
+    host_index=None,
+    host_count=None,
 ) -> ModelReport:
     """Run the full sweep for one model; write CSV + ledger rows as we go."""
     from fairify_tpu.utils.cache import enable_persistent_cache
@@ -289,12 +303,34 @@ def verify_model(
     query = cfg.query()
     enc = encode(query)
     p_list, lo, hi = build_partitions(cfg)
+    span_start = 0
+    sink_name = model_name
+    if host_count is not None and partition_span is None:
+        from fairify_tpu.parallel.multihost import host_slice
+
+        partition_span = host_slice(len(p_list), host_index, host_count)
+    if partition_span is not None:
+        # Multi-host sweeps hand each host a contiguous slice of the global
+        # grid (parallel.multihost.host_slice).  Partition ids and the
+        # pruning/simulation PRNG keys are global, so masks and every
+        # *decided* verdict are host-assignment invariant; the stage-0
+        # attack streams are span-relative, so only the SAT-vs-UNKNOWN
+        # frontier of undecidable partitions may shift with the host count.
+        span_start, span_stop = partition_span
+        p_list = p_list[span_start:span_stop]
+        lo, hi = lo[span_start:span_stop], hi[span_start:span_stop]
+        # Hosts may share result_dir (network fs): qualify sinks by span so
+        # concurrent appends never interleave.
+        sink_name = f"{model_name}@{span_start}-{span_stop}"
     P = len(p_list)
+    if P == 0:  # e.g. more hosts than partitions — an empty but valid span
+        return ModelReport(model=model_name, dataset=cfg.dataset, outcomes=[],
+                           partitions_total=0)
 
     os.makedirs(cfg.result_dir, exist_ok=True)
-    ledger_path = _ledger_path(cfg, model_name)
+    ledger_path = _ledger_path(cfg, sink_name)
     done = _load_ledger(ledger_path) if resume else {}
-    csv_path = os.path.join(cfg.result_dir, f"{model_name}.csv")
+    csv_path = os.path.join(cfg.result_dir, f"{sink_name}.csv")
 
     from fairify_tpu.utils.profiling import ThroughputCounter, xla_trace
 
@@ -304,13 +340,14 @@ def verify_model(
             prune = pruning.sound_prune_grid(
                 net, lo, hi, cfg.sim_size, cfg.seed,
                 exact_certify=cfg.exact_certify_masks, chunk=cfg.grid_chunk,
+                index_offset=span_start,
             )
         with timer.phase("stage0_decide"):
             if stage0 is not None:  # precomputed by the stacked family kernel
                 unsat0, sat0, witnesses = stage0
             else:
                 unsat0, sat0, witnesses = _stage0_certify_and_attack(
-                    net, enc, lo, hi, cfg, mesh=mesh)
+                    net, enc, lo, hi, cfg, mesh=mesh, seed_offset=span_start)
         with timer.phase("stage0_parity"):
             step, spans = _chunk_spans(P, cfg.grid_chunk)
             parity = np.empty(P, dtype=np.float32)
@@ -336,7 +373,8 @@ def verify_model(
         # verdicts already computed are always reported (the reporting loop
         # itself is cheap and never discards work).
         pending = [p for p in range(P)
-                   if (p + 1) not in done and not sat0[p] and not unsat0[p]]
+                   if (span_start + p + 1) not in done
+                   and not sat0[p] and not unsat0[p]]
         # Gradient attack on the stage-0 leftovers: counterexamples the
         # random sampler misses (logit zero-crossings on thin slabs) are
         # found by batched PGD in one jit, sparing those roots the BaB tree.
@@ -349,7 +387,7 @@ def verify_model(
                     blk = pending[s:s + step]
                     w = engine.pgd_attack(
                         net, enc, lo[blk], hi[blk],
-                        np.random.default_rng(cfg.engine.seed + 1 + s),
+                        np.random.default_rng(cfg.engine.seed + 1 + span_start + s),
                     )
                     pgd_wit.update({s + k: v for k, v in w.items()})
             for i, ce in pgd_wit.items():
@@ -378,7 +416,7 @@ def verify_model(
         orig_acc = float((pred.astype(int) == dataset.y_test).mean())
 
     for p in range(P):
-        pid = p + 1
+        pid = span_start + p + 1
         if pid in done:
             rec = done[pid]
             out = PartitionOutcome(pid, rec["verdict"])
@@ -416,9 +454,7 @@ def verify_model(
                 h_net = mask_ops.apply_dead_masks(net, [jnp.asarray(d) for d in merged])
                 dec2 = engine.decide_box(
                     h_net, enc, lo[p], hi[p],
-                    engine.EngineConfig(
-                        **{**cfg.engine.__dict__, "soft_timeout_s": cfg.soft_timeout_s}
-                    ),
+                    replace(cfg.engine, soft_timeout_s=cfg.soft_timeout_s),
                 )
                 hv_time = dec2.elapsed_s
                 h_time = time.perf_counter() - t_h
@@ -501,7 +537,7 @@ def verify_model(
         import csv as _csv
 
         cols = list(cfg.query().columns)
-        ce_path = os.path.join(cfg.result_dir, f"{model_name}-counterexamples.csv")
+        ce_path = os.path.join(cfg.result_dir, f"{sink_name}-counterexamples.csv")
         new_file = not os.path.isfile(ce_path)
         with open(ce_path, "a", newline="") as fp:
             wr = _csv.writer(fp)
@@ -511,7 +547,7 @@ def verify_model(
                 wr.writerow([pid, "x"] + [int(v) for v in x])
                 wr.writerow([pid, "x'"] + [int(v) for v in xp])
 
-    counter.dump(os.path.join(cfg.result_dir, f"{cfg.name}-{model_name}.throughput.json"))
+    counter.dump(os.path.join(cfg.result_dir, f"{cfg.name}-{sink_name}.throughput.json"))
     return ModelReport(
         model=model_name, dataset=cfg.dataset, outcomes=outcomes,
         original_acc=orig_acc, total_time_s=timer.total(), partitions_total=P,
@@ -519,13 +555,19 @@ def verify_model(
 
 
 def run_sweep(
-    cfg: SweepConfig, model_root=None, data_root=None, mesh=None, stack: bool = True
+    cfg: SweepConfig, model_root=None, data_root=None, mesh=None, stack: bool = True,
+    host_index=None, host_count=None,
 ) -> List[ModelReport]:
     """Sweep every model of the configured family (the drivers' outer loop).
 
     With ``stack=True``, models sharing an architecture get their stage-0
     certificates and attacks from one vmapped family kernel (e.g. the eleven
     32-32-1 CP nets run as a single batch) before per-model refinement.
+
+    ``host_count`` distributes the partition grid across processes: this
+    process sweeps only its :func:`fairify_tpu.parallel.multihost.host_slice`
+    span of every model (family stacking is disabled — stage-0 results are
+    span-local).
     """
     import sys
 
@@ -550,6 +592,8 @@ def run_sweep(
         return []
 
     stage0_by_model = {}
+    if host_count is not None:
+        stack = False  # stage-0 family results would be grid-global
     if stack:
         from collections import defaultdict
 
@@ -571,6 +615,7 @@ def run_sweep(
     for name, net in nets.items():
         reports.append(
             verify_model(net, cfg, model_name=name, dataset=dataset, mesh=mesh,
-                         stage0=stage0_by_model.get(name))
+                         stage0=stage0_by_model.get(name),
+                         host_index=host_index, host_count=host_count)
         )
     return reports

@@ -56,3 +56,43 @@ def test_throughput_counter():
 def test_xla_trace_noop():
     with xla_trace(None):
         pass
+
+
+def test_sweep_host_spans_cover_grid(tmp_path):
+    """Two simulated hosts sweep disjoint spans; merged ledgers equal the
+    single-host run's verdict map (global PRNG keys + partition ids)."""
+    import os
+
+    from fairify_tpu.models.train import init_mlp
+    from fairify_tpu.verify import presets, sweep
+
+    net = init_mlp((20, 8, 1), seed=3)
+    base = presets.get("GC").with_(
+        soft_timeout_s=30.0, hard_timeout_s=300.0, sim_size=64,
+        exact_certify_masks=False)
+
+    whole = sweep.verify_model(
+        net, base.with_(result_dir=str(tmp_path / "whole")),
+        model_name="m", resume=False)
+    assert whole.counts["unknown"] == 0  # fully decidable → strict equality
+
+    # Hosts share one result_dir: sinks are span-qualified so appends never
+    # interleave on a network filesystem.
+    shared = base.with_(result_dir=str(tmp_path / "shared"))
+    spans = [multihost.host_slice(201, pi, 2) for pi in range(2)]
+    ledgers = []
+    reports = []
+    for hi_, pc in ((0, 2), (1, 2)):
+        rep, codes = multihost.sweep_host(
+            net, shared, model_name="m", process_index=hi_, process_count=pc)
+        reports.append(rep)
+        s, e = spans[hi_]
+        ledgers.append(os.path.join(shared.result_dir,
+                                    f"GC-m@{s}-{e}.ledger.jsonl"))
+    assert all(os.path.isfile(p) for p in ledgers)
+    assert sum(len(r.outcomes) for r in reports) == whole.partitions_total
+
+    merged = multihost.merge_ledgers(ledgers)
+    assert len(merged) == whole.partitions_total
+    whole_map = {o.partition_id: o.verdict for o in whole.outcomes}
+    assert {k: v["verdict"] for k, v in merged.items()} == whole_map
