@@ -1,0 +1,27 @@
+"""Golden verdict parity against the reference's published Table V.
+
+GC-4/Age is the reference's fully-determined row: 100% coverage, 201
+partitions, 2 SAT / 199 UNSAT / 0 UNKNOWN (BASELINE.md, Appendix Table V).
+The full sweep reproduces those counts exactly — partitioning, pruning,
+certificates and attacks included — which pins the whole pipeline against
+the published artifact (SURVEY.md §4's "golden verdict tests").
+"""
+import pytest
+
+from fairify_tpu.verify import presets, sweep
+
+
+def test_gc4_age_matches_table_v(tmp_path, reference_assets_available):
+    if not reference_assets_available:
+        pytest.skip("reference assets not mounted")
+    from fairify_tpu.models import zoo
+
+    net = zoo.load("german", "GC-4")
+    cfg = presets.get("GC").with_(
+        result_dir=str(tmp_path), soft_timeout_s=5.0, hard_timeout_s=300.0)
+    report = sweep.verify_model(net, cfg, model_name="GC-4", resume=False)
+    assert report.partitions_total == 201
+    assert report.counts == {"sat": 2, "unsat": 199, "unknown": 0}
+    # Every SAT partition carries an exactly-validated counterexample pair.
+    ces = [o for o in report.outcomes if o.verdict == "sat"]
+    assert all(o.counterexample is not None and o.v_accurate for o in ces)
