@@ -34,23 +34,29 @@ def _cmd_list(_args) -> int:
     return 0
 
 
-def _cmd_run(args) -> int:
-    from fairify_tpu.verify import presets, sweep
+def _overridden_cfg(args):
+    """Preset + the shared CLI override flags (run/experiment)."""
+    from fairify_tpu.verify import presets
 
     cfg = presets.get(args.preset)
     overrides = {}
-    if args.soft_timeout is not None:
+    if getattr(args, "soft_timeout", None) is not None:
         overrides["soft_timeout_s"] = float(args.soft_timeout)
-    if args.hard_timeout is not None:
+    if getattr(args, "hard_timeout", None) is not None:
         overrides["hard_timeout_s"] = float(args.hard_timeout)
-    if args.models:
+    if getattr(args, "models", None):
         overrides["models"] = tuple(args.models)
-    if args.result_dir:
+    if getattr(args, "result_dir", None):
         overrides["result_dir"] = args.result_dir
-    if args.seed is not None:
+    if getattr(args, "seed", None) is not None:
         overrides["seed"] = args.seed
-    if overrides:
-        cfg = cfg.with_(**overrides)
+    return cfg.with_(**overrides) if overrides else cfg
+
+
+def _cmd_run(args) -> int:
+    from fairify_tpu.verify import sweep
+
+    cfg = _overridden_cfg(args)
 
     mesh = None
     if args.mesh:
@@ -91,6 +97,92 @@ def _cmd_bench(_args) -> int:
     return 0
 
 
+def _cmd_experiment(args) -> int:
+    """Verify → localize → repair → hybrid-route → audit, one model.
+
+    The reference's experiment drivers + detect_bias/new_model scripts
+    (``src/AC/Verify-AC-experiment-new2.py``, ``src/AC/detect_bias.py``,
+    ``src/AC/new_model.py``) as one command.
+    """
+    from fairify_tpu.analysis import experiment
+    from fairify_tpu.data import loaders
+    from fairify_tpu.models import zoo
+
+    cfg = _overridden_cfg(args)
+    net = zoo.load(cfg.dataset, args.model, root=args.model_root)
+    dataset = loaders.load(cfg.dataset, root=args.data_root)
+    res = experiment.run_experiment(
+        net, cfg, args.model, dataset=dataset, repair_mode=args.repair,
+        causal_samples=args.causal_samples)
+    if args.save_fairer:
+        from fairify_tpu.models import export
+
+        # The reference's repaired-model artifact (AC-16.h5 analog,
+        # ``src/AC/detect_bias.py:408``) in Keras-compatible HDF5.
+        export.save_keras_h5(res.fairer_net, args.save_fairer)
+    out = {
+        "model": args.model,
+        "verdicts": res.report.counts,
+        "counterexample_pairs": len(res.ce_pairs),
+        "biased_neurons": ([[l, j, round(float(s), 5)]
+                            for l, j, s in res.localization.ranked]
+                           if res.localization else []),
+        "metrics": res.metrics,
+        "causal_rates": {k: round(v, 5) for k, v in res.causal_rates.items()},
+        "saved_fairer": args.save_fairer or None,
+    }
+    print(json.dumps(out))
+    return 0
+
+
+def _cmd_metrics(args) -> int:
+    """Group-fairness report for zoo models on their dataset's test split
+    (the reference's AIF360 metric blocks, ``src/CP/Verify-CP.py:398-458``)."""
+    import numpy as np
+
+    from fairify_tpu.analysis import metrics as gm
+    from fairify_tpu.data import loaders
+    from fairify_tpu.models import mlp as mlp_mod
+    from fairify_tpu.models import zoo
+    from fairify_tpu.verify import presets
+
+    import jax.numpy as jnp
+
+    cfg = presets.get(args.preset)
+    ds = loaders.load(cfg.dataset, root=args.data_root)
+    pa = cfg.query().protected[0]
+    pa_col = list(cfg.query().columns).index(pa)
+    rc = 1
+    paths = zoo.model_paths(cfg.dataset, root=args.model_root)
+    skipped = []
+    for path in paths:
+        if args.models and path.stem not in args.models:
+            continue
+        net = zoo.load(cfg.dataset, path.stem, root=args.model_root)
+        if net.in_dim != ds.X_test.shape[1]:
+            skipped.append(path.stem)
+            continue
+        pred = np.asarray(
+            mlp_mod.predict(net, jnp.asarray(ds.X_test, jnp.float32))).astype(int)
+        rep = gm.group_report(ds.X_test, ds.y_test, pred,
+                              ds.X_test[:, pa_col]).as_dict()
+        print(json.dumps({"model": path.stem, "protected": pa,
+                          **{k: round(v, 5) for k, v in rep.items()}}))
+        rc = 0
+    if rc:
+        if skipped:
+            print(f"all candidate models skipped (input dim != "
+                  f"{ds.X_test.shape[1]}): {skipped}", file=sys.stderr)
+        elif args.models:
+            print(f"no zoo model matched --models {args.models} for dataset "
+                  f"{cfg.dataset!r} (available: {[p.stem for p in paths]})",
+                  file=sys.stderr)
+        else:
+            print(f"no models found for dataset {cfg.dataset!r} "
+                  f"(set --model-root or FAIRIFY_TPU_MODEL_ROOT)", file=sys.stderr)
+    return rc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="fairify_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -115,8 +207,31 @@ def main(argv=None) -> int:
 
     sub.add_parser("bench", help="run the headline benchmark")
 
+    exp = sub.add_parser(
+        "experiment", help="verify + localize + repair + hybrid-route + audit")
+    exp.add_argument("preset")
+    exp.add_argument("--model", required=True)
+    exp.add_argument("--repair", choices=("masked", "retrain", "both"),
+                     default="masked")
+    exp.add_argument("--causal-samples", type=int, default=2000)
+    exp.add_argument("--soft-timeout", type=float, default=None)
+    exp.add_argument("--hard-timeout", type=float, default=None)
+    exp.add_argument("--result-dir", default=None)
+    exp.add_argument("--model-root", default=None)
+    exp.add_argument("--data-root", default=None)
+    exp.add_argument("--seed", type=int, default=None)
+    exp.add_argument("--save-fairer", default=None,
+                     help="write the repaired model as Keras-compatible .h5")
+
+    met = sub.add_parser("metrics", help="group-fairness report per zoo model")
+    met.add_argument("preset")
+    met.add_argument("--models", nargs="*")
+    met.add_argument("--model-root", default=None)
+    met.add_argument("--data-root", default=None)
+
     args = ap.parse_args(argv)
-    return {"list": _cmd_list, "run": _cmd_run, "bench": _cmd_bench}[args.cmd](args)
+    return {"list": _cmd_list, "run": _cmd_run, "bench": _cmd_bench,
+            "experiment": _cmd_experiment, "metrics": _cmd_metrics}[args.cmd](args)
 
 
 if __name__ == "__main__":

@@ -17,8 +17,9 @@ def test_gc4_age_matches_table_v(tmp_path, reference_assets_available):
     from fairify_tpu.models import zoo
 
     net = zoo.load("german", "GC-4")
-    cfg = presets.get("GC").with_(
-        result_dir=str(tmp_path), soft_timeout_s=5.0, hard_timeout_s=300.0)
+    # Keep the preset's generous 100 s soft timeout: the assertion includes
+    # unknown == 0, which must not hinge on a loaded CI machine's wall clock.
+    cfg = presets.get("GC").with_(result_dir=str(tmp_path))
     report = sweep.verify_model(net, cfg, model_name="GC-4", resume=False)
     assert report.partitions_total == 201
     assert report.counts == {"sat": 2, "unsat": 199, "unknown": 0}

@@ -102,3 +102,28 @@ def test_partition_counts_match_reference_shapes():
     cfg = presets.get("DF")
     p_list, _, _ = sweep.build_partitions(cfg)
     assert len(p_list) <= 100
+
+
+def test_cli_metrics_subcommand(capsys, reference_assets_available):
+    """`fairify_tpu metrics` prints one group-report JSON line per model."""
+    if not reference_assets_available:
+        pytest.skip("reference assets not mounted")
+    import json
+
+    from fairify_tpu import cli
+
+    rc = cli.main(["metrics", "GC", "--models", "GC-4"])
+    assert rc == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rep = json.loads(line)
+    assert rep["model"] == "GC-4" and rep["protected"] == "age"
+    for key in ("accuracy", "disparate_impact", "statistical_parity_difference",
+                "equal_opportunity_difference", "average_odds_difference",
+                "error_rate_difference", "consistency", "theil_index"):
+        assert key in rep
+
+
+def test_cli_host_pair_validation(capsys):
+    from fairify_tpu import cli
+
+    assert cli.main(["run", "GC", "--host-index", "0"]) == 2
