@@ -19,6 +19,19 @@ import json
 import sys
 
 
+def _finite(v):
+    """JSON-strict numbers: non-finite floats (e.g. disparate impact with an
+    all-negative privileged group) become null, never the bare `Infinity`
+    token json.dumps would emit."""
+    import math
+
+    if isinstance(v, dict):
+        return {k: _finite(x) for k, x in v.items()}
+    if isinstance(v, float):
+        return round(v, 5) if math.isfinite(v) else None
+    return v
+
+
 def _cmd_list(_args) -> int:
     from fairify_tpu.verify import presets
 
@@ -127,8 +140,8 @@ def _cmd_experiment(args) -> int:
         "biased_neurons": ([[l, j, round(float(s), 5)]
                             for l, j, s in res.localization.ranked]
                            if res.localization else []),
-        "metrics": res.metrics,
-        "causal_rates": {k: round(v, 5) for k, v in res.causal_rates.items()},
+        "metrics": _finite(res.metrics),
+        "causal_rates": _finite(res.causal_rates),
         "saved_fairer": args.save_fairer or None,
     }
     print(json.dumps(out))
@@ -152,35 +165,28 @@ def _cmd_metrics(args) -> int:
     ds = loaders.load(cfg.dataset, root=args.data_root)
     pa = cfg.query().protected[0]
     pa_col = list(cfg.query().columns).index(pa)
-    rc = 1
-    paths = zoo.model_paths(cfg.dataset, root=args.model_root)
-    skipped = []
-    for path in paths:
-        if args.models and path.stem not in args.models:
-            continue
-        net = zoo.load(cfg.dataset, path.stem, root=args.model_root)
-        if net.in_dim != ds.X_test.shape[1]:
-            skipped.append(path.stem)
-            continue
+    nets, skipped = zoo.load_matching(
+        cfg.dataset, ds.X_test.shape[1],
+        models=tuple(args.models) if args.models else None,
+        root=args.model_root)
+    for name, net in nets.items():
         pred = np.asarray(
             mlp_mod.predict(net, jnp.asarray(ds.X_test, jnp.float32))).astype(int)
         rep = gm.group_report(ds.X_test, ds.y_test, pred,
                               ds.X_test[:, pa_col]).as_dict()
-        print(json.dumps({"model": path.stem, "protected": pa,
-                          **{k: round(v, 5) for k, v in rep.items()}}))
-        rc = 0
-    if rc:
-        if skipped:
-            print(f"all candidate models skipped (input dim != "
-                  f"{ds.X_test.shape[1]}): {skipped}", file=sys.stderr)
-        elif args.models:
-            print(f"no zoo model matched --models {args.models} for dataset "
-                  f"{cfg.dataset!r} (available: {[p.stem for p in paths]})",
-                  file=sys.stderr)
-        else:
-            print(f"no models found for dataset {cfg.dataset!r} "
-                  f"(set --model-root or FAIRIFY_TPU_MODEL_ROOT)", file=sys.stderr)
-    return rc
+        print(json.dumps({"model": name, "protected": pa, **_finite(rep)}))
+    if nets:
+        return 0
+    if skipped:
+        print(f"all candidate models skipped (input dim != "
+              f"{ds.X_test.shape[1]}): {skipped}", file=sys.stderr)
+    elif args.models:
+        print(f"no zoo model matched --models {args.models} for dataset "
+              f"{cfg.dataset!r}", file=sys.stderr)
+    else:
+        print(f"no models found for dataset {cfg.dataset!r} "
+              f"(set --model-root or FAIRIFY_TPU_MODEL_ROOT)", file=sys.stderr)
+    return 1
 
 
 def main(argv=None) -> int:

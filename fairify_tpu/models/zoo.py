@@ -49,3 +49,24 @@ def load(dataset: str, name: str, root=None):
 
 def load_family(dataset: str, root=None) -> dict:
     return {p.stem: load_keras_h5(p) for p in model_paths(dataset, root)}
+
+
+def load_matching(dataset: str, n_attrs: int, models=None, root=None):
+    """Zoo models whose input width matches the verification domain.
+
+    Returns ``(nets, skipped)``: ``nets`` maps name → net for every model
+    with ``in_dim == n_attrs`` (optionally restricted to ``models``),
+    ``skipped`` lists the mismatched names (e.g. the 12-input CP notebook
+    models vs the 6-attribute domain).  Shared by the sweep driver and the
+    metrics CLI so the selection rules cannot drift.
+    """
+    nets, skipped = {}, []
+    for path in model_paths(dataset, root=root):
+        if models is not None and path.stem not in models:
+            continue
+        net = load(dataset, path.stem, root=root)
+        if net.in_dim != n_attrs:
+            skipped.append(path.stem)
+            continue
+        nets[path.stem] = net
+    return nets, skipped

@@ -17,7 +17,7 @@ from __future__ import annotations
 import os
 import time
 from dataclasses import dataclass
-from typing import List
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -28,7 +28,6 @@ from fairify_tpu.ops import exact as exact_ops
 from fairify_tpu.ops import interval as interval_ops
 from fairify_tpu.ops import masks as mask_ops
 from fairify_tpu.ops import simulate as sim_ops
-from fairify_tpu.utils.prng import partition_key
 
 
 @dataclass
@@ -43,21 +42,36 @@ class PruneResult:
     pos_prob: List[np.ndarray]  # activation frequency per neuron
     ws_lb: List[np.ndarray]
     ws_ub: List[np.ndarray]
-    sim: np.ndarray  # (P, sim_size, d) simulated samples
+    sim: Optional[np.ndarray]  # (P, sim_size, d) samples; None if keep_sim=False
+    # (consumers regenerate rows on device via ops.simulate.simulate_box with
+    # grid_keys(seed, global_index, 1) — bit-identical)
     sv_time_s: float  # exact-verification phase (analog of SV solver time)
 
 
 from functools import partial
 
 
-@partial(jax.jit, static_argnames=("sim_size", "pallas"))
-def _sim_and_bounds(net: MLP, keys, lo, hi, sim_size: int, pallas: bool = False):
+@partial(jax.jit, static_argnames=("sim_size", "pallas", "with_sim"))
+def _sim_and_bounds(net: MLP, keys, lo, hi, sim_size: int, pallas: bool = False,
+                    with_sim: bool = True):
     stats, sim = jax.vmap(
         lambda k, l, h: sim_ops.simulate_and_stats(net, k, l, h, sim_size)
     )(keys, lo, hi)
     bounds_fn = interval_ops.network_bounds_pallas if pallas else interval_ops.network_bounds
     bounds = bounds_fn(net, lo, hi)
-    return stats, sim, bounds
+    # ``with_sim=False`` drops the (P, S, d) sample tensor from the jit
+    # outputs: XLA dead-code-eliminates its materialization and — the real
+    # win on a tunnelled TPU — it is never transferred to the host (the
+    # adult grid's samples are ~0.8 GB; consumers regenerate rows on device
+    # from the deterministic per-partition keys instead).
+    return stats, (sim if with_sim else None), bounds
+
+
+def grid_keys(seed: int, index_offset: int, n: int):
+    """Per-partition keys for global indices [offset, offset+n), one call."""
+    base = jax.random.key(seed)
+    return jax.vmap(lambda i: jax.random.fold_in(base, i))(
+        jnp.arange(index_offset, index_offset + n))
 
 
 @partial(jax.jit, static_argnames=("sim_size",))
@@ -78,6 +92,7 @@ def sound_prune_grid(
     exact_certify: bool = True,
     chunk: int = 0,
     index_offset: int = 0,
+    keep_sim: bool = True,
 ) -> PruneResult:
     """Sound pruning for a (P, d) box grid in batched device passes.
 
@@ -105,24 +120,24 @@ def sound_prune_grid(
     for s, e in spans:
         clo = pad_rows(lo_np[s:e], step)
         chi = pad_rows(hi_np[s:e], step)
-        keys = jnp.stack(
-            [partition_key(seed, index_offset + s + i) for i in range(step)])
+        keys = grid_keys(seed, index_offset + s, step)
         stats, sim, bounds = _sim_and_bounds(
             net, keys, jnp.asarray(clo, jnp.float32), jnp.asarray(chi, jnp.float32),
-            sim_size, pallas=use_pallas,
+            sim_size, pallas=use_pallas, with_sim=keep_sim,
         )
         n = e - s
         cand_c.append([np.asarray(c)[:n] for c in stats.candidates])
         pos_c.append([np.asarray(p) [:n] for p in stats.positive_prob])
         lb_c.append([np.asarray(b)[:n] for b in bounds.ws_lb])
         ub_c.append([np.asarray(b)[:n] for b in bounds.ws_ub])
-        sim_c.append(np.asarray(sim)[:n])
+        if keep_sim:
+            sim_c.append(np.asarray(sim)[:n])
 
     L = len(cand_c[0])
     _cat = lambda parts: [np.concatenate([p[l] for p in parts]) for l in range(L)]
     candidates, pos_prob = _cat(cand_c), _cat(pos_c)
     ws_lb, ws_ub = _cat(lb_c), _cat(ub_c)
-    sim = np.concatenate(sim_c)
+    sim = np.concatenate(sim_c) if keep_sim else None
     bounds = interval_ops.LayerBounds(
         ws_lb=tuple(ws_lb), ws_ub=tuple(ws_ub), pl_lb=(), pl_ub=())
 
@@ -167,7 +182,7 @@ def sound_prune_grid(
         pos_prob=pos_prob,
         ws_lb=ws_lb,
         ws_ub=ws_ub,
-        sim=np.asarray(sim),
+        sim=sim,
         sv_time_s=sv_time,
     )
 
@@ -185,7 +200,7 @@ def harsh_prune_grid(net: MLP, lo: np.ndarray, hi: np.ndarray, sim_size: int, se
     Returns per-layer (P, n_l) dead masks for the box grid.
     """
     P = lo.shape[0]
-    keys = jnp.stack([partition_key(seed, i) for i in range(P)])
+    keys = grid_keys(seed, 0, P)
     stats = _sim_stats(
         net, keys, jnp.asarray(lo, jnp.float32), jnp.asarray(hi, jnp.float32), sim_size
     )

@@ -233,22 +233,38 @@ def _stage0_family(stacked, enc: PairEncoding, lo, hi, cfg: SweepConfig, mesh=No
     return results
 
 
-@jax.jit
-def _parity_grid(net, sim, alive):
-    """Pruned-vs-original prediction parity for the WHOLE grid in one kernel.
+from functools import partial as _partial
 
-    ``sim``: (P, S, d) simulated inputs; ``alive``: per-layer (P, n_l) alive
-    masks.  Replaces the reference's per-partition ``pruned_acc`` loop
-    (``src/GC/Verify-GC.py:265-270``) — and the per-partition device dispatch
-    that a naive port would pay — with one vmapped forward pair.
+
+@_partial(jax.jit, static_argnames=("sim_size",))
+def _parity_grid_from_keys(net, keys, lo, hi, alive, sim_size: int):
+    """Pruned-vs-original prediction parity for the whole grid, one kernel.
+
+    Replaces the reference's per-partition ``pruned_acc`` loop
+    (``src/GC/Verify-GC.py:265-270``).  The simulation samples are
+    regenerated on device from their per-partition keys (bit-identical to
+    ``PruneResult.sim``: same ``simulate_box`` + key), so the (P, S, d)
+    sample tensor never crosses the host↔device link — on the tunnelled
+    single-chip setup that transfer dominated the whole stage-0 wall time
+    for the adult grid (~0.8 GB per model).
     """
+    from fairify_tpu.ops import simulate as sim_ops
 
-    def one(s, masks):
+    def one(k, l, h, masks):
+        s = sim_ops.simulate_box(k, l, h, sim_size)
         orig = mlp_mod.forward(net, s) > 0.0
         masked = mlp_mod.forward(net.with_masks(masks), s) > 0.0
         return jnp.mean((orig == masked).astype(jnp.float32))
 
-    return jax.vmap(one)(sim, alive)
+    return jax.vmap(one)(keys, lo, hi, alive)
+
+
+@_partial(jax.jit, static_argnames=("sim_size",))
+def _sim_rows(key, lo, hi, sim_size: int):
+    """One partition's simulation samples, regenerated from its key."""
+    from fairify_tpu.ops import simulate as sim_ops
+
+    return sim_ops.simulate_box(key, lo, hi, sim_size)
 
 
 def _c_check_np(weights, biases, dead, ce) -> tuple:
@@ -340,7 +356,7 @@ def verify_model(
             prune = pruning.sound_prune_grid(
                 net, lo, hi, cfg.sim_size, cfg.seed,
                 exact_certify=cfg.exact_certify_masks, chunk=cfg.grid_chunk,
-                index_offset=span_start,
+                index_offset=span_start, keep_sim=False,
             )
         with timer.phase("stage0_decide"):
             if stage0 is not None:  # precomputed by the stacked family kernel
@@ -355,9 +371,12 @@ def verify_model(
                 alive = tuple(
                     jnp.asarray(_pad_rows(1.0 - d[s:e], step), jnp.float32)
                     for d in prune.st_deads)
-                block = _parity_grid(
-                    net, jnp.asarray(_pad_rows(prune.sim[s:e], step), jnp.float32),
-                    alive)
+                keys = pruning.grid_keys(cfg.seed, span_start + s, step)
+                block = _parity_grid_from_keys(
+                    net, keys,
+                    jnp.asarray(_pad_rows(lo[s:e], step), jnp.float32),
+                    jnp.asarray(_pad_rows(hi[s:e], step), jnp.float32),
+                    alive, cfg.sim_size)
                 parity[s:e] = np.asarray(block)[: e - s]
         stage0_per_part = 0.0  # finalized (incl. the PGD phase) below
 
@@ -419,7 +438,11 @@ def verify_model(
         pid = span_start + p + 1
         if pid in done:
             rec = done[pid]
-            out = PartitionOutcome(pid, rec["verdict"])
+            ce = rec.get("ce")
+            out = PartitionOutcome(
+                pid, rec["verdict"],
+                counterexample=(tuple(np.asarray(c, dtype=np.int64) for c in ce)
+                                if ce else None))
             outcomes.append(out)
             counts = {"sat": sat_count, "unsat": unsat_count, "unknown": unk_count}
             counts[rec["verdict"]] += 1
@@ -473,9 +496,13 @@ def verify_model(
         if verdict == "sat" and ce is not None:
             c_check, v_accurate = _c_check_np(weights, biases, dead, ce)
         if h_attempt:  # masks changed after the batched parity pass
+            sim_p = np.asarray(_sim_rows(
+                pruning.grid_keys(cfg.seed, span_start + p, 1)[0],
+                jnp.asarray(lo[p], jnp.float32), jnp.asarray(hi[p], jnp.float32),
+                cfg.sim_size))
             pruned_acc = float((
-                mlp_mod.predict_np(weights, biases, prune.sim[p])
-                == mlp_mod.predict_np(weights, biases, prune.sim[p], dead=dead)
+                mlp_mod.predict_np(weights, biases, sim_p)
+                == mlp_mod.predict_np(weights, biases, sim_p, dead=dead)
             ).mean())
         else:
             pruned_acc = float(parity[p])
@@ -577,17 +604,11 @@ def run_sweep(
 
     dataset = loaders.load(cfg.dataset, root=data_root)
     n_attrs = len(cfg.query().columns)
-    nets = {}
-    for path in zoo.model_paths(cfg.dataset, root=model_root):
-        if cfg.models is not None and path.stem not in cfg.models:
-            continue
-        net = zoo.load(cfg.dataset, path.stem, root=model_root)
-        if net.in_dim != n_attrs:
-            # e.g. the 12-input CP notebook models vs the 6-attribute domain.
-            print(f"skipping {path.stem}: input dim {net.in_dim} != "
-                  f"domain dim {n_attrs}", file=sys.stderr)
-            continue
-        nets[path.stem] = net
+    nets, skipped = zoo.load_matching(
+        cfg.dataset, n_attrs, models=cfg.models, root=model_root)
+    for name in skipped:
+        print(f"skipping {name}: input dim != domain dim {n_attrs}",
+              file=sys.stderr)
     if not nets:
         return []
 
