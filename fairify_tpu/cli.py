@@ -181,8 +181,9 @@ def _cmd_metrics(args) -> int:
         print(f"all candidate models skipped (input dim != "
               f"{ds.X_test.shape[1]}): {skipped}", file=sys.stderr)
     elif args.models:
+        avail = [p.stem for p in zoo.model_paths(cfg.dataset, root=args.model_root)]
         print(f"no zoo model matched --models {args.models} for dataset "
-              f"{cfg.dataset!r}", file=sys.stderr)
+              f"{cfg.dataset!r} (available: {avail})", file=sys.stderr)
     else:
         print(f"no models found for dataset {cfg.dataset!r} "
               f"(set --model-root or FAIRIFY_TPU_MODEL_ROOT)", file=sys.stderr)

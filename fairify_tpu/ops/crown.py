@@ -60,12 +60,20 @@ def _relu_relaxation(lo: jax.Array, hi: jax.Array, mask: jax.Array):
     return us, ui, ls
 
 
-def _backward_bounds(params: MLP, k: int, pre_lbs, pre_ubs, in_lb, in_ub):
+def _backward_bounds(params: MLP, k: int, pre_lbs, pre_ubs, in_lb, in_ub,
+                     alphas_low=None, alphas_up=None):
     """CROWN bounds on layer-k pre-activations given bounds for layers < k.
 
     ``in_lb``/``in_ub``: (..., d) input box.  ``pre_lbs[j]``/``pre_ubs[j]``:
     (..., n_j) pre-activation bounds of hidden layer j.  Returns (lo, hi) of
     shape (..., n_k).
+
+    ``alphas_low``/``alphas_up``: optional per-hidden-layer (..., n_j) lower
+    ReLU slopes in [0, 1] for unstable neurons — the α of α-CROWN (Xu et
+    al. 2021, public algorithm).  ``relu(z) ≥ α·z`` holds for every
+    α ∈ [0, 1] when ``lo < 0 < hi``, so *any* values are sound; the
+    optimizer below tunes them per box.  ``None`` keeps the adaptive
+    heuristic slope.
     """
     w_k = params.weights[k]
     batch = in_lb.shape[:-1]
@@ -77,15 +85,24 @@ def _backward_bounds(params: MLP, k: int, pre_lbs, pre_ubs, in_lb, in_ub):
     c_up = c_low
     for j in range(k - 1, -1, -1):
         us, ui, ls = _relu_relaxation(pre_lbs[j], pre_ubs[j], params.masks[j])
+        unstable = (pre_lbs[j] < 0.0) & (pre_ubs[j] > 0.0)
+        if alphas_low is not None:
+            ls_low = jnp.where(unstable, alphas_low[j], ls) * params.masks[j]
+        else:
+            ls_low = ls
+        if alphas_up is not None:
+            ls_up = jnp.where(unstable, alphas_up[j], ls) * params.masks[j]
+        else:
+            ls_up = ls
         # Pass through h_j = relu(z_j): pick relaxation per coefficient sign.
         Ap = jnp.maximum(A_low, 0.0)
         An = jnp.minimum(A_low, 0.0)
         c_low = c_low + matmul(jnp.expand_dims(ui, -2), An)[..., 0, :]
-        A_low = Ap * ls[..., :, None] + An * us[..., :, None]
+        A_low = Ap * ls_low[..., :, None] + An * us[..., :, None]
         Ap = jnp.maximum(A_up, 0.0)
         An = jnp.minimum(A_up, 0.0)
         c_up = c_up + matmul(jnp.expand_dims(ui, -2), Ap)[..., 0, :]
-        A_up = Ap * us[..., :, None] + An * ls[..., :, None]
+        A_up = Ap * us[..., :, None] + An * ls_up[..., :, None]
         # Pass through z_j = h_{j-1} @ w_j + b_j.
         w_j, b_j = params.weights[j], params.biases[j]
         c_low = c_low + matmul(jnp.expand_dims(b_j, -2), A_low)[..., 0, :]
@@ -145,3 +162,60 @@ def crown_output_bounds(params: MLP, lb: jax.Array, ub: jax.Array, widen: bool =
     """CROWN bounds of the scalar output logit over a batch of boxes."""
     bounds = crown_bounds(params, lb, ub, widen=widen)
     return bounds.ws_lb[-1][..., 0], bounds.ws_ub[-1][..., 0]
+
+
+def alpha_crown_output_bounds(params: MLP, lb: jax.Array, ub: jax.Array,
+                              iters: int = 8, widen: bool = True):
+    """α-CROWN output-logit bounds: per-box optimized lower ReLU slopes.
+
+    Standard α-CROWN (Xu et al. 2021): intermediate-layer bounds stay fixed
+    (plain CROWN), and the final backward pass is re-run with free lower
+    slopes ``α ∈ [0, 1]`` for unstable neurons, tuned by signed-gradient
+    ascent to maximize the output lower bound and minimize the upper bound
+    (separate α sets per direction).  Every iterate is sound — the search
+    only moves between valid relaxations — so the result is intersected
+    with the unoptimized bound and widened like every other bound kernel.
+
+    Batched over arbitrary leading axes and fully jit-compatible (``iters``
+    is static, the loop unrolls).  Typically worthwhile only for the
+    branch-and-bound leftovers: several extra backward passes per call.
+    """
+    bounds = crown_bounds(params, lb, ub, widen=True)
+    k = params.depth - 1
+    pre_lbs = [bounds.ws_lb[j] for j in range(k)]
+    pre_ubs = [bounds.ws_ub[j] for j in range(k)]
+    lo0, hi0 = bounds.ws_lb[-1][..., 0], bounds.ws_ub[-1][..., 0]
+    if k == 0 or iters <= 0:
+        return lo0, hi0
+
+    # Start from the adaptive heuristic slope (what plain CROWN uses).
+    init = [jnp.where(pre_ubs[j] >= -pre_lbs[j], 1.0, 0.0) for j in range(k)]
+    al = [a for a in init]
+    au = [a for a in init]
+
+    def width(al_, au_):
+        lo, hi = _backward_bounds(params, k, pre_lbs, pre_ubs, lb, ub,
+                                  alphas_low=al_, alphas_up=au_)
+        return jnp.sum(hi[..., 0] - lo[..., 0]), (lo[..., 0], hi[..., 0])
+
+    lr = 0.5
+    # Track the best *unwidened* optimized bounds; widen once at the end and
+    # only then intersect with the (already-widened) plain-CROWN baseline —
+    # the result can never be looser than plain CROWN.
+    opt_lo = opt_hi = None
+    for _ in range(iters):
+        (_, (lo, hi)), grads = jax.value_and_grad(width, argnums=(0, 1),
+                                                  has_aux=True)(al, au)
+        opt_lo = lo if opt_lo is None else jnp.maximum(opt_lo, lo)
+        opt_hi = hi if opt_hi is None else jnp.minimum(opt_hi, hi)
+        g_al, g_au = grads
+        # Signed updates: per-box α gradients decouple (the objective sums
+        # over the batch), and sign steps need no per-net learning rate.
+        al = [jnp.clip(a - lr * jnp.sign(g), 0.0, 1.0) for a, g in zip(al, g_al)]
+        au = [jnp.clip(a - lr * jnp.sign(g), 0.0, 1.0) for a, g in zip(au, g_au)]
+        lr *= 0.6
+    _, (lo, hi) = width(al, au)
+    opt_lo, opt_hi = jnp.maximum(opt_lo, lo), jnp.minimum(opt_hi, hi)
+    if widen:
+        opt_lo, opt_hi = _widen(opt_lo, opt_hi)
+    return jnp.maximum(opt_lo, lo0), jnp.minimum(opt_hi, hi0)

@@ -16,8 +16,18 @@ def run_key(seed: int) -> jax.Array:
     return jax.random.key(seed)
 
 
-def partition_key(seed: int, partition_index: int) -> jax.Array:
-    return jax.random.fold_in(jax.random.key(seed), partition_index)
+def grid_keys(seed: int, index_offset: int, n: int) -> jax.Array:
+    """Per-partition keys for global indices [offset, offset+n), one call.
+
+    The single key-derivation scheme of the framework: every consumer
+    (pruning simulation, parity replay, heuristic-retry replay) regenerates
+    identical streams from (seed, global partition index).
+    """
+    import jax.numpy as jnp
+
+    base = jax.random.key(seed)
+    return jax.vmap(lambda i: jax.random.fold_in(base, i))(
+        jnp.arange(index_offset, index_offset + n))
 
 
 def shuffled_order(n: int, seed: int) -> np.ndarray:

@@ -62,6 +62,14 @@ def _role_logit_bounds(net: MLP, x_lo, x_hi, xp_lo, xp_hi, use_crown: bool):
     return lb_x, ub_x, lb_p, ub_p
 
 
+@partial(jax.jit, static_argnames=("alpha_iters",))
+def _role_logit_bounds_alpha(net: MLP, x_lo, x_hi, xp_lo, xp_hi, alpha_iters: int):
+    """α-CROWN role bounds for the BaB frontier (static unrolled iters)."""
+    lb_x, ub_x = crown_ops.alpha_crown_output_bounds(net, x_lo, x_hi, iters=alpha_iters)
+    lb_p, ub_p = crown_ops.alpha_crown_output_bounds(net, xp_lo, xp_hi, iters=alpha_iters)
+    return lb_x, ub_x, lb_p, ub_p
+
+
 def no_flip_certified(
     lb_x, ub_x, lb_p, ub_p, valid_assign: np.ndarray, valid_pair: np.ndarray
 ) -> np.ndarray:
@@ -390,6 +398,10 @@ def decide_leaf(enc: PairEncoding, weights, biases, point: np.ndarray, lo, hi):
 @dataclass
 class EngineConfig:
     use_crown: bool = True
+    # α-CROWN signed-gradient slope-optimization steps for branch-and-bound
+    # bounds (0 = plain CROWN).  Stage-0 stays plain CROWN — the whole grid
+    # rarely needs the extra backward passes; the BaB leftovers do.
+    alpha_iters: int = 8
     attack_samples: int = 128
     bab_attack_samples: int = 16
     frontier_size: int = 512
@@ -505,10 +517,21 @@ def decide_many(
         plo = _pad(blo, F).astype(np.float32)
         phi = _pad(bhi, F).astype(np.float32)
         x_lo, x_hi, xp_lo, xp_hi, valid = role_boxes(enc, plo, phi)
-        lb_x, ub_x, lb_p, ub_p = _role_logit_bounds(
-            net, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo), jnp.asarray(xp_hi),
-            cfg.use_crown,
-        )
+        # Escalation: plain CROWN clears the easy boxes in one cheap pass;
+        # once a fifth of the deadline is spent the survivors are the hard
+        # ones, where α-CROWN's extra backward passes pay for themselves.
+        use_alpha = (cfg.use_crown and cfg.alpha_iters > 0
+                     and time.perf_counter() - t0 > 0.2 * deadline_s)
+        if use_alpha:
+            lb_x, ub_x, lb_p, ub_p = _role_logit_bounds_alpha(
+                net, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
+                jnp.asarray(xp_hi), cfg.alpha_iters,
+            )
+        else:
+            lb_x, ub_x, lb_p, ub_p = _role_logit_bounds(
+                net, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
+                jnp.asarray(xp_hi), cfg.use_crown,
+            )
         certified = no_flip_certified(lb_x, ub_x, lb_p, ub_p, valid, enc.valid_pair)[:batch]
 
         undecided = np.where(~certified & live)[0]

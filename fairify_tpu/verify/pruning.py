@@ -67,11 +67,7 @@ def _sim_and_bounds(net: MLP, keys, lo, hi, sim_size: int, pallas: bool = False,
     return stats, (sim if with_sim else None), bounds
 
 
-def grid_keys(seed: int, index_offset: int, n: int):
-    """Per-partition keys for global indices [offset, offset+n), one call."""
-    base = jax.random.key(seed)
-    return jax.vmap(lambda i: jax.random.fold_in(base, i))(
-        jnp.arange(index_offset, index_offset + n))
+from fairify_tpu.utils.prng import grid_keys  # canonical key derivation
 
 
 @partial(jax.jit, static_argnames=("sim_size",))

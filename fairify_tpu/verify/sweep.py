@@ -25,6 +25,7 @@ import json
 import os
 import time
 from dataclasses import dataclass, field, replace
+from functools import partial
 from typing import Dict, List, Optional
 
 import jax
@@ -233,10 +234,7 @@ def _stage0_family(stacked, enc: PairEncoding, lo, hi, cfg: SweepConfig, mesh=No
     return results
 
 
-from functools import partial as _partial
-
-
-@_partial(jax.jit, static_argnames=("sim_size",))
+@partial(jax.jit, static_argnames=("sim_size",))
 def _parity_grid_from_keys(net, keys, lo, hi, alive, sim_size: int):
     """Pruned-vs-original prediction parity for the whole grid, one kernel.
 
@@ -259,7 +257,7 @@ def _parity_grid_from_keys(net, keys, lo, hi, alive, sim_size: int):
     return jax.vmap(one)(keys, lo, hi, alive)
 
 
-@_partial(jax.jit, static_argnames=("sim_size",))
+@partial(jax.jit, static_argnames=("sim_size",))
 def _sim_rows(key, lo, hi, sim_size: int):
     """One partition's simulation samples, regenerated from its key."""
     from fairify_tpu.ops import simulate as sim_ops
@@ -552,27 +550,25 @@ def verify_model(
                 "ce": [ce[0].tolist(), ce[1].tolist()] if ce else None,
                 "time_s": round(total_time, 4),
             }) + "\n")
+        if ce is not None:
+            # Counterexample CSV, encoded form (``src/CP/Verify-CP.py:310-326``),
+            # appended per partition like the ledger: crash-safe, and resumed
+            # partitions (written by the run that decided them) never repeat.
+            # Decoded form: analysis.decode.counterexample_table.
+            import csv as _csv
+
+            ce_path = os.path.join(cfg.result_dir, f"{sink_name}-counterexamples.csv")
+            new_file = not os.path.isfile(ce_path)
+            with open(ce_path, "a", newline="") as fp:
+                wr = _csv.writer(fp)
+                if new_file:
+                    wr.writerow(["partition_id", "role"] + list(cfg.query().columns))
+                wr.writerow([pid, "x"] + [int(v) for v in ce[0]])
+                wr.writerow([pid, "x'"] + [int(v) for v in ce[1]])
 
         # Hard budget is enforced where work happens: the BaB deadline above
         # and the heuristic-retry guard.  Verdicts already computed are always
         # reported — no work is discarded by a reporting-loop break.
-
-    # Counterexample CSV, encoded form (``src/CP/Verify-CP.py:310-326``);
-    # decoded form available via analysis.decode.counterexample_table.
-    ces = [(o.partition_id, o.counterexample) for o in outcomes if o.counterexample]
-    if ces:
-        import csv as _csv
-
-        cols = list(cfg.query().columns)
-        ce_path = os.path.join(cfg.result_dir, f"{sink_name}-counterexamples.csv")
-        new_file = not os.path.isfile(ce_path)
-        with open(ce_path, "a", newline="") as fp:
-            wr = _csv.writer(fp)
-            if new_file:
-                wr.writerow(["partition_id", "role"] + cols)
-            for pid, (x, xp) in ces:
-                wr.writerow([pid, "x"] + [int(v) for v in x])
-                wr.writerow([pid, "x'"] + [int(v) for v in xp])
 
     counter.dump(os.path.join(cfg.result_dir, f"{cfg.name}-{sink_name}.throughput.json"))
     return ModelReport(

@@ -87,3 +87,42 @@ def test_crown_stable_layers_exact_for_linear_region():
     logits = np.asarray(mlp.forward(net, jnp.asarray(pts)))
     assert abs(float(clb) - logits.min()) < 1e-3
     assert abs(float(cub) - logits.max()) < 1e-3
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+@pytest.mark.parametrize("sizes", [(3, 8, 1), (3, 6, 6, 1), (4, 10, 5, 5, 1)])
+def test_alpha_crown_sound_and_no_looser(seed, sizes):
+    """α-CROWN output bounds remain sound and never meaningfully loosen
+    plain CROWN (they are intersected with it; slack tolerance only)."""
+    rng = np.random.default_rng(100 + seed)
+    net = random_net(rng, sizes)
+    lo = np.zeros(sizes[0], dtype=np.float32)
+    hi = np.full(sizes[0], 2.0, dtype=np.float32)
+
+    pts = grid_points(lo, hi)
+    logits = np.asarray(mlp.forward(net, jnp.asarray(pts)))
+
+    clb, cub = crown.crown_output_bounds(net, jnp.asarray(lo), jnp.asarray(hi))
+    alb, aub = crown.alpha_crown_output_bounds(
+        net, jnp.asarray(lo), jnp.asarray(hi), iters=8)
+
+    assert float(alb) <= logits.min() + 1e-5
+    assert float(aub) >= logits.max() - 1e-5
+    # Intersected with plain CROWN after widening: never looser, exactly.
+    assert float(alb) >= float(clb) - 1e-7
+    assert float(aub) <= float(cub) + 1e-7
+
+
+def test_alpha_crown_tightens_deep_net():
+    """On deeper nets (where CROWN's heuristic slope is weakest) the
+    α-optimized bounds should be strictly tighter for most random boxes."""
+    rng = np.random.default_rng(7)
+    net = random_net(rng, (4, 10, 10, 10, 1))
+    lo = np.zeros((16, 4), dtype=np.float32)
+    hi = np.full((16, 4), 3.0, dtype=np.float32)
+    clb, cub = crown.crown_output_bounds(net, jnp.asarray(lo), jnp.asarray(hi))
+    alb, aub = crown.alpha_crown_output_bounds(net, jnp.asarray(lo), jnp.asarray(hi), iters=8)
+    cw = np.asarray(cub) - np.asarray(clb)
+    aw = np.asarray(aub) - np.asarray(alb)
+    assert (aw <= cw + 1e-4).all()
+    assert aw.mean() < cw.mean()  # strictly tighter on average
