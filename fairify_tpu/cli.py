@@ -85,7 +85,8 @@ def _cmd_run(args) -> int:
         return 2
     reports = sweep.run_sweep(cfg, model_root=args.model_root, data_root=args.data_root,
                               mesh=mesh, host_index=args.host_index,
-                              host_count=args.host_count)
+                              host_count=args.host_count,
+                              retry_unknown=args.retry_unknown)
     if not reports:
         print(f"no models found for dataset {cfg.dataset!r} "
               f"(set --model-root or FAIRIFY_TPU_MODEL_ROOT)", file=sys.stderr)
@@ -205,6 +206,8 @@ def main(argv=None) -> int:
     run.add_argument("--seed", type=int, default=None)
     run.add_argument("--model-root", default=None)
     run.add_argument("--data-root", default=None)
+    run.add_argument("--retry-unknown", action="store_true",
+                     help="re-attempt partitions a previous run left UNKNOWN")
     run.add_argument("--host-index", type=int, default=None,
                      help="this process's index for multi-host partition distribution")
     run.add_argument("--host-count", type=int, default=None,

@@ -304,6 +304,7 @@ def verify_model(
     dataset: Optional[loaders.LoadedDataset] = None,
     mesh=None,
     resume: bool = True,
+    retry_unknown: bool = False,
     stage0=None,
     partition_span=None,
     host_index=None,
@@ -344,6 +345,13 @@ def verify_model(
     os.makedirs(cfg.result_dir, exist_ok=True)
     ledger_path = _ledger_path(cfg, sink_name)
     done = _load_ledger(ledger_path) if resume else {}
+    if retry_unknown:
+        # Re-attempt budget-exhausted partitions (e.g. with a larger soft
+        # timeout); decided verdicts stay settled.  The re-decided rows are
+        # re-appended to the ledger, and _load_ledger's last-wins merge makes
+        # the retry the record of truth on the next resume.
+        done = {pid: rec for pid, rec in done.items()
+                if rec["verdict"] != "unknown"}
     csv_path = os.path.join(cfg.result_dir, f"{sink_name}.csv")
 
     from fairify_tpu.utils.profiling import ThroughputCounter, xla_trace
@@ -570,6 +578,11 @@ def verify_model(
         # and the heuristic-retry guard.  Verdicts already computed are always
         # reported — no work is discarded by a reporting-loop break.
 
+    if retry_unknown:
+        # Re-decided rows were appended after their original 'unknown' rows;
+        # restore one-row-per-partition ascending order for row-for-row
+        # comparison against reference CSVs.
+        csvio.rewrite_deduped(csv_path)
     counter.dump(os.path.join(cfg.result_dir, f"{cfg.name}-{sink_name}.throughput.json"))
     return ModelReport(
         model=model_name, dataset=cfg.dataset, outcomes=outcomes,
@@ -579,7 +592,7 @@ def verify_model(
 
 def run_sweep(
     cfg: SweepConfig, model_root=None, data_root=None, mesh=None, stack: bool = True,
-    host_index=None, host_count=None,
+    host_index=None, host_count=None, retry_unknown: bool = False,
 ) -> List[ModelReport]:
     """Sweep every model of the configured family (the drivers' outer loop).
 
@@ -633,6 +646,7 @@ def run_sweep(
         reports.append(
             verify_model(net, cfg, model_name=name, dataset=dataset, mesh=mesh,
                          stage0=stage0_by_model.get(name),
-                         host_index=host_index, host_count=host_count)
+                         host_index=host_index, host_count=host_count,
+                         retry_unknown=retry_unknown)
         )
     return reports

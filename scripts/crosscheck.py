@@ -51,15 +51,9 @@ def main() -> int:
     enc = encode(cfg.query())
     _, lo, hi = sweep.build_partitions(cfg)
 
-    verdicts = {}
-    with open(args.ledger) as fp:
-        for line in fp:
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            verdicts[rec["partition_id"]] = rec["verdict"]
-    unsat = np.array(sorted(pid - 1 for pid, v in verdicts.items() if v == "unsat"))
+    ledger = sweep._load_ledger(args.ledger)
+    unsat = np.array(sorted(pid - 1 for pid, rec in ledger.items()
+                            if rec["verdict"] == "unsat"))
     print(f"auditing {len(unsat)} UNSAT partitions of {args.model} "
           f"({args.samples} samples + {args.restarts}x40 PGD each)",
           file=sys.stderr)

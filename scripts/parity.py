@@ -181,7 +181,11 @@ def cmd_render(args):
                 # truth (the heuristic path is unsound, utils/prune.py:862-939);
                 # counts within that slack + our unknowns are consistent —
                 # scripts/crosscheck.py adjudicates by attacking our UNSATs.
-                near = abs(r["sat"] - ref["sat"]) <= ref["hs"] + r["unknown"]
+                # Direction matters: our SATs are exact-replay-validated, so
+                # a SAT *surplus* can only be explained by ref heuristic rows
+                # (#HS); a SAT *deficit* additionally by our own unknowns.
+                near = ((r["sat"] - ref["sat"] <= ref["hs"])
+                        and (ref["sat"] - r["sat"] <= ref["hs"] + r["unknown"]))
                 agree = "exact" if ok else ("near*" if near else "MISMATCH")
             elif ref["ver"] == "SAT":
                 agree = "yes" if r["sat"] > 0 else "MISMATCH"

@@ -127,3 +127,56 @@ def test_cli_host_pair_validation(capsys):
     from fairify_tpu import cli
 
     assert cli.main(["run", "GC", "--host-index", "0"]) == 2
+
+
+def test_retry_unknown_reattempts_only_unknowns(tmp_path):
+    """resume keeps decided verdicts; retry_unknown re-decides UNKNOWN rows."""
+    import json
+
+    from fairify_tpu.models.train import init_mlp
+
+    net = init_mlp((20, 8, 1), seed=3)
+    cfg = presets.get("GC").with_(
+        result_dir=str(tmp_path), soft_timeout_s=30.0, hard_timeout_s=300.0,
+        sim_size=64, exact_certify_masks=False)
+    ledger = os.path.join(str(tmp_path), "GC-m.ledger.jsonl")
+    # Fabricate a ledger: partition 1 budget-exhausted, 2..201 decided.
+    with open(ledger, "w") as fp:
+        fp.write(json.dumps({"partition_id": 1, "verdict": "unknown",
+                             "ce": None, "time_s": 0.0}) + "\n")
+        for pid in range(2, 202):
+            fp.write(json.dumps({"partition_id": pid, "verdict": "unsat",
+                                 "ce": None, "time_s": 0.0}) + "\n")
+
+    plain = sweep.verify_model(net, cfg, model_name="m", resume=True)
+    assert plain.counts["unknown"] == 1  # resume keeps the recorded verdicts
+
+    retried = sweep.verify_model(net, cfg, model_name="m", resume=True,
+                                 retry_unknown=True)
+    by_pid = {o.partition_id: o.verdict for o in retried.outcomes}
+    assert by_pid[1] in ("sat", "unsat")  # re-decided with the real budget
+    assert sum(v == "unsat" for pid, v in by_pid.items() if pid > 1) == 200
+
+
+def test_retry_unknown_csv_stays_one_row_per_partition(tmp_path):
+    import csv as _csv
+    import json
+
+    from fairify_tpu.models.train import init_mlp
+    from fairify_tpu.verify import csvio
+
+    net = init_mlp((20, 8, 1), seed=3)
+    cfg = presets.get("GC").with_(
+        result_dir=str(tmp_path), soft_timeout_s=30.0, hard_timeout_s=300.0,
+        sim_size=64, exact_certify_masks=False)
+    first = sweep.verify_model(net, cfg, model_name="m", resume=False)
+    # Force partition 5 back to unknown in the ledger, then retry.
+    ledger = os.path.join(str(tmp_path), "GC-m.ledger.jsonl")
+    with open(ledger, "a") as fp:
+        fp.write(json.dumps({"partition_id": 5, "verdict": "unknown",
+                             "ce": None, "time_s": 0.0}) + "\n")
+    sweep.verify_model(net, cfg, model_name="m", resume=True, retry_unknown=True)
+    with open(os.path.join(str(tmp_path), "m.csv"), newline="") as fp:
+        rows = list(_csv.reader(fp))[1:]
+    pids = [int(r[0]) for r in rows]
+    assert pids == sorted(pids) and len(pids) == len(set(pids)) == 201
