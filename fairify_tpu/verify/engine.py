@@ -442,6 +442,7 @@ def decide_many(
     roots_hi: np.ndarray,
     cfg: EngineConfig,
     deadline_s: Optional[float] = None,
+    mesh=None,
 ) -> list:
     """Branch-and-bound over MANY root boxes sharing one device frontier.
 
@@ -455,9 +456,17 @@ def decide_many(
 
     ``deadline_s`` defaults to ``soft_timeout_s × n_roots`` — the same total
     budget the reference would spend, but shared work-conservingly.
+
+    With a ``mesh``, the padded frontier batch is sharded over the
+    ``parts`` axis for the bound and attack kernels (the host branching
+    logic is unchanged), so stage 1 scales across chips like stage 0.
     """
     from fairify_tpu.verify.property import role_boxes
 
+    if mesh is not None:
+        from fairify_tpu.parallel import mesh as mesh_mod
+
+        net_sharded = mesh_mod.replicated(mesh, net)
     t0 = time.perf_counter()
     R = roots_lo.shape[0]
     if deadline_s is None:
@@ -517,6 +526,11 @@ def decide_many(
         plo = _pad(blo, F).astype(np.float32)
         phi = _pad(bhi, F).astype(np.float32)
         x_lo, x_hi, xp_lo, xp_hi, valid = role_boxes(enc, plo, phi)
+        bound_net = net
+        if mesh is not None:
+            x_lo, x_hi, xp_lo, xp_hi = mesh_mod.shard_parts(
+                mesh, x_lo, x_hi, xp_lo, xp_hi)
+            bound_net = net_sharded
         # Escalation: plain CROWN clears the easy boxes in one cheap pass;
         # once a fifth of the deadline is spent the survivors are the hard
         # ones, where α-CROWN's extra backward passes pay for themselves.
@@ -524,14 +538,15 @@ def decide_many(
                      and time.perf_counter() - t0 > 0.2 * deadline_s)
         if use_alpha:
             lb_x, ub_x, lb_p, ub_p = _role_logit_bounds_alpha(
-                net, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
+                bound_net, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
                 jnp.asarray(xp_hi), cfg.alpha_iters,
             )
         else:
             lb_x, ub_x, lb_p, ub_p = _role_logit_bounds(
-                net, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
+                bound_net, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
                 jnp.asarray(xp_hi), cfg.use_crown,
             )
+        lb_x, ub_x, lb_p, ub_p = (np.asarray(v)[:F] for v in (lb_x, ub_x, lb_p, ub_p))
         certified = no_flip_certified(lb_x, ub_x, lb_p, ub_p, valid, enc.valid_pair)[:batch]
 
         undecided = np.where(~certified & live)[0]
@@ -539,7 +554,12 @@ def decide_many(
             # Attack the undecided boxes (padded so the forward compiles once).
             ulo, uhi = _pad(blo[undecided], F), _pad(bhi[undecided], F)
             xr, pr = build_attack_candidates(enc, rng, ulo, uhi, cfg.bab_attack_samples)
-            lx, lp = _attack_logits(net, jnp.asarray(xr), jnp.asarray(pr))
+            if mesh is not None:
+                xr_s, pr_s = mesh_mod.shard_parts(mesh, xr, pr)
+                lx, lp = _attack_logits(bound_net, xr_s, pr_s)
+                lx, lp = np.asarray(lx)[:F], np.asarray(lp)[:F]
+            else:
+                lx, lp = _attack_logits(net, jnp.asarray(xr), jnp.asarray(pr))
             found, wit = find_flips(
                 enc, np.asarray(lx), np.asarray(lp), _pad(valid[undecided], F)
             )

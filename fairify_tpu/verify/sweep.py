@@ -430,7 +430,8 @@ def verify_model(
             deadline = min(cfg.soft_timeout_s * len(pending), hard_left)
             with timer.phase("bab"):
                 decisions = engine.decide_many(
-                    net, enc, lo[pending], hi[pending], cfg.engine, deadline_s=deadline
+                    net, enc, lo[pending], hi[pending], cfg.engine,
+                    deadline_s=deadline, mesh=mesh,
                 )
             bab = dict(zip(pending, decisions))
     cumulative = timer.total()

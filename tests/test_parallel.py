@@ -96,3 +96,28 @@ def test_sweep_host_spans_cover_grid(tmp_path):
     assert len(merged) == whole.partitions_total
     whole_map = {o.partition_id: o.verdict for o in whole.outcomes}
     assert {k: v["verdict"] for k, v in merged.items()} == whole_map
+
+
+def test_decide_many_mesh_invariant():
+    """BaB over a sharded frontier returns the same verdicts as unsharded."""
+    import numpy as np
+
+    from fairify_tpu.models.train import init_mlp
+    from fairify_tpu.parallel.mesh import make_mesh
+    from fairify_tpu.verify import engine, presets, sweep
+    from fairify_tpu.verify.property import encode
+
+    net = init_mlp((20, 8, 1), seed=5)
+    cfg = presets.get("GC")
+    enc = encode(cfg.query())
+    _, lo, hi = sweep.build_partitions(cfg)
+    lo, hi = lo[:24], hi[:24]
+    ecfg = engine.EngineConfig(soft_timeout_s=30.0, frontier_size=64)
+
+    plain = engine.decide_many(net, enc, lo, hi, ecfg)
+    mesh = make_mesh()
+    sharded = engine.decide_many(net, enc, lo, hi, ecfg, mesh=mesh)
+    pv = [d.verdict for d in plain]
+    sv = [d.verdict for d in sharded]
+    assert "unknown" not in pv  # fully decidable -> strict comparison
+    assert pv == sv

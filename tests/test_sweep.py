@@ -180,3 +180,31 @@ def test_retry_unknown_csv_stays_one_row_per_partition(tmp_path):
         rows = list(_csv.reader(fp))[1:]
     pids = [int(r[0]) for r in rows]
     assert pids == sorted(pids) and len(pids) == len(set(pids)) == 201
+
+
+def test_retry_unknown_csv_counters_recomputed(tmp_path):
+    import csv as _csv
+    import json
+
+    from fairify_tpu.models.train import init_mlp
+
+    net = init_mlp((20, 8, 1), seed=3)
+    cfg = presets.get("GC").with_(
+        result_dir=str(tmp_path), soft_timeout_s=30.0, hard_timeout_s=300.0,
+        sim_size=64, exact_certify_masks=False)
+    sweep.verify_model(net, cfg, model_name="m", resume=False)
+    ledger = os.path.join(str(tmp_path), "GC-m.ledger.jsonl")
+    with open(ledger, "a") as fp:
+        fp.write(json.dumps({"partition_id": 5, "verdict": "unknown",
+                             "ce": None, "time_s": 0.0}) + "\n")
+    rep = sweep.verify_model(net, cfg, model_name="m", resume=True,
+                             retry_unknown=True)
+    with open(os.path.join(str(tmp_path), "m.csv"), newline="") as fp:
+        rows = list(_csv.reader(fp))[1:]
+    # Counters must be cumulative and consistent with the final verdicts.
+    counts = {"sat": 0, "unsat": 0, "unknown": 0}
+    for row in rows:
+        counts[row[1]] += 1
+        assert [int(row[2]), int(row[3]), int(row[4])] == [
+            counts["sat"], counts["unsat"], counts["unknown"]]
+    assert counts == rep.counts
