@@ -94,6 +94,29 @@ def _cmd_run(args) -> int:
     for rep in reports:
         c = rep.counts
         host = {} if args.host_count is None else {"host": args.host_index}
+        if args.decode_counterexamples:
+            # Decoded (raw-category) pair CSV, the reference's
+            # ``decode_counterexample`` output
+            # (``src/AC/Verify-AC-experiment-new2.py:383-407``).
+            import os
+
+            from fairify_tpu.analysis.decode import counterexample_table
+            from fairify_tpu.data import loaders
+
+            pairs = [o.counterexample for o in rep.outcomes if o.counterexample]
+            if pairs:
+                ds = loaders.load(cfg.dataset, root=args.data_root)
+                table = counterexample_table(ds, pairs)
+                name = rep.model
+                if args.host_count is not None:
+                    # Hosts may share result_dir — qualify like the other
+                    # sinks so spans never clobber each other.
+                    span = (f"@{rep.outcomes[0].partition_id - 1}-"
+                            f"{rep.outcomes[-1].partition_id}")
+                    name += span
+                out = os.path.join(cfg.result_dir,
+                                   f"{name}-counterexamples-decoded.csv")
+                table.to_csv(out, index=False)
         print(json.dumps({
             "model": rep.model, "dataset": rep.dataset, **host,
             "partitions": rep.partitions_total, "attempted": len(rep.outcomes),
@@ -206,6 +229,8 @@ def main(argv=None) -> int:
     run.add_argument("--seed", type=int, default=None)
     run.add_argument("--model-root", default=None)
     run.add_argument("--data-root", default=None)
+    run.add_argument("--decode-counterexamples", action="store_true",
+                     help="also write raw-category decoded counterexample CSVs")
     run.add_argument("--retry-unknown", action="store_true",
                      help="re-attempt partitions a previous run left UNKNOWN")
     run.add_argument("--host-index", type=int, default=None,
