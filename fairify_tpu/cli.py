@@ -107,15 +107,9 @@ def _cmd_run(args) -> int:
             if pairs:
                 ds = loaders.load(cfg.dataset, root=args.data_root)
                 table = counterexample_table(ds, pairs)
-                name = rep.model
-                if args.host_count is not None:
-                    # Hosts may share result_dir — qualify like the other
-                    # sinks so spans never clobber each other.
-                    span = (f"@{rep.outcomes[0].partition_id - 1}-"
-                            f"{rep.outcomes[-1].partition_id}")
-                    name += span
-                out = os.path.join(cfg.result_dir,
-                                   f"{name}-counterexamples-decoded.csv")
+                out = os.path.join(
+                    cfg.result_dir,
+                    f"{rep.sink_name or rep.model}-counterexamples-decoded.csv")
                 table.to_csv(out, index=False)
         print(json.dumps({
             "model": rep.model, "dataset": rep.dataset, **host,

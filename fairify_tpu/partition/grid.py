@@ -190,3 +190,56 @@ def pad_rows(arr: np.ndarray, step: int) -> np.ndarray:
         return arr
     return np.concatenate(
         [arr, np.repeat(arr[-1:], step - arr.shape[0], axis=0)], axis=0)
+
+
+class BoxList:
+    """Lazy sequence view over a (P, d) box tensor as per-partition dicts.
+
+    The cartesian grids of the stress/relaxed presets reach millions of
+    partitions; materializing a Python dict per box costs gigabytes.  The
+    sweep only needs ``len``/slicing, so boxes live as two arrays and the
+    dict form (`{attr: (lo, hi)}`) is synthesized per access for the few
+    callers that want it (density/coverage helpers, tests).
+    """
+
+    def __init__(self, lo: np.ndarray, hi: np.ndarray, columns):
+        self.lo, self.hi, self.columns = lo, hi, tuple(columns)
+
+    def __len__(self):
+        return self.lo.shape[0]
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return BoxList(self.lo[i], self.hi[i], self.columns)
+        return {c: (int(self.lo[i, j]), int(self.hi[i, j]))
+                for j, c in enumerate(self.columns)}
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+
+def product_boxes(columns, p_dict: Dict[str, List[Range]], range_dict: RangeDict):
+    """(lo, hi) arrays of the chunked-attribute cartesian product.
+
+    Vectorized equivalent of ``partitioned_ranges`` +
+    ``boxes_from_partitions`` with identical ordering (first chunked
+    attribute slowest, matching ``itertools.product``), but O(P·d) array
+    writes instead of P Python dicts.
+    """
+    columns = list(columns)
+    chunked = list(p_dict.keys())
+    sizes = [len(p_dict[a]) for a in chunked]
+    P = int(np.prod(sizes)) if sizes else 1
+    idx = np.indices(sizes).reshape(len(sizes), -1) if sizes else None
+    lo = np.empty((P, len(columns)), dtype=np.int64)
+    hi = np.empty((P, len(columns)), dtype=np.int64)
+    for j, c in enumerate(columns):
+        if c in p_dict:
+            arr = np.asarray(p_dict[c], dtype=np.int64)
+            k = chunked.index(c)
+            lo[:, j] = arr[idx[k], 0]
+            hi[:, j] = arr[idx[k], 1]
+        else:
+            lo[:, j], hi[:, j] = range_dict[c][0], range_dict[c][1]
+    return lo, hi

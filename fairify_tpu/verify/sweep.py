@@ -68,6 +68,10 @@ class ModelReport:
     original_acc: float = 0.0
     total_time_s: float = 0.0
     partitions_total: int = 0
+    # Result-sink base name ("model" or span-qualified "model@start-stop"
+    # for multi-host runs); derived files (e.g. decoded CE CSVs) must use
+    # this so sibling sinks never collide across hosts.
+    sink_name: str = ""
 
     @property
     def counts(self) -> Dict[str, int]:
@@ -91,8 +95,14 @@ def build_partitions(cfg: SweepConfig):
             rng=np.random.default_rng(cfg.seed),
         )
     else:
+        # Vectorized cartesian product: stress/relaxed grids reach millions
+        # of boxes, so they are built as arrays (identical ordering to the
+        # dict path) with a lazy dict view for the few content consumers.
         p_dict = grid_mod.partition_attributes(ranges, cfg.partition_threshold)
-        p_list = grid_mod.partitioned_ranges(attrs, p_dict, ranges)
+        lo0, hi0 = grid_mod.product_boxes(domain.columns, p_dict, ranges)
+        order = shuffled_order(lo0.shape[0], cfg.seed)  # random.shuffle :73
+        lo, hi = lo0[order], hi0[order]
+        return grid_mod.BoxList(lo, hi, domain.columns), lo, hi
     order = shuffled_order(len(p_list), cfg.seed)  # replaces random.shuffle :73
     p_list = [p_list[i] for i in order]
     lo, hi = grid_mod.boxes_from_partitions(p_list, domain.columns)
@@ -340,7 +350,7 @@ def verify_model(
     P = len(p_list)
     if P == 0:  # e.g. more hosts than partitions — an empty but valid span
         return ModelReport(model=model_name, dataset=cfg.dataset, outcomes=[],
-                           partitions_total=0)
+                           partitions_total=0, sink_name=sink_name)
 
     os.makedirs(cfg.result_dir, exist_ok=True)
     ledger_path = _ledger_path(cfg, sink_name)
@@ -588,6 +598,7 @@ def verify_model(
     return ModelReport(
         model=model_name, dataset=cfg.dataset, outcomes=outcomes,
         original_acc=orig_acc, total_time_s=timer.total(), partitions_total=P,
+        sink_name=sink_name,
     )
 
 
