@@ -120,6 +120,47 @@ def cmd_run(args):
         print(f"== {run_id} done in {time.perf_counter() - t0:.1f}s", flush=True)
 
 
+def cmd_refresh(args):
+    """Recompute verdict counts in results.jsonl from the ledgers.
+
+    After a ``--retry-unknown`` pass rewrites a model's ledger, the cached
+    counts in results.jsonl are stale; this re-reads every ledger (last
+    record per partition wins) and rewrites the results file in place.
+    Timing fields are kept from the original run and marked refreshed.
+    """
+    import glob
+
+    sys.path.insert(0, ROOT)
+    from fairify_tpu.verify.sweep import _load_ledger
+
+    results_path = os.path.join(args.out, "results.jsonl")
+    recs = []
+    with open(results_path) as fp:
+        for line in fp:
+            recs.append(json.loads(line))
+    by_key = {(r["run_id"], r["model"]): r for r in recs}
+    preset_of = {rid: preset for rid, preset, _, _ in RUNS}
+    changed = 0
+    for (run_id, model), rec in by_key.items():
+        ledger = os.path.join(args.out, run_id,
+                              f"{preset_of.get(run_id, run_id)}-{model}.ledger.jsonl")
+        if not os.path.isfile(ledger):
+            continue
+        led = _load_ledger(ledger)
+        counts = {"sat": 0, "unsat": 0, "unknown": 0}
+        for r in led.values():
+            counts[r["verdict"]] += 1
+        if (counts["sat"], counts["unsat"], counts["unknown"]) != (
+                rec["sat"], rec["unsat"], rec["unknown"]):
+            rec.update(counts)
+            rec["refreshed"] = True
+            changed += 1
+    with open(results_path, "w") as fp:
+        for r in recs:
+            fp.write(json.dumps(r) + "\n")
+    print(f"refreshed {changed} of {len(recs)} rows from ledgers")
+
+
 def cmd_render(args):
     baseline = parse_baseline()
     recs = []
@@ -217,6 +258,9 @@ def main():
     ren = sub.add_parser("render")
     ren.add_argument("--out", default="parity")
     ren.set_defaults(fn=cmd_render)
+    rf = sub.add_parser("refresh")
+    rf.add_argument("--out", default="parity")
+    rf.set_defaults(fn=cmd_refresh)
     args = ap.parse_args()
     args.fn(args)
 
