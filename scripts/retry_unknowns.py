@@ -1,0 +1,58 @@
+"""Second-chance pass over every parity run with UNKNOWN partitions.
+
+Reads ``<out>/results.jsonl``, and for each model with unknown > 0 re-runs
+the sweep with ``retry_unknown`` and a larger soft timeout (the ledger
+makes this incremental: decided partitions are skipped, only the
+budget-exhausted ones are re-attempted — now with the α-CROWN escalated
+engine).  Finish with ``python scripts/parity.py refresh`` + ``render``.
+
+Usage: python scripts/retry_unknowns.py [--out parity] [--soft 30]
+       [--hard 900] [--max-unknown 100000]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "scripts"))
+
+from parity import RUNS  # noqa: E402  (scripts/ sibling)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="parity")
+    ap.add_argument("--soft", type=float, default=30.0)
+    ap.add_argument("--hard", type=float, default=900.0)
+    ap.add_argument("--max-unknown", type=int, default=100000,
+                    help="skip rows with more unknowns than this")
+    args = ap.parse_args()
+
+    from fairify_tpu.models import zoo
+    from fairify_tpu.verify import presets, sweep
+
+    cfg_of = {rid: (preset, overrides) for rid, preset, overrides, _ in RUNS}
+    with open(os.path.join(args.out, "results.jsonl")) as fp:
+        recs = [json.loads(line) for line in fp]
+    todo = [r for r in recs if r["unknown"] > 0
+            and r["unknown"] <= args.max_unknown]
+    print(f"{len(todo)} models with unknowns to retry", flush=True)
+    for r in sorted(todo, key=lambda r: r["unknown"]):
+        preset, overrides = cfg_of[r["run_id"]]
+        cfg = presets.get(preset).with_(
+            soft_timeout_s=args.soft, hard_timeout_s=args.hard,
+            result_dir=os.path.join(args.out, r["run_id"]), **overrides)
+        net = zoo.load(cfg.dataset, r["model"])
+        rep = sweep.verify_model(net, cfg, model_name=r["model"],
+                                 resume=True, retry_unknown=True)
+        print(json.dumps({"run_id": r["run_id"], "model": r["model"],
+                          "was_unknown": r["unknown"], **rep.counts}),
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
