@@ -178,3 +178,29 @@ def excise(params: MLP) -> MLP:
         if i + 1 < n:
             ws[i + 1] = ws[i + 1][keep, :]
     return from_numpy(ws, bs)
+
+
+def local_affine_np(weights, biases, x):
+    """Exact local affine form of the logit at ``x``: ``(f(x), df/dx)`` in f64.
+
+    A ReLU MLP is affine within the activation region of ``x``, so the
+    gradient is the product of the weight matrices masked by the active
+    units — exact (up to f64 rounding), no autodiff or device dispatch.
+    Used by the flip-slab search (``verify.engine.slab_search``).
+    """
+    h = np.asarray(x, dtype=np.float64)
+    n = len(weights)
+    masks = []
+    f = 0.0
+    for i, (w, b) in enumerate(zip(weights, biases)):
+        z = h @ np.asarray(w, dtype=np.float64) + np.asarray(b, dtype=np.float64)
+        if i < n - 1:
+            m = z > 0
+            masks.append(m)
+            h = z * m
+        else:
+            f = float(z[0])
+    g = np.asarray(weights[-1], dtype=np.float64)[:, 0]
+    for i in range(n - 2, -1, -1):
+        g = np.asarray(weights[i], dtype=np.float64) @ (g * masks[i])
+    return f, g

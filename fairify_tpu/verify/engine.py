@@ -237,6 +237,7 @@ def pgd_attack(
     rng: np.random.Generator,
     steps: int = 30,
     restarts: int = 32,
+    return_points: bool = False,
 ):
     """Gradient attack over a batch of boxes → exact-validated witnesses.
 
@@ -268,9 +269,22 @@ def pgd_attack(
     found, wit = find_flips(enc, np.asarray(fx), np.asarray(fp), valid)
     weights = [np.asarray(w) for w in net.weights]
     biases = [np.asarray(b) for b in net.biases]
-    return extract_witnesses(
+    witnesses = extract_witnesses(
         found, wit, np.asarray(x), np.asarray(xp), weights, biases, limit=B
     )
+    if not return_points:
+        return witnesses
+    # Per box, the role point with the smallest |logit| among valid
+    # assignments — the natural seed for the exact flip-slab search.
+    fx_np = np.abs(np.asarray(fx, dtype=np.float64))
+    fx_np = np.where(valid[:, None, :], fx_np, np.inf)
+    flat = fx_np.reshape(pad_to, -1)
+    idx = flat.argmin(axis=1)
+    S, V = fx_np.shape[1], fx_np.shape[2]
+    si, vi = np.divmod(idx, V)
+    pts = np.asarray(x)[np.arange(pad_to), si, vi][:B]
+    best_abs = flat[np.arange(pad_to), idx][:B]
+    return witnesses, pts, best_abs
 
 
 def extract_witnesses(found, wit, x_cand, xp_cand, weights, biases, limit=None) -> dict:
@@ -632,3 +646,83 @@ def decide_box(
         net, enc, np.asarray(lo)[None, :], np.asarray(hi)[None, :], cfg,
         deadline_s=cfg.soft_timeout_s,
     )[0]
+
+
+def slab_search(weights, biases, enc: PairEncoding, lo, hi, shared0,
+                max_iters: int = 24):
+    """Deterministic exact flip-slab search from a near-zero seed point.
+
+    On wide integer domains (default-credit: attribute ranges of ~10^6) the
+    protected-attribute logit offset |δ| can sit at the f32 noise floor of
+    the box's logit range, so the gradient attack cannot resolve the flip
+    slab ``f(x) ∈ (0, -δ)``.  The logit is piecewise affine, so instead:
+    evaluate ``(f, ∇f)`` exactly in f64 (:func:`models.mlp.local_affine_np`),
+    and Newton-step an integer coordinate — preferring step granularity
+    |∇f_j| finer than the slab width — until ``f`` lands inside the slab;
+    the final pair is validated in exact rational arithmetic, so a returned
+    witness is ground truth regardless of f64 rounding.
+
+    Returns ``(x, xp)`` int64 arrays, or ``None``.
+    """
+    from fairify_tpu.models.mlp import local_affine_np
+
+    lo = np.asarray(lo, dtype=np.int64)
+    hi = np.asarray(hi, dtype=np.int64)
+    if not len(enc.pa_idx):
+        return None
+    pa_idx = np.asarray(enc.pa_idx)
+    pa_set = set(int(j) for j in pa_idx)
+    V = enc.n_assign
+    in_box = [
+        bool((lo[pa_idx] <= enc.assignments[v]).all()
+             and (enc.assignments[v] <= hi[pa_idx]).all())
+        for v in range(V)
+    ]
+    shared = np.clip(np.round(np.asarray(shared0, dtype=np.float64)),
+                     lo, hi).astype(np.float64)
+    for a in range(V):
+        for b in range(V):
+            if not (enc.valid_pair[a, b] and in_box[a] and in_box[b]):
+                continue
+            x = shared.copy()
+            x[pa_idx] = enc.assignments[a]
+            for _ in range(max_iters):
+                f, g = local_affine_np(weights, biases, x)
+                delta = float(((enc.assignments[b] - enc.assignments[a])
+                               * g[pa_idx]).sum())
+                if delta == 0.0:
+                    break
+                t_lo, t_hi = (0.0, -delta) if delta < 0 else (-delta, 0.0)
+                if t_lo < f < t_hi:
+                    xp = x.copy()
+                    xp[pa_idx] = enc.assignments[b]
+                    if validate_pair(weights, biases,
+                                     x.astype(np.int64), xp.astype(np.int64)):
+                        return x.astype(np.int64), xp.astype(np.int64)
+                    break  # f64 in-slab but exact sign disagrees — abandon
+                need = (t_lo + t_hi) / 2.0 - f
+                # Finest coordinate (ascending |g_j|) whose in-box step range
+                # can actually reach the target; if none reaches, the one
+                # making the most progress toward it.
+                best_j, best_t = -1, 0
+                fb_j, fb_t, fb_reach = -1, 0, 0.0
+                for j in np.argsort(np.abs(g)):
+                    j = int(j)
+                    if j in pa_set or g[j] == 0.0:
+                        continue
+                    t_unc = need / g[j]
+                    t = int(np.clip(round(t_unc), lo[j] - x[j], hi[j] - x[j]))
+                    if t == 0:
+                        continue
+                    if lo[j] - x[j] - 0.5 <= t_unc <= hi[j] - x[j] + 0.5:
+                        best_j, best_t = j, t
+                        break
+                    reach = abs(g[j] * t)
+                    if reach > fb_reach:
+                        fb_j, fb_t, fb_reach = j, t, reach
+                if best_j < 0:
+                    best_j, best_t = fb_j, fb_t
+                if best_j < 0:
+                    break
+                x[best_j] += best_t
+    return None

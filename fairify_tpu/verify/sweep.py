@@ -420,11 +420,36 @@ def verify_model(
                     else len(pending)
                 for s in range(0, len(pending), step):
                     blk = pending[s:s + step]
-                    w = engine.pgd_attack(
+                    w, near_zero, near_abs = engine.pgd_attack(
                         net, enc, lo[blk], hi[blk],
                         np.random.default_rng(cfg.engine.seed + 1 + span_start + s),
+                        return_points=True,
                     )
                     pgd_wit.update({s + k: v for k, v in w.items()})
+                    # Exact flip-slab refinement from the PGD near-zero seeds:
+                    # finds the measure-tiny SAT slabs f32 attacks cannot
+                    # resolve (wide domains like default-credit).  Gated on
+                    # PGD having actually reached the zero-crossing region —
+                    # boxes whose best |logit| stays large have no slab to
+                    # refine, and skipping them keeps this host-side pass off
+                    # the narrow-domain hot path.
+                    seed_rng = np.random.default_rng(cfg.engine.seed + 77 + s)
+                    for k in range(len(blk)):
+                        if (s + k) in pgd_wit or near_abs[k] > 50.0:
+                            continue
+                        p_g = blk[k]
+                        # Seed diversity matters: each start lands in a
+                        # different activation region, and regions differ in
+                        # whether their slab contains a lattice point.
+                        seeds = [near_zero[k], (lo[p_g] + hi[p_g]) / 2.0]
+                        seeds += [seed_rng.integers(lo[p_g], hi[p_g] + 1)
+                                  for _ in range(6)]
+                        for seed_pt in seeds:
+                            ce = engine.slab_search(
+                                weights, biases, enc, lo[p_g], hi[p_g], seed_pt)
+                            if ce is not None:
+                                pgd_wit[s + k] = ce
+                                break
             for i, ce in pgd_wit.items():
                 p = pending[i]
                 sat0[p] = True

@@ -204,3 +204,36 @@ def test_pgd_attack_finds_thin_slab_flip():
     assert 0 in wit
     x, xp = wit[0]
     assert x[0] == 377 and xp[0] == 377
+
+
+def test_slab_search_finds_hairline_flip():
+    """A flip slab thinner than f32 resolution at the box's logit scale is
+    found by the exact f64 Newton line search and validated exactly."""
+    import numpy as np
+
+    from fairify_tpu.data.domains import DomainSpec
+    from fairify_tpu.models import mlp as mlp_mod
+    from fairify_tpu.verify import engine
+    from fairify_tpu.verify import property as prop
+
+    # f(x) = 7e-4·x0 + 1e-3·pa − 350 over x0 ∈ [0, 1e6]: logits span ±350
+    # while the protected offset is 1e-3 — the flip slab is ~1e-9 of the
+    # shared range, far below f32 resolution at |f| ~ 350.
+    w = np.array([[7e-4], [1e-3], [0.0]], dtype=np.float32)
+    b = np.array([-350.0], dtype=np.float32)
+    net = mlp_mod.from_numpy([w], [b])
+    dom = DomainSpec(name="t", label="y",
+                     ranges={"x0": (0, 1_000_000), "pa": (0, 1), "z": (0, 3)})
+    enc = prop.encode(prop.FairnessQuery(domain=dom, protected=("pa",)))
+    lo = np.array([0, 0, 0], dtype=np.int64)
+    hi = np.array([1_000_000, 1, 3], dtype=np.int64)
+    weights = [np.asarray(x) for x in net.weights]
+    biases = [np.asarray(x) for x in net.biases]
+
+    ce = engine.slab_search(weights, biases, enc, lo, hi,
+                            shared0=(lo + hi) / 2.0)
+    assert ce is not None
+    x, xp = ce
+    assert engine.validate_pair(weights, biases, x, xp)
+    diff = np.where(x != xp)[0]
+    assert list(diff) == [1]  # only the protected attribute differs
