@@ -280,7 +280,7 @@ def pgd_attack(
     fx_np = np.where(valid[:, None, :], fx_np, np.inf)
     flat = fx_np.reshape(pad_to, -1)
     idx = flat.argmin(axis=1)
-    S, V = fx_np.shape[1], fx_np.shape[2]
+    V = fx_np.shape[2]
     si, vi = np.divmod(idx, V)
     pts = np.asarray(x)[np.arange(pad_to), si, vi][:B]
     best_abs = flat[np.arange(pad_to), idx][:B]
@@ -711,6 +711,8 @@ def slab_search(weights, biases, enc: PairEncoding, lo, hi, shared0,
                     if j in pa_set or g[j] == 0.0:
                         continue
                     t_unc = need / g[j]
+                    if not np.isfinite(t_unc):  # subnormal g[j]: unusable dim
+                        continue
                     t = int(np.clip(round(t_unc), lo[j] - x[j], hi[j] - x[j]))
                     if t == 0:
                         continue

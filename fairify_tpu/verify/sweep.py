@@ -433,7 +433,7 @@ def verify_model(
                     # boxes whose best |logit| stays large have no slab to
                     # refine, and skipping them keeps this host-side pass off
                     # the narrow-domain hot path.
-                    seed_rng = np.random.default_rng(cfg.engine.seed + 77 + s)
+                    seed_rng = np.random.default_rng(cfg.engine.seed + 77 + span_start + s)
                     for k in range(len(blk)):
                         if (s + k) in pgd_wit or near_abs[k] > 50.0:
                             continue

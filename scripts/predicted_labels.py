@@ -1,0 +1,76 @@
+"""Predicted-label model variants: train on another classifier's labels, verify.
+
+The reference's ``experimentData/task2`` notebooks study Fairify on MLPs
+trained against labels *predicted* by KNN / random-forest models instead of
+the ground truth (SURVEY.md §4.3).  This script is that pipeline as a
+first-class command: fit the teacher, relabel the training split, train an
+MLP student, export it as Keras-compatible ``.h5``, and run the dataset's
+verification preset on it.
+
+Usage:
+    python scripts/predicted_labels.py [--preset GC] [--teacher knn|rf]
+        [--hidden 50] [--epochs 30] [--out res/predicted]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--preset", default="GC")
+    ap.add_argument("--teacher", choices=("knn", "rf"), default="knn")
+    ap.add_argument("--hidden", type=int, nargs="*", default=[50])
+    ap.add_argument("--epochs", type=int, default=30)
+    ap.add_argument("--soft", type=float, default=10.0)
+    ap.add_argument("--hard", type=float, default=600.0)
+    ap.add_argument("--out", default="res/predicted")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from fairify_tpu.data import loaders
+    from fairify_tpu.models import export, train
+    from fairify_tpu.verify import presets, sweep
+
+    cfg = presets.get(args.preset).with_(
+        soft_timeout_s=args.soft, hard_timeout_s=args.hard, result_dir=args.out)
+    ds = loaders.load(cfg.dataset)
+
+    if args.teacher == "knn":
+        from sklearn.neighbors import KNeighborsClassifier
+
+        teacher = KNeighborsClassifier(n_neighbors=5)
+    else:
+        from sklearn.ensemble import RandomForestClassifier
+
+        teacher = RandomForestClassifier(n_estimators=100, random_state=42)
+    teacher.fit(ds.X_train, ds.y_train)
+    y_soft = teacher.predict(ds.X_train).astype(np.float32)
+    teacher_acc = float((teacher.predict(ds.X_test) == ds.y_test).mean())
+
+    net = train.train_mlp(ds.X_train.astype(np.float32), y_soft,
+                          hidden=list(args.hidden), epochs=args.epochs)
+    os.makedirs(args.out, exist_ok=True)
+    name = f"{args.preset}-{args.teacher}"
+    h5_path = os.path.join(args.out, f"{name}.h5")
+    export.save_keras_h5(net, h5_path)
+
+    report = sweep.verify_model(net, cfg, model_name=name, dataset=ds,
+                                resume=False)
+    print(json.dumps({
+        "model": name, "teacher": args.teacher, "teacher_acc": round(teacher_acc, 4),
+        "student_h5": h5_path, "partitions": report.partitions_total,
+        **report.counts, "student_acc": round(report.original_acc, 4),
+        "total_time_s": round(report.total_time_s, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()
