@@ -1,0 +1,296 @@
+"""Synthetic tabular-data generators (the reference's task1 analog).
+
+The reference's ``experimentData/task1`` notebooks synthesize German-credit
+rows with CTGAN and (distil)GPT-2, train new models (GC-6..8) on the
+synthetic rows, and compare their verification outcomes against the
+real-data models (``src/GC/Verify-GC-experiment.py:88-107``).  This module
+provides the same capability with from-scratch generators (no pretrained
+checkpoints, no external fetch), both over the integer attribute lattice of
+a :class:`~fairify_tpu.data.domains.DomainSpec`:
+
+* :class:`GaussianCopula` — empirical per-column marginals coupled by a
+  latent Gaussian correlation (the CTGAN-lite analog; closed-form fit).
+* :class:`ARColumnModel` — an autoregressive categorical model over the
+  column sequence (the LM analog): a shared MLP trunk over causally-masked
+  one-hot prefixes with one softmax head per column, trained with optax and
+  sampled column-by-column on device.
+
+Both generators model the label column jointly with the features, so
+sampled rows arrive fully labelled — matching how the reference's
+generators emit complete rows.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fairify_tpu.utils import prng
+
+
+# ---------------------------------------------------------------------------
+# Gaussian copula
+# ---------------------------------------------------------------------------
+
+def _norm_ppf(u: np.ndarray) -> np.ndarray:
+    """Inverse standard-normal CDF (Acklam's rational approximation).
+
+    Avoids a scipy dependency in the sampling path; max abs error ~1e-9,
+    far below the integer-lattice quantization of the output.
+    """
+    u = np.clip(u, 1e-12, 1 - 1e-12)
+    a = [-3.969683028665376e+01, 2.209460984245205e+02, -2.759285104469687e+02,
+         1.383577518672690e+02, -3.066479806614716e+01, 2.506628277459239e+00]
+    b = [-5.447609879822406e+01, 1.615858368580409e+02, -1.556989798598866e+02,
+         6.680131188771972e+01, -1.328068155288572e+01]
+    c = [-7.784894002430293e-03, -3.223964580411365e-01, -2.400758277161838e+00,
+         -2.549732539343734e+00, 4.374664141464968e+00, 2.938163982698783e+00]
+    d = [7.784695709041462e-03, 3.224671290700398e-01, 2.445134137142996e+00,
+         3.754408661907416e+00]
+    lo, hi = 0.02425, 1 - 0.02425
+    out = np.empty_like(u)
+    m = u < lo
+    if m.any():
+        q = np.sqrt(-2 * np.log(u[m]))
+        out[m] = (((((c[0] * q + c[1]) * q + c[2]) * q + c[3]) * q + c[4]) * q + c[5]) / \
+                 ((((d[0] * q + d[1]) * q + d[2]) * q + d[3]) * q + 1)
+    m = (u >= lo) & (u <= hi)
+    if m.any():
+        q = u[m] - 0.5
+        r = q * q
+        out[m] = (((((a[0] * r + a[1]) * r + a[2]) * r + a[3]) * r + a[4]) * r + a[5]) * q / \
+                 (((((b[0] * r + b[1]) * r + b[2]) * r + b[3]) * r + b[4]) * r + 1)
+    m = u > hi
+    if m.any():
+        q = np.sqrt(-2 * np.log(1 - u[m]))
+        out[m] = -(((((c[0] * q + c[1]) * q + c[2]) * q + c[3]) * q + c[4]) * q + c[5]) / \
+                 ((((d[0] * q + d[1]) * q + d[2]) * q + d[3]) * q + 1)
+    return out
+
+
+def _norm_cdf(z: np.ndarray) -> np.ndarray:
+    from math import sqrt
+
+    try:
+        from scipy.special import erf
+    except ImportError:  # pragma: no cover - scipy is a sklearn dependency here
+        import math
+
+        erf = np.vectorize(math.erf)
+    return 0.5 * (1.0 + erf(z / sqrt(2.0)))
+
+
+@dataclass
+class GaussianCopula:
+    """Empirical-marginal Gaussian copula over integer columns.
+
+    ``values[j]``/``cum[j]`` give column *j*'s observed support and its
+    cumulative probabilities; ``chol`` is the Cholesky factor of the
+    normal-scores correlation matrix.
+    """
+
+    values: List[np.ndarray]
+    cum: List[np.ndarray]
+    chol: np.ndarray
+
+    @staticmethod
+    def fit(X: np.ndarray) -> "GaussianCopula":
+        X = np.asarray(X)
+        n, d = X.shape
+        values, cum, scores = [], [], np.empty((n, d))
+        for j in range(d):
+            col = X[:, j]
+            vals, counts = np.unique(col, return_counts=True)
+            p = counts / n
+            cj = np.cumsum(p)
+            values.append(vals.astype(np.int64))
+            cum.append(cj)
+            # mid-CDF normal scores keep ties well-defined on discrete data
+            mid = cj - p / 2.0
+            lookup = {v: mid[i] for i, v in enumerate(vals)}
+            scores[:, j] = _norm_ppf(np.array([lookup[v] for v in col]))
+        corr = np.corrcoef(scores, rowvar=False)
+        corr = np.atleast_2d(corr)
+        # jitter for numerical PD-ness on near-degenerate columns
+        corr = corr + 1e-6 * np.eye(d)
+        np.nan_to_num(corr, copy=False, nan=0.0)
+        np.fill_diagonal(corr, 1.0 + 1e-6)
+        chol = np.linalg.cholesky(corr)
+        return GaussianCopula(values, cum, chol)
+
+    def sample(self, n: int, seed: int = 0) -> np.ndarray:
+        d = self.chol.shape[0]
+        rng = np.random.default_rng(seed)
+        z = rng.standard_normal((n, d)) @ self.chol.T
+        u = _norm_cdf(z)
+        out = np.empty((n, d), dtype=np.int64)
+        for j in range(d):
+            idx = np.searchsorted(self.cum[j], u[:, j], side="left")
+            idx = np.clip(idx, 0, len(self.values[j]) - 1)
+            out[:, j] = self.values[j][idx]
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Autoregressive column model (JAX)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ARColumnModel:
+    """p(x) = prod_j p(x_j | x_<j>) over integer columns, MLP trunk + heads.
+
+    One-hot prefix encoding with causal masking; shared two-layer trunk;
+    per-column heads stored as one padded ``(d, H, Kmax)`` tensor so both
+    training and sampling are single fused einsums on device.
+    """
+
+    lo: np.ndarray            # (d,) column minima
+    card: np.ndarray          # (d,) column cardinalities
+    offsets: np.ndarray       # (d,) one-hot block offsets
+    params: dict              # trunk/head weights (jnp arrays)
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def init(lo: Sequence[int], hi: Sequence[int], hidden: int = 64, seed: int = 0) -> "ARColumnModel":
+        lo = np.asarray(lo, dtype=np.int64)
+        hi = np.asarray(hi, dtype=np.int64)
+        card = (hi - lo + 1).astype(np.int64)
+        offsets = np.concatenate([[0], np.cumsum(card)[:-1]])
+        D = int(card.sum())
+        d = len(card)
+        kmax = int(card.max())
+        rng = np.random.default_rng(seed)
+
+        def lin(i, o):
+            return rng.normal(scale=np.sqrt(2.0 / i), size=(i, o)).astype(np.float32)
+
+        params = {
+            "w1": jnp.asarray(lin(D, hidden)), "b1": jnp.zeros(hidden, jnp.float32),
+            "w2": jnp.asarray(lin(hidden, hidden)), "b2": jnp.zeros(hidden, jnp.float32),
+            "wh": jnp.asarray(rng.normal(scale=0.05, size=(d, hidden, kmax)).astype(np.float32)),
+            "bh": jnp.zeros((d, kmax), jnp.float32),
+        }
+        return ARColumnModel(lo, card, offsets, params)
+
+    # -- shared pieces -------------------------------------------------------
+    def _consts(self):
+        d = len(self.card)
+        D = int(self.card.sum())
+        kmax = int(self.card.max())
+        col_of = np.repeat(np.arange(d), self.card)          # (D,) one-hot slot -> column
+        class_mask = (np.arange(kmax)[None, :] < self.card[:, None])  # (d, kmax)
+        return d, D, kmax, jnp.asarray(col_of), jnp.asarray(class_mask)
+
+    def _onehot(self, X: np.ndarray) -> np.ndarray:
+        """(n, d) ints -> (n, D) concatenated one-hots."""
+        n, d = X.shape
+        idx = (X - self.lo[None, :]) + self.offsets[None, :]
+        out = np.zeros((n, int(self.card.sum())), dtype=np.float32)
+        out[np.arange(n)[:, None], idx] = 1.0
+        return out
+
+    # -- training ------------------------------------------------------------
+    def fit(self, X: np.ndarray, epochs: int = 300, lr: float = 3e-3,
+            batch_size: int = 256, seed: int = 0) -> List[float]:
+        X = np.asarray(X, dtype=np.int64)
+        X = np.clip(X, self.lo[None, :], (self.lo + self.card - 1)[None, :])
+        d, D, kmax, col_of, class_mask = self._consts()
+        oh = self._onehot(X)                                  # (n, D)
+        tgt = (X - self.lo[None, :]).astype(np.int32)         # (n, d)
+        # causal[j, i] keeps one-hot slot i only if its column precedes j
+        causal = (col_of[None, :] < jnp.arange(d)[:, None]).astype(jnp.float32)
+        neg = jnp.where(class_mask, 0.0, -1e30)               # (d, kmax)
+
+        def loss_fn(params, xb, yb):
+            # xb: (B, D) one-hot rows; prefixes for all d targets at once
+            pref = xb[:, None, :] * causal[None, :, :]        # (B, d, D)
+            h = jax.nn.relu(jnp.einsum("bdi,ih->bdh", pref, params["w1"]) + params["b1"])
+            h = jax.nn.relu(jnp.einsum("bdh,hk->bdk", h, params["w2"]) + params["b2"])
+            logits = jnp.einsum("bdh,dhk->bdk", h, params["wh"]) + params["bh"] + neg
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            ll = jnp.take_along_axis(logp, yb[:, :, None], axis=-1)[..., 0]
+            return -ll.mean()
+
+        opt = optax.adam(lr)
+        params = self.params
+        opt_state = opt.init(params)
+
+        @jax.jit
+        def step(params, opt_state, xb, yb):
+            l, g = jax.value_and_grad(loss_fn)(params, xb, yb)
+            upd, opt_state = opt.update(g, opt_state)
+            return optax.apply_updates(params, upd), opt_state, l
+
+        n = len(X)
+        rng = np.random.default_rng(seed)
+        hist = []
+        for ep in range(epochs):
+            order = rng.permutation(n)
+            tot = 0.0
+            for s in range(0, n, batch_size):
+                sel = order[s:s + batch_size]
+                params, opt_state, l = step(params, opt_state,
+                                            jnp.asarray(oh[sel]), jnp.asarray(tgt[sel]))
+                tot += float(l) * len(sel)
+            hist.append(tot / n)
+        self.params = params
+        return hist
+
+    # -- sampling ------------------------------------------------------------
+    def sample(self, n: int, seed: int = 0) -> np.ndarray:
+        d, D, kmax, col_of, class_mask = self._consts()
+        neg = jnp.where(class_mask, 0.0, -1e30)
+        offsets = jnp.asarray(self.offsets)
+        params = self.params
+
+        def trunk(x):  # (n, D) -> (n, H)
+            h = jax.nn.relu(x @ params["w1"] + params["b1"])
+            return jax.nn.relu(h @ params["w2"] + params["b2"])
+
+        @jax.jit
+        def draw(key):
+            x = jnp.zeros((n, D), jnp.float32)
+            cols = []
+            for j in range(d):  # static unroll over columns
+                h = trunk(x)
+                logits = h @ params["wh"][j] + params["bh"][j] + neg[j]
+                key, sub = jax.random.split(key)
+                cj = jax.random.categorical(sub, logits, axis=-1)  # (n,)
+                cols.append(cj)
+                x = x.at[jnp.arange(n), offsets[j] + cj].set(1.0)
+            return jnp.stack(cols, axis=1)
+
+        cls = np.asarray(draw(prng.run_key(seed)))
+        return cls.astype(np.int64) + self.lo[None, :]
+
+
+# ---------------------------------------------------------------------------
+# Bootstrap baseline
+# ---------------------------------------------------------------------------
+
+def bootstrap_rows(X: np.ndarray, n: int, seed: int = 0) -> np.ndarray:
+    """Resample-with-replacement baseline generator (task1's third arm)."""
+    rng = np.random.default_rng(seed)
+    X = np.asarray(X)
+    return X[rng.integers(0, len(X), size=n)]
+
+
+GENERATORS = ("copula", "ar", "bootstrap")
+
+
+def synthesize(kind: str, X: np.ndarray, lo, hi, n: int, seed: int = 0,
+               ar_epochs: int = 200, ar_hidden: int = 64) -> np.ndarray:
+    """Fit generator ``kind`` on labelled rows ``X`` and sample ``n`` rows."""
+    if kind == "copula":
+        return GaussianCopula.fit(X).sample(n, seed=seed)
+    if kind == "ar":
+        m = ARColumnModel.init(lo, hi, hidden=ar_hidden, seed=seed)
+        m.fit(X, epochs=ar_epochs, seed=seed)
+        return m.sample(n, seed=seed + 1)
+    if kind == "bootstrap":
+        return bootstrap_rows(X, n, seed=seed)
+    raise ValueError(f"unknown generator {kind!r}; options: {GENERATORS}")
