@@ -137,6 +137,31 @@ COMPAS = DomainSpec(
     },
 )
 
+# Compas, 12-feature encoding (``data/compass/compass.csv``) — the input
+# layout of the reference's CP-2..10 / aCP-1-Old zoo models, which its
+# committed CP driver never runs (it filters to CP-11,
+# ``src/CP/Verify-CP.py:91``; the 12-input family is exercised only by the
+# ``experimentData/task4`` node runs).  Ranges profiled from the CSV; the
+# anonymized columns d..l are small ordinal scores.
+COMPAS12 = DomainSpec(
+    name="compass12",
+    label="label",
+    ranges={
+        "sex": (0, 1),
+        "age": (0, 2),
+        "race": (0, 1),
+        "d": (0, 20),
+        "e": (1, 10),
+        "f": (0, 38),
+        "g": (0, 1),
+        "h": (0, 1),
+        "i": (0, 1),
+        "j": (1, 10),
+        "k": (1, 10),
+        "l": (0, 38),
+    },
+)
+
 # Default Credit — src/DF/Verify-DF.py:52-83 (30 features).
 DEFAULT_CREDIT = DomainSpec(
     name="default",
@@ -200,6 +225,7 @@ DOMAINS = {
     "adult": ADULT,
     "bank": BANK,
     "compass": COMPAS,
+    "compass12": COMPAS12,
     "default": DEFAULT_CREDIT,
     "lsac": LSAC,
 }

@@ -238,6 +238,23 @@ def load_compass(root=None) -> LoadedDataset:
     return LoadedDataset("compass", df, X_train, y_train, X_test, y_test, label, encoders)
 
 
+def load_compass12(root=None) -> LoadedDataset:
+    """Compas in the 12-feature encoding of ``data/compass/compass.csv``.
+
+    The layout the reference's 12-input CP models consume (run only by its
+    ``experimentData/task4`` notebooks; the committed driver sticks to the
+    6-feature ``compas_preprocessed_full.csv``).  All columns arrive integer-
+    encoded, so no further transformation is applied.
+    """
+    path = _root(root) / "compass" / "compass.csv"
+    df = pd.read_csv(path)
+    label = "label"
+    X = df.drop(columns=[label])
+    y = df[label]
+    X_train, y_train, X_test, y_test = _split(X, y)
+    return LoadedDataset("compass12", df, X_train, y_train, X_test, y_test, label, {})
+
+
 # ---------------------------------------------------------------------------
 # Default Credit  (utils/verif_utils.py:267-307)
 # ---------------------------------------------------------------------------
@@ -367,6 +384,7 @@ LOADERS = {
     "adult": load_adult,
     "bank": load_bank,
     "compass": load_compass,
+    "compass12": load_compass12,
     "default": load_default,
     "adult_onehot": load_adult_onehot,
     "adult_adf": load_adult_adf,

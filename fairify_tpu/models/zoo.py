@@ -22,6 +22,7 @@ FAMILIES = {
     "german": ("german", "GC"),
     "bank": ("bank", "BM"),
     "compass": ("compass", "CP"),
+    "compass12": ("compass", "CP"),
     "default": ("default", "DF"),
 }
 

@@ -38,6 +38,11 @@ PRESETS = {
     "CP": SweepConfig(name="CP", dataset="compass", protected=("Race",),
                       partition_threshold=5, heuristic_threshold=50,
                       models=("CP-11",), **_BASE),
+    # The 12-input CP family (CP-2..10, aCP-1-Old) the reference verifies
+    # only via its task4 node runs; width-mismatched models are skipped by
+    # the zoo's input-dim filter automatically.
+    "CP12": SweepConfig(name="CP12", dataset="compass12", protected=("race",),
+                        partition_threshold=5, heuristic_threshold=50, **_BASE),
     "DF": SweepConfig(name="DF", dataset="default", protected=("SEX_2",),
                       partition_threshold=8, heuristic_threshold=100,
                       capped_partitions=True, max_partitions=100,

@@ -1,0 +1,77 @@
+"""Shared scaffolding for the sweep-recording harnesses (parity, variants).
+
+One resumable results ledger convention: ``<out>/results.jsonl`` holds one
+JSON line per (run_id, model).  Models whose input width does not match the
+verification domain produce a ``skipped`` record so resumption converges
+instead of re-listing them forever (e.g. the 6-input CP-1/CP-11 under the
+12-feature ``CP12`` preset).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+
+
+def done_set(results_path: str) -> set:
+    done = set()
+    if os.path.isfile(results_path):
+        with open(results_path) as fp:
+            for line in fp:
+                rec = json.loads(line)
+                done.add((rec["run_id"], rec["model"]))
+    return done
+
+
+def model_natkey(name: str):
+    """Natural sort key robust to non-standard names like ``aCP-1-Old``."""
+    return [int(t) if t.isdigit() else t for t in re.split(r"(\d+)", name)]
+
+
+def run_and_record(cfg, run_id: str, results_path: str, extra=None,
+                   model_filter=None, done=None) -> list:
+    """Sweep every not-yet-recorded zoo model under ``cfg``; append records.
+
+    Returns the newly appended records (verified rows plus ``skipped``
+    markers for width-mismatched models).
+    """
+    from fairify_tpu.models import zoo
+    from fairify_tpu.verify import sweep
+
+    if done is None:
+        done = done_set(results_path)
+    names = [p.stem for p in zoo.model_paths(cfg.dataset)]
+    if cfg.models is not None:
+        names = [n for n in names if n in cfg.models]
+    if model_filter:
+        names = [n for n in names if n in model_filter]
+    todo = [n for n in names if (run_id, n) not in done]
+    if not todo:
+        return []
+    print(f"== {run_id}: {todo}", flush=True)
+    t0 = time.perf_counter()
+    reports = sweep.run_sweep(cfg.with_(models=tuple(todo)))
+    recs = []
+    for rep in reports:
+        counts = rep.counts
+        decided = counts["sat"] + counts["unsat"]
+        recs.append({
+            "run_id": run_id, "model": rep.model, **(extra or {}),
+            "partitions": rep.partitions_total, **counts,
+            "total_time_s": round(rep.total_time_s, 2),
+            "decided_per_sec": round(decided / max(rep.total_time_s, 1e-9), 3),
+            "original_acc": round(rep.original_acc, 4),
+            "soft_s": cfg.soft_timeout_s, "hard_s": cfg.hard_timeout_s,
+        })
+    reported = {r["model"] for r in recs}
+    for name in todo:
+        if name not in reported:
+            recs.append({"run_id": run_id, "model": name, **(extra or {}),
+                         "skipped": "input-width mismatch with domain"})
+    with open(results_path, "a") as fp:
+        for rec in recs:
+            fp.write(json.dumps(rec) + "\n")
+            print(json.dumps(rec), flush=True)
+    print(f"== {run_id} done in {time.perf_counter() - t0:.1f}s", flush=True)
+    return recs

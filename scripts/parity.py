@@ -31,7 +31,6 @@ import json
 import os
 import re
 import sys
-import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
@@ -44,6 +43,7 @@ RUNS = [
     ("AC-sex", "AC", {}, "Sex"),
     ("AC-race", "AC", {"protected": ("race",)}, "Race"),
     ("CP-race", "CP", {}, None),
+    ("CP12-race", "CP12", {}, None),
     ("DF-sex2", "DF", {}, None),
 ]
 
@@ -69,22 +69,12 @@ def parse_baseline(path=os.path.join(ROOT, "BASELINE.md")):
     return rows
 
 
-def _done(path):
-    done = set()
-    if os.path.isfile(path):
-        with open(path) as fp:
-            for line in fp:
-                rec = json.loads(line)
-                done.add((rec["run_id"], rec["model"]))
-    return done
-
-
 def cmd_run(args):
-    from fairify_tpu.verify import presets, sweep
+    from _sweeplib import run_and_record
+    from fairify_tpu.verify import presets
 
     os.makedirs(args.out, exist_ok=True)
     results_path = os.path.join(args.out, "results.jsonl")
-    done = _done(results_path)
     wanted = set(args.runs.split(",")) if args.runs else None
     for run_id, preset, overrides, pa in RUNS:
         if wanted and run_id not in wanted:
@@ -92,32 +82,7 @@ def cmd_run(args):
         cfg = presets.get(preset).with_(
             soft_timeout_s=args.soft, hard_timeout_s=args.hard,
             result_dir=os.path.join(args.out, run_id), **overrides)
-        from fairify_tpu.models import zoo
-
-        names = [p.stem for p in zoo.model_paths(cfg.dataset)]
-        if cfg.models is not None:
-            names = [n for n in names if n in cfg.models]
-        todo = [n for n in names if (run_id, n) not in done]
-        if not todo:
-            continue
-        print(f"== {run_id}: {todo}", flush=True)
-        t0 = time.perf_counter()
-        reports = sweep.run_sweep(cfg.with_(models=tuple(todo)))
-        for rep in reports:
-            counts = rep.counts
-            decided = counts["sat"] + counts["unsat"]
-            rec = {
-                "run_id": run_id, "model": rep.model, "pa": pa,
-                "partitions": rep.partitions_total, **counts,
-                "total_time_s": round(rep.total_time_s, 2),
-                "decided_per_sec": round(decided / max(rep.total_time_s, 1e-9), 3),
-                "original_acc": round(rep.original_acc, 4),
-                "soft_s": args.soft, "hard_s": args.hard,
-            }
-            with open(results_path, "a") as fp:
-                fp.write(json.dumps(rec) + "\n")
-            print(json.dumps(rec), flush=True)
-        print(f"== {run_id} done in {time.perf_counter() - t0:.1f}s", flush=True)
+        run_and_record(cfg, run_id, results_path, extra={"pa": pa})
 
 
 def cmd_refresh(args):
@@ -142,6 +107,8 @@ def cmd_refresh(args):
     preset_of = {rid: preset for rid, preset, _, _ in RUNS}
     changed = 0
     for (run_id, model), rec in by_key.items():
+        if "skipped" in rec:
+            continue
         ledger = os.path.join(args.out, run_id,
                               f"{preset_of.get(run_id, run_id)}-{model}.ledger.jsonl")
         if not os.path.isfile(ledger):
@@ -173,11 +140,10 @@ def cmd_render(args):
         sys.exit(f"no results in {path} yet — run `python scripts/parity.py run` first")
     order = {rid: i for i, (rid, _, _, _) in enumerate(RUNS)}
 
-    def natkey(r):
-        m = re.match(r"([A-Z]+)-(\d+)", r["model"])
-        return (order.get(r["run_id"], 99), m.group(1), int(m.group(2)))
+    from _sweeplib import model_natkey
 
-    recs.sort(key=natkey)
+    recs = [r for r in recs if "skipped" not in r]
+    recs.sort(key=lambda r: (order.get(r["run_id"], 99), model_natkey(r["model"])))
     lines = [
         "# PARITY — full-zoo verdicts vs the reference's Appendix Table V",
         "",

@@ -25,11 +25,12 @@ sys.path.insert(0, ROOT)
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--preset", default="GC")
-    ap.add_argument("--teacher", choices=("knn", "rf"), default="knn")
+    ap.add_argument("--teacher", choices=("knn", "rf", "tabpfn"), default="knn")
     ap.add_argument("--hidden", type=int, nargs="*", default=[50])
     ap.add_argument("--epochs", type=int, default=30)
     ap.add_argument("--soft", type=float, default=10.0)
     ap.add_argument("--hard", type=float, default=600.0)
+    ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default="res/predicted")
     args = ap.parse_args()
 
@@ -47,18 +48,30 @@ def main() -> None:
         from sklearn.neighbors import KNeighborsClassifier
 
         teacher = KNeighborsClassifier(n_neighbors=5)
-    else:
+    elif args.teacher == "rf":
         from sklearn.ensemble import RandomForestClassifier
 
         teacher = RandomForestClassifier(n_estimators=100, random_state=42)
+    else:
+        # task3's teacher; the package (and its pretrained prior) is not in
+        # this image, so the option is gated rather than stubbed.
+        try:
+            from tabpfn import TabPFNClassifier
+        except ImportError:
+            sys.exit("tabpfn is not installed in this environment; "
+                     "use --teacher knn or rf (task2 analogs)")
+        teacher = TabPFNClassifier()
     teacher.fit(ds.X_train, ds.y_train)
     y_soft = teacher.predict(ds.X_train).astype(np.float32)
     teacher_acc = float((teacher.predict(ds.X_test) == ds.y_test).mean())
 
     net = train.train_mlp(ds.X_train.astype(np.float32), y_soft,
-                          hidden=list(args.hidden), epochs=args.epochs)
+                          hidden=list(args.hidden), epochs=args.epochs,
+                          seed=args.seed)
     os.makedirs(args.out, exist_ok=True)
     name = f"{args.preset}-{args.teacher}"
+    if args.seed:  # keep seed sweeps side by side (seed 0 = legacy name)
+        name += f"-s{args.seed}"
     h5_path = os.path.join(args.out, f"{name}.h5")
     export.save_keras_h5(net, h5_path)
 

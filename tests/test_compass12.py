@@ -1,0 +1,43 @@
+"""The 12-feature compas encoding (task4's CP family): domain, loader, sweep wiring."""
+import numpy as np
+import pytest
+
+from fairify_tpu.data import domains, loaders
+from fairify_tpu.models import zoo
+from fairify_tpu.verify import presets, sweep
+
+pytestmark = pytest.mark.usefixtures("skip_without_reference_assets")
+
+
+@pytest.fixture
+def skip_without_reference_assets(reference_assets_available):
+    if not reference_assets_available:
+        pytest.skip("reference assets not mounted")
+
+
+def test_domain_matches_data():
+    ds = loaders.load("compass12")
+    dom = domains.get_domain("compass12")
+    assert tuple(ds.feature_columns) == dom.columns
+    X = np.asarray(ds.X)
+    lo, hi = dom.lo_hi()
+    assert (X >= lo[None, :]).all() and (X <= hi[None, :]).all()
+
+
+def test_zoo_filter_selects_12_input_models():
+    cfg = presets.get("CP12")
+    nets, skipped = zoo.load_matching("compass12", 12)
+    # the 12-input family: CP-2..10 + aCP-1-Old; 6-input CP-1/CP-11 skipped
+    assert len(nets) >= 9 and all(n.in_dim == 12 for n in nets.values())
+    assert "CP-11" in skipped and "CP-1" in skipped
+    assert cfg.query().protected == ("race",)
+
+
+def test_cp12_partition_grid_builds():
+    cfg = presets.get("CP12")
+    parts = sweep.build_partitions(cfg)
+    lo, hi = parts[1], parts[2]
+    assert lo.shape[1] == 12
+    # PA column stays full-range in every partition box
+    race = cfg.query().domain.columns.index("race")
+    assert (lo[:, race] == 0).all() and (hi[:, race] == 1).all()
