@@ -39,10 +39,24 @@ def train_mlp(
     lr: float = 1e-3,
     batch_size: int = 128,
     seed: int = 0,
+    standardize: bool = True,
 ) -> MLP:
-    """Train a binary classifier MLP (ReLU hidden, logit output)."""
+    """Train a binary classifier MLP (ReLU hidden, logit output).
+
+    With ``standardize`` the optimizer sees zero-mean/unit-variance
+    features (raw integer attributes span 0..10^5 across these datasets,
+    which otherwise collapses training to the majority class); the affine
+    transform is folded exactly into the first layer afterwards, so the
+    returned network still consumes the raw integer lattice that
+    verification domains are defined over.
+    """
     X = np.asarray(X, dtype=np.float32)
     y = np.asarray(y, dtype=np.float32)
+    if standardize:
+        mu = X.mean(axis=0)
+        sd = X.std(axis=0)
+        sd[sd == 0] = 1.0
+        X = (X - mu) / sd
     sizes = [X.shape[1], *hidden, 1]
     net = init_mlp(sizes, seed)
     params = (net.weights, net.biases)
@@ -66,4 +80,11 @@ def train_mlp(
         for s in range(0, n - batch_size + 1, batch_size):
             idx = order[s : s + batch_size]
             params, opt_state, _ = step(params, opt_state, Xj[idx], yj[idx])
-    return MLP(params[0], params[1], net.masks)
+    ws, bs = list(params[0]), list(params[1])
+    if standardize:
+        # fold x -> (x-mu)/sd into layer 0: W' = W/sd, b' = b - (mu/sd)@W
+        w0 = np.asarray(ws[0]) / sd[:, None]
+        b0 = np.asarray(bs[0]) - (mu / sd) @ np.asarray(ws[0])
+        ws[0] = jnp.asarray(w0.astype(np.float32))
+        bs[0] = jnp.asarray(b0.astype(np.float32))
+    return MLP(tuple(ws), tuple(bs), net.masks)
