@@ -163,6 +163,9 @@ def _cmd_experiment(args) -> int:
         "saved_fairer": args.save_fairer or None,
     }
     print(json.dumps(out))
+    if args.json_out:
+        with open(args.json_out, "w") as fp:
+            json.dump(out, fp)
     return 0
 
 
@@ -249,6 +252,8 @@ def main(argv=None) -> int:
     exp.add_argument("--model-root", default=None)
     exp.add_argument("--data-root", default=None)
     exp.add_argument("--seed", type=int, default=None)
+    exp.add_argument("--json-out", default=None,
+                     help="also write the summary JSON to this file")
     exp.add_argument("--save-fairer", default=None,
                      help="write the repaired model as Keras-compatible .h5")
 

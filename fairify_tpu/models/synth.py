@@ -136,6 +136,67 @@ class GaussianCopula:
 
 
 # ---------------------------------------------------------------------------
+# Column quantizer: bounded-cardinality view of wide integer columns
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ColumnQuantizer:
+    """Maps wide integer columns onto ≤``max_card`` frequency bins.
+
+    The AR model below is categorical per column; German's ``credit_amount``
+    spans 0..20000, which would make its one-hot input 20k wide and its
+    softmax unlearnable from ~1k rows.  Narrow columns pass through
+    unchanged; wide ones are binned at empirical quantile edges, and
+    decoding draws uniformly among the *observed* values of the bin — so
+    decoded rows always stay on the dataset's support (like the copula).
+    """
+
+    bins: List[List[np.ndarray]]   # bins[j][k] = observed values of bin k
+    edges: List[np.ndarray]        # bin upper-bound edges for encode()
+
+    @staticmethod
+    def fit(X: np.ndarray, max_card: int = 64) -> "ColumnQuantizer":
+        X = np.asarray(X)
+        bins, edges = [], []
+        for j in range(X.shape[1]):
+            vals = np.unique(X[:, j])
+            if len(vals) <= max_card:
+                bins.append([np.array([v]) for v in vals])
+                edges.append(vals.astype(np.float64))
+            else:
+                qs = np.quantile(X[:, j], np.linspace(0, 1, max_card + 1)[1:])
+                ub = np.unique(qs)                      # bin upper bounds
+                idx = np.searchsorted(ub, vals, side="left")
+                kept = np.unique(idx)                   # drop empty bins
+                bins.append([vals[idx == k] for k in kept])
+                edges.append(ub[kept].astype(np.float64))
+        return ColumnQuantizer(bins, edges)
+
+    @property
+    def card(self) -> np.ndarray:
+        return np.array([len(b) for b in self.bins], dtype=np.int64)
+
+    def encode(self, X: np.ndarray) -> np.ndarray:
+        X = np.asarray(X)
+        out = np.empty_like(X, dtype=np.int64)
+        for j, ub in enumerate(self.edges):
+            out[:, j] = np.clip(np.searchsorted(ub, X[:, j], side="left"),
+                                0, len(self.bins[j]) - 1)
+        return out
+
+    def decode(self, B: np.ndarray, seed: int = 0) -> np.ndarray:
+        rng = np.random.default_rng(seed)
+        B = np.asarray(B)
+        out = np.empty_like(B, dtype=np.int64)
+        for j, col_bins in enumerate(self.bins):
+            for k, vals in enumerate(col_bins):
+                m = B[:, j] == k
+                if m.any():
+                    out[m, j] = vals[rng.integers(0, len(vals), size=int(m.sum()))]
+        return out
+
+
+# ---------------------------------------------------------------------------
 # Autoregressive column model (JAX)
 # ---------------------------------------------------------------------------
 
@@ -284,13 +345,27 @@ GENERATORS = ("copula", "ar", "bootstrap")
 
 def synthesize(kind: str, X: np.ndarray, lo, hi, n: int, seed: int = 0,
                ar_epochs: int = 200, ar_hidden: int = 64) -> np.ndarray:
-    """Fit generator ``kind`` on labelled rows ``X`` and sample ``n`` rows."""
+    """Fit generator ``kind`` on labelled rows ``X`` and sample ``n`` rows.
+
+    Rows are clipped to the ``[lo, hi]`` domain lattice first, so every
+    generator's output support stays inside the verification domain even
+    when the raw dataset carries out-of-spec values.
+    """
+    lo = np.asarray(lo, dtype=np.int64)
+    hi = np.asarray(hi, dtype=np.int64)
+    X = np.clip(np.asarray(X, dtype=np.int64), lo[None, :], hi[None, :])
     if kind == "copula":
         return GaussianCopula.fit(X).sample(n, seed=seed)
     if kind == "ar":
-        m = ARColumnModel.init(lo, hi, hidden=ar_hidden, seed=seed)
-        m.fit(X, epochs=ar_epochs, seed=seed)
-        return m.sample(n, seed=seed + 1)
+        # bounded-cardinality view keeps the one-hot width ~d*64 even when
+        # a column spans 0..20000 (German credit_amount)
+        q = ColumnQuantizer.fit(X)
+        B = q.encode(X)
+        card = q.card
+        m = ARColumnModel.init(np.zeros_like(card), card - 1,
+                               hidden=ar_hidden, seed=seed)
+        m.fit(B, epochs=ar_epochs, seed=seed)
+        return q.decode(m.sample(n, seed=seed + 1), seed=seed + 2)
     if kind == "bootstrap":
         return bootstrap_rows(X, n, seed=seed)
     raise ValueError(f"unknown generator {kind!r}; options: {GENERATORS}")

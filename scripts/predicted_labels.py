@@ -77,12 +77,15 @@ def main() -> None:
 
     report = sweep.verify_model(net, cfg, model_name=name, dataset=ds,
                                 resume=False)
-    print(json.dumps({
+    rec = {
         "model": name, "teacher": args.teacher, "teacher_acc": round(teacher_acc, 4),
         "student_h5": h5_path, "partitions": report.partitions_total,
         **report.counts, "student_acc": round(report.original_acc, 4),
         "total_time_s": round(report.total_time_s, 2),
-    }))
+    }
+    print(json.dumps(rec))
+    with open(os.path.join(args.out, "summary.jsonl"), "a") as fp:
+        fp.write(json.dumps(rec) + "\n")
 
 
 if __name__ == "__main__":

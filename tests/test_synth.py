@@ -65,6 +65,32 @@ def test_bootstrap_rows_subset():
     assert all(tuple(r) in rows for r in B)
 
 
+def test_quantizer_roundtrip_and_support():
+    rng = np.random.default_rng(0)
+    wide = rng.integers(0, 20000, size=(500, 1))   # credit_amount-like
+    narrow = rng.integers(0, 3, size=(500, 1))
+    X = np.concatenate([wide, narrow], axis=1)
+    q = synth.ColumnQuantizer.fit(X, max_card=16)
+    assert q.card[0] <= 16 and q.card[1] == 3
+    B = q.encode(X)
+    assert (B >= 0).all() and (B < q.card[None, :]).all()
+    # narrow column is identity-coded
+    decoded = q.decode(B, seed=1)
+    assert np.array_equal(decoded[:, 1], X[:, 1])
+    # decoded wide values come from the observed support and the right bin
+    support = set(np.unique(wide))
+    assert all(v in support for v in decoded[:, 0])
+
+
+def test_ar_handles_wide_columns_quickly():
+    rng = np.random.default_rng(1)
+    X = np.stack([rng.integers(0, 20000, size=300),
+                  rng.integers(0, 2, size=300)], axis=1)
+    S = synth.synthesize("ar", X, [0, 0], [19999, 1], 50, seed=0, ar_epochs=5)
+    assert S.shape == (50, 2)
+    assert set(np.unique(S[:, 0])) <= set(np.unique(X[:, 0]))
+
+
 def test_synthesize_dispatch():
     X = _toy(200)
     lo, hi = [0, 0, 0], [4, 4, 1]
