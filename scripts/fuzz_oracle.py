@@ -1,0 +1,104 @@
+"""Randomized soundness fuzzer: engine verdicts vs the brute-force oracle.
+
+Random tiny MLPs × random integer domains × random queries (plain /
+multi-PA / relaxed), decided by the complete engine and cross-checked
+against exhaustive pair enumeration (``fairify_tpu/verify/oracle.py``).
+Any disagreement is a soundness or completeness bug; SAT witnesses are
+additionally replayed in exact arithmetic.  This is the standing
+adversarial self-check the reference lacks (its closest analogs are the
+C-check / V-accurate replay columns, ``src/GC/Verify-GC.py:225-254``).
+
+Usage:
+    python scripts/fuzz_oracle.py [--trials 200] [--seed0 0] [--verbose]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def one_trial(seed: int, cfg) -> dict:
+    import numpy as np
+
+    from fairify_tpu.verify import engine, property as prop
+    from fairify_tpu.verify.oracle import brute_force_verdict, random_net, tiny_domain
+
+    rng = np.random.default_rng(seed)
+    # random domain: 3-5 attrs, small ranges (oracle is exponential)
+    d = int(rng.integers(3, 6))
+    names = [f"a{i}" for i in range(d)]
+    ranges = {}
+    for nm in names:
+        lo = int(rng.integers(0, 2))
+        ranges[nm] = (lo, lo + int(rng.integers(1, 4)))
+    n_pa = int(rng.integers(1, 3))
+    pa = tuple(rng.choice(names, size=n_pa, replace=False).tolist())
+    ra, eps = (), 0
+    rest = [nm for nm in names if nm not in pa]
+    if rest and rng.random() < 0.3:
+        ra, eps = (rest[0],), int(rng.integers(1, 3))
+    dom = tiny_domain(ranges)
+    query = prop.FairnessQuery(domain=dom, protected=pa, relaxed=ra, relax_eps=eps)
+
+    hidden = [int(rng.integers(2, 7)) for _ in range(int(rng.integers(1, 4)))]
+    scale = float(rng.choice([0.3, 1.0, 3.0]))
+    net = random_net(rng, (d, *hidden, 1), scale=scale)
+
+    enc = prop.encode(query)
+    lo, hi = dom.lo_hi()
+    lo, hi = lo.astype(np.int64), hi.astype(np.int64)
+    want = brute_force_verdict(net, query, lo, hi)
+    got = engine.decide_box(net, enc, lo, hi, cfg)
+    rec = {"seed": seed, "pa": pa, "ra": ra, "eps": eps, "hidden": hidden,
+           "scale": scale, "want": want, "got": got.verdict}
+    if got.verdict == "sat":
+        x, xp = got.counterexample
+        ws = [np.asarray(w) for w in net.weights]
+        bs = [np.asarray(b) for b in net.biases]
+        rec["witness_valid"] = bool(engine.validate_pair(ws, bs, x, xp))
+    return rec
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--trials", type=int, default=200)
+    ap.add_argument("--seed0", type=int, default=0)
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args()
+
+    from fairify_tpu.verify import engine
+
+    cfg = engine.EngineConfig(frontier_size=64, attack_samples=32,
+                              bab_attack_samples=8, soft_timeout_s=60.0,
+                              max_nodes=50_000)
+    t0 = time.perf_counter()
+    mismatches, bad_witness, unknowns = [], [], 0
+    for i in range(args.trials):
+        rec = one_trial(args.seed0 + i, cfg)
+        if args.verbose:
+            print(json.dumps(rec), flush=True)
+        if rec["got"] == "unknown":
+            unknowns += 1  # budget exhaustion is not a soundness bug
+        elif rec["got"] != rec["want"]:
+            mismatches.append(rec)
+        if rec.get("witness_valid") is False:
+            bad_witness.append(rec)
+    print(json.dumps({
+        "trials": args.trials, "mismatches": len(mismatches),
+        "invalid_witnesses": len(bad_witness), "unknowns": unknowns,
+        "elapsed_s": round(time.perf_counter() - t0, 1),
+    }))
+    failures = {rec["seed"]: rec for rec in mismatches + bad_witness}
+    for rec in failures.values():
+        print("FAIL " + json.dumps(rec), file=sys.stderr)
+    return 1 if (mismatches or bad_witness) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,72 +5,16 @@ truth the reference would obtain from Z3 (``src/GC/Verify-GC.py:134-154``) —
 and the engine's verdict must match, with SAT counterexamples validated
 exactly.
 """
-import itertools
-
 import numpy as np
 import pytest
 
-from fairify_tpu.data.domains import DomainSpec
 from fairify_tpu.models import mlp
 from fairify_tpu.verify import engine, property as prop
-
-
-def tiny_domain(ranges):
-    return DomainSpec(name="tiny", label="y", ranges=dict(ranges))
-
-
-def random_net(rng, sizes, scale=1.0):
-    ws, bs = [], []
-    for i in range(len(sizes) - 1):
-        ws.append((scale * rng.normal(size=(sizes[i], sizes[i + 1]))).astype(np.float32))
-        bs.append((scale * rng.normal(size=(sizes[i + 1],))).astype(np.float32))
-    return mlp.from_numpy(ws, bs)
-
-
-def np_sign(net, x):
-    return engine.exact_logit_sign(
-        [np.asarray(w) for w in net.weights], [np.asarray(b) for b in net.biases], x
-    )
-
-
-def oracle(net, query, lo, hi):
-    """Exhaustive pair enumeration: 'sat' iff any legal pair strictly flips."""
-    enc = prop.encode(query)
-    cols = query.columns
-    d = len(cols)
-    shared_dims = [i for i in range(d) if i not in set(enc.pa_idx.tolist())]
-    axes = [range(int(lo[i]), int(hi[i]) + 1) for i in shared_dims]
-    deltas = (
-        list(itertools.product(range(-enc.eps, enc.eps + 1), repeat=len(enc.ra_idx)))
-        if (len(enc.ra_idx) and enc.eps)
-        else [()]
-    )
-    valid = [
-        i for i in range(enc.n_assign)
-        if all(lo[enc.pa_idx[k]] <= enc.assignments[i, k] <= hi[enc.pa_idx[k]]
-               for k in range(len(enc.pa_idx)))
-    ]
-    for combo in itertools.product(*axes):
-        point = np.zeros(d, dtype=np.int64)
-        point[shared_dims] = combo
-        signs = {}
-        for a in valid:
-            x = point.copy()
-            x[enc.pa_idx] = enc.assignments[a]
-            signs[a] = np_sign(net, x)
-        for a in valid:
-            for b in valid:
-                if not enc.valid_pair[a, b]:
-                    continue
-                for dl in deltas:
-                    xp = point.copy()
-                    xp[enc.pa_idx] = enc.assignments[b]
-                    for k, dv in enumerate(dl):
-                        xp[enc.ra_idx[k]] += dv
-                    sp = signs[b] if (not dl or all(v == 0 for v in dl)) else np_sign(net, xp)
-                    if (signs[a] > 0 and sp < 0) or (signs[a] < 0 and sp > 0):
-                        return "sat"
-    return "unsat"
+from fairify_tpu.verify.oracle import (
+    brute_force_verdict as oracle,
+    random_net,
+    tiny_domain,
+)
 
 
 CFG = engine.EngineConfig(frontier_size=64, attack_samples=32, bab_attack_samples=8,

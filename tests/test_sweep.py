@@ -10,7 +10,7 @@ from fairify_tpu.data.domains import DomainSpec
 from fairify_tpu.models import mlp
 from fairify_tpu.verify import engine, presets, property as prop, sweep
 from fairify_tpu.verify.config import SweepConfig
-from tests.test_engine import oracle, random_net
+from fairify_tpu.verify.oracle import brute_force_verdict as oracle, random_net
 
 
 @pytest.fixture()
