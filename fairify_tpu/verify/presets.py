@@ -43,6 +43,12 @@ PRESETS = {
     # the zoo's input-dim filter automatically.
     "CP12": SweepConfig(name="CP12", dataset="compass12", protected=("race",),
                         partition_threshold=5, heuristic_threshold=50, **_BASE),
+    # LSAC bar passage: the reference ships the dataset but never wires it
+    # (``data/lsac``, SURVEY.md §2.4) and has no zoo models for it; this
+    # preset makes it a first-class target for the trained-student
+    # pipelines (scripts/predicted_labels.py, scripts/synthetic_models.py).
+    "LSAC": SweepConfig(name="LSAC", dataset="lsac", protected=("race1",),
+                        partition_threshold=10, heuristic_threshold=5, **_BASE),
     "DF": SweepConfig(name="DF", dataset="default", protected=("SEX_2",),
                       partition_threshold=8, heuristic_threshold=100,
                       capped_partitions=True, max_partitions=100,

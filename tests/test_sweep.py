@@ -79,8 +79,9 @@ def test_sweep_verdicts_mesh_invariant(tmp_path, tiny_registered):
 
 def test_presets_cover_all_drivers():
     names = presets.names()
-    # 5 base + CP12 (task4's 12-input family) + 3 stress + 3 relaxed + 3+3 targeted
-    assert len(names) == 18
+    # 5 base + CP12 (task4's 12-input family) + LSAC + 3 stress + 3 relaxed
+    # + 3+3 targeted
+    assert len(names) == 19
     for n in names:
         cfg = presets.get(n)
         q = cfg.query()  # builds without error, drops phantom attributes
