@@ -77,9 +77,18 @@ def main() -> int:
     cfg = engine.EngineConfig(frontier_size=64, attack_samples=32,
                               bab_attack_samples=8, soft_timeout_s=60.0,
                               max_nodes=50_000)
+    import jax
+
     t0 = time.perf_counter()
     mismatches, bad_witness, unknowns = [], [], 0
     for i in range(args.trials):
+        if i and i % 10 == 0:
+            # every trial jits fresh shapes; without this the accumulated
+            # executables eventually OOM the LLVM JIT on long runs
+            jax.clear_caches()
+        if i and i % 25 == 0:
+            print(json.dumps({"progress": i, "mismatches": len(mismatches),
+                              "unknowns": unknowns}), flush=True)
         rec = one_trial(args.seed0 + i, cfg)
         if args.verbose:
             print(json.dumps(rec), flush=True)
