@@ -416,6 +416,18 @@ def verify_model(
         if pending:
             with timer.phase("stage0_pgd"):
                 pgd_wit = {}
+                # The slab refinement below is serial host work (exact
+                # arithmetic per seed); on hard models with thousands of
+                # near-zero boxes it would otherwise dwarf the hard budget
+                # (observed: ~1 h on AC-11's 16k-partition grid).  Cap the
+                # slab-only time (PGD/jit excluded) at a quarter of the
+                # remaining budget — skipped boxes keep their BaB/unknown
+                # path, so only SAT-discovery opportunity is traded, never
+                # soundness.  Like every budget-bound path here, which boxes
+                # get refined is wall-clock dependent when the cap binds;
+                # decided verdicts stay ground-truth-checked either way.
+                slab_budget = 0.25 * max(cfg.hard_timeout_s - timer.total(), 0.0)
+                slab_spent = 0.0
                 step = min(cfg.grid_chunk, len(pending)) if cfg.grid_chunk > 0 \
                     else len(pending)
                 for s in range(0, len(pending), step):
@@ -435,6 +447,8 @@ def verify_model(
                     # the narrow-domain hot path.
                     seed_rng = np.random.default_rng(cfg.engine.seed + 77 + span_start + s)
                     for k in range(len(blk)):
+                        if slab_spent > slab_budget:
+                            break
                         if (s + k) in pgd_wit or near_abs[k] > 50.0:
                             continue
                         p_g = blk[k]
@@ -444,12 +458,14 @@ def verify_model(
                         seeds = [near_zero[k], (lo[p_g] + hi[p_g]) / 2.0]
                         seeds += [seed_rng.integers(lo[p_g], hi[p_g] + 1)
                                   for _ in range(6)]
+                        t_slab = time.perf_counter()
                         for seed_pt in seeds:
                             ce = engine.slab_search(
                                 weights, biases, enc, lo[p_g], hi[p_g], seed_pt)
                             if ce is not None:
                                 pgd_wit[s + k] = ce
                                 break
+                        slab_spent += time.perf_counter() - t_slab
             for i, ce in pgd_wit.items():
                 p = pending[i]
                 sat0[p] = True
