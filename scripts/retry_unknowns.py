@@ -38,7 +38,7 @@ def main() -> None:
     cfg_of = {rid: (preset, overrides) for rid, preset, overrides, _ in RUNS}
     with open(os.path.join(args.out, "results.jsonl")) as fp:
         recs = [json.loads(line) for line in fp]
-    todo = [r for r in recs if r["unknown"] > 0
+    todo = [r for r in recs if "skipped" not in r and r["unknown"] > 0
             and r["unknown"] <= args.max_unknown]
     print(f"{len(todo)} models with unknowns to retry", flush=True)
     for r in sorted(todo, key=lambda r: r["unknown"]):
