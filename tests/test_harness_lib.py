@@ -1,0 +1,27 @@
+"""Shared harness scaffolding (scripts/_sweeplib): ledger resume + sorting."""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+import _sweeplib  # noqa: E402
+
+
+def test_done_set_includes_skipped_records(tmp_path):
+    path = str(tmp_path / "results.jsonl")
+    with open(path, "w") as fp:
+        fp.write(json.dumps({"run_id": "r", "model": "CP-2", "sat": 1}) + "\n")
+        fp.write(json.dumps({"run_id": "r", "model": "CP-1",
+                             "skipped": "input-width mismatch with domain"}) + "\n")
+    done = _sweeplib.done_set(path)
+    # both verified and skipped models count as done → resume converges
+    assert done == {("r", "CP-2"), ("r", "CP-1")}
+    assert _sweeplib.done_set(str(tmp_path / "missing.jsonl")) == set()
+
+
+def test_model_natkey_orders_families_and_odd_names():
+    names = ["CP-10", "CP-2", "aCP-1-Old", "CP-1"]
+    ordered = sorted(names, key=_sweeplib.model_natkey)
+    assert ordered.index("CP-1") < ordered.index("CP-2") < ordered.index("CP-10")
+    assert "aCP-1-Old" in ordered  # non-standard name sorts without crashing
