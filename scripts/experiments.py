@@ -86,14 +86,13 @@ def cmd_render(args):
                 f"{r['total_time_s']} |")
         lines.append("")
 
-    exp = _load_json(args.experiment) if args.experiment else None
-    if exp:
+    exps = [_load_json(p) for p in args.experiment.split(",")] if args.experiment else []
+    for exp in filter(None, exps):
         lines += [
-            "## Repair experiment (verify → localize → repair → route → audit)",
+            f"## Repair experiment: `{exp['model']}` (verify → localize → repair → route → audit)",
             "",
-            f"Model `{exp['model']}`: verdicts {exp['verdicts']}, "
-            f"{exp['counterexample_pairs']} counterexample pairs, "
-            f"top biased neurons {exp['biased_neurons'][:3]}.",
+            f"Verdicts {exp['verdicts']}, {exp['counterexample_pairs']} "
+            f"counterexample pairs, top biased neurons {exp['biased_neurons'][:3]}.",
             "",
             "| Variant | Acc | DI | SPD | EOD | AOD | ERD | Consistency | Theil | Causal rate |",
             "|---|---|---|---|---|---|---|---|---|---|",
