@@ -60,13 +60,17 @@ def _relu_relaxation(lo: jax.Array, hi: jax.Array, mask: jax.Array):
     return us, ui, ls
 
 
-def _backward_bounds(params: MLP, k: int, pre_lbs, pre_ubs, in_lb, in_ub,
-                     alphas_low=None, alphas_up=None):
-    """CROWN bounds on layer-k pre-activations given bounds for layers < k.
+def _backward_linear(params: MLP, k: int, pre_lbs, pre_ubs, batch,
+                     alphas_low=None, alphas_up=None,
+                     beta_signs=None, betas_low=None, betas_up=None):
+    """CROWN linear forms of layer-k pre-activations in terms of the input.
 
-    ``in_lb``/``in_ub``: (..., d) input box.  ``pre_lbs[j]``/``pre_ubs[j]``:
-    (..., n_j) pre-activation bounds of hidden layer j.  Returns (lo, hi) of
-    shape (..., n_k).
+    Backward-propagates through layers k-1..0 and returns
+    ``(A_low, c_low, A_up, c_up)`` with ``A_*`` of shape batch + (d, n_k)
+    and ``c_*`` of shape batch + (n_k,) such that for every x in the box the
+    ``pre_*`` bounds were computed over::
+
+        z_k ≥ x @ A_low + c_low      z_k ≤ x @ A_up + c_up
 
     ``alphas_low``/``alphas_up``: optional per-hidden-layer (..., n_j) lower
     ReLU slopes in [0, 1] for unstable neurons — the α of α-CROWN (Xu et
@@ -74,9 +78,24 @@ def _backward_bounds(params: MLP, k: int, pre_lbs, pre_ubs, in_lb, in_ub,
     α ∈ [0, 1] when ``lo < 0 < hi``, so *any* values are sound; the
     optimizer below tunes them per box.  ``None`` keeps the adaptive
     heuristic slope.
+
+    ``beta_signs``/``betas_low``/``betas_up``: the β of β-CROWN (Wang et
+    al. 2021, public algorithm) — per-hidden-layer (..., n_j) arrays
+    encoding branch-and-bound split constraints ``s_j · z_j ≥ 0``
+    (``beta_signs`` ∈ {−1, 0, +1}; 0 = unconstrained).  By weak duality,
+    for any β ≥ 0::
+
+        min_{x ∈ box, s·z(x) ≥ 0} f(x)  ≥  min_{x ∈ box} [f(x) − β·s·z(x)]
+        max_{x ∈ box, s·z(x) ≥ 0} f(x)  ≤  max_{x ∈ box} [f(x) + β·s·z(x)]
+
+    so the constraint enters the backward pass as an extra exact-linear
+    ``∓β·s`` coefficient on ``z_j`` — no relaxation involved — and the
+    multipliers are tunable by gradient ascent exactly like the α's.
+    Without β the branch constraint can only tighten *intermediate* bounds
+    (the clamps in :func:`sign_constrained_output_bounds`), which leaves the
+    final concretization ranging over the whole box and stalls BaB.
     """
     w_k = params.weights[k]
-    batch = in_lb.shape[:-1]
     n_k = w_k.shape[1]
     # Linear forms: z_k ≥ h_j @ A_low + c_low and z_k ≤ h_j @ A_up + c_up.
     A_low = jnp.broadcast_to(w_k, batch + w_k.shape)
@@ -103,13 +122,22 @@ def _backward_bounds(params: MLP, k: int, pre_lbs, pre_ubs, in_lb, in_ub,
         An = jnp.minimum(A_up, 0.0)
         c_up = c_up + matmul(jnp.expand_dims(ui, -2), Ap)[..., 0, :]
         A_up = Ap * us[..., :, None] + An * ls_up[..., :, None]
+        # β split terms: A_* now holds coefficients on z_j, where the
+        # constraint s_j·z_j ≥ 0 contributes its exact linear penalty.
+        if beta_signs is not None:
+            A_low = A_low - (betas_low[j] * beta_signs[j])[..., :, None]
+            A_up = A_up + (betas_up[j] * beta_signs[j])[..., :, None]
         # Pass through z_j = h_{j-1} @ w_j + b_j.
         w_j, b_j = params.weights[j], params.biases[j]
         c_low = c_low + matmul(jnp.expand_dims(b_j, -2), A_low)[..., 0, :]
         c_up = c_up + matmul(jnp.expand_dims(b_j, -2), A_up)[..., 0, :]
         A_low = matmul(jnp.broadcast_to(w_j, batch + w_j.shape), A_low)
         A_up = matmul(jnp.broadcast_to(w_j, batch + w_j.shape), A_up)
-    # Concretize over the input box.
+    return A_low, c_low, A_up, c_up
+
+
+def _concretize(A_low, c_low, A_up, c_up, in_lb, in_ub):
+    """Extreme values of the linear forms over the input box."""
     lo = (
         matmul(jnp.expand_dims(in_lb, -2), jnp.maximum(A_low, 0.0))[..., 0, :]
         + matmul(jnp.expand_dims(in_ub, -2), jnp.minimum(A_low, 0.0))[..., 0, :]
@@ -121,6 +149,56 @@ def _backward_bounds(params: MLP, k: int, pre_lbs, pre_ubs, in_lb, in_ub,
         + c_up
     )
     return lo, hi
+
+
+def _backward_bounds(params: MLP, k: int, pre_lbs, pre_ubs, in_lb, in_ub,
+                     alphas_low=None, alphas_up=None,
+                     beta_signs=None, betas_low=None, betas_up=None):
+    """CROWN bounds on layer-k pre-activations given bounds for layers < k.
+
+    ``in_lb``/``in_ub``: (..., d) input box.  ``pre_lbs[j]``/``pre_ubs[j]``:
+    (..., n_j) pre-activation bounds of hidden layer j.  Returns (lo, hi) of
+    shape (..., n_k).
+    """
+    A_low, c_low, A_up, c_up = _backward_linear(
+        params, k, pre_lbs, pre_ubs, in_lb.shape[:-1],
+        alphas_low=alphas_low, alphas_up=alphas_up,
+        beta_signs=beta_signs, betas_low=betas_low, betas_up=betas_up)
+    return _concretize(A_low, c_low, A_up, c_up, in_lb, in_ub)
+
+
+def _optimize_relaxation(width, init, iters: int, with_beta: bool,
+                         lr0: float = 0.5, decay: float = 0.7, lr_b: float = 0.8):
+    """Signed-gradient ascent on CROWN relaxation parameters (α and β).
+
+    ``width(al, au, bl, bu) -> (summed_width, (lo, hi))``; ``init`` is the
+    per-layer starting α list (the adaptive heuristic slope).  α's clip to
+    [0, 1], β's to [0, ∞); every iterate is a valid relaxation so the best
+    (lo, hi) across iterates — including the final parameters — is kept.
+    Returns ``(lo, hi, al, au)`` with the final α's (for form extraction).
+    """
+    al = [a for a in init]
+    au = [a for a in init]
+    bl = [jnp.zeros_like(a) for a in init]
+    bu = [jnp.zeros_like(a) for a in init]
+    lr = lr0
+    best_lo = best_hi = None
+    for _ in range(iters):
+        (_, (lo, hi)), grads = jax.value_and_grad(
+            width, argnums=(0, 1, 2, 3), has_aux=True)(al, au, bl, bu)
+        best_lo = lo if best_lo is None else jnp.maximum(best_lo, lo)
+        best_hi = hi if best_hi is None else jnp.minimum(best_hi, hi)
+        g_al, g_au, g_bl, g_bu = grads
+        al = [jnp.clip(a - lr * jnp.sign(g), 0.0, 1.0) for a, g in zip(al, g_al)]
+        au = [jnp.clip(a - lr * jnp.sign(g), 0.0, 1.0) for a, g in zip(au, g_au)]
+        if with_beta:
+            bl = [jnp.maximum(b - lr_b * jnp.sign(g), 0.0) for b, g in zip(bl, g_bl)]
+            bu = [jnp.maximum(b - lr_b * jnp.sign(g), 0.0) for b, g in zip(bu, g_bu)]
+        lr *= decay
+    _, (lo, hi) = width(al, au, bl, bu)
+    best_lo = jnp.maximum(best_lo, lo)
+    best_hi = jnp.minimum(best_hi, hi)
+    return best_lo, best_hi, al, au
 
 
 def crown_bounds(params: MLP, lb: jax.Array, ub: jax.Array, widen: bool = True) -> LayerBounds:
@@ -164,6 +242,114 @@ def crown_output_bounds(params: MLP, lb: jax.Array, ub: jax.Array, widen: bool =
     return bounds.ws_lb[-1][..., 0], bounds.ws_ub[-1][..., 0]
 
 
+def sign_constrained_output_bounds(params: MLP, lb: jax.Array, ub: jax.Array,
+                                   signs, alpha_iters: int = 0):
+    """Output bounds under per-neuron activation-sign branch constraints.
+
+    ``signs``: per-hidden-layer (..., n_j) arrays — +1 forces the neuron
+    active (pre-activation ≥ 0), −1 forces it inactive (≤ 0), 0 free.  These
+    are the branch-and-bound splits of the β-CROWN family (Wang et al. 2021,
+    public algorithm), enforced through two mechanisms:
+
+    * **clamps** — the constrained neuron's own interval is clipped
+      (``lo ← max(lo, 0)`` when forced active, ``hi ← min(hi, 0)`` when
+      inactive), which stabilises its relaxation for deeper layers;
+    * **β multipliers** — every backward pass carries the Lagrange penalty
+      ``∓β·s·z_j`` of each split (see :func:`_backward_linear`), which is
+      what actually transfers the constraint into the *concretized* bound
+      (clamps alone leave the final concretization ranging over the whole
+      input box and stall BaB — measured on AC-7: lb pinned at −3.18
+      regardless of split depth).
+
+    With ``alpha_iters > 0`` every intermediate layer bound is α/β-optimized
+    (signed-gradient ascent, β clipped ≥ 0, best iterate kept) — full
+    α-CROWN with optimized intermediate bounds, not just an optimized final
+    pass.  On deep narrow nets this is the difference between useless and
+    decisive: AC-7 (64-32-16-8-4-1) partitions whose plain-CROWN root bound
+    is −3.18 certify *at the root* with the optimized pipeline.  Cost is
+    O(L²·iters) small matmuls per batch — irrelevant against HBM traffic
+    for these ≤100-wide nets, and the whole frontier batches in one launch.
+
+    Returns ``(out_lo, out_hi, feasible, scores, resolved)``:
+
+    * ``out_lo``/``out_hi``: (...,) widened output-logit bounds, valid for
+      every input in the box satisfying the sign pattern;
+    * ``feasible``: (...,) bool — False when some clamp produced an empty
+      interval, i.e. the branch region is provably empty;
+    * ``scores``: per-hidden-layer (..., n_j) branch-selection scores — the
+      CROWN triangle intercept ``ub·(−lb)/(ub−lb)`` of still-free unstable
+      neurons (0 for stable/constrained/pruned ones): BaBSR-style proxy for
+      which split removes the most relaxation slack;
+    * ``resolved``: per-hidden-layer (..., n_j) int8 — the sign every alive
+      neuron is known to have within this branch (+1/−1 from stability or
+      the split pattern, 0 = still unstable).  A branch with no unresolved
+      neuron defines an affine region; the caller can finish it exactly
+      (``verify.engine._leaf_sign_lp``).
+    """
+    n = params.depth
+    ws_lb, ws_ub, feas = [], [], None
+    scores, resolved = [], []
+    lo_run, hi_run = lb, ub
+    sgn = None
+    for k in range(n):
+        zlo_i, zhi_i = affine_interval(params.weights[k], params.biases[k], lo_run, hi_run)
+        if k == 0:
+            zlo, zhi = zlo_i, zhi_i
+        else:
+            if alpha_iters <= 0:
+                zlo_c, zhi_c = _backward_bounds(
+                    params, k, ws_lb, ws_ub, lb, ub,
+                    beta_signs=sgn, betas_low=[jnp.zeros_like(s) for s in sgn],
+                    betas_up=[jnp.zeros_like(s) for s in sgn])
+            else:
+
+                def width(al_, au_, bl_, bu_, k=k):
+                    lo_o, hi_o = _backward_bounds(
+                        params, k, ws_lb, ws_ub, lb, ub,
+                        alphas_low=al_, alphas_up=au_,
+                        beta_signs=sgn, betas_low=bl_, betas_up=bu_)
+                    return jnp.sum(hi_o - lo_o), (lo_o, hi_o)
+
+                init = [jnp.where(ws_ub[j] >= -ws_lb[j], 1.0, 0.0)
+                        for j in range(k)]
+                zlo_c, zhi_c, _, _ = _optimize_relaxation(
+                    width, init, alpha_iters, with_beta=True)
+            zlo = jnp.maximum(zlo_i, zlo_c)
+            zhi = jnp.minimum(zhi_i, zhi_c)
+        zlo, zhi = _widen(zlo, zhi)
+        if k < n - 1:
+            s = signs[k]
+            zlo = jnp.where(s > 0, jnp.maximum(zlo, 0.0), zlo)
+            zhi = jnp.where(s < 0, jnp.minimum(zhi, 0.0), zhi)
+            bad = (zlo > zhi).any(axis=-1)
+            feas = bad if feas is None else (feas | bad)
+            # Empty interval: collapse to a point so downstream layers stay
+            # numerically sane; the feasible flag already excludes the branch.
+            zhi = jnp.maximum(zhi, zlo)
+            unstable = (zlo < 0.0) & (zhi > 0.0)
+            denom = jnp.where(unstable, zhi - zlo, 1.0)
+            scores.append(
+                jnp.where(unstable, zhi * (-zlo) / denom, 0.0) * params.masks[k])
+            resolved.append(jnp.where(
+                zlo >= 0.0, 1, jnp.where(zhi <= 0.0, -1, 0)
+            ).astype(jnp.int8) * (params.masks[k] > 0.5))
+            sgn = [signs[j].astype(lb.dtype) * params.masks[j]
+                   for j in range(k + 1)]
+        ws_lb.append(zlo)
+        ws_ub.append(zhi)
+        if k == n - 1:
+            break
+        m = params.masks[k]
+        lo_run = jax.nn.relu(zlo) * m
+        hi_run = jax.nn.relu(zhi) * m
+    out_lo, out_hi = ws_lb[-1][..., 0], ws_ub[-1][..., 0]
+    if feas is None:
+        feasible = jnp.ones(out_lo.shape, dtype=bool)
+    else:
+        feasible = ~feas
+    return out_lo, out_hi, feasible, scores, resolved
+
+
 def alpha_crown_output_bounds(params: MLP, lb: jax.Array, ub: jax.Array,
                               iters: int = 8, widen: bool = True):
     """α-CROWN output-logit bounds: per-box optimized lower ReLU slopes.
@@ -188,34 +374,73 @@ def alpha_crown_output_bounds(params: MLP, lb: jax.Array, ub: jax.Array,
     if k == 0 or iters <= 0:
         return lo0, hi0
 
-    # Start from the adaptive heuristic slope (what plain CROWN uses).
-    init = [jnp.where(pre_ubs[j] >= -pre_lbs[j], 1.0, 0.0) for j in range(k)]
-    al = [a for a in init]
-    au = [a for a in init]
-
-    def width(al_, au_):
+    def width(al_, au_, bl_, bu_):
         lo, hi = _backward_bounds(params, k, pre_lbs, pre_ubs, lb, ub,
                                   alphas_low=al_, alphas_up=au_)
         return jnp.sum(hi[..., 0] - lo[..., 0]), (lo[..., 0], hi[..., 0])
 
-    lr = 0.5
-    # Track the best *unwidened* optimized bounds; widen once at the end and
-    # only then intersect with the (already-widened) plain-CROWN baseline —
-    # the result can never be looser than plain CROWN.
-    opt_lo = opt_hi = None
-    for _ in range(iters):
-        (_, (lo, hi)), grads = jax.value_and_grad(width, argnums=(0, 1),
-                                                  has_aux=True)(al, au)
-        opt_lo = lo if opt_lo is None else jnp.maximum(opt_lo, lo)
-        opt_hi = hi if opt_hi is None else jnp.minimum(opt_hi, hi)
-        g_al, g_au = grads
-        # Signed updates: per-box α gradients decouple (the objective sums
-        # over the batch), and sign steps need no per-net learning rate.
-        al = [jnp.clip(a - lr * jnp.sign(g), 0.0, 1.0) for a, g in zip(al, g_al)]
-        au = [jnp.clip(a - lr * jnp.sign(g), 0.0, 1.0) for a, g in zip(au, g_au)]
-        lr *= 0.6
-    _, (lo, hi) = width(al, au)
-    opt_lo, opt_hi = jnp.maximum(opt_lo, lo), jnp.minimum(opt_hi, hi)
+    # Start from the adaptive heuristic slope (what plain CROWN uses); track
+    # the best *unwidened* optimized bounds, widen once at the end, and only
+    # then intersect with the (already-widened) plain-CROWN baseline — the
+    # result can never be looser than plain CROWN.
+    init = [jnp.where(pre_ubs[j] >= -pre_lbs[j], 1.0, 0.0) for j in range(k)]
+    opt_lo, opt_hi, _, _ = _optimize_relaxation(width, init, iters,
+                                                with_beta=False)
     if widen:
         opt_lo, opt_hi = _widen(opt_lo, opt_hi)
     return jnp.maximum(opt_lo, lo0), jnp.minimum(opt_hi, hi0)
+
+
+def crown_output_form_sets(params: MLP, lb: jax.Array, ub: jax.Array,
+                           alpha_iters: int = 0):
+    """Output-logit linear forms over the box, for relational certificates.
+
+    Returns ``(form_sets, lo, hi)`` where ``form_sets`` is a list of one or
+    two tuples ``(A_low, c_low, A_up, c_up)`` — ``A_*`` of shape (..., d),
+    ``c_*`` of shape (...,) — each satisfying, for every x in [lb, ub]::
+
+        f(x) ≥ x·A_low + c_low        f(x) ≤ x·A_up + c_up
+
+    Set 0 is plain CROWN (adaptive heuristic slopes); with ``alpha_iters > 0``
+    a second set is added whose lower slopes were α-optimized against the
+    output width (final iterate — every iterate is a valid relaxation, so the
+    forms are sound; a consumer may take the elementwise best bound across
+    sets).  ``lo``/``hi`` are the concretized, outward-widened scalar output
+    bounds intersected across sets (matching
+    :func:`alpha_crown_output_bounds` semantics).  The forms themselves are
+    returned *unwidened*: any certificate derived from them must add its own
+    outward slack (see ``_widen``).
+
+    The relational consumer (``verify.engine``) ties the two roles of the
+    fairness pair through these forms — bounding f(x) − f(x') over the tied
+    pair set — which is strictly tighter than differencing the concretized
+    per-role bounds the reference's interval analysis would give
+    (``utils/prune.py:105-164``).
+    """
+    bounds = crown_bounds(params, lb, ub, widen=True)
+    k = params.depth - 1
+    pre_lbs = [bounds.ws_lb[j] for j in range(k)]
+    pre_ubs = [bounds.ws_ub[j] for j in range(k)]
+    batch = lb.shape[:-1]
+    A_l, c_l, A_u, c_u = _backward_linear(params, k, pre_lbs, pre_ubs, batch)
+    plain = (A_l[..., 0], c_l[..., 0], A_u[..., 0], c_u[..., 0])
+    lo0, hi0 = bounds.ws_lb[-1][..., 0], bounds.ws_ub[-1][..., 0]
+    if k == 0 or alpha_iters <= 0:
+        return [plain], lo0, hi0
+
+    def width(al_, au_, bl_, bu_):
+        lo, hi = _backward_bounds(params, k, pre_lbs, pre_ubs, lb, ub,
+                                  alphas_low=al_, alphas_up=au_)
+        return jnp.sum(hi[..., 0] - lo[..., 0]), (lo[..., 0], hi[..., 0])
+
+    init = [jnp.where(pre_ubs[j] >= -pre_lbs[j], 1.0, 0.0) for j in range(k)]
+    opt_lo, opt_hi, al, au = _optimize_relaxation(width, init, alpha_iters,
+                                                  with_beta=False)
+    A_l, c_l, A_u, c_u = _backward_linear(params, k, pre_lbs, pre_ubs, batch,
+                                          alphas_low=al, alphas_up=au)
+    tuned = (A_l[..., 0], c_l[..., 0], A_u[..., 0], c_u[..., 0])
+    lo1, hi1 = _concretize(A_l, c_l, A_u, c_u, lb, ub)
+    lo1, hi1 = lo1[..., 0], hi1[..., 0]
+    opt_lo, opt_hi = jnp.maximum(opt_lo, lo1), jnp.minimum(opt_hi, hi1)
+    opt_lo, opt_hi = _widen(opt_lo, opt_hi)
+    return [plain, tuned], jnp.maximum(opt_lo, lo0), jnp.minimum(opt_hi, hi0)

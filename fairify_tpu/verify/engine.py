@@ -62,12 +62,103 @@ def _role_logit_bounds(net: MLP, x_lo, x_hi, xp_lo, xp_hi, use_crown: bool):
     return lb_x, ub_x, lb_p, ub_p
 
 
-@partial(jax.jit, static_argnames=("alpha_iters",))
-def _role_logit_bounds_alpha(net: MLP, x_lo, x_hi, xp_lo, xp_hi, alpha_iters: int):
-    """α-CROWN role bounds for the BaB frontier (static unrolled iters)."""
-    lb_x, ub_x = crown_ops.alpha_crown_output_bounds(net, x_lo, x_hi, iters=alpha_iters)
-    lb_p, ub_p = crown_ops.alpha_crown_output_bounds(net, xp_lo, xp_hi, iters=alpha_iters)
-    return lb_x, ub_x, lb_p, ub_p
+# ---------------------------------------------------------------------------
+# Tied pair-difference certificate
+# ---------------------------------------------------------------------------
+#
+# Separate role bounds discard the defining structure of the fairness pair:
+# x and x' agree on every non-PA coordinate (RA dims within ±ε).  A flip
+# x⁺/x'⁻ forces f(x) − f(x') > 0, so an upper bound of the *difference over
+# the tied pair set* that is ≤ 0 kills the flip even when both role logit
+# ranges straddle zero — which is exactly the regime where the hard models
+# (large logit range, tiny PA sensitivity) leave the separate-bound
+# certificate stuck.  The difference bound comes from the CROWN output
+# linear forms: f(x) ≤ Aᵘ·x + cᵘ over the x role box and f(x') ≥ Aˡ·x' + cˡ
+# over the x' role box, so over tied pairs
+#
+#   f(x) − f(x') ≤ Σ_{j∉PA} max_{s_j∈[lo,hi]} (Aᵘ_j − Aˡ_j)·s_j
+#                + Σ_{j∈PA} (Aᵘ_j·a_j − Aˡ_j·b_j)  + ε·Σ_{j∈RA} |Aˡ_j|
+#                + cᵘ − cˡ
+#
+# — the shared-dim coefficients *cancel* instead of concretizing twice.
+
+
+def _tied_diff_ub(A_pos, c_pos, A_neg, c_neg, lo, hi, shared_mask):
+    """Upper bounds of (pos-form − neg-form) over tied shared coordinates.
+
+    ``A_pos``/``c_pos``: (B, Vp, d)/(B, Vp) upper linear form of the role
+    that must be positive; ``A_neg``/``c_neg``: lower form of the role that
+    must be negative (constants include their PA/ε contributions).
+    ``lo``/``hi``: (B, d) shared box.  Returns ``(M, coef)``: the (B, Vp, Vn)
+    bound matrix and the per-dim max |Aᵖᵒˢ − Aⁿᵉᵍ| (B, d) branching score.
+    The Vp axis is mapped with ``lax.scan`` so the (B, V, V, d) tensor is
+    never materialised (GC's PA=age has V=57).
+    """
+
+    def one(carry, au_cu):
+        au, cu = au_cu
+        D = (au[:, None, :] - A_neg) * shared_mask
+        m = jnp.where(D > 0, D * hi[:, None, :], D * lo[:, None, :])
+        row = m.sum(-1) + cu[:, None] - c_neg
+        return jnp.maximum(carry, jnp.abs(D).max(axis=1)), row
+
+    coef0 = jnp.zeros(lo.shape, dtype=A_pos.dtype)
+    coef, rows = jax.lax.scan(
+        one, coef0, (jnp.moveaxis(A_pos, 1, 0), jnp.moveaxis(c_pos, 1, 0)))
+    return jnp.moveaxis(rows, 0, 1), coef
+
+
+def _certify_impl(net: MLP, x_lo, x_hi, xp_lo, xp_hi, lo, hi, assign_vals,
+                  pa_mask, ra_mask, eps, valid, valid_pair, alpha_iters: int):
+    """Combined fairness certificate + branch scores for a batch of boxes.
+
+    Per box: CROWN (α-CROWN when ``alpha_iters > 0``) role logit bounds give
+    the separate-bound kills of :func:`no_flip_certified`; the output linear
+    forms additionally give tied pair-difference kills per direction.  A box
+    is certified iff every valid assignment pair has both flip directions
+    killed by either mechanism.  Returns ``(certified (B,), score (B, d))``
+    where ``score`` is the max difference-form coefficient magnitude per
+    shared dim — the input-split analog of bound-improvement branching
+    (splitting dim j tightens the difference bound by ~score_j·width_j/2).
+    """
+    sets_x, lb_x, ub_x = crown_ops.crown_output_form_sets(
+        net, x_lo, x_hi, alpha_iters)
+    sets_p, lb_p, ub_p = crown_ops.crown_output_form_sets(
+        net, xp_lo, xp_hi, alpha_iters)
+    t1_dead = (ub_x[..., :, None] <= 0.0) | (lb_p[..., None, :] >= 0.0)
+    t2_dead = (lb_x[..., :, None] >= 0.0) | (ub_p[..., None, :] <= 0.0)
+
+    shared = 1.0 - pa_mask
+    pa_dot = lambda A: jnp.sum(A * assign_vals[None, :, :], axis=-1)
+    ra_abs = lambda A: eps * jnp.sum(jnp.abs(A) * ra_mask, axis=-1)
+    ub1 = ub2 = None
+    score = jnp.zeros(lo.shape, dtype=lo.dtype)
+    for (Alx, clx, Aux, cux), (Alp, clp, Aup, cup) in zip(sets_x, sets_p):
+        # Direction x⁺/x'⁻: needs f(x_a) − f(x'_b) > 0.
+        m1, s1 = _tied_diff_ub(
+            Aux, cux + pa_dot(Aux), Alp, clp + pa_dot(Alp) - ra_abs(Alp),
+            lo, hi, shared)
+        # Direction x⁻/x'⁺: needs f(x'_b) − f(x_a) > 0 (matrix built [b, a]).
+        m2, s2 = _tied_diff_ub(
+            Aup, cup + pa_dot(Aup) + ra_abs(Aup), Alx, clx + pa_dot(Alx),
+            lo, hi, shared)
+        m2 = jnp.swapaxes(m2, -1, -2)
+        ub1 = m1 if ub1 is None else jnp.minimum(ub1, m1)
+        ub2 = m2 if ub2 is None else jnp.minimum(ub2, m2)
+        score = jnp.maximum(score, jnp.maximum(s1, s2))
+    # Outward slack: the forms are unwidened f32 (crown_output_form_sets).
+    from fairify_tpu.ops.interval import SOUND_SLACK_ABS, SOUND_SLACK_REL
+
+    widen = lambda u: u + SOUND_SLACK_REL * jnp.abs(u) + SOUND_SLACK_ABS
+    t1_dead = t1_dead | (widen(ub1) <= 0.0)
+    t2_dead = t2_dead | (widen(ub2) <= 0.0)
+
+    pair_ok = valid_pair[None] & valid[..., :, None] & valid[..., None, :]
+    possible = pair_ok & ~(t1_dead & t2_dead)
+    return ~possible.any(axis=(-2, -1)), score
+
+
+_role_certify_kernel = jax.jit(_certify_impl, static_argnames=("alpha_iters",))
 
 
 def no_flip_certified(
@@ -357,10 +448,17 @@ def decide_leaf(enc: PairEncoding, weights, biases, point: np.ndarray, lo, hi):
     """Exactly decide a leaf box (all shared dims collapsed to one point).
 
     Enumerates PA assignment pairs and, for RA dims, the full delta lattice
-    [-ε, ε]^|RA|.  Returns ('sat', (x, xp)) or ('unsat', None).
+    [-ε, ε]^|RA|.  Returns ('sat', (x, xp)), ('unsat', None), or
+    ('unknown', None) when the delta lattice is too large to enumerate —
+    (2ε+1)^|RA| is exponential in the relaxed-attribute count, so a future
+    preset with several RA dims degrades to an honest UNKNOWN instead of
+    silently stalling the sweep (today's presets use |RA| ≤ 1, ε = 5).
     """
     import itertools as it
 
+    if len(enc.ra_idx) and enc.eps and \
+            (2 * enc.eps + 1) ** len(enc.ra_idx) > 100_000:
+        return "unknown", None
     lo = np.asarray(lo)
     hi = np.asarray(hi)
     valid = [
@@ -405,6 +503,248 @@ def decide_leaf(enc: PairEncoding, weights, biases, point: np.ndarray, lo, hi):
 
 
 # ---------------------------------------------------------------------------
+# Uniform-sign branch-and-bound (neuron splits)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("alpha_iters",))
+def _sign_bound_kernel(net: MLP, lo, hi, signs, alpha_iters: int):
+    return crown_ops.sign_constrained_output_bounds(net, lo, hi, signs,
+                                                    alpha_iters=alpha_iters)
+
+
+def _leaf_sign_lp(weights, biases, masks, pattern, lo, hi, want_positive: bool):
+    """Exact endgame for a fully-resolved sign-BaB branch (affine region).
+
+    With every alive neuron's activation sign resolved, the network is
+    affine over the branch region {x ∈ box : s_j·z_j(x) ≥ 0 ∀j}, so the
+    exact region extremum is one small LP (13-30 vars, ≤ ~130 constraints;
+    scipy/HiGGS solves it in milliseconds).  This is the LP-duality endgame
+    the iterative β optimizer approximates — at a leaf we take the exact
+    answer instead.  Returns 'certified' (extremum strictly on the wanted
+    side of 0, with a 1e-6 margin), 'infeasible' (region empty), or 'mixed'.
+    """
+    from scipy.optimize import linprog
+
+    d = len(lo)
+    A = np.eye(d)
+    c = np.zeros(d)
+    A_cons, b_cons = [], []
+    for i, (w, b) in enumerate(zip(weights, biases)):
+        w = np.asarray(w, np.float64)
+        b = np.asarray(b, np.float64)
+        Az = A @ w
+        cz = c @ w + b
+        if i < len(weights) - 1:
+            s = np.asarray(pattern[i])
+            m = np.asarray(masks[i]) > 0.5
+            if ((s == 0) & m).any():
+                return "mixed"  # unresolved neuron: region not affine
+            for j in np.where(m)[0]:
+                sj = float(s[j])
+                A_cons.append(-sj * Az[:, j])
+                b_cons.append(sj * cz[j])
+            act = (m & (s > 0)).astype(np.float64)
+            A = Az * act[None, :]
+            c = cz * act
+        else:
+            A, c = Az, cz
+    g = A[:, 0]
+    c0 = float(c[0])
+    sense = 1.0 if want_positive else -1.0
+    res = linprog(sense * g,
+                  A_ub=np.stack(A_cons) if A_cons else None,
+                  b_ub=np.asarray(b_cons) if b_cons else None,
+                  bounds=list(zip(np.asarray(lo, float), np.asarray(hi, float))),
+                  method="highs")
+    if res.status == 2:
+        return "infeasible"
+    if res.status != 0 or res.fun is None:
+        return "mixed"
+    extremum = sense * res.fun + c0  # min f if want_positive else max f
+    margin = 1e-6 + 1e-9 * abs(c0)
+    if want_positive and extremum > margin:
+        return "certified"
+    if (not want_positive) and extremum < -margin:
+        return "certified"
+    return "mixed"
+
+
+@jax.jit
+def _sample_role_logits(net: MLP, x_roles, xp_roles):
+    from fairify_tpu.models.mlp import forward
+
+    return forward(net, x_roles), forward(net, xp_roles)
+
+
+def uniform_sign_bab(
+    net: MLP,
+    enc: PairEncoding,
+    roots_lo: np.ndarray,
+    roots_hi: np.ndarray,
+    cfg: "EngineConfig",
+    deadline_s: float,
+    mesh=None,
+) -> list:
+    """Prove a uniform logit sign over each root box via neuron-split BaB.
+
+    A uniform output sign over the (RA-widened) partition box forbids every
+    flip pair at once — the decisive certificate for deep nets whose logit
+    is far from zero on average but whose input-split bounds converge too
+    slowly (e.g. the adult AC-7 64-32-16-8-4-1 model, where the reference's
+    Z3 also times out, ``BASELINE.md`` AC7 rows).  Branching is on *neuron
+    activation signs* (β-CROWN-family splits, primal form — see
+    :func:`fairify_tpu.ops.crown.sign_constrained_output_bounds`), with all
+    roots sharing one padded device frontier like :func:`decide_many`.
+
+    Per root the conjectured sign comes from sampled role logits; a sample
+    with the opposite sign, an exhausted node budget, or a branch whose
+    bound contradicts the conjecture marks the root 'mixed' (hand it to the
+    pair BaB).  Returns per-root verdicts: 'unsat' | 'mixed'.
+    """
+    t0 = time.perf_counter()
+    R = roots_lo.shape[0]
+    n_hidden = net.depth - 1
+    if n_hidden == 0 or not len(enc.pa_idx):
+        return ["mixed"] * R
+    F = cfg.frontier_size
+    if mesh is not None:
+        from fairify_tpu.parallel import mesh as mesh_mod
+
+        bound_net = mesh_mod.replicated(mesh, net)
+    else:
+        bound_net = net
+    host_w = [np.asarray(w) for w in net.weights]
+    host_b = [np.asarray(b) for b in net.biases]
+    host_m = [np.asarray(m) for m in net.masks]
+
+    # The sign box: PA dims already span the partition's PA range; RA dims
+    # widen by ε because x' may leave the box (property.role_boxes).
+    slo = np.asarray(roots_lo, dtype=np.int64).copy()
+    shi = np.asarray(roots_hi, dtype=np.int64).copy()
+    if len(enc.ra_idx) and enc.eps:
+        slo[:, enc.ra_idx] -= enc.eps
+        shi[:, enc.ra_idx] += enc.eps
+
+    # Sign conjecture: role logits at sampled shared points — any mixed
+    # sample disqualifies the root immediately (it cannot be uniform).
+    rng = np.random.default_rng(cfg.seed + 3)
+    xr, pr = build_attack_candidates(enc, rng, roots_lo, roots_hi, 32)
+    lx, lp = _sample_role_logits(net, jnp.asarray(xr), jnp.asarray(pr))
+    lx, lp = np.asarray(lx), np.asarray(lp)
+    va = None
+    if len(enc.pa_idx):
+        from fairify_tpu.verify.property import role_boxes
+
+        _, _, _, _, va = role_boxes(enc, roots_lo.astype(np.float32),
+                                    roots_hi.astype(np.float32))
+    allv = np.concatenate([
+        np.where(va[:, None, :], lx, np.nan).reshape(R, -1),
+        np.where(va[:, None, :], lp, np.nan).reshape(R, -1)], axis=1)
+    want_pos = np.nanmin(allv, axis=1) > 0.0
+    want_neg = np.nanmax(allv, axis=1) < 0.0
+    candidate = want_pos | want_neg
+
+    from collections import deque
+
+    hidden_sizes = [int(b.shape[0]) for b in net.biases[:n_hidden]]
+    zero_signs = [np.zeros(n, dtype=np.int8) for n in hidden_sizes]
+    frontier = deque((r, zero_signs) for r in range(R) if candidate[r])
+    verdicts = ["mixed"] * R
+    settled = np.zeros(R, dtype=bool)
+    settled[~candidate] = True
+    open_n = np.where(candidate, 1, 0).astype(np.int64)
+    nodes = np.zeros(R, dtype=np.int64)
+
+    def fail(r):
+        settled[r] = True  # verdict stays 'mixed'
+
+    while frontier:
+        if (time.perf_counter() - t0) > deadline_s:
+            break
+        batch_items = []
+        while frontier and len(batch_items) < F:
+            r, sgn = frontier.popleft()
+            if settled[r]:
+                continue
+            batch_items.append((r, sgn))
+        if not batch_items:
+            break
+        batch = len(batch_items)
+        broot = np.array([r for r, _ in batch_items])
+        blo = _pad(slo[broot].astype(np.float32), F)
+        bhi = _pad(shi[broot].astype(np.float32), F)
+        bsigns = tuple(
+            _pad(np.stack([sgn[j] for _, sgn in batch_items]).astype(np.float32), F)
+            for j in range(n_hidden))
+        # subtract.at, not fancy-index -=: a root's two children routinely
+        # share a batch, and x[idx] -= 1 decrements duplicates only once.
+        np.subtract.at(open_n, broot, 1)
+        np.add.at(nodes, broot, 1)
+        if mesh is not None:
+            blo, bhi, *bsigns = mesh_mod.shard_parts(mesh, blo, bhi, *bsigns)
+            bsigns = tuple(bsigns)
+        out_lo, out_hi, feasible, scores, resolved = _sign_bound_kernel(
+            bound_net, jnp.asarray(blo), jnp.asarray(bhi),
+            tuple(jnp.asarray(s) for s in bsigns), cfg.alpha_iters)
+        out_lo = np.asarray(out_lo)[:batch]
+        out_hi = np.asarray(out_hi)[:batch]
+        feasible = np.asarray(feasible)[:batch]
+        scores = [np.asarray(s)[:batch] for s in scores]
+        resolved = [np.asarray(s)[:batch] for s in resolved]
+
+        for k, (r, sgn) in enumerate(batch_items):
+            if settled[r]:
+                continue
+            if not feasible[k]:
+                pass  # empty branch region: discharged
+            elif (want_pos[r] and out_lo[k] > 0.0) or \
+                    (want_neg[r] and out_hi[k] < 0.0):
+                pass  # branch certified
+            elif nodes[r] > cfg.max_nodes:
+                fail(r)
+                continue
+            elif (want_pos[r] and out_hi[k] < 0.0) or \
+                    (want_neg[r] and out_lo[k] > 0.0):
+                # Bound contradicts the conjecture on a (possibly empty)
+                # branch — heuristic bail, the pair BaB owns this root.
+                fail(r)
+                continue
+            else:
+                flat = [s[k] for s in scores]
+                best_layer, best_idx, best_val = -1, -1, 0.0
+                for j, s in enumerate(flat):
+                    i = int(s.argmax())
+                    if s[i] > best_val:
+                        best_layer, best_idx, best_val = j, i, float(s[i])
+                if best_layer < 0:
+                    # Fully-resolved branch: the region is affine — finish
+                    # it exactly with the leaf LP (β at its dual optimum).
+                    outcome = _leaf_sign_lp(
+                        host_w, host_b, host_m, [rv[k] for rv in resolved],
+                        slo[r], shi[r], bool(want_pos[r]))
+                    if outcome == "mixed":
+                        fail(r)
+                        continue
+                    # certified / infeasible: branch discharged.
+                else:
+                    for forced in (1, -1):
+                        child = list(sgn)
+                        child[best_layer] = child[best_layer].copy()
+                        child[best_layer][best_idx] = forced
+                        frontier.append((r, child))
+                    open_n[r] += 2
+        # Settle only after the whole batch: settling inside the item loop
+        # would declare a root done while its popped-but-unevaluated sibling
+        # is still in this very batch (it would then be skipped unsoundly).
+        for r in set(int(x) for x in broot):
+            if not settled[r] and open_n[r] == 0:
+                verdicts[r] = "unsat"
+                settled[r] = True
+    return verdicts
+
+
+# ---------------------------------------------------------------------------
 # Branch-and-bound
 # ---------------------------------------------------------------------------
 
@@ -422,6 +762,11 @@ class EngineConfig:
     max_nodes: int = 200_000
     soft_timeout_s: float = 100.0
     seed: int = 0
+    # Uniform-sign neuron-split BaB pre-phase (uniform_sign_bab): the
+    # certificate of choice for deep nets whose logit range excludes zero
+    # over most of the box; sign_bab_frac caps its share of the deadline.
+    sign_bab: bool = True
+    sign_bab_frac: float = 0.5
 
 
 @dataclass
@@ -490,16 +835,37 @@ def decide_many(
     biases = [np.asarray(b) for b in net.biases]
     branch_dims = _branch_dims(enc, roots_lo.shape[1])
     F = cfg.frontier_size
+    assign_vals, pa_mask, ra_mask = _enc_tensors(enc, roots_lo.shape[1])
+    assign_vals, pa_mask, ra_mask = (
+        jnp.asarray(assign_vals), jnp.asarray(pa_mask), jnp.asarray(ra_mask))
+    valid_pair_dev = jnp.asarray(enc.valid_pair)
 
     from collections import deque
+
+    verdicts: list = [None] * R
+    ces: list = [None] * R
+
+    # Phase S — uniform-sign neuron-split BaB.  Roots whose sampled role
+    # logits are one-signed get a β-CROWN-style activation-split proof
+    # attempt first; input splitting on deep nets converges too slowly for
+    # exactly these roots (AC-7: 22k+ input-split nodes without progress).
+    # alpha_iters > 0 is required: with no β optimization the split
+    # constraints never reach the concretized bound and the phase cannot
+    # progress past root-level certification (see crown.py docstring).
+    if cfg.sign_bab and cfg.use_crown and cfg.alpha_iters > 0 and R:
+        sv = uniform_sign_bab(
+            net, enc, np.asarray(roots_lo, dtype=np.int64),
+            np.asarray(roots_hi, dtype=np.int64), cfg,
+            deadline_s=cfg.sign_bab_frac * deadline_s, mesh=mesh)
+        for r, v in enumerate(sv):
+            if v == "unsat":
+                verdicts[r] = "unsat"
 
     frontier = deque(
         (np.asarray(roots_lo[r], dtype=np.int64), np.asarray(roots_hi[r], dtype=np.int64), r)
         for r in range(R)
+        if verdicts[r] is None
     )
-
-    verdicts: list = [None] * R
-    ces: list = [None] * R
     nodes = np.zeros(R, dtype=np.int64)
     leaves = np.zeros(R, dtype=np.int64)
     open_boxes = np.ones(R, dtype=np.int64)  # root boxes still in the frontier
@@ -541,27 +907,37 @@ def decide_many(
         phi = _pad(bhi, F).astype(np.float32)
         x_lo, x_hi, xp_lo, xp_hi, valid = role_boxes(enc, plo, phi)
         bound_net = net
+        valid_in = valid
         if mesh is not None:
-            x_lo, x_hi, xp_lo, xp_hi = mesh_mod.shard_parts(
-                mesh, x_lo, x_hi, xp_lo, xp_hi)
+            x_lo, x_hi, xp_lo, xp_hi, plo_in, phi_in, valid_in = \
+                mesh_mod.shard_parts(mesh, x_lo, x_hi, xp_lo, xp_hi, plo, phi, valid)
             bound_net = net_sharded
+        else:
+            plo_in, phi_in = plo, phi
         # Escalation: plain CROWN clears the easy boxes in one cheap pass;
         # once a fifth of the deadline is spent the survivors are the hard
         # ones, where α-CROWN's extra backward passes pay for themselves.
         use_alpha = (cfg.use_crown and cfg.alpha_iters > 0
                      and time.perf_counter() - t0 > 0.2 * deadline_s)
-        if use_alpha:
-            lb_x, ub_x, lb_p, ub_p = _role_logit_bounds_alpha(
-                bound_net, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
-                jnp.asarray(xp_hi), cfg.alpha_iters,
+        score = None
+        if cfg.use_crown:
+            cert_dev, score_dev = _role_certify_kernel(
+                bound_net, jnp.asarray(x_lo), jnp.asarray(x_hi),
+                jnp.asarray(xp_lo), jnp.asarray(xp_hi),
+                jnp.asarray(plo_in), jnp.asarray(phi_in),
+                assign_vals, pa_mask, ra_mask, float(enc.eps),
+                jnp.asarray(valid_in), valid_pair_dev,
+                alpha_iters=cfg.alpha_iters if use_alpha else 0,
             )
+            certified = np.asarray(cert_dev)[:batch]
+            score = np.asarray(score_dev)[:F]
         else:
             lb_x, ub_x, lb_p, ub_p = _role_logit_bounds(
                 bound_net, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
                 jnp.asarray(xp_hi), cfg.use_crown,
             )
-        lb_x, ub_x, lb_p, ub_p = (np.asarray(v)[:F] for v in (lb_x, ub_x, lb_p, ub_p))
-        certified = no_flip_certified(lb_x, ub_x, lb_p, ub_p, valid, enc.valid_pair)[:batch]
+            lb_x, ub_x, lb_p, ub_p = (np.asarray(v)[:F] for v in (lb_x, ub_x, lb_p, ub_p))
+            certified = no_flip_certified(lb_x, ub_x, lb_p, ub_p, valid, enc.valid_pair)[:batch]
 
         undecided = np.where(~certified & live)[0]
         if undecided.size:
@@ -602,8 +978,18 @@ def decide_many(
                     verdict, ce = decide_leaf(enc, weights, biases, l.copy(), l, h)
                     if verdict == "sat":
                         settle(r, "sat", ce)
+                    elif verdict == "unknown":
+                        settle(r, "unknown")
                     continue
-                dim = branch_dims[int(widths.argmax())]
+                # Coefficient-aware branching: split the dim whose width
+                # contributes most to the difference-certificate slack
+                # (score_j·width_j); zero-score frontier → widest-dim.
+                if score is not None:
+                    sc = score[k][branch_dims] * widths
+                    dim = (branch_dims[int(sc.argmax())] if float(sc.max()) > 0
+                           else branch_dims[int(widths.argmax())])
+                else:
+                    dim = branch_dims[int(widths.argmax())]
                 mid = (l[dim] + h[dim]) // 2
                 left_hi = h.copy()
                 left_hi[dim] = mid

@@ -139,19 +139,34 @@ def _stage0_certify_and_attack(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
 
 
 def _stage0_block(net, enc: PairEncoding, lo, hi, cfg: SweepConfig, mesh, rng_seed):
-    x_lo, x_hi, xp_lo, xp_hi, valid = role_boxes(enc, lo.astype(np.float32), hi.astype(np.float32))
+    flo, fhi = lo.astype(np.float32), hi.astype(np.float32)
+    x_lo, x_hi, xp_lo, xp_hi, valid = role_boxes(enc, flo, fhi)
+    plo, phi, valid_in = flo, fhi, valid
     if mesh is not None:
         from fairify_tpu.parallel import mesh as mesh_mod
 
-        n = lo.shape[0]
-        x_lo, x_hi, xp_lo, xp_hi = mesh_mod.shard_parts(mesh, x_lo, x_hi, xp_lo, xp_hi)
+        x_lo, x_hi, xp_lo, xp_hi, plo, phi, valid_in = mesh_mod.shard_parts(
+            mesh, x_lo, x_hi, xp_lo, xp_hi, flo, fhi, valid)
         net = mesh_mod.replicated(mesh, net)
-    lb_x, ub_x, lb_p, ub_p = engine._role_logit_bounds(
-        net, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo), jnp.asarray(xp_hi),
-        cfg.engine.use_crown,
-    )
-    lb_x, ub_x, lb_p, ub_p = (np.asarray(v)[: lo.shape[0]] for v in (lb_x, ub_x, lb_p, ub_p))
-    unsat = engine.no_flip_certified(lb_x, ub_x, lb_p, ub_p, valid, enc.valid_pair)
+    if cfg.engine.use_crown:
+        # Combined certificate: separate role bounds + tied pair-difference
+        # kills (engine._certify_impl) — one kernel for the whole block.
+        assign_vals, pa_mask, ra_mask = engine._enc_tensors(enc, lo.shape[1])
+        cert, _ = engine._role_certify_kernel(
+            net, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
+            jnp.asarray(xp_hi), jnp.asarray(plo), jnp.asarray(phi),
+            jnp.asarray(assign_vals), jnp.asarray(pa_mask), jnp.asarray(ra_mask),
+            float(enc.eps), jnp.asarray(valid_in), jnp.asarray(enc.valid_pair),
+            alpha_iters=0,
+        )
+        unsat = np.asarray(cert)[: lo.shape[0]]
+    else:
+        lb_x, ub_x, lb_p, ub_p = engine._role_logit_bounds(
+            net, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo), jnp.asarray(xp_hi),
+            cfg.engine.use_crown,
+        )
+        lb_x, ub_x, lb_p, ub_p = (np.asarray(v)[: lo.shape[0]] for v in (lb_x, ub_x, lb_p, ub_p))
+        unsat = engine.no_flip_certified(lb_x, ub_x, lb_p, ub_p, valid, enc.valid_pair)
 
     rng = np.random.default_rng(rng_seed)
     xr, pr = engine.build_attack_candidates(enc, rng, lo, hi, cfg.engine.attack_samples)
@@ -197,26 +212,52 @@ def _stage0_family(stacked, enc: PairEncoding, lo, hi, cfg: SweepConfig, mesh=No
     from fairify_tpu.models.mlp import MLP, forward
 
     M = stacked.weights[0].shape[0]
-    x_lo, x_hi, xp_lo, xp_hi, valid = role_boxes(
-        enc, lo.astype(np.float32), hi.astype(np.float32)
-    )
+    flo, fhi = lo.astype(np.float32), hi.astype(np.float32)
+    x_lo, x_hi, xp_lo, xp_hi, valid = role_boxes(enc, flo, fhi)
+    plo, phi, valid_in = flo, fhi, valid
     if mesh is not None:
         from fairify_tpu.parallel import mesh as mesh_mod
 
-        x_lo, x_hi, xp_lo, xp_hi = mesh_mod.shard_parts(mesh, x_lo, x_hi, xp_lo, xp_hi)
+        x_lo, x_hi, xp_lo, xp_hi, plo, phi, valid_in = mesh_mod.shard_parts(
+            mesh, x_lo, x_hi, xp_lo, xp_hi, flo, fhi, valid)
         stacked = mesh_mod.replicated(mesh, stacked)
 
-    @jax.jit
-    def family_bounds(stacked, a, b, c, d, use_crown):
-        return jax.vmap(
-            lambda net: engine._role_logit_bounds.__wrapped__(net, a, b, c, d, use_crown)
-        )(MLP(stacked.weights, stacked.biases, stacked.masks))
+    if cfg.engine.use_crown:
+        assign_vals, pa_mask, ra_mask = engine._enc_tensors(enc, lo.shape[1])
 
-    lb_x, ub_x, lb_p, ub_p = family_bounds(
-        stacked, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
-        jnp.asarray(xp_hi), cfg.engine.use_crown,
-    )
-    lb_x, ub_x, lb_p, ub_p = (np.asarray(v)[:, : lo.shape[0]] for v in (lb_x, ub_x, lb_p, ub_p))
+        @partial(jax.jit, static_argnames=("alpha_iters",))
+        def family_certify(stacked, a, b, c, d, plo, phi, av, pm, rm, eps, va, vp,
+                           alpha_iters):
+            return jax.vmap(
+                lambda net: engine._certify_impl(
+                    net, a, b, c, d, plo, phi, av, pm, rm, eps, va, vp, alpha_iters)
+            )(MLP(stacked.weights, stacked.biases, stacked.masks))
+
+        cert, _ = family_certify(
+            stacked, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
+            jnp.asarray(xp_hi), jnp.asarray(plo), jnp.asarray(phi),
+            jnp.asarray(assign_vals), jnp.asarray(pa_mask), jnp.asarray(ra_mask),
+            float(enc.eps), jnp.asarray(valid_in), jnp.asarray(enc.valid_pair),
+            alpha_iters=0,
+        )
+        unsat_all = np.asarray(cert)[:, : lo.shape[0]]
+    else:
+
+        @jax.jit
+        def family_bounds(stacked, a, b, c, d, use_crown):
+            return jax.vmap(
+                lambda net: engine._role_logit_bounds.__wrapped__(net, a, b, c, d, use_crown)
+            )(MLP(stacked.weights, stacked.biases, stacked.masks))
+
+        lb_x, ub_x, lb_p, ub_p = family_bounds(
+            stacked, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
+            jnp.asarray(xp_hi), cfg.engine.use_crown,
+        )
+        lb_x, ub_x, lb_p, ub_p = (np.asarray(v)[:, : lo.shape[0]] for v in (lb_x, ub_x, lb_p, ub_p))
+        unsat_all = np.stack([
+            engine.no_flip_certified(lb_x[m], ub_x[m], lb_p[m], ub_p[m], valid, enc.valid_pair)
+            for m in range(M)
+        ])
 
     rng = np.random.default_rng(cfg.engine.seed)
     xr, pr = engine.build_attack_candidates(enc, rng, lo, hi, cfg.engine.attack_samples)
@@ -231,9 +272,7 @@ def _stage0_family(stacked, enc: PairEncoding, lo, hi, cfg: SweepConfig, mesh=No
 
     results = []
     for m in range(M):
-        unsat = engine.no_flip_certified(
-            lb_x[m], ub_x[m], lb_p[m], ub_p[m], valid, enc.valid_pair
-        )
+        unsat = unsat_all[m]
         found, wit = engine.find_flips(enc, lx[m], lp[m], valid)
         weights = [np.asarray(w[m]) for w in stacked.weights]
         biases = [np.asarray(b[m]) for b in stacked.biases]

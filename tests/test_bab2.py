@@ -1,0 +1,214 @@
+"""Round-2 engine upgrades: tied pair-difference certificate, β-CROWN-style
+sign-constrained bounds, uniform-sign BaB, and the LP leaf endgame.
+
+Oracle style follows tests/test_engine.py: tiny domains where exact
+brute-force enumeration is feasible, deliberately re-deriving the property
+semantics independently of the engine code.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from fairify_tpu.models import mlp
+from fairify_tpu.ops import crown as crown_ops
+from fairify_tpu.verify import engine
+from fairify_tpu.verify import property as prop
+from fairify_tpu.data.domains import DomainSpec
+
+
+def tiny_domain(ranges):
+    cols = tuple(ranges)
+    return DomainSpec(name="toy", columns=cols,
+                      ranges={k: tuple(v) for k, v in ranges.items()}, label="y")
+
+
+def random_net(rng, sizes, pa_scale=1.0):
+    ws, bs = [], []
+    for a, b in zip(sizes[:-1], sizes[1:]):
+        ws.append(rng.normal(size=(a, b)).astype(np.float32))
+        bs.append((rng.normal(size=(b,)) * 0.5).astype(np.float32))
+    return mlp.from_numpy(ws, bs)
+
+
+def brute_force_flip(net, enc, lo, hi):
+    """Exhaustive exact flip search on the integer lattice (independent oracle)."""
+    import itertools as it
+
+    W = [np.asarray(w) for w in net.weights]
+    B = [np.asarray(b) for b in net.biases]
+    free = [d for d in range(len(lo)) if d not in set(int(j) for j in enc.pa_idx)]
+    deltas = (list(it.product(range(-enc.eps, enc.eps + 1), repeat=len(enc.ra_idx)))
+              if len(enc.ra_idx) and enc.eps else [tuple()])
+    for pt in it.product(*(range(int(lo[d]), int(hi[d]) + 1) for d in free)):
+        base = np.zeros(len(lo), dtype=np.int64)
+        base[free] = pt
+        for a in range(enc.n_assign):
+            if not ((lo[enc.pa_idx] <= enc.assignments[a]).all()
+                    and (enc.assignments[a] <= hi[enc.pa_idx]).all()):
+                continue
+            x = base.copy()
+            x[enc.pa_idx] = enc.assignments[a]
+            sx = engine.exact_logit_sign(W, B, x)
+            if sx == 0:
+                continue
+            for b in range(enc.n_assign):
+                if not enc.valid_pair[a, b]:
+                    continue
+                if not ((lo[enc.pa_idx] <= enc.assignments[b]).all()
+                        and (enc.assignments[b] <= hi[enc.pa_idx]).all()):
+                    continue
+                for dl in deltas:
+                    xp = base.copy()
+                    xp[enc.pa_idx] = enc.assignments[b]
+                    for k, dv in enumerate(dl):
+                        xp[enc.ra_idx[k]] += dv
+                    sp = engine.exact_logit_sign(W, B, xp)
+                    if (sx > 0 and sp < 0) or (sx < 0 and sp > 0):
+                        return True
+    return False
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_tied_diff_certificate_sound(seed):
+    """A box certified by the combined (role-bound + tied-diff) certificate
+    must contain no exact flip pair — checked by brute force."""
+    rng = np.random.default_rng(seed)
+    dom = tiny_domain({"a": (0, 4), "pa": (0, 2), "ra": (0, 4)})
+    query = prop.FairnessQuery(domain=dom, protected=("pa",),
+                               relaxed=("ra",), relax_eps=1)
+    enc = prop.encode(query)
+    net = random_net(rng, (3, 8, 5, 1))
+    # Damp PA sensitivity so a meaningful fraction of trials certify.
+    ws = [np.asarray(w).copy() for w in net.weights]
+    ws[0][1, :] *= 0.01
+    net = mlp.from_numpy(ws, [np.asarray(b) for b in net.biases])
+    lo, hi = dom.lo_hi()
+    lo = lo.astype(np.int64)[None, :]
+    hi = hi.astype(np.int64)[None, :]
+    x_lo, x_hi, xp_lo, xp_hi, valid = prop.role_boxes(
+        enc, lo.astype(np.float32), hi.astype(np.float32))
+    av, pm, rm = engine._enc_tensors(enc, 3)
+    cert, score = engine._role_certify_kernel(
+        net, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
+        jnp.asarray(xp_hi), jnp.asarray(lo, jnp.float32),
+        jnp.asarray(hi, jnp.float32), jnp.asarray(av), jnp.asarray(pm),
+        jnp.asarray(rm), float(enc.eps), jnp.asarray(valid),
+        jnp.asarray(enc.valid_pair), alpha_iters=4)
+    assert np.asarray(score).shape == (1, 3)
+    if bool(np.asarray(cert)[0]):
+        assert not brute_force_flip(net, enc, lo[0], hi[0])
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_sign_constrained_bounds_sound(seed):
+    """Constrained bounds must contain f(x) for every sampled x that
+    satisfies the branch sign pattern."""
+    rng = np.random.default_rng(50 + seed)
+    net = random_net(rng, (4, 10, 6, 1))
+    lo = np.zeros((1, 4), dtype=np.float32)
+    hi = np.full((1, 4), 4.0, dtype=np.float32)
+    sizes = [10, 6]
+    signs = [np.zeros((1, n), dtype=np.float32) for n in sizes]
+    # Random split pattern on a few neurons.
+    for _ in range(3):
+        j = rng.integers(2)
+        signs[j][0, rng.integers(sizes[j])] = rng.choice([-1.0, 1.0])
+    out_lo, out_hi, feas, scores, resolved = crown_ops.sign_constrained_output_bounds(
+        net, jnp.asarray(lo), jnp.asarray(hi),
+        tuple(jnp.asarray(s) for s in signs), alpha_iters=6)
+    out_lo, out_hi = float(np.asarray(out_lo)[0]), float(np.asarray(out_hi)[0])
+    # Sample points, keep those satisfying the pattern, check containment.
+    X = rng.uniform(0.0, 4.0, size=(4000, 4)).astype(np.float32)
+    pre = mlp.preactivations(net, jnp.asarray(X))
+    keep = np.ones(len(X), dtype=bool)
+    for j in range(2):
+        z = np.asarray(pre[j])
+        s = signs[j][0]
+        keep &= ((s == 0) | (s * z >= 0)).all(axis=1)
+    if keep.any():
+        f = np.asarray(mlp.forward(net, jnp.asarray(X[keep])))
+        assert f.min() >= out_lo - 1e-3
+        assert f.max() <= out_hi + 1e-3
+    for rv, n in zip(resolved, sizes):
+        assert np.asarray(rv).shape == (1, n)
+
+
+def test_uniform_sign_bab_positive_net():
+    """A net whose logit is provably positive everywhere → 'unsat' roots."""
+    rng = np.random.default_rng(7)
+    ws = [rng.normal(size=(3, 6)).astype(np.float32) * 0.1,
+          rng.normal(size=(6, 1)).astype(np.float32) * 0.1]
+    bs = [np.zeros(6, dtype=np.float32), np.full(1, 5.0, dtype=np.float32)]
+    net = mlp.from_numpy(ws, bs)
+    dom = tiny_domain({"a": (0, 6), "pa": (0, 1), "b": (0, 6)})
+    query = prop.FairnessQuery(domain=dom, protected=("pa",))
+    enc = prop.encode(query)
+    lo, hi = dom.lo_hi()
+    roots_lo = np.stack([lo, lo]).astype(np.int64)
+    roots_hi = np.stack([hi, hi]).astype(np.int64)
+    from fairify_tpu.verify.engine import EngineConfig, uniform_sign_bab
+
+    verdicts = uniform_sign_bab(net, enc, roots_lo, roots_hi,
+                                EngineConfig(alpha_iters=4), deadline_s=60.0)
+    assert verdicts == ["unsat", "unsat"]
+
+
+def test_uniform_sign_bab_mixed_net_bails():
+    """A net with an obvious sign change must not be certified 'unsat'."""
+    ws = [np.array([[1.0], [0.0], [0.0]], dtype=np.float32)]
+    bs = [np.array([-3.0], dtype=np.float32)]  # f = a - 3: mixed over [0, 6]
+    net = mlp.from_numpy(ws, bs)
+    dom = tiny_domain({"a": (0, 6), "pa": (0, 1), "b": (0, 6)})
+    query = prop.FairnessQuery(domain=dom, protected=("pa",))
+    enc = prop.encode(query)
+    lo, hi = dom.lo_hi()
+    from fairify_tpu.verify.engine import EngineConfig, uniform_sign_bab
+
+    verdicts = uniform_sign_bab(net, enc, lo.astype(np.int64)[None],
+                                hi.astype(np.int64)[None],
+                                EngineConfig(alpha_iters=4), deadline_s=30.0)
+    assert verdicts == ["mixed"]
+
+
+def test_leaf_sign_lp_exact():
+    """LP endgame on a fully-resolved pattern matches brute-force region min."""
+    rng = np.random.default_rng(11)
+    ws = [rng.normal(size=(2, 3)).astype(np.float32),
+          rng.normal(size=(3, 1)).astype(np.float32)]
+    bs = [rng.normal(size=(3,)).astype(np.float32),
+          np.array([2.0], dtype=np.float32)]
+    lo = np.zeros(2)
+    hi = np.full(2, 5.0)
+    masks = [np.ones(3, dtype=np.float32), np.ones(1, dtype=np.float32)]
+    # Brute-force the true pattern-region minimum on a fine grid.
+    gx, gy = np.meshgrid(np.linspace(0, 5, 201), np.linspace(0, 5, 201))
+    X = np.stack([gx.ravel(), gy.ravel()], axis=1)
+    z = X @ ws[0] + bs[0]
+    for pattern in ([1, 1, 1], [1, -1, 1], [-1, -1, -1]):
+        sat = ((np.array(pattern) * z) >= 0).all(axis=1)
+        outcome = engine._leaf_sign_lp(ws, bs, masks, [np.array(pattern)],
+                                       lo, hi, want_positive=True)
+        if not sat.any():
+            assert outcome in ("infeasible", "certified", "mixed")
+            continue
+        h = np.maximum(z[sat], 0.0) * (np.array(pattern) > 0)
+        f = h @ ws[1] + bs[1]
+        true_min = f.min()
+        if outcome == "certified":
+            assert true_min > -1e-4
+        elif outcome == "infeasible":
+            assert not sat.any()
+
+
+def test_decide_leaf_ra_lattice_guard():
+    """An exponential RA delta lattice degrades to 'unknown', not a stall."""
+    dom = tiny_domain({"pa": (0, 1), "r1": (0, 9), "r2": (0, 9), "r3": (0, 9)})
+    query = prop.FairnessQuery(domain=dom, protected=("pa",),
+                               relaxed=("r1", "r2", "r3"), relax_eps=30)
+    enc = prop.encode(query)
+    net = random_net(np.random.default_rng(0), (4, 3, 1))
+    W = [np.asarray(w) for w in net.weights]
+    B = [np.asarray(b) for b in net.biases]
+    point = np.array([0, 3, 3, 3], dtype=np.int64)
+    verdict, ce = engine.decide_leaf(enc, W, B, point, point, point)
+    assert verdict == "unknown" and ce is None
