@@ -39,7 +39,7 @@ def causal_discrimination(
     predict_batch: Callable[[np.ndarray], np.ndarray],
     lo: Sequence[int],
     hi: Sequence[int],
-    pa_index: int,
+    pa_index,
     conf: float = 0.99,
     max_error: float = 0.01,
     min_samples: int = 100,
@@ -47,21 +47,32 @@ def causal_discrimination(
     batch_size: int = 512,
     rng: Optional[np.random.Generator] = None,
     keep_examples: int = 100,
+    max_combos: int = 4096,
 ) -> CausalResult:
     """Causal discrimination rate of a black-box classifier.
 
-    A sampled assignment of the non-protected attributes is *discriminatory*
-    if sweeping the protected attribute over [lo[pa], hi[pa]] changes the
-    prediction (``causal_discrimination``, ``src/AC/metrics.py:101-168``).
-    Stops when the Wald interval at ``conf`` is narrower than ``2·max_error``
-    (after ``min_samples``), like ``_check_stopping_condition`` (``:243-257``).
+    ``pa_index`` is one attribute index or a sequence of them.  A sampled
+    assignment of the non-protected attributes is *discriminatory* if
+    sweeping the protected attribute(s) over the full cartesian product of
+    their [lo, hi] ranges changes the prediction (``causal_discrimination``,
+    ``src/AC/metrics.py:101-168``; the attribute-set case is the joint sweep
+    of ``discrimination_search``, ``:170-227``).  Stops when the Wald
+    interval at ``conf`` is narrower than ``2·max_error`` (after
+    ``min_samples``), like ``_check_stopping_condition`` (``:243-257``).
     """
     rng = rng or np.random.default_rng(0)
     lo = np.asarray(lo, dtype=np.int64)
     hi = np.asarray(hi, dtype=np.int64)
     d = lo.shape[0]
-    pa_values = np.arange(lo[pa_index], hi[pa_index] + 1)
-    V = len(pa_values)
+    idx = np.atleast_1d(np.asarray(pa_index, dtype=np.int64))
+    V = int(np.prod([hi[k] - lo[k] + 1 for k in idx]))
+    if V > max_combos:  # before meshgrid materializes the product
+        raise ValueError(
+            f"joint PA sweep of {V} combinations exceeds max_combos="
+            f"{max_combos}; narrow the attribute set or ranges")
+    grids = np.meshgrid(*(np.arange(lo[k], hi[k] + 1) for k in idx),
+                        indexing="ij")
+    combos = np.stack([g.ravel() for g in grids], axis=1)  # (V, |idx|)
 
     tested = 0
     disc = 0
@@ -70,7 +81,7 @@ def causal_discrimination(
         n = min(batch_size, max_samples - tested)
         x = rng.integers(lo[None, :], hi[None, :] + 1, size=(n, d))
         sweep = np.repeat(x[:, None, :], V, axis=1).astype(np.float32)
-        sweep[:, :, pa_index] = pa_values[None, :]
+        sweep[:, :, idx] = combos[None, :, :]
         preds = np.asarray(predict_batch(sweep.reshape(n * V, d))).reshape(n, V)
         flips = (preds != preds[:, :1]).any(axis=1)
         for i in np.where(flips)[0][: max(0, keep_examples - len(examples))]:
@@ -116,7 +127,8 @@ def discrimination_search(
         for j in pa_indices:
             if j <= i or i in flagged or j in flagged:
                 continue
-            # Sweep both attributes jointly: flip if any combo changes output.
-            res = causal_discrimination(predict_batch, lo, hi, i, **kw)
+            # Joint sweep over the (i, j) value product — one batch per
+            # round, every combination for every sampled base assignment.
+            res = causal_discrimination(predict_batch, lo, hi, (i, j), **kw)
             results[(i, j)] = res
     return results

@@ -25,8 +25,12 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
 # The generated models keep the reference's naming convention: the first
-# free slot per family (GC-6.., AC-17.., BM-14..) indexed by generator.
+# free slot per family (GC-6.., AC-17.., BM-14..).  Slots are keyed by
+# generator *kind*, not by position in --generators, so a subset run (e.g.
+# --generators ar) writes the same .h5 a full run would — never another
+# generator's slot.
 SLOT_BASE = {"GC": 6, "AC": 17, "BM": 14, "CP": 12, "DF": 12}
+SLOT_OFFSET = {"copula": 0, "ar": 1, "bootstrap": 2}
 
 
 def main() -> None:
@@ -91,10 +95,11 @@ def main() -> None:
     records.append(train_and_verify("real", real, f"{fam}-real"))
     print(json.dumps(records[-1]), flush=True)
 
-    for i, kind in enumerate([g for g in args.generators.split(",") if g]):
+    for kind in [g for g in args.generators.split(",") if g]:
         rows = synth.synthesize(kind, real, lo, hi, args.n, seed=args.seed,
                                 ar_epochs=args.ar_epochs)
-        rec = train_and_verify(kind, rows, f"{fam}-{SLOT_BASE.get(fam, 90) + i}")
+        slot = SLOT_BASE.get(fam, 90) + SLOT_OFFSET.get(kind, len(SLOT_OFFSET))
+        rec = train_and_verify(kind, rows, f"{fam}-{slot}")
         records.append(rec)
         print(json.dumps(rec), flush=True)
 

@@ -87,6 +87,48 @@ def test_causal_rate_fair_net_is_zero():
     assert res.interval[1] <= 0.05
 
 
+def test_causal_joint_pair_sweep_oracle():
+    """Joint (i, j) sweep rate matches the brute-force oracle and differs
+    from the singleton rate (regression: the pair case used to silently
+    re-run the singleton sweep for i, ``VERDICT.md`` round 1 item 3).
+
+    f = +1 iff pa1 + pa2 ≥ 2 on pa ∈ {0,1}²: sweeping pa1 alone flips only
+    when the sampled pa2 is 1 (exact rate 0.5); sweeping the pair jointly
+    always flips (rate 1.0).
+    """
+    def predict(X):
+        return (X[:, 1] + X[:, 2] >= 2.0)
+
+    lo, hi = [0, 0, 0], [5, 1, 1]
+    single = causal.causal_discrimination(predict, lo, hi, 1,
+                                          min_samples=3000, max_samples=3000)
+    pair = causal.causal_discrimination(predict, lo, hi, (1, 2),
+                                        min_samples=3000, max_samples=3000)
+    assert single.rate == pytest.approx(0.5, abs=0.05)
+    assert pair.rate == pytest.approx(1.0)
+    assert pair.rate > single.rate
+
+
+def test_discrimination_search_superset_pruning():
+    """Flagged singletons prune their supersets; clean singletons don't."""
+    # Always-flip on pa index 1 → singleton flags → no pair tested.
+    biased = lambda X: X[:, 1] > 0.0
+    res = causal.discrimination_search(biased, [0, 0, 0], [5, 1, 1], (1, 2),
+                                       min_samples=500, max_samples=500)
+    assert (1,) in res and (1, 2) not in res
+    # Constant prediction → nothing flags → the joint pair runs.
+    fair = lambda X: np.ones(len(X), dtype=bool)
+    res = causal.discrimination_search(fair, [0, 0, 0], [5, 1, 1], (1, 2),
+                                       min_samples=500, max_samples=500)
+    assert (1, 2) in res and res[(1, 2)].rate == 0.0
+
+
+def test_causal_joint_combo_guard():
+    with pytest.raises(ValueError):
+        causal.causal_discrimination(lambda X: np.ones(len(X), dtype=bool),
+                                     [0, 0, 0], [5, 4095, 4095], (1, 2))
+
+
 # ---------------------------------------------------------------------------
 # Localization + masked repair
 # ---------------------------------------------------------------------------

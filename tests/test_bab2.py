@@ -154,9 +154,16 @@ def test_uniform_sign_bab_positive_net():
 
 
 def test_uniform_sign_bab_mixed_net_bails():
-    """A net with an obvious sign change must not be certified 'unsat'."""
-    ws = [np.array([[1.0], [0.0], [0.0]], dtype=np.float32)]
-    bs = [np.array([-3.0], dtype=np.float32)]  # f = a - 3: mixed over [0, 6]
+    """A net with an obvious sign change must not be certified 'unsat'.
+
+    Needs a hidden layer: depth-1 nets take the n_hidden == 0 early-exit
+    and would pass vacuously without exercising the sampling bail.
+    """
+    # f = relu(a) - 3: mixed sign over a ∈ [0, 6] (f(0) = -3, f(6) = +3).
+    ws = [np.array([[1.0], [0.0], [0.0]], dtype=np.float32),
+          np.array([[1.0]], dtype=np.float32)]
+    bs = [np.array([0.0], dtype=np.float32),
+          np.array([-3.0], dtype=np.float32)]
     net = mlp.from_numpy(ws, bs)
     dom = tiny_domain({"a": (0, 6), "pa": (0, 1), "b": (0, 6)})
     query = prop.FairnessQuery(domain=dom, protected=("pa",))
