@@ -1,13 +1,19 @@
-"""Gated Z3 SMT backend (optional; the native engine does not need it).
+"""SMT encodings of the pair property: SMT-LIB2 export + gated Z3 backend.
 
 The reference's decision procedure is a Z3 query over the pruned network
 (``src/GC/Verify-GC.py:128-214``; generic encoder pattern in
 ``utils/DF-1-Model-Functions.py:62-137``).  ``z3-solver`` is not part of
-this framework's environment, so the module is import-gated: when Z3 *is*
-available, :func:`decide_box_smt` offers a drop-in second opinion for
-cross-checking native verdicts (useful for parity audits against the
-reference); otherwise :data:`HAVE_Z3` is False and callers fall back to
-:func:`fairify_tpu.verify.engine.decide_box`.
+this framework's environment, so the module has two faces:
+
+* :func:`to_smtlib` — a pure-Python SMT-LIB2 emitter (exact dyadic-rational
+  weight literals, QF_LIRA) that needs no solver.  It is exercised in CI
+  (semantic tests evaluate the emitted formula against exact witnesses) and
+  powers ``scripts/smt_export.py``, which dumps per-partition ``.smt2``
+  files + native verdicts so ANY external SMT solver (z3, cvc5, yices) can
+  replay the native-vs-SMT agreement audit offline.
+* :func:`decide_box_smt` — a live Z3 second opinion, import-gated on
+  :data:`HAVE_Z3`; picked up automatically (tests included) wherever
+  ``z3-solver`` is installed.
 """
 from __future__ import annotations
 
@@ -95,3 +101,91 @@ def decide_box_smt(
     if res == z3.unsat:
         return "unsat", None
     return "unknown", None
+
+
+# ---------------------------------------------------------------------------
+# SMT-LIB2 export (no solver required)
+# ---------------------------------------------------------------------------
+
+
+def _rat(v: float) -> str:
+    """Exact SMT-LIB Real literal for a float (floats are dyadic rationals)."""
+    from fractions import Fraction
+
+    f = Fraction(float(v))
+    if f.denominator == 1:
+        body = f"{abs(f.numerator)}.0"
+    else:
+        body = f"(/ {abs(f.numerator)} {f.denominator})"
+    return body if f >= 0 else f"(- {body})"
+
+
+def to_smtlib(net: MLP, enc: PairEncoding, lo: np.ndarray, hi: np.ndarray,
+              name: str = "partition", get_model: bool = False) -> str:
+    """SMT-LIB2 script deciding the pair property on one partition box.
+
+    Semantics match :mod:`fairify_tpu.verify.property` (and the reference's
+    constraint builders, ``utils/verif_utils.py:631-945``): integer points;
+    every PA differs and both are box-constrained on PA dims; RA dims obey
+    ``|x_i − x'_i| ≤ ε`` with x' *not* box-constrained (the reference
+    comments that constraint out); all other dims equal; violation = strict
+    logit sign flip.  Weights enter as exact dyadic rationals, so ``sat`` /
+    ``unsat`` from any sound solver is ground truth for the f32 network —
+    the same quantity the native engine's exact leaf checks reason about.
+    """
+    small = excise(net)
+    weights = [np.asarray(w) for w in small.weights]
+    biases = [np.asarray(b) for b in small.biases]
+    d = len(lo)
+    pa = set(int(i) for i in enc.pa_idx)
+    ra = set(int(i) for i in enc.ra_idx)
+    # Strict SMT-LIB ordering: options precede set-logic; (get-model) is
+    # only legal after a sat answer, so it is opt-in (expected-sat exports).
+    out = [f"; fairify_tpu pair property — {name}"]
+    if get_model:
+        out.append("(set-option :produce-models true)")
+    out.append("(set-logic QF_LIRA)")
+    for i in range(d):
+        out.append(f"(declare-const x{i} Int)")
+        out.append(f"(declare-const xp{i} Int)")
+    for i in range(d):
+        out.append(f"(assert (and (>= x{i} {int(lo[i])}) (<= x{i} {int(hi[i])})))")
+        if i in pa:
+            out.append(
+                f"(assert (and (>= xp{i} {int(lo[i])}) (<= xp{i} {int(hi[i])})))")
+            out.append(f"(assert (distinct x{i} xp{i}))")
+        elif i in ra and enc.eps:
+            out.append(f"(assert (let ((dd (- x{i} xp{i})))"
+                       f" (<= (ite (>= dd 0) dd (- dd)) {int(enc.eps)})))")
+        else:
+            out.append(f"(assert (= xp{i} x{i}))")
+
+    def emit_net(prefix: str, var: str):
+        prev = [f"(to_real {var}{i})" for i in range(d)]
+        n = len(weights)
+        for li, (w, b) in enumerate(zip(weights, biases)):
+            cur = []
+            for j in range(w.shape[1]):
+                terms = [f"(* {_rat(w[t, j])} {prev[t]})" for t in range(w.shape[0])]
+                terms.append(_rat(b[j]))
+                z = f"(+ {' '.join(terms)})" if len(terms) > 1 else terms[0]
+                zname = f"{prefix}z{li}_{j}"
+                out.append(f"(define-fun {zname} () Real {z})")
+                if li < n - 1:
+                    hname = f"{prefix}h{li}_{j}"
+                    out.append(f"(define-fun {hname} () Real"
+                               f" (ite (>= {zname} 0.0) {zname} 0.0))")
+                    cur.append(hname)
+                else:
+                    cur.append(zname)
+            prev = cur
+        return prev[0]
+
+    y = emit_net("a_", "x")
+    yp = emit_net("b_", "xp")
+    out.append(f"(assert (or (and (< {y} 0.0) (> {yp} 0.0))"
+               f" (and (> {y} 0.0) (< {yp} 0.0))))")
+    out.append("(check-sat)")
+    if get_model:
+        out.append("(get-model)")
+    return "\n".join(out) + "\n"

@@ -1,0 +1,101 @@
+"""Export per-partition SMT-LIB2 audit files for offline solver replay.
+
+``z3-solver`` is not installable in this environment, so the native-vs-SMT
+agreement audit is packaged to run ANYWHERE: for sampled partitions of a
+preset (stratified by the native verdict recorded in a sweep ledger) this
+writes one ``.smt2`` file each — the exact pair property with dyadic-
+rational weights (``fairify_tpu.verify.smt.to_smtlib``) — plus a
+``manifest.jsonl`` mapping file → expected answer.  Any sound QF_LIRA
+solver (z3, cvc5, yices2) must report ``sat`` for native SAT rows and
+``unsat`` for native UNSAT rows; a disagreement would disprove the native
+engine.  Where z3 IS importable, ``tests/test_smt.py`` runs the same
+audit live via ``decide_box_smt``.
+
+Usage:
+    python scripts/smt_export.py <preset> <model> <ledger.jsonl>
+        [--per-class 4] [--out audits/smt]
+Replay (any machine with a solver):
+    for f in audits/smt/*.smt2; do z3 "$f"; done   # compare to manifest
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("preset")
+    ap.add_argument("model")
+    ap.add_argument("ledger")
+    ap.add_argument("--per-class", type=int, default=4)
+    ap.add_argument("--out", default="audits/smt")
+    args = ap.parse_args()
+
+    from fairify_tpu.models import zoo
+    from fairify_tpu.verify import presets, smt, sweep
+    from fairify_tpu.verify.property import encode
+
+    cfg = presets.get(args.preset)
+    net = zoo.load(cfg.dataset, args.model)
+    enc = encode(cfg.query())
+    _, lo, hi = sweep.build_partitions(cfg)
+
+    # Last-wins per partition (resumed/re-decided ledgers append; the final
+    # row is the record of truth — same merge as sweep._load_ledger).
+    latest: dict = {}
+    with open(args.ledger) as fp:
+        for line in fp:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            latest[rec["partition_id"]] = rec
+    by_class: dict = {"sat": [], "unsat": [], "unknown": []}
+    for pid in sorted(latest):
+        rec = latest[pid]
+        by_class.setdefault(rec["verdict"], []).append(rec)
+
+    os.makedirs(args.out, exist_ok=True)
+    manifest_path = os.path.join(args.out, "manifest.jsonl")
+    # Rewrite the manifest for this (preset, model): stale rows must never
+    # coexist with regenerated files.
+    kept = []
+    if os.path.isfile(manifest_path):
+        with open(manifest_path) as fp:
+            kept = [json.loads(line) for line in fp]
+        kept = [r for r in kept
+                if not (r["preset"] == args.preset and r["model"] == args.model)]
+    rows = list(kept)
+    n_out = 0
+    for verdict in ("sat", "unsat", "unknown"):
+        for rec in by_class[verdict][: args.per_class]:
+            pid = rec["partition_id"]
+            p = pid - 1  # partition_id is 1-based grid index
+            fname = f"{args.preset}-{args.model}-p{pid}.smt2"
+            text = smt.to_smtlib(net, enc, lo[p], hi[p],
+                                 name=f"{args.preset}/{args.model} "
+                                      f"partition {pid}",
+                                 get_model=(verdict == "sat"))
+            with open(os.path.join(args.out, fname), "w") as fp:
+                fp.write(text)
+            rows.append({
+                "file": fname, "preset": args.preset, "model": args.model,
+                "partition_id": pid, "native_verdict": verdict,
+                "expected_smt": verdict if verdict != "unknown" else None,
+                "native_ce": rec.get("ce"),
+            })
+            n_out += 1
+    with open(manifest_path, "w") as mf:
+        for r in rows:
+            mf.write(json.dumps(r) + "\n")
+    print(f"wrote {n_out} .smt2 files to {args.out} (+ manifest.jsonl)")
+
+
+if __name__ == "__main__":
+    main()

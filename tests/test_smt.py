@@ -1,0 +1,238 @@
+"""SMT encoding tests: the emitted SMT-LIB2 formula is evaluated with an
+exact Fraction-arithmetic interpreter against known witnesses — so the
+encoder is exercised (and its semantics pinned) without any solver in the
+environment.  Where z3-solver IS importable, the live backend is
+agreement-tested against the native engine too.
+"""
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+from fairify_tpu.data.domains import DomainSpec
+from fairify_tpu.models import mlp
+from fairify_tpu.verify import property as prop
+from fairify_tpu.verify import smt
+
+
+# ---------------------------------------------------------------------------
+# Minimal exact SMT-LIB interpreter (the subset to_smtlib emits)
+# ---------------------------------------------------------------------------
+
+
+def _tokenize(text):
+    for line in text.splitlines():
+        line = line.split(";", 1)[0]
+        for tok in line.replace("(", " ( ").replace(")", " ) ").split():
+            yield tok
+
+
+def _parse_all(text):
+    toks = list(_tokenize(text))
+    pos = 0
+
+    def parse():
+        nonlocal pos
+        tok = toks[pos]
+        pos += 1
+        if tok == "(":
+            items = []
+            while toks[pos] != ")":
+                items.append(parse())
+            pos += 1
+            return items
+        return tok
+
+    forms = []
+    while pos < len(toks):
+        forms.append(parse())
+    return forms
+
+
+def _ev(e, env):
+    if isinstance(e, str):
+        if e in env:
+            return env[e]
+        if e == "true":
+            return True
+        if e == "false":
+            return False
+        return Fraction(e.replace(".0", "")) if "." in e else Fraction(int(e))
+    op = e[0]
+    if op == "+":
+        return sum((_ev(a, env) for a in e[1:]), Fraction(0))
+    if op == "*":
+        r = Fraction(1)
+        for a in e[1:]:
+            r *= _ev(a, env)
+        return r
+    if op == "-":
+        if len(e) == 2:
+            return -_ev(e[1], env)
+        return _ev(e[1], env) - _ev(e[2], env)
+    if op == "/":
+        return _ev(e[1], env) / _ev(e[2], env)
+    if op == "to_real":
+        return _ev(e[1], env)
+    if op == "ite":
+        return _ev(e[2], env) if _ev(e[1], env) else _ev(e[3], env)
+    if op == ">=":
+        return _ev(e[1], env) >= _ev(e[2], env)
+    if op == "<=":
+        return _ev(e[1], env) <= _ev(e[2], env)
+    if op == ">":
+        return _ev(e[1], env) > _ev(e[2], env)
+    if op == "<":
+        return _ev(e[1], env) < _ev(e[2], env)
+    if op == "=":
+        return _ev(e[1], env) == _ev(e[2], env)
+    if op == "distinct":
+        return _ev(e[1], env) != _ev(e[2], env)
+    if op == "and":
+        return all(_ev(a, env) for a in e[1:])
+    if op == "or":
+        return any(_ev(a, env) for a in e[1:])
+    if op == "not":
+        return not _ev(e[1], env)
+    if op == "let":
+        inner = dict(env)
+        for name, expr in e[1]:
+            inner[name] = _ev(expr, env)
+        return _ev(e[2], inner)
+    raise ValueError(f"unhandled op {op}")
+
+
+def holds(text, assignment):
+    """True iff every (assert ...) in the script holds under the assignment."""
+    env = {k: Fraction(v) for k, v in assignment.items()}
+    for form in _parse_all(text):
+        if form[0] == "define-fun":
+            env[form[1]] = _ev(form[4], env)
+        elif form[0] == "assert":
+            if not _ev(form[1], env):
+                return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Encoder semantics
+# ---------------------------------------------------------------------------
+
+
+def _toy(ranges):
+    cols = tuple(ranges)
+    return DomainSpec(name="toy", columns=cols,
+                      ranges={k: tuple(v) for k, v in ranges.items()}, label="y")
+
+
+def _flip_net():
+    # logit = relu(2·pa) − 1: pa=0 → −1, pa=1 → +1 (guaranteed flip pair).
+    ws = [np.array([[0.0], [2.0]], dtype=np.float32),
+          np.array([[1.0]], dtype=np.float32)]
+    bs = [np.array([0.0], dtype=np.float32),
+          np.array([-1.0], dtype=np.float32)]
+    return mlp.from_numpy(ws, bs)
+
+
+def _setup(relaxed=False):
+    ranges = {"a": (0, 3), "pa": (0, 1)}
+    q = prop.FairnessQuery(domain=_toy(ranges), protected=("pa",),
+                           relaxed=("a",) if relaxed else (),
+                           relax_eps=1 if relaxed else 0)
+    enc = prop.encode(q)
+    lo, hi = q.domain.lo_hi()
+    return enc, lo.astype(np.int64), hi.astype(np.int64)
+
+
+def test_smtlib_witness_satisfies():
+    enc, lo, hi = _setup()
+    text = smt.to_smtlib(_flip_net(), enc, lo, hi)
+    assert "(check-sat)" in text and "QF_LIRA" in text
+    # (a=1, pa=0) vs (a=1, pa=1): logits −1 / +1 — a genuine flip pair.
+    assert holds(text, {"x0": 1, "x1": 0, "xp0": 1, "xp1": 1})
+
+
+def test_smtlib_rejects_equal_pa():
+    enc, lo, hi = _setup()
+    text = smt.to_smtlib(_flip_net(), enc, lo, hi)
+    assert not holds(text, {"x0": 1, "x1": 1, "xp0": 1, "xp1": 1})
+
+
+def test_smtlib_rejects_shared_dim_mismatch():
+    enc, lo, hi = _setup()
+    text = smt.to_smtlib(_flip_net(), enc, lo, hi)
+    # non-PA dim differs (0 vs 2) with no RA declared → equality violated.
+    assert not holds(text, {"x0": 0, "x1": 0, "xp0": 2, "xp1": 1})
+
+
+def test_smtlib_rejects_out_of_box():
+    enc, lo, hi = _setup()
+    text = smt.to_smtlib(_flip_net(), enc, lo, hi)
+    assert not holds(text, {"x0": 9, "x1": 0, "xp0": 9, "xp1": 1})
+
+
+def test_smtlib_rejects_no_flip():
+    enc, lo, hi = _setup()
+    # Constant-positive logit: no pair can satisfy the flip disjunction.
+    ws = [np.zeros((2, 1), dtype=np.float32)]
+    bs = [np.array([1.0], dtype=np.float32)]
+    net = mlp.from_numpy(ws, bs)
+    text = smt.to_smtlib(net, enc, lo, hi)
+    assert not holds(text, {"x0": 1, "x1": 0, "xp0": 1, "xp1": 1})
+
+
+def test_smtlib_relaxed_attribute_ball():
+    enc, lo, hi = _setup(relaxed=True)
+    text = smt.to_smtlib(_flip_net(), enc, lo, hi)
+    # |Δa| = 1 ≤ ε: allowed (and x' may even leave the box by ε).
+    assert holds(text, {"x0": 1, "x1": 0, "xp0": 2, "xp1": 1})
+    # |Δa| = 3 > ε: rejected.
+    assert not holds(text, {"x0": 0, "x1": 0, "xp0": 3, "xp1": 1})
+
+
+def test_smtlib_exact_rational_weights():
+    # 0.1f32 is not 1/10; the literal must be its exact dyadic value.
+    ws = [np.array([[np.float32(0.1)], [0.0]], dtype=np.float32)]
+    bs = [np.array([0.0], dtype=np.float32)]
+    enc, lo, hi = _setup()
+    text = smt.to_smtlib(mlp.from_numpy(ws, bs), enc, lo, hi)
+    assert "(/ 13421773 134217728)" in text
+
+
+def test_smtlib_masked_neurons_excised():
+    net = _flip_net()
+    net = mlp.MLP(net.weights, net.biases,
+                  (np.array([0.0], dtype=np.float32),  # kill the hidden unit
+                   np.ones(1, dtype=np.float32)))
+    enc, lo, hi = _setup()
+    text = smt.to_smtlib(net, enc, lo, hi)
+    # Pruned hidden layer has no neurons: logit ≡ −1 for both roles.
+    assert not holds(text, {"x0": 1, "x1": 0, "xp0": 1, "xp1": 1})
+
+
+# ---------------------------------------------------------------------------
+# Live Z3 agreement (runs wherever z3-solver is installed)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(not smt.HAVE_Z3, reason="z3-solver not installed")
+@pytest.mark.parametrize("seed", range(5))
+def test_z3_agrees_with_native_engine(seed):
+    from fairify_tpu.verify import engine
+
+    rng = np.random.default_rng(seed)
+    ranges = {"a": (0, 3), "pa": (0, 1), "b": (0, 3)}
+    q = prop.FairnessQuery(domain=_toy(ranges), protected=("pa",))
+    enc = prop.encode(q)
+    lo, hi = q.domain.lo_hi()
+    ws = [rng.normal(size=(3, 6)).astype(np.float32),
+          rng.normal(size=(6, 1)).astype(np.float32)]
+    bs = [rng.normal(size=(6,)).astype(np.float32) * 0.5,
+          rng.normal(size=(1,)).astype(np.float32)]
+    net = mlp.from_numpy(ws, bs)
+    native = engine.decide_box(net, enc, lo.astype(np.int64), hi.astype(np.int64),
+                               engine.EngineConfig(soft_timeout_s=30.0))
+    smt_verdict, _ = smt.decide_box_smt(net, enc, lo.astype(np.int64),
+                                        hi.astype(np.int64))
+    if "unknown" not in (native.verdict, smt_verdict):
+        assert native.verdict == smt_verdict
