@@ -41,14 +41,23 @@ def _require_z3():
 
 def _z3_net(x, weights, biases):
     """Depth-generic symbolic forward: ToReal input, ReLU hidden, linear out
-    (one encoder replaces the reference's 53 per-model files)."""
+    (one encoder replaces the reference's 53 per-model files).
+
+    Weight literals are built with :class:`fractions.Fraction` so z3 reasons
+    about the *exact dyadic value* of each f32 weight — the same formula
+    :func:`to_smtlib` exports (feeding raw Python floats would let z3 coerce
+    via decimal repr, e.g. 0.1 → 1/10, a different network).
+    """
+    from fractions import Fraction
+
     h = [z3.ToReal(v) if isinstance(v, z3.ArithRef) and v.is_int() else v for v in x]
     n = len(weights)
     for i, (w, b) in enumerate(zip(weights, biases)):
         w = np.asarray(w, dtype=np.float64)
         bb = np.asarray(b, dtype=np.float64)
         z = [
-            sum(float(w[t, j]) * h[t] for t in range(w.shape[0])) + float(bb[j])
+            sum(z3.RealVal(Fraction(float(w[t, j]))) * h[t]
+                for t in range(w.shape[0])) + z3.RealVal(Fraction(float(bb[j])))
             for j in range(w.shape[1])
         ]
         h = z if i == n - 1 else [z3.If(v >= 0, v, 0) for v in z]

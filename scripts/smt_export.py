@@ -71,6 +71,13 @@ def main() -> None:
             kept = [json.loads(line) for line in fp]
         kept = [r for r in kept
                 if not (r["preset"] == args.preset and r["model"] == args.model)]
+    # Stale .smt2 files must go with their manifest rows, or the documented
+    # glob replay would execute orphans with no recorded expectation.
+    import glob as _glob
+
+    for old in _glob.glob(os.path.join(
+            args.out, f"{args.preset}-{args.model}-p*.smt2")):
+        os.remove(old)
     rows = list(kept)
     n_out = 0
     for verdict in ("sat", "unsat", "unknown"):

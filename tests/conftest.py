@@ -40,3 +40,5 @@ def reference_assets_available():
 
 def pytest_configure(config):
     np.random.seed(0)
+    config.addinivalue_line(
+        "markers", "slow: multi-minute tests (subprocess sweeps, end-to-end)")
