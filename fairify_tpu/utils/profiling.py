@@ -62,6 +62,9 @@ class ThroughputCounter:
             "partitions_per_sec_per_chip": round(pps / max(self.n_devices, 1), 4),
         }
 
-    def dump(self, path: str) -> None:
+    def dump(self, path: str, phases: Optional[Dict[str, float]] = None) -> None:
+        out = self.summary()
+        if phases:
+            out["phases_s"] = {k: round(v, 3) for k, v in phases.items()}
         with open(path, "w") as fp:
-            json.dump(self.summary(), fp, indent=2)
+            json.dump(out, fp, indent=2)

@@ -674,7 +674,8 @@ def verify_model(
         # restore one-row-per-partition ascending order for row-for-row
         # comparison against reference CSVs.
         csvio.rewrite_deduped(csv_path)
-    counter.dump(os.path.join(cfg.result_dir, f"{cfg.name}-{sink_name}.throughput.json"))
+    counter.dump(os.path.join(cfg.result_dir, f"{cfg.name}-{sink_name}.throughput.json"),
+                 phases=timer.phases)
     return ModelReport(
         model=model_name, dataset=cfg.dataset, outcomes=outcomes,
         original_acc=orig_acc, total_time_s=timer.total(), partitions_total=P,

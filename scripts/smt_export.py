@@ -71,13 +71,6 @@ def main() -> None:
             kept = [json.loads(line) for line in fp]
         kept = [r for r in kept
                 if not (r["preset"] == args.preset and r["model"] == args.model)]
-    # Stale .smt2 files must go with their manifest rows, or the documented
-    # glob replay would execute orphans with no recorded expectation.
-    import glob as _glob
-
-    for old in _glob.glob(os.path.join(
-            args.out, f"{args.preset}-{args.model}-p*.smt2")):
-        os.remove(old)
     rows = list(kept)
     n_out = 0
     for verdict in ("sat", "unsat", "unknown"):
@@ -101,6 +94,16 @@ def main() -> None:
     with open(manifest_path, "w") as mf:
         for r in rows:
             mf.write(json.dumps(r) + "\n")
+    # Only after files and manifest are both written: drop stale .smt2 for
+    # this (preset, model) so the glob replay stays 1:1 with the manifest —
+    # deleting first would make a mid-export crash orphan the old manifest.
+    import glob as _glob
+
+    current = {r["file"] for r in rows}
+    for old in _glob.glob(os.path.join(
+            args.out, f"{args.preset}-{args.model}-p*.smt2")):
+        if os.path.basename(old) not in current:
+            os.remove(old)
     print(f"wrote {n_out} .smt2 files to {args.out} (+ manifest.jsonl)")
 
 

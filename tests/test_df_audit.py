@@ -1,0 +1,126 @@
+"""DF capped-partitioning audit: our capped grid vs the REFERENCE's.
+
+VERDICT round-1 item 6 asked for evidence that the 8-partition DF grid is
+the faithful product of the reference's cap logic, not an accident.  These
+tests import the reference's ``partition_df`` / ``partitioned_ranges_df``
+(``/root/reference/utils/input_partition.py:78-182``) and compare outputs
+on random domains and on the real default-credit domain.
+"""
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+from fairify_tpu.partition import grid as grid_mod
+
+REF = "/root/reference/utils/input_partition.py"
+
+
+def _ref_module():
+    """Exec the reference partitioner with its heavyweight star-import
+    stripped (``from utils.verif_utils import *`` drags in tf/aif360, not
+    present here; it also happens to be where ``random`` reaches the module
+    namespace, so inject it explicitly)."""
+    import random
+    import types
+
+    src = open(REF).read().replace("from utils.verif_utils import *", "")
+    mod = types.ModuleType("ref_input_partition")
+    mod.random = random
+    exec(compile(src, REF, "exec"), mod.__dict__)
+    return mod
+
+
+pytestmark = pytest.mark.skipif(not os.path.isfile(REF),
+                                reason="reference checkout not present")
+
+
+def _norm(boxes):
+    """Normalize a partition list for comparison (tuples, sorted keys)."""
+    return [tuple(sorted((k, (int(v[0]), int(v[1]))) for k, v in b.items()))
+            for b in boxes]
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_chunking_matches_reference(seed):
+    rng = np.random.default_rng(seed)
+    ranges = {}
+    for i in range(rng.integers(2, 7)):
+        lo = int(rng.integers(0, 20))
+        ranges[f"a{i}"] = (lo, lo + int(rng.integers(0, 40)))
+    size = int(rng.integers(2, 12))
+    ref = _ref_module().partition_df({k: list(v) for k, v in ranges.items()}, size)
+    got = grid_mod.partition_attributes_capped(ranges, size)
+    assert set(got) == set(ref)
+    for k in got:
+        assert [list(p) for p in got[k]] == [list(p) for p in ref[k]]
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_capped_expansion_matches_reference_deterministic(seed):
+    """Below the cap both sides enumerate the identical box list in order."""
+    rng = np.random.default_rng(100 + seed)
+    attrs, ranges = [], {}
+    for i in range(rng.integers(3, 6)):
+        lo = int(rng.integers(0, 5))
+        attrs.append(f"a{i}")
+        ranges[f"a{i}"] = (lo, lo + int(rng.integers(0, 25)))
+    pa = [attrs[0]]
+    size = int(rng.integers(3, 10))
+    cap = 200  # big enough that the sampling branch never triggers here
+    mod = _ref_module()
+    p_ref = mod.partition_df({k: list(v) for k, v in ranges.items()}, size)
+    ref = mod.partitioned_ranges_df(attrs, pa, p_ref,
+                                    {k: list(v) for k, v in ranges.items()},
+                                    max_partitions=cap)
+    if len(ref) > cap:  # pragma: no cover - cap chosen to avoid this
+        pytest.skip("sampling branch")
+    p_got = grid_mod.partition_attributes_capped(ranges, size)
+    got = grid_mod.partitioned_ranges_capped(attrs, pa, p_got, ranges,
+                                             max_partitions=cap)
+    assert _norm(got) == _norm(ref)
+
+
+def test_capped_sampling_branch_properties():
+    """Above the cap: exactly max_partitions boxes, each a member of the
+    full product.  Only protected attributes are included *unconditionally*
+    (non-PA attrs that would overflow are dropped to full range instead),
+    so the sampling branch needs a wide PA."""
+    attrs = ["p", "b"]
+    ranges = {"p": (0, 59), "b": (0, 3)}
+    size = 10  # p chunks into 6; PA is always chosen -> 6 combos > cap 4
+    p_got = grid_mod.partition_attributes_capped(ranges, size)
+    cap = 4
+    got = grid_mod.partitioned_ranges_capped(attrs, ["p"], p_got, ranges,
+                                             max_partitions=cap,
+                                             rng=np.random.default_rng(7))
+    assert len(got) == cap
+    full = grid_mod.partitioned_ranges_capped(attrs, ["p"], p_got, ranges,
+                                              max_partitions=1000)
+    full_set = set(_norm(full))
+    assert set(_norm(got)) <= full_set
+    assert len(set(_norm(got))) == cap  # sampled without replacement
+
+
+def test_df_domain_grid_is_the_reference_grid():
+    """The real default-credit domain: our capped grid == the reference's,
+    box for box — documenting that the 8-partition DF grid is the faithful
+    output of the cap logic (``src/DF/Verify-DF.py:93``)."""
+    from fairify_tpu.data.domains import get_domain
+
+    dom = get_domain("default")
+    ranges = {k: tuple(v) for k, v in dom.ranges.items()}
+    attrs = list(dom.columns)
+    pa = ["SEX_2"]
+    mod = _ref_module()
+    p_ref = mod.partition_df({k: list(v) for k, v in ranges.items()}, 8)
+    ref = mod.partitioned_ranges_df(attrs, pa, p_ref,
+                                    {k: list(v) for k, v in ranges.items()},
+                                    max_partitions=100)
+    p_got = grid_mod.partition_attributes_capped(ranges, 8)
+    got = grid_mod.partitioned_ranges_capped(attrs, pa, p_got, ranges,
+                                             max_partitions=100)
+    assert len(ref) <= 100 and _norm(got) == _norm(ref)
+    # The published DF runs verify 8 partitions/model; pin that here.
+    assert len(got) == 8
