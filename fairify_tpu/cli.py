@@ -63,6 +63,11 @@ def _overridden_cfg(args):
         overrides["result_dir"] = args.result_dir
     if getattr(args, "seed", None) is not None:
         overrides["seed"] = args.seed
+    if getattr(args, "max_partitions", None) is not None:
+        # DF-style capped partitioning at an arbitrary cap
+        # (``utils/input_partition.py:111-182`` with max_partitions=N).
+        overrides["capped_partitions"] = True
+        overrides["max_partitions"] = int(args.max_partitions)
     return cfg.with_(**overrides) if overrides else cfg
 
 
@@ -224,6 +229,9 @@ def main(argv=None) -> int:
     run.add_argument("--hard-timeout", type=float, default=None)
     run.add_argument("--result-dir", default=None)
     run.add_argument("--seed", type=int, default=None)
+    run.add_argument("--max-partitions", type=int, default=None,
+                     help="cap the grid via DF-style capped partitioning "
+                          "(PA-first priority, sampled excess combos)")
     run.add_argument("--model-root", default=None)
     run.add_argument("--data-root", default=None)
     run.add_argument("--decode-counterexamples", action="store_true",

@@ -105,6 +105,29 @@ PRESETS = {
                                 domain_overrides={"poutcome": (2, 2)},
                                 partition_threshold=10, heuristic_threshold=20,
                                 soft_timeout_s=100.0, sim_size=1000, **_HOUR),
+    # Framework-native DF variant (the reference ships no targeted DF
+    # driver).  The stock DF grid is 8 enormous boxes — every monetary dim
+    # spans up to ~10^6 values, so the sampling attack finds a witness
+    # instantly and the certificate/BaB path never runs (grid invariance
+    # under the cap is pinned in tests/test_df_audit.py).  Pinning the
+    # monetary dims to a concrete applicant profile (a targeted
+    # sub-population, like targeted/GC's number_of_credits=2,
+    # ``targeted/GC/Verify-GC.py:55``) yields boxes the bound certificates
+    # genuinely decide — the DF models' certificate-path coverage.
+    "targeted-DF": SweepConfig(
+        name="targeted-DF", dataset="default", protected=("SEX_2",),
+        domain_overrides={
+            "LIMIT_BAL": (50000, 50000),
+            "BILL_AMT1": (10000, 10000), "BILL_AMT2": (10000, 10000),
+            "BILL_AMT3": (10000, 10000), "BILL_AMT4": (10000, 10000),
+            "BILL_AMT5": (10000, 10000), "BILL_AMT6": (10000, 10000),
+            "PAY_AMT1": (2000, 2000), "PAY_AMT2": (2000, 2000),
+            "PAY_AMT3": (2000, 2000), "PAY_AMT4": (2000, 2000),
+            "PAY_AMT5": (2000, 2000), "PAY_AMT6": (2000, 2000),
+        },
+        partition_threshold=8, heuristic_threshold=100,
+        capped_partitions=True, max_partitions=100,
+        soft_timeout_s=100.0, sim_size=1000, **_HOUR),
 }
 
 

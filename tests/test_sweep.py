@@ -80,8 +80,8 @@ def test_sweep_verdicts_mesh_invariant(tmp_path, tiny_registered):
 def test_presets_cover_all_drivers():
     names = presets.names()
     # 5 base + CP12 (task4's 12-input family) + LSAC + 3 stress + 3 relaxed
-    # + 3+3 targeted
-    assert len(names) == 19
+    # + 3+3 targeted + targeted-DF (framework-native certificate-path DF)
+    assert len(names) == 20
     for n in names:
         cfg = presets.get(n)
         q = cfg.query()  # builds without error, drops phantom attributes
