@@ -130,7 +130,7 @@ def budgeted_model_sweep(cfg, net, model_name: str, dataset=None):
     t0 = time.perf_counter()
     counts = {"sat": 0, "unsat": 0, "unknown": 0}
     span = 0
-    K = max(cfg.grid_chunk, 2048)
+    K = cfg.grid_chunk or 2048  # first span: one stage-0 chunk
     while span < P:
         left = cfg.hard_timeout_s - (time.perf_counter() - t0)
         if left <= 0:

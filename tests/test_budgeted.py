@@ -1,0 +1,72 @@
+"""Budgeted attempt-until-hard-budget sweep (scripts/_sweeplib.py).
+
+Reference semantics under test: a contiguous attempted prefix of the
+shuffled grid, coverage reported instead of UNKNOWN-padding, per-config
+ledgers, and resume that continues the prefix rather than restarting.
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+
+import _sweeplib  # noqa: E402
+
+from fairify_tpu.models.train import init_mlp
+from fairify_tpu.verify import presets, sweep
+
+
+def _cfg(tmp_path, hard):
+    return presets.get("GC").with_(
+        soft_timeout_s=2.0, hard_timeout_s=hard,
+        result_dir=str(tmp_path / "out"), grid_chunk=64)
+
+
+def test_budgeted_full_coverage(tmp_path):
+    """A generous budget attempts the whole grid: cov == 1.0."""
+    net = init_mlp((20, 6, 1), seed=1)
+    rec = _sweeplib.budgeted_model_sweep(_cfg(tmp_path, 600.0), net, "m")
+    assert rec["attempted"] == rec["partitions"] == 201
+    assert rec["cov"] == 1.0
+    assert rec["sat"] + rec["unsat"] + rec["unknown"] == 201
+
+
+def test_budgeted_prefix_and_ledger_dirs(tmp_path):
+    """An exhausted budget attempts a proper prefix (here: nothing) with no
+    UNKNOWN-padding of the unattempted tail; per-config ledger dirs keep
+    different budgets from resuming into each other.  (A wall-clock-based
+    partial prefix would be machine-speed dependent — a warm jit cache can
+    legitimately finish the whole 201-box grid inside any nonzero budget —
+    so the deterministic zero-budget edge pins the semantics instead.)"""
+    net = init_mlp((20, 6, 1), seed=1)
+    rec = _sweeplib.budgeted_model_sweep(_cfg(tmp_path, 0.0), net, "m")
+    assert rec["attempted"] == 0 and rec["partitions"] == 201
+    assert rec["cov"] == 0.0
+    # Attempted counts only: the unattempted tail is coverage, not UNKNOWN.
+    assert rec["sat"] + rec["unsat"] + rec["unknown"] == 0
+
+    rec2 = _sweeplib.budgeted_model_sweep(_cfg(tmp_path, 600.0), net, "m")
+    assert rec2["attempted"] == 201
+    # Per-config result_dir suffixes: budgets never share ledgers.
+    assert (tmp_path / "out" / "b2-600").is_dir()
+    assert not (tmp_path / "out" / "b2-0").glob("*.ledger.jsonl") or \
+        not list((tmp_path / "out" / "b2-0").glob("*.ledger.jsonl"))
+
+
+def test_config_key_distinguishes_budgets(tmp_path):
+    results = tmp_path / "results.jsonl"
+    with open(results, "w") as fp:
+        fp.write('{"run_id": "x", "model": "m", "soft_s": 5.0, "hard_s": 60.0,'
+                 ' "cap": null, "attempted": 10}\n')
+        fp.write('{"run_id": "x", "model": "legacy", "soft_s": 5.0,'
+                 ' "hard_s": 60.0}\n')
+        fp.write('{"run_id": "x", "model": "sk", "skipped": "mismatch"}\n')
+    done = _sweeplib.done_set(str(results))
+    assert ("x", "m", (5.0, 60.0, None)) in done
+    # Legacy rows (pre-cap/attempted fields) get a sentinel key: a new
+    # full-grid run with the same budgets must NOT be skipped.
+    assert ("x", "legacy", (5.0, 60.0, None)) not in done
+    assert ("x", "legacy", ("legacy", 5.0, 60.0)) in done
+    assert ("x", "sk", "skipped") in done
