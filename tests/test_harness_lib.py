@@ -15,8 +15,10 @@ def test_done_set_includes_skipped_records(tmp_path):
         fp.write(json.dumps({"run_id": "r", "model": "CP-1",
                              "skipped": "input-width mismatch with domain"}) + "\n")
     done = _sweeplib.done_set(path)
-    # both verified and skipped models count as done → resume converges
-    assert done == {("r", "CP-2"), ("r", "CP-1")}
+    # both verified and skipped models count as done → resume converges;
+    # keys carry the binding config (pre-round-2 rows get a legacy sentinel)
+    assert ("r", "CP-2", ("legacy", None, None)) in done
+    assert ("r", "CP-1", "skipped") in done
     assert _sweeplib.done_set(str(tmp_path / "missing.jsonl")) == set()
 
 
