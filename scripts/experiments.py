@@ -88,28 +88,64 @@ def cmd_render(args):
 
     exps = [_load_json(p) for p in args.experiment.split(",")] if args.experiment else []
     for exp in filter(None, exps):
-        lines += [
-            f"## Repair experiment: `{exp['model']}` (verify → localize → repair → route → audit)",
-            "",
-            f"Verdicts {exp['verdicts']}, {exp['counterexample_pairs']} "
-            f"counterexample pairs, top biased neurons {exp['biased_neurons'][:3]}.",
-            "",
-            "| Variant | Acc | DI | SPD | EOD | AOD | ERD | Consistency | Theil | Causal rate |",
-            "|---|---|---|---|---|---|---|---|---|---|",
-        ]
-        for variant, m in exp["metrics"].items():
-            lines.append(
-                f"| {variant} | {m['accuracy']} | {m['disparate_impact']} | "
-                f"{m['statistical_parity_difference']} | {m['equal_opportunity_difference']} | "
-                f"{m['average_odds_difference']} | {m['error_rate_difference']} | "
-                f"{m['consistency']} | {m['theil_index']} | "
-                f"{exp['causal_rates'].get(variant, '—')} |")
-        lines.append("")
+        lines += _experiment_section(exp)
 
     out_md = os.path.join(ROOT, "EXPERIMENTS.md")
     with open(out_md, "w") as fp:
         fp.write("\n".join(lines) + "\n")
     print(f"wrote {out_md}")
+
+
+def _experiment_section(exp, note=""):
+    lines = [
+        f"## Repair experiment: `{exp['model']}` (verify → localize → repair → route → audit)",
+        "",
+        (f"Verdicts {exp['verdicts']}, {exp['counterexample_pairs']} "
+         f"counterexample pairs, top biased neurons {exp['biased_neurons'][:3]}."
+         + (f"  {note}" if note else "")),
+        "",
+        "| Variant | Acc | DI | SPD | EOD | AOD | ERD | Consistency | Theil | Causal rate |",
+        "|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    for variant, m in exp["metrics"].items():
+        lines.append(
+            f"| {variant} | {m['accuracy']} | {m['disparate_impact']} | "
+            f"{m['statistical_parity_difference']} | {m['equal_opportunity_difference']} | "
+            f"{m['average_odds_difference']} | {m['error_rate_difference']} | "
+            f"{m['consistency']} | {m['theil_index']} | "
+            f"{exp['causal_rates'].get(variant, '—')} |")
+    lines.append("")
+    return lines
+
+
+def cmd_append(args):
+    """Append one experiment section to the existing EXPERIMENTS.md.
+
+    ``render`` regenerates the whole file from its source JSONs; when those
+    live in a gitignored results dir from an earlier round, appending keeps
+    the committed sections intact while recording the new run.
+    """
+    exp = _load_json(args.experiment)
+    if exp is None:
+        raise SystemExit(f"missing experiment JSON: {args.experiment}")
+    out_md = os.path.join(ROOT, "EXPERIMENTS.md")
+    existing = open(out_md).read() if os.path.isfile(out_md) else ""
+    body = "\n".join(_experiment_section(exp, note=args.note))
+    header = f"## Repair experiment: `{exp['model']}`"
+    if header in existing:
+        # Splice the replacement in place (up to the next header or EOF) so
+        # re-running an earlier model's experiment never reorders sections.
+        start = existing.index(header)
+        nxt = existing.find("\n## ", start + 1)
+        tail = existing[nxt + 1:] if nxt >= 0 else ""
+        out = existing[:start] + body + ("\n" + tail if tail else "\n")
+    elif existing:
+        out = existing.rstrip("\n") + "\n\n" + body + "\n"
+    else:
+        out = "# EXPERIMENTS — generated-model pipelines\n\n" + body + "\n"
+    with open(out_md, "w") as fp:
+        fp.write(out)
+    print(f"appended {exp['model']} section to {out_md}")
 
 
 def main():
@@ -121,6 +157,10 @@ def main():
     rend.add_argument("--experiment", default=None)
     rend.add_argument("--platform", default="CPU (virtual mesh)")
     rend.set_defaults(fn=cmd_render)
+    app = sub.add_parser("append")
+    app.add_argument("--experiment", required=True)
+    app.add_argument("--note", default="")
+    app.set_defaults(fn=cmd_append)
     args = ap.parse_args()
     args.fn(args)
 
