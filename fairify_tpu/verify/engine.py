@@ -89,23 +89,32 @@ def _tied_diff_ub(A_pos, c_pos, A_neg, c_neg, lo, hi, shared_mask):
     ``A_pos``/``c_pos``: (B, Vp, d)/(B, Vp) upper linear form of the role
     that must be positive; ``A_neg``/``c_neg``: lower form of the role that
     must be negative (constants include their PA/ε contributions).
-    ``lo``/``hi``: (B, d) shared box.  Returns ``(M, coef)``: the (B, Vp, Vn)
-    bound matrix and the per-dim max |Aᵖᵒˢ − Aⁿᵉᵍ| (B, d) branching score.
+    ``lo``/``hi``: (B, d) shared box.  Returns ``(M, coef, mag)``: the
+    (B, Vp, Vn) bound matrix, the per-dim max |Aᵖᵒˢ − Aⁿᵉᵍ| (B, d) branching
+    score, and the (B, Vp, Vn) summed magnitude of the concretized terms —
+    Σ_j |D_j|·max(|lo_j|,|hi_j|) + |cᵘ| + |cⁿ| — against which outward
+    slack must be scaled: the bound itself cancels (that is the whole point
+    of the certificate) while the f32 summands it nets out can be large
+    (wide integer domains, e.g. default-credit dims spanning ~10⁶), so
+    slack ∝ |bound| would under-cover the accumulation error.
     The Vp axis is mapped with ``lax.scan`` so the (B, V, V, d) tensor is
     never materialised (GC's PA=age has V=57).
     """
+    absbox = jnp.maximum(jnp.abs(lo), jnp.abs(hi))
 
     def one(carry, au_cu):
         au, cu = au_cu
         D = (au[:, None, :] - A_neg) * shared_mask
         m = jnp.where(D > 0, D * hi[:, None, :], D * lo[:, None, :])
         row = m.sum(-1) + cu[:, None] - c_neg
-        return jnp.maximum(carry, jnp.abs(D).max(axis=1)), row
+        mag = (jnp.abs(D) * absbox[:, None, :]).sum(-1) \
+            + jnp.abs(cu)[:, None] + jnp.abs(c_neg)
+        return jnp.maximum(carry, jnp.abs(D).max(axis=1)), (row, mag)
 
     coef0 = jnp.zeros(lo.shape, dtype=A_pos.dtype)
-    coef, rows = jax.lax.scan(
+    coef, (rows, mags) = jax.lax.scan(
         one, coef0, (jnp.moveaxis(A_pos, 1, 0), jnp.moveaxis(c_pos, 1, 0)))
-    return jnp.moveaxis(rows, 0, 1), coef
+    return jnp.moveaxis(rows, 0, 1), coef, jnp.moveaxis(mags, 0, 1)
 
 
 def _certify_impl(net: MLP, x_lo, x_hi, xp_lo, xp_hi, lo, hi, assign_vals,
@@ -131,27 +140,33 @@ def _certify_impl(net: MLP, x_lo, x_hi, xp_lo, xp_hi, lo, hi, assign_vals,
     shared = 1.0 - pa_mask
     pa_dot = lambda A: jnp.sum(A * assign_vals[None, :, :], axis=-1)
     ra_abs = lambda A: eps * jnp.sum(jnp.abs(A) * ra_mask, axis=-1)
+    # Outward slack scaled by the *concretized term magnitudes*, not the
+    # cancelled bound value: each set's bound is widened with its own
+    # magnitude before the min over sets (widening after the min would pair
+    # one set's bound with another's magnitude).  Forms are unwidened f32
+    # (crown_output_form_sets); accumulation error scales with what was
+    # summed, which the certificate exists precisely to cancel.
+    from fairify_tpu.ops.interval import SOUND_SLACK_ABS, SOUND_SLACK_REL
+
+    widen = lambda u, g: u + SOUND_SLACK_REL * g + SOUND_SLACK_ABS
     ub1 = ub2 = None
     score = jnp.zeros(lo.shape, dtype=lo.dtype)
     for (Alx, clx, Aux, cux), (Alp, clp, Aup, cup) in zip(sets_x, sets_p):
         # Direction x⁺/x'⁻: needs f(x_a) − f(x'_b) > 0.
-        m1, s1 = _tied_diff_ub(
+        m1, s1, g1 = _tied_diff_ub(
             Aux, cux + pa_dot(Aux), Alp, clp + pa_dot(Alp) - ra_abs(Alp),
             lo, hi, shared)
         # Direction x⁻/x'⁺: needs f(x'_b) − f(x_a) > 0 (matrix built [b, a]).
-        m2, s2 = _tied_diff_ub(
+        m2, s2, g2 = _tied_diff_ub(
             Aup, cup + pa_dot(Aup) + ra_abs(Aup), Alx, clx + pa_dot(Alx),
             lo, hi, shared)
-        m2 = jnp.swapaxes(m2, -1, -2)
-        ub1 = m1 if ub1 is None else jnp.minimum(ub1, m1)
-        ub2 = m2 if ub2 is None else jnp.minimum(ub2, m2)
+        w1 = widen(m1, g1)
+        w2 = jnp.swapaxes(widen(m2, g2), -1, -2)
+        ub1 = w1 if ub1 is None else jnp.minimum(ub1, w1)
+        ub2 = w2 if ub2 is None else jnp.minimum(ub2, w2)
         score = jnp.maximum(score, jnp.maximum(s1, s2))
-    # Outward slack: the forms are unwidened f32 (crown_output_form_sets).
-    from fairify_tpu.ops.interval import SOUND_SLACK_ABS, SOUND_SLACK_REL
-
-    widen = lambda u: u + SOUND_SLACK_REL * jnp.abs(u) + SOUND_SLACK_ABS
-    t1_dead = t1_dead | (widen(ub1) <= 0.0)
-    t2_dead = t2_dead | (widen(ub2) <= 0.0)
+    t1_dead = t1_dead | (ub1 <= 0.0)
+    t2_dead = t2_dead | (ub2 <= 0.0)
 
     pair_ok = valid_pair[None] & valid[..., :, None] & valid[..., None, :]
     possible = pair_ok & ~(t1_dead & t2_dead)
@@ -608,13 +623,16 @@ def uniform_sign_bab(
     Per root the conjectured sign comes from sampled role logits; a sample
     with the opposite sign, an exhausted node budget, or a branch whose
     bound contradicts the conjecture marks the root 'mixed' (hand it to the
-    pair BaB).  Returns per-root verdicts: 'unsat' | 'mixed'.
+    pair BaB).  Returns ``(verdicts, nodes, cost_s)``: per-root verdicts
+    ('unsat' | 'mixed'), sign-BaB node counts, and attributed wall time
+    (each batch's time split evenly over its sub-boxes, same additive
+    accounting as :func:`decide_many`, so per-root costs sum ≈ phase total).
     """
     t0 = time.perf_counter()
     R = roots_lo.shape[0]
     n_hidden = net.depth - 1
     if n_hidden == 0 or not len(enc.pa_idx):
-        return ["mixed"] * R
+        return ["mixed"] * R, np.zeros(R, np.int64), np.zeros(R, np.float64)
     F = cfg.frontier_size
     if mesh is not None:
         from fairify_tpu.parallel import mesh as mesh_mod
@@ -646,11 +664,18 @@ def uniform_sign_bab(
 
         _, _, _, _, va = role_boxes(enc, roots_lo.astype(np.float32),
                                     roots_hi.astype(np.float32))
-    allv = np.concatenate([
-        np.where(va[:, None, :], lx, np.nan).reshape(R, -1),
-        np.where(va[:, None, :], lp, np.nan).reshape(R, -1)], axis=1)
-    want_pos = np.nanmin(allv, axis=1) > 0.0
-    want_neg = np.nanmax(allv, axis=1) < 0.0
+    # ±inf fill (not NaN) keeps nanmin's all-NaN RuntimeWarning out of long
+    # sweeps; a root with no valid PA assignment is trivially non-candidate
+    # (has_valid guard), not a numerical edge case.
+    allv_min = np.concatenate([
+        np.where(va[:, None, :], lx, np.inf).reshape(R, -1),
+        np.where(va[:, None, :], lp, np.inf).reshape(R, -1)], axis=1)
+    allv_max = np.concatenate([
+        np.where(va[:, None, :], lx, -np.inf).reshape(R, -1),
+        np.where(va[:, None, :], lp, -np.inf).reshape(R, -1)], axis=1)
+    has_valid = va.any(axis=-1)
+    want_pos = (allv_min.min(axis=1) > 0.0) & has_valid
+    want_neg = (allv_max.max(axis=1) < 0.0) & has_valid
     candidate = want_pos | want_neg
 
     from collections import deque
@@ -663,6 +688,7 @@ def uniform_sign_bab(
     settled[~candidate] = True
     open_n = np.where(candidate, 1, 0).astype(np.int64)
     nodes = np.zeros(R, dtype=np.int64)
+    cost_s = np.zeros(R, dtype=np.float64)
 
     def fail(r):
         settled[r] = True  # verdict stays 'mixed'
@@ -670,6 +696,7 @@ def uniform_sign_bab(
     while frontier:
         if (time.perf_counter() - t0) > deadline_s:
             break
+        t_iter = time.perf_counter()
         batch_items = []
         while frontier and len(batch_items) < F:
             r, sgn = frontier.popleft()
@@ -749,7 +776,8 @@ def uniform_sign_bab(
             if not settled[r] and open_n[r] == 0:
                 verdicts[r] = "unsat"
                 settled[r] = True
-    return verdicts
+        np.add.at(cost_s, broot, (time.perf_counter() - t_iter) / batch)
+    return verdicts, nodes, cost_s
 
 
 # ---------------------------------------------------------------------------
@@ -860,8 +888,15 @@ def decide_many(
     # alpha_iters > 0 is required: with no β optimization the split
     # constraints never reach the concretized bound and the phase cannot
     # progress past root-level certification (see crown.py docstring).
+    # Sign-phase nodes/time are merged into the reported Decisions (so the
+    # additive accounting invariant Σ per-root ≈ phase total holds and
+    # sign-certified roots carry their true cost, not nodes=0/0 s) but are
+    # kept OUT of the pair-BaB ``nodes`` budget counter — max_nodes keeps
+    # governing the input-split tree alone, as before.
+    sign_nodes = np.zeros(R, dtype=np.int64)
+    sign_cost = np.zeros(R, dtype=np.float64)
     if cfg.sign_bab and cfg.use_crown and cfg.alpha_iters > 0 and R:
-        sv = uniform_sign_bab(
+        sv, sign_nodes, sign_cost = uniform_sign_bab(
             net, enc, np.asarray(roots_lo, dtype=np.int64),
             np.asarray(roots_hi, dtype=np.int64), cfg,
             deadline_s=cfg.sign_bab_frac * deadline_s, mesh=mesh)
@@ -1022,8 +1057,9 @@ def decide_many(
             settle(r, "unsat" if open_boxes[r] == 0 else "unknown")
 
     return [
-        Decision(verdicts[r], ces[r], nodes=int(nodes[r]), leaves=int(leaves[r]),
-                 elapsed_s=float(cost_s[r]))
+        Decision(verdicts[r], ces[r],
+                 nodes=int(nodes[r] + sign_nodes[r]), leaves=int(leaves[r]),
+                 elapsed_s=float(cost_s[r] + sign_cost[r]))
         for r in range(R)
     ]
 

@@ -148,9 +148,13 @@ def test_uniform_sign_bab_positive_net():
     roots_hi = np.stack([hi, hi]).astype(np.int64)
     from fairify_tpu.verify.engine import EngineConfig, uniform_sign_bab
 
-    verdicts = uniform_sign_bab(net, enc, roots_lo, roots_hi,
-                                EngineConfig(alpha_iters=4), deadline_s=60.0)
+    verdicts, nodes, cost = uniform_sign_bab(
+        net, enc, roots_lo, roots_hi,
+        EngineConfig(alpha_iters=4), deadline_s=60.0)
     assert verdicts == ["unsat", "unsat"]
+    # ADVICE r2: sign-phase work must be attributed to the roots it served.
+    assert (nodes >= 1).all()
+    assert (cost > 0.0).all()
 
 
 def test_uniform_sign_bab_mixed_net_bails():
@@ -171,10 +175,47 @@ def test_uniform_sign_bab_mixed_net_bails():
     lo, hi = dom.lo_hi()
     from fairify_tpu.verify.engine import EngineConfig, uniform_sign_bab
 
-    verdicts = uniform_sign_bab(net, enc, lo.astype(np.int64)[None],
-                                hi.astype(np.int64)[None],
-                                EngineConfig(alpha_iters=4), deadline_s=30.0)
+    verdicts, _, _ = uniform_sign_bab(net, enc, lo.astype(np.int64)[None],
+                                      hi.astype(np.int64)[None],
+                                      EngineConfig(alpha_iters=4), deadline_s=30.0)
     assert verdicts == ["mixed"]
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_tied_diff_slack_covers_wide_domains(seed):
+    """ADVICE r2 (medium): the tied-diff outward slack must scale with the
+    concretized term magnitudes, not the cancelled bound value.
+
+    On wide integer domains the f32 per-dim products D·hi are huge while the
+    netted bound is near zero; the widened f32 bound must still dominate the
+    exact f64 supremum of (pos-form − neg-form) over tied coordinates."""
+    from fairify_tpu.ops.interval import SOUND_SLACK_ABS, SOUND_SLACK_REL
+
+    rng = np.random.default_rng(100 + seed)
+    B, V, d = 3, 2, 6
+    lo = np.zeros((B, d), dtype=np.float64)
+    hi = np.full((B, d), 1e6, dtype=np.float64)
+    # Nearly-cancelling forms: A_neg = A_pos + tiny perturbation.
+    A_pos = rng.normal(size=(B, V, d)).astype(np.float32)
+    pert = (rng.normal(size=(B, V, d)) * 1e-7).astype(np.float32)
+    A_neg = A_pos + pert
+    c_pos = rng.normal(size=(B, V)).astype(np.float32)
+    c_neg = c_pos + (rng.normal(size=(B, V)) * 1e-7).astype(np.float32)
+    shared = np.ones(d, dtype=np.float32)
+    m, _, g = engine._tied_diff_ub(
+        jnp.asarray(A_pos), jnp.asarray(c_pos), jnp.asarray(A_neg),
+        jnp.asarray(c_neg), jnp.asarray(lo, jnp.float32),
+        jnp.asarray(hi, jnp.float32), jnp.asarray(shared))
+    widened = np.asarray(m) + SOUND_SLACK_REL * np.asarray(g) + SOUND_SLACK_ABS
+    # Exact supremum in f64: per-dim max of D_j·s_j over [lo_j, hi_j].
+    D = A_pos.astype(np.float64)[:, :, None, :] - A_neg.astype(np.float64)[:, None, :, :]
+    sup = np.where(D > 0, D * hi[:, None, None, :], D * lo[:, None, None, :]).sum(-1) \
+        + c_pos.astype(np.float64)[:, :, None] - c_neg.astype(np.float64)[:, None, :]
+    assert (widened >= sup - 1e-12).all()
+    # The magnitude term must reflect the concretized scale: ≥ the f64
+    # recomputation of Σ_j |D_j|·max(|lo_j|,|hi_j|) (up to f32 rounding).
+    mag64 = (np.abs(D) * np.maximum(np.abs(lo), np.abs(hi))[:, None, None, :]).sum(-1)
+    assert (np.asarray(g, np.float64) >= (1 - 1e-5) * mag64).all()
 
 
 def test_leaf_sign_lp_exact():
