@@ -1,0 +1,251 @@
+"""Planet-style triangle-relaxation LP and the complete sign BaB built on it.
+
+The decisive certificate for the AC-7-class residue (deep nets whose logit is
+one-signed over the box but whose CROWN/β-CROWN bound gap stays ~3 units):
+relax every unstable ReLU with the triangle (Ehlers 2017 "Planet") envelope,
+solve one small LP (≤ ~260 vars on the zoo's nets, milliseconds in HiGHS),
+and branch on the neuron whose LP solution most violates the exact ReLU
+semantics.  With only ~15-25 unstable neurons per partition box, the tree
+closes in tens of nodes where the reference's Z3 spent its 100 s soft
+timeout and round 2's device β-CROWN frontier burned 2,000+ s without
+converging (``PERF.md`` AC-7 rows; ``/root/reference/src/AC/Verify-AC.py``
+run, BASELINE.md AC7: ~half the attempted partitions UNKNOWN).
+
+Division of labour with the device path: XLA computes the *batched* CROWN
+pre-activation bounds for every box in one launch (`ops.crown.crown_bounds`);
+the host solves the per-box LPs — the same split as the reference's
+TPU-pruning + host-Z3 design, with HiGHS in the solver seat.
+
+Evidence class: f64 LP with scale-aware margin — identical posture to
+``engine._leaf_sign_lp`` (which remains the fully-resolved special case of
+this relaxation), NOT exact rational arithmetic.  Certificates from here are
+audited by the certificate-attack harness like every other UNSAT.
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _lp_margin(obj_scale: float) -> float:
+    """Certification margin against f64 accumulation + HiGHS tolerances.
+
+    ``obj_scale`` must bound the *objective magnitude range* (Σ|w_out|·|h|
+    over the variable bounds, plus |b_out|), not just the coefficient sums:
+    HiGHS feasibility/duality tolerances act on the solved system's scale,
+    so on wide integer domains (variables ~10⁶) the optimum can be off by
+    ~tol × scale — a margin blind to the variable magnitudes would certify
+    through that noise.
+    """
+    return 1e-5 + 1e-6 * max(obj_scale, 1.0)
+
+
+class TriangleLP:
+    """Reusable triangle-relaxation tableau for one box of one network.
+
+    Variables: input x (d) then post-activations h_k per hidden layer.
+    Pre-activations are eliminated (z_k = W_k·h_{k-1} + b_k substituted into
+    every constraint).  Stable/forced neurons contribute equalities or fixed
+    bounds; unstable free neurons contribute the triangle:
+
+        h ≥ 0,  h ≥ z,  h ≤ u·(z − l)/(u − l).
+
+    Forcing a neuron active adds ``h = z ∧ z ≥ 0``; inactive adds
+    ``h = 0 ∧ z ≤ 0`` — exactly the sign-split semantics of
+    ``crown.sign_constrained_output_bounds``, but solved to LP optimality
+    instead of iterated to a β-ascent fixed point.
+    """
+
+    def __init__(self, weights, biases, masks, lo, hi, pre_lb, pre_ub):
+        self.d = len(lo)
+        self.nh = len(weights) - 1
+        self.sizes = [int(w.shape[1]) for w in weights[: self.nh]]
+        self.W = [np.asarray(w, np.float64) for w in weights]
+        self.b = [np.asarray(b, np.float64) for b in biases]
+        self.alive = [np.asarray(m, np.float64) > 0.5 for m in masks[: self.nh]]
+        self.lo = np.asarray(lo, np.float64)
+        self.hi = np.asarray(hi, np.float64)
+        self.pre_lb = [np.asarray(p, np.float64) for p in pre_lb]
+        self.pre_ub = [np.asarray(p, np.float64) for p in pre_ub]
+        self.off = [self.d]
+        for s in self.sizes[:-1]:
+            self.off.append(self.off[-1] + s)
+        self.nvar = self.d + sum(self.sizes)
+        self.out_w = np.asarray(weights[self.nh], np.float64)[:, 0]
+        self.out_b = float(np.asarray(biases[self.nh], np.float64)[0])
+
+    def _prev_span(self, k: int) -> Tuple[int, int]:
+        return (0, self.d) if k == 0 else (self.off[k - 1], self.sizes[k - 1])
+
+    def unstable(self) -> List[Tuple[int, int]]:
+        """(layer, neuron) of every alive neuron with l < 0 < u."""
+        out = []
+        for k in range(self.nh):
+            l, u = self.pre_lb[k], self.pre_ub[k]
+            for j in range(self.sizes[k]):
+                if self.alive[k][j] and l[j] < 0.0 < u[j]:
+                    out.append((k, j))
+        return out
+
+    def solve_min(self, forced: Sequence[np.ndarray]):
+        """Minimise the output logit subject to the relaxation + forcings.
+
+        Returns ``(status, value, x)``: status 'ok' | 'infeasible' | 'error';
+        on 'ok', ``value`` is the LP optimum (a sound lower bound of the
+        region minimum) and ``x`` the full variable vector for branching.
+        """
+        from scipy.optimize import linprog
+
+        lb_v = np.empty(self.nvar)
+        ub_v = np.empty(self.nvar)
+        lb_v[: self.d] = self.lo
+        ub_v[: self.d] = self.hi
+        A_ub: List[np.ndarray] = []
+        b_ub: List[float] = []
+        A_eq: List[np.ndarray] = []
+        b_eq: List[float] = []
+        for k in range(self.nh):
+            W, bb = self.W[k], self.b[k]
+            l, u = self.pre_lb[k], self.pre_ub[k]
+            po, pn = self._prev_span(k)
+            o = self.off[k]
+            f = forced[k]
+            for j in range(self.sizes[k]):
+                hv = o + j
+                if not self.alive[k][j] or u[j] <= 0.0 or f[j] == -1:
+                    lb_v[hv] = ub_v[hv] = 0.0
+                    if f[j] == -1 and u[j] > 0.0:  # z ≤ 0
+                        row = np.zeros(self.nvar)
+                        row[po: po + pn] = W[:, j]
+                        A_ub.append(row)
+                        b_ub.append(-bb[j])
+                    continue
+                if l[j] >= 0.0 or f[j] == 1:  # h = z (≥ 0 via var bound)
+                    row = np.zeros(self.nvar)
+                    row[po: po + pn] = W[:, j]
+                    row[hv] = -1.0
+                    A_eq.append(row)
+                    b_eq.append(-bb[j])
+                    lb_v[hv] = max(float(l[j]), 0.0)
+                    ub_v[hv] = max(float(u[j]), 0.0)
+                    continue
+                # Unstable, free: the triangle.
+                lb_v[hv] = 0.0
+                ub_v[hv] = float(u[j])
+                row = np.zeros(self.nvar)  # z − h ≤ 0
+                row[po: po + pn] = W[:, j]
+                row[hv] = -1.0
+                A_ub.append(row)
+                b_ub.append(-bb[j])
+                s = float(u[j] / (u[j] - l[j]))
+                row = np.zeros(self.nvar)  # h − s·z ≤ −s·l
+                row[po: po + pn] = -s * W[:, j]
+                row[hv] = 1.0
+                A_ub.append(row)
+                b_ub.append(s * bb[j] - s * float(l[j]))
+        c = np.zeros(self.nvar)
+        oo, on = self._prev_span(self.nh)
+        c[oo: oo + on] = self.out_w
+        res = linprog(
+            c,
+            A_ub=np.stack(A_ub) if A_ub else None,
+            b_ub=np.asarray(b_ub) if b_ub else None,
+            A_eq=np.stack(A_eq) if A_eq else None,
+            b_eq=np.asarray(b_eq) if b_eq else None,
+            bounds=np.stack([lb_v, ub_v], axis=1),
+            method="highs",
+        )
+        if res.status == 2:
+            return "infeasible", None, None
+        if res.status != 0 or res.fun is None:
+            return "error", None, None
+        return "ok", float(res.fun) + self.out_b, res.x
+
+    def branch_neuron(self, x: np.ndarray, forced) -> Optional[Tuple[int, int]]:
+        """Free unstable neuron whose LP point most violates exact ReLU."""
+        best, pick = 0.0, None
+        for k in range(self.nh):
+            l, u = self.pre_lb[k], self.pre_ub[k]
+            po, pn = self._prev_span(k)
+            prev = x[po: po + pn]
+            for j in range(self.sizes[k]):
+                if forced[k][j] != 0 or not self.alive[k][j]:
+                    continue
+                if not (l[j] < 0.0 < u[j]):
+                    continue
+                z = float(self.W[k][:, j] @ prev + self.b[k][j])
+                v = abs(float(x[self.off[k] + j]) - max(0.0, z))
+                if v > best:
+                    best, pick = v, (k, j)
+        return pick
+
+    def margin(self) -> float:
+        # Objective magnitude over the relaxation: last-hidden post-activation
+        # bounds are [0, max(u, 0)] (post-ReLU), so Σ|w_out|·u⁺ + |b_out|
+        # bounds |objective| over the entire feasible set.
+        k = self.nh - 1
+        h_hi = np.maximum(self.pre_ub[k], 0.0)
+        scale = float(np.abs(self.out_w) @ h_hi) + abs(self.out_b)
+        return _lp_margin(scale)
+
+
+def sign_bab_lp(
+    weights,
+    biases,
+    masks,
+    lo,
+    hi,
+    pre_lb,
+    pre_ub,
+    want_positive: bool,
+    max_nodes: int = 4000,
+    deadline_s: float = 30.0,
+) -> Tuple[str, int]:
+    """Complete LP branch-and-bound for a uniform output sign over a box.
+
+    Proves ``min f > margin`` (``want_positive``) or ``max f < −margin``
+    over the triangle relaxation, branching on ReLU-violating neurons until
+    every branch is certified or refuted.  Returns ``(outcome, nodes)``:
+
+    * ``'certified'`` — every branch cleared the margin: uniform sign proved;
+    * ``'refuted'``   — a fully-resolved affine region's true optimum lands
+      at or inside the margin band: the conjecture fails (or is too marginal
+      for the f64+margin evidence class) and NO sign method can certify it —
+      the caller should hand the root to the pair BaB, not retry;
+    * ``'budget'``    — node/deadline budget exhausted before closure.
+
+    For ``want_positive=False`` the network is negated (out_w, out_b ↦ −)
+    so one minimisation path serves both signs.
+    """
+    t0 = time.perf_counter()
+    lp = TriangleLP(weights, biases, masks, lo, hi, pre_lb, pre_ub)
+    if not want_positive:
+        lp.out_w = -lp.out_w
+        lp.out_b = -lp.out_b
+    margin = lp.margin()
+    root = [np.zeros(s, dtype=np.int8) for s in lp.sizes]
+    stack = [root]
+    nodes = 0
+    while stack:
+        if nodes >= max_nodes or (time.perf_counter() - t0) > deadline_s:
+            return "budget", nodes
+        forced = stack.pop()
+        nodes += 1
+        st, val, x = lp.solve_min(forced)
+        if st == "infeasible":
+            continue  # empty branch region: discharged
+        if st == "error":
+            return "budget", nodes
+        if val > margin:
+            continue  # branch certified
+        pick = lp.branch_neuron(x, forced)
+        if pick is None:
+            return "refuted", nodes
+        k, j = pick
+        for sign in (1, -1):
+            child = [f.copy() for f in forced]
+            child[k][j] = sign
+            stack.append(child)
+    return "certified", nodes

@@ -1,0 +1,107 @@
+"""Diagnose the AC-7 UNKNOWN residue (round-3 scoping, VERDICT.md item 1).
+
+For a sample of the 4,433 undecided partitions per PA, report which regime
+each box is in:
+
+* one-signed sampled logits (sign-BaB candidate that ran out of budget), vs
+* genuinely mixed-sign logits over the box (uniform-sign certificate
+  inapplicable — needs the relational pair-difference BaB), and
+* how close the PGD attack gets to a flip (best |logit| and the PA logit
+  offset |δ| at that point — the flip-slab width).
+
+Usage: env PYTHONPATH= JAX_PLATFORMS=cpu python scripts/diagnose_ac7.py [N]
+"""
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import numpy as np
+import jax.numpy as jnp
+
+from fairify_tpu.models import zoo
+from fairify_tpu.verify import engine, presets, sweep
+from fairify_tpu.verify.property import encode, role_boxes
+
+
+def main(n_sample=96, pa="sex"):
+    cfg = presets.get("AC")
+    if pa != "sex":
+        cfg = cfg.with_(protected=(pa,))
+    p_list, lo, hi = sweep.build_partitions(cfg)
+    led_path = os.path.join(ROOT, "parity", f"AC-{pa}", "AC-AC-7.ledger.jsonl")
+    led = {}
+    for line in open(led_path):
+        r = json.loads(line)
+        led[r["partition_id"]] = r["verdict"]
+    unk = sorted(pid for pid, v in led.items() if v == "unknown")
+    print(f"PA={pa}: {len(unk)} unknown of {len(led)}")
+    rng = np.random.default_rng(0)
+    pick = rng.choice(len(unk), size=min(n_sample, len(unk)), replace=False)
+    idx = np.array([unk[i] - 1 for i in sorted(pick)])
+
+    net = zoo.load("adult", "AC-7")
+    enc = encode(cfg.query())
+    blo, bhi = lo[idx], hi[idx]
+    B = len(idx)
+
+    # Sampled role logits (1024 samples per box).
+    xr, pr = engine.build_attack_candidates(enc, rng, blo, bhi, 1024)
+    lx, lp = engine._attack_logits(net, jnp.asarray(xr), jnp.asarray(pr))
+    lx, lp = np.asarray(lx), np.asarray(lp)
+    x_lo, x_hi, xp_lo, xp_hi, valid = role_boxes(
+        enc, blo.astype(np.float32), bhi.astype(np.float32))
+    allv = np.concatenate([
+        np.where(valid[:, None, :], lx, np.nan).reshape(B, -1),
+        np.where(valid[:, None, :], lp, np.nan).reshape(B, -1)], axis=1)
+    smin = np.nanmin(allv, axis=1)
+    smax = np.nanmax(allv, axis=1)
+    one_signed = (smin > 0) | (smax < 0)
+
+    # PA sensitivity at sampled points: |f(x_a) - f(x_b)| across the two
+    # assignments, same shared coords (slab width δ).
+    # lx shape (B, S, V); V=2 for sex.
+    if lx.shape[-1] == 2:
+        delta = np.abs(lx[..., 0] - lx[..., 1])
+        dmed = np.median(delta, axis=1)
+        dmax = delta.max(axis=1)
+    else:
+        dmed = dmax = np.zeros(B)
+
+    # CROWN root bounds (alpha 8).
+    from fairify_tpu.ops import crown as crown_ops
+    lbx, ubx = crown_ops.crown_output_bounds(net, jnp.asarray(x_lo), jnp.asarray(x_hi))
+    lbx, ubx = np.asarray(lbx), np.asarray(ubx)
+    # reduce over valid assignments
+    lb = np.where(valid, lbx, np.inf).min(axis=1)
+    ub = np.where(valid, ubx, -np.inf).max(axis=1)
+
+    # PGD best |logit|.
+    w, pts, best_abs = engine.pgd_attack(
+        net, enc, blo, bhi, np.random.default_rng(1), return_points=True)
+
+    print(f"one-signed-sample boxes: {one_signed.sum()}/{B}")
+    print(f"sampled logit min/max percentiles: "
+          f"min p10={np.percentile(smin,10):.3f} p50={np.percentile(smin,50):.3f} "
+          f"p90={np.percentile(smin,90):.3f}; "
+          f"max p10={np.percentile(smax,10):.3f} p50={np.percentile(smax,50):.3f} "
+          f"p90={np.percentile(smax,90):.3f}")
+    print(f"PA |delta| median-of-medians={np.median(dmed):.5f} "
+          f"max-of-max={dmax.max():.5f}")
+    print(f"CROWN root lb p50={np.percentile(lb,50):.2f}  ub p50={np.percentile(ub,50):.2f}")
+    print(f"PGD witnesses found: {len(w)}/{B}; best|logit| p10={np.percentile(best_abs,10):.4f} "
+          f"p50={np.percentile(best_abs,50):.4f} p90={np.percentile(best_abs,90):.4f}")
+    # Regime classification
+    mixed = ~one_signed
+    print(f"mixed-sign boxes: {mixed.sum()} — these need the relational certificate")
+    # For mixed boxes: is the PGD objective (min(max f_a, -min f_b)) actually
+    # negative (no flip nearby) or positive-but-invalid (f32 flip, exact no)?
+    return 0
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 96
+    pa = sys.argv[2] if len(sys.argv) > 2 else "sex"
+    sys.exit(main(n, pa))
