@@ -12,7 +12,7 @@ both models run one batched forward each.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, Optional, Sequence
+from typing import Dict, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -72,19 +72,22 @@ def evaluate_hybrid(
     original, fairer,
     lo, hi, verdicts,
     privileged_value=1,
-) -> Dict[str, dict]:
+) -> Tuple[Dict[str, dict], HybridReport]:
     """Accuracy + group metrics for original/fairer/hybrid side by side
-    (``Verify-AC-experiment-new2.py:653-787``)."""
+    (``Verify-AC-experiment-new2.py:653-787``), plus the routing report
+    (one ``hybrid_predict`` call serves both — the partition-membership
+    broadcast is the expensive part on adult-scale grids)."""
     from fairify_tpu.analysis import metrics as gm
 
     Xj = jnp.asarray(np.asarray(X), jnp.float32)
     prot = np.asarray(X)[:, protected_col]
+    routing = hybrid_predict(X, original, fairer, lo, hi, verdicts)
     out = {}
     preds = {
         "original": np.asarray(mlp_mod.predict(original, Xj)).astype(int),
         "fairer": np.asarray(mlp_mod.predict(fairer, Xj)).astype(int),
-        "hybrid": hybrid_predict(X, original, fairer, lo, hi, verdicts).predictions,
+        "hybrid": routing.predictions,
     }
     for name, p in preds.items():
         out[name] = gm.group_report(X, y, p, prot, privileged_value).as_dict()
-    return out
+    return out, routing

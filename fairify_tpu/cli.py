@@ -149,7 +149,8 @@ def _cmd_experiment(args) -> int:
     dataset = loaders.load(cfg.dataset, root=args.data_root)
     res = experiment.run_experiment(
         net, cfg, args.model, dataset=dataset, repair_mode=args.repair,
-        causal_samples=args.causal_samples)
+        causal_samples=args.causal_samples,
+        verify_repaired=not args.no_verify_repaired)
     if args.save_fairer:
         from fairify_tpu.models import export
 
@@ -165,6 +166,9 @@ def _cmd_experiment(args) -> int:
                            if res.localization else []),
         "metrics": _finite(res.metrics),
         "causal_rates": _finite(res.causal_rates),
+        "fairer_verdicts": res.fairer_verdicts,
+        "routing": res.routing,
+        "success": res.success,
         "saved_fairer": args.save_fairer or None,
     }
     print(json.dumps(out))
@@ -252,8 +256,10 @@ def main(argv=None) -> int:
     exp.add_argument("preset")
     exp.add_argument("--model", required=True)
     exp.add_argument("--repair", choices=("masked", "retrain", "both"),
-                     default="masked")
+                     default="retrain")
     exp.add_argument("--causal-samples", type=int, default=2000)
+    exp.add_argument("--no-verify-repaired", action="store_true",
+                     help="skip re-verifying the repaired model's grid")
     exp.add_argument("--soft-timeout", type=float, default=None)
     exp.add_argument("--hard-timeout", type=float, default=None)
     exp.add_argument("--result-dir", default=None)

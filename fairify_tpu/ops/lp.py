@@ -79,16 +79,6 @@ class TriangleLP:
     def _prev_span(self, k: int) -> Tuple[int, int]:
         return (0, self.d) if k == 0 else (self.off[k - 1], self.sizes[k - 1])
 
-    def unstable(self) -> List[Tuple[int, int]]:
-        """(layer, neuron) of every alive neuron with l < 0 < u."""
-        out = []
-        for k in range(self.nh):
-            l, u = self.pre_lb[k], self.pre_ub[k]
-            for j in range(self.sizes[k]):
-                if self.alive[k][j] and l[j] < 0.0 < u[j]:
-                    out.append((k, j))
-        return out
-
     def solve_min(self, forced: Sequence[np.ndarray]):
         """Minimise the output logit subject to the relaxation + forcings.
 

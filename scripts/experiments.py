@@ -104,6 +104,21 @@ def _experiment_section(exp, note=""):
          f"counterexample pairs, top biased neurons {exp['biased_neurons'][:3]}."
          + (f"  {note}" if note else "")),
         "",
+    ]
+    if exp.get("fairer_verdicts"):
+        lines += [f"Repaired-model verdicts (same grid): {exp['fairer_verdicts']}.", ""]
+    if exp.get("routing"):
+        r = exp["routing"]
+        lines += [(f"Hybrid routing over the test set: {r['fair']} → fairer, "
+                   f"{r['original']} → original, {r['miss']} misses."), ""]
+    if exp.get("success") is not None:
+        s = exp["success"]
+        verdict = "PASSED" if s.get("passed") else "FAILED"
+        fails = [k for k, v in s.items() if k != "passed" and not v]
+        lines += [(f"Success criteria (reference's own bar, "
+                   f"`src/AC/new_model.py:248-260`): **{verdict}**"
+                   + (f" — failing: {', '.join(fails)}" if fails else "")), ""]
+    lines += [
         "| Variant | Acc | DI | SPD | EOD | AOD | ERD | Consistency | Theil | Causal rate |",
         "|---|---|---|---|---|---|---|---|---|---|",
     ]

@@ -202,6 +202,70 @@ def test_counterexample_retrain_respects_floor():
     assert any(str(h["epoch"]).startswith("stage2") for h in res.history)
 
 
+def test_counterexample_retrain_meets_success_criteria():
+    """VERDICT r2 ask #3: the repair must *improve* fairness by the
+    reference's own bar (causal rate down, DI toward 1, |SPD|/|EOD|/|AOD|
+    not worse, accuracy ≥ floor) — asserted end-to-end on a small model
+    whose bias is genuinely repairable.
+
+    Construction: logit = x0 + 2.5·pa − 3.5 (per-group thresholds 1 vs 3.5)
+    over x0 ∈ [0,8]; true labels y = (x0 ≥ 4), so the *fair* classifier
+    x0 − 3.5 is also the most accurate one — repair can reach both."""
+    import jax.numpy as jnp
+
+    from fairify_tpu.analysis import causal, experiment
+    from fairify_tpu.analysis import metrics as gm
+
+    ws = [np.array([[1.0, 0.0], [0.0, 1.0], [0.0, 0.0]], dtype=np.float32),
+          np.array([[1.0], [2.5]], dtype=np.float32)]
+    bs = [np.array([10.0, 10.0], dtype=np.float32),
+          np.array([-38.5], dtype=np.float32)]
+    net = mlp.from_numpy(ws, bs)
+    rng = np.random.default_rng(7)
+    X = np.stack([rng.integers(0, 9, 600), rng.integers(0, 2, 600),
+                  rng.integers(0, 5, 600)], axis=1).astype(np.float32)
+    y = (X[:, 0] >= 4).astype(int)
+
+    # Counterexample pairs: shared coords where the PA flip changes the class.
+    pairs = []
+    for _ in range(400):
+        x = np.array([rng.integers(0, 9), 0, rng.integers(0, 5)], np.float32)
+        xp = x.copy()
+        xp[1] = 1
+        px = float(mlp.predict(net, jnp.asarray(x[None]))[0])
+        pp = float(mlp.predict(net, jnp.asarray(xp[None]))[0])
+        if px != pp:
+            pairs.append((x, xp))
+    assert len(pairs) > 50  # the construction really is biased
+
+    res = repair.counterexample_retrain(
+        net, X, y, pairs, X, y, stage1_epochs=2, stage2_epochs=8,
+        protected_col=1, seed=0)
+    fairer = res.net
+
+    prot = X[:, 1]
+    metrics_out = {
+        "original": gm.group_report(
+            X, y, np.asarray(mlp.predict(net, jnp.asarray(X))).astype(int),
+            prot).as_dict(),
+        "fairer": gm.group_report(
+            X, y, np.asarray(mlp.predict(fairer, jnp.asarray(X))).astype(int),
+            prot).as_dict(),
+    }
+    lo = np.array([0, 0, 0], np.int64)
+    hi = np.array([8, 1, 4], np.int64)
+    rates = {
+        name: causal.causal_discrimination(
+            lambda Z, n=m: np.asarray(mlp.predict(n, jnp.asarray(Z, jnp.float32))),
+            lo, hi, 1, min_samples=200, max_samples=2000).rate
+        for name, m in (("original", net), ("fairer", fairer))
+    }
+    success = experiment.repair_success(metrics_out, rates)
+    assert success["passed"], (success, metrics_out, rates)
+    # And the improvement is substantive, not within-tolerance noise:
+    assert rates["fairer"] < 0.5 * max(rates["original"], 1e-9)
+
+
 # ---------------------------------------------------------------------------
 # Hybrid routing
 # ---------------------------------------------------------------------------
@@ -230,7 +294,8 @@ def test_evaluate_hybrid_report_keys():
     rng = np.random.default_rng(5)
     X = rng.integers(0, 10, size=(40, d))
     y = rng.integers(0, 2, size=40)
-    out = hybrid.evaluate_hybrid(X, y, 1, original, fairer, lo, hi, ["sat"])
+    out, routing = hybrid.evaluate_hybrid(X, y, 1, original, fairer, lo, hi, ["sat"])
     assert set(out) == {"original", "fairer", "hybrid"}
     for v in out.values():
         assert "consistency" in v and "disparate_impact" in v
+    assert routing.routed_fair + routing.routed_original + routing.routed_miss == 40
