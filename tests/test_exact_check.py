@@ -1,0 +1,247 @@
+"""Independent exact UNSAT checker (verify.exact_check).
+
+Oracle style follows tests/test_bab2.py: tiny domains where exhaustive
+lattice enumeration is feasible; the checker must agree exactly with brute
+force — no float tolerance anywhere in the assertions.
+"""
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+from fairify_tpu.models import mlp
+from fairify_tpu.verify import exact_check as ec
+from fairify_tpu.verify import property as prop
+from fairify_tpu.data.domains import DomainSpec
+
+from test_bab2 import brute_force_flip, tiny_domain  # noqa: F401 (oracle reuse)
+
+
+# ---------------------------------------------------------------------------
+# Exact simplex
+# ---------------------------------------------------------------------------
+
+
+def F(x):
+    return Fraction(x)
+
+
+def test_simplex_feasible_point_satisfies_system():
+    # x0 + x1 >= 3 (as -x0 - x1 <= -3), x0 - x1 <= 1, box [0, 10]^2
+    A = [[F(-1), F(-1)], [F(1), F(-1)]]
+    b = [F(-3), F(1)]
+    st, pt = ec._feasible(A, b, [F(0), F(0)], [F(10), F(10)])
+    assert st == "feasible"
+    assert pt[0] + pt[1] >= 3
+    assert pt[0] - pt[1] <= 1
+    assert all(F(0) <= v <= F(10) for v in pt)
+
+
+def test_simplex_infeasible():
+    # x0 >= 5 and x0 <= 2 simultaneously.
+    A = [[F(-1), F(0)], [F(1), F(0)]]
+    b = [F(-5), F(2)]
+    st, pt = ec._feasible(A, b, [F(0), F(0)], [F(10), F(10)])
+    assert st == "infeasible" and pt is None
+
+
+def test_simplex_equality_pinned_dims():
+    # Width-0 dim: x1 fixed at 4 by its bounds; require x0 + x1 >= 6.
+    A = [[F(-1), F(-1)]]
+    b = [F(-6)]
+    st, pt = ec._feasible(A, b, [F(0), F(4)], [F(10), F(4)])
+    assert st == "feasible" and pt[1] == 4 and pt[0] >= 2
+
+
+def test_exact_dual_bound_matches_lp_optimum():
+    from scipy.optimize import linprog
+
+    c = [F(1), F(1)]
+    A_ub = [[F(-1), F(-1)]]
+    b_ub = [F(-3)]
+    A_eq = [[F(1), F(-1)]]
+    b_eq = [F(1)]
+    lb = [F(0), F(0)]
+    ub = [F(10), F(10)]
+    res = linprog([1.0, 1.0], A_ub=[[-1.0, -1.0]], b_ub=[-3.0],
+                  A_eq=[[1.0, -1.0]], b_eq=[1.0],
+                  bounds=[(0, 10), (0, 10)], method="highs")
+    y_ub = [F(max(float(-m), 0.0)) for m in np.atleast_1d(res.ineqlin.marginals)]
+    y_eq = [F(float(-m)) for m in np.atleast_1d(res.eqlin.marginals)]
+    bound = ec._exact_dual_bound(c, A_ub, b_ub, A_eq, b_eq, lb, ub, y_ub, y_eq)
+    assert bound == 3  # exact: optimum of min x0+x1 is 3
+    # Garbage duals must still give a VALID (just weaker) bound:
+    bound2 = ec._exact_dual_bound(c, A_ub, b_ub, A_eq, b_eq, lb, ub,
+                                  [F(0)], [F(7)])
+    assert bound2 <= 3
+
+
+# ---------------------------------------------------------------------------
+# Pair-property checker vs brute force
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_pair_checker_agrees_with_brute_force(seed):
+    rng = np.random.default_rng(seed)
+    dom = tiny_domain({"a": (0, 4), "pa": (0, 2), "b": (0, 4)})
+    query = prop.FairnessQuery(domain=dom, protected=("pa",))
+    enc = prop.encode(query)
+    ws = [rng.normal(size=(3, 6)).astype(np.float32) * 0.6,
+          rng.normal(size=(6, 1)).astype(np.float32)]
+    bs = [(rng.normal(size=(6,)) * 0.3).astype(np.float32),
+          np.array([float(rng.normal()) * 0.5], dtype=np.float32)]
+    net = mlp.from_numpy(ws, bs)
+    W = [np.asarray(w) for w in net.weights]
+    B = [np.asarray(b) for b in net.biases]
+    lo, hi = dom.lo_hi()
+    lo = lo.astype(np.int64)
+    hi = hi.astype(np.int64)
+    res = ec.decide_pair_box_exact(W, B, enc, lo, hi, max_nodes=20000)
+    truth = brute_force_flip(net, enc, lo, hi)
+    assert res["verdict"] in ("unsat_confirmed", "refuted")
+    assert (res["verdict"] == "refuted") == truth
+    if truth:
+        from fairify_tpu.verify.engine import validate_pair
+
+        x, xp = res["witness"]
+        assert validate_pair(W, B, np.asarray(x), np.asarray(xp))
+
+
+def test_pair_checker_relaxed_attribute():
+    """RA δ handling: flips only reachable via the ε shift are found."""
+    # f = a + 3*pa - 4.5 won't flip with pa alone on a ∈ [0,1] ... build a
+    # net where the RA dim decides: f = ra + 2*pa - 2.5 over ra ∈ [0, 4].
+    ws = [np.array([[0.0], [2.0], [1.0]], dtype=np.float32),
+          np.array([[1.0]], dtype=np.float32)]
+    bs = [np.array([0.0], dtype=np.float32), np.array([-2.5], dtype=np.float32)]
+    net = mlp.from_numpy(ws, bs)
+    dom = tiny_domain({"a": (0, 1), "pa": (0, 1), "ra": (1, 1)})
+    query = prop.FairnessQuery(domain=dom, protected=("pa",),
+                               relaxed=("ra",), relax_eps=1)
+    enc = prop.encode(query)
+    W = [np.asarray(w) for w in net.weights]
+    B = [np.asarray(b) for b in net.biases]
+    lo, hi = dom.lo_hi()
+    res = ec.decide_pair_box_exact(W, B, enc, lo.astype(np.int64),
+                                  hi.astype(np.int64))
+    # pa=1, ra=1: z = 1+2-2.5 = +0.5 ... pa=0, ra'=2: 2-2.5 = -0.5: flip.
+    assert res["verdict"] == "refuted"
+    assert brute_force_flip(net, enc, lo.astype(np.int64), hi.astype(np.int64))
+
+
+def test_pair_checker_ra_direction_asymmetry():
+    """Review repro: a flip reachable ONLY via the RA shift leaving the box
+    in the direction the role-swap symmetry does not cover.
+
+    f = ra − 4.5 over ra ∈ [0, 4], ε = 1: x = (·, ra=4) gives −0.5 and
+    x' = (·, ra=5, shifted out of the box) gives +0.5 — direction
+    f_x < 0 ∧ f_x' > 0 only.  A one-direction sweep confirms UNSAT here;
+    the checker must refute."""
+    ws = [np.array([[0.0], [0.0], [1.0]], dtype=np.float32),
+          np.array([[1.0]], dtype=np.float32)]
+    bs = [np.array([0.0], dtype=np.float32), np.array([-4.5], dtype=np.float32)]
+    net = mlp.from_numpy(ws, bs)
+    dom = tiny_domain({"a": (0, 1), "pa": (0, 1), "ra": (0, 4)})
+    query = prop.FairnessQuery(domain=dom, protected=("pa",),
+                               relaxed=("ra",), relax_eps=1)
+    enc = prop.encode(query)
+    W = [np.asarray(w) for w in net.weights]
+    B = [np.asarray(b) for b in net.biases]
+    lo, hi = dom.lo_hi()
+    res = ec.decide_pair_box_exact(W, B, enc, lo.astype(np.int64),
+                                  hi.astype(np.int64))
+    assert res["verdict"] == "refuted"
+    assert brute_force_flip(net, enc, lo.astype(np.int64), hi.astype(np.int64))
+
+
+def test_pair_checker_multi_pa_validity():
+    """Review repro: with two protected attributes, a legal pair must differ
+    in EVERY PA coordinate (property.encode's conjunction of neq).  Here
+    f = 4·|p − q| − 2 flips only across pairs differing in exactly one of
+    p/q — which are NOT valid pairs — so the box is UNSAT and the checker
+    must not refute with an invalid witness."""
+    # |p − q| via relu(p − q) + relu(q − p).
+    ws = [np.array([[0.0, 0.0], [1.0, -1.0], [-1.0, 1.0]], dtype=np.float32),
+          np.array([[4.0], [4.0]], dtype=np.float32)]
+    bs = [np.zeros(2, dtype=np.float32), np.array([-2.0], dtype=np.float32)]
+    net = mlp.from_numpy(ws, bs)
+    dom = tiny_domain({"a": (0, 1), "p": (0, 1), "q": (0, 1)})
+    query = prop.FairnessQuery(domain=dom, protected=("p", "q"))
+    enc = prop.encode(query)
+    W = [np.asarray(w) for w in net.weights]
+    B = [np.asarray(b) for b in net.biases]
+    lo, hi = dom.lo_hi()
+    res = ec.decide_pair_box_exact(W, B, enc, lo.astype(np.int64),
+                                  hi.astype(np.int64))
+    assert not brute_force_flip(net, enc, lo.astype(np.int64), hi.astype(np.int64))
+    assert res["verdict"] == "unsat_confirmed"
+
+
+# ---------------------------------------------------------------------------
+# Sign-certificate confirmation
+# ---------------------------------------------------------------------------
+
+
+def test_sign_certificate_positive_net_confirmed():
+    ws = [np.array([[1.0, -1.0]], dtype=np.float32),
+          np.array([[1.0], [1.0]], dtype=np.float32)]
+    bs = [np.zeros(2, dtype=np.float32), np.array([0.5], dtype=np.float32)]
+    net = mlp.from_numpy(ws, bs)
+    W = [np.asarray(w) for w in net.weights]
+    B = [np.asarray(b) for b in net.biases]
+    r = ec.confirm_sign_certificate(W, B, np.array([-4]), np.array([4]),
+                                    want_positive=True)
+    assert r["verdict"] == "confirmed"
+
+
+def test_sign_certificate_needs_splits_confirmed():
+    """The f ≡ 1 cancellation net (test_bab2): root LP dips below zero, the
+    exact confirmation must still close via phase splits."""
+    ws = [np.array([[1.0, -1.0, 1.0]], dtype=np.float32),
+          np.array([[-1.0], [1.0], [1.0]], dtype=np.float32)]
+    bs = [np.array([0.0, 0.0, 8.0], dtype=np.float32),
+          np.array([-7.0], dtype=np.float32)]
+    net = mlp.from_numpy(ws, bs)
+    W = [np.asarray(w) for w in net.weights]
+    B = [np.asarray(b) for b in net.biases]
+    r = ec.confirm_sign_certificate(W, B, np.array([-4]), np.array([4]),
+                                    want_positive=True)
+    assert r["verdict"] == "confirmed"
+    assert r["nodes"] > 1
+
+
+def test_sign_certificate_mixed_net_not_confirmed():
+    ws = [np.array([[1.0]], dtype=np.float32), np.array([[1.0]], dtype=np.float32)]
+    bs = [np.zeros(1, dtype=np.float32), np.array([-2.0], dtype=np.float32)]
+    net = mlp.from_numpy(ws, bs)
+    W = [np.asarray(w) for w in net.weights]
+    B = [np.asarray(b) for b in net.biases]
+    r = ec.confirm_sign_certificate(W, B, np.array([0]), np.array([6]),
+                                    want_positive=True)
+    assert r["verdict"] == "not_confirmed"
+
+
+def test_negative_sign_certificate():
+    ws = [np.array([[1.0]], dtype=np.float32), np.array([[-1.0]], dtype=np.float32)]
+    bs = [np.zeros(1, dtype=np.float32), np.array([-1.0], dtype=np.float32)]
+    net = mlp.from_numpy(ws, bs)
+    W = [np.asarray(w) for w in net.weights]
+    B = [np.asarray(b) for b in net.biases]
+    r = ec.confirm_sign_certificate(W, B, np.array([0]), np.array([6]),
+                                    want_positive=False)
+    assert r["verdict"] == "confirmed"
+
+
+def test_exact_logit_sign_frac_matches_float():
+    rng = np.random.default_rng(3)
+    ws = [rng.normal(size=(2, 4)).astype(np.float32),
+          rng.normal(size=(4, 1)).astype(np.float32)]
+    bs = [rng.normal(size=(4,)).astype(np.float32),
+          rng.normal(size=(1,)).astype(np.float32)]
+    W, B = ec._frac_weights(ws, bs)
+    from fairify_tpu.verify.engine import exact_logit_sign
+
+    for _ in range(20):
+        x = rng.integers(-5, 6, size=2)
+        assert ec._exact_logit_sign_frac(W, B, x) == exact_logit_sign(ws, bs, x)
