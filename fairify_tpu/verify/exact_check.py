@@ -839,3 +839,33 @@ def confirm_sign_certificate(
             child[k][j] = ph
             stack.append(child)
     return {"verdict": "confirmed", "nodes": nodes}
+
+
+def pair_is_legal(enc, lo, hi, x, xp) -> bool:
+    """Well-formedness of a counterexample pair, independent of its signs.
+
+    The replay audit must establish more than a strict flip: the pair has
+    to be a *legal* fairness pair — every PA coordinate differs
+    (``property.encode``'s conjunction of neq), non-PA coordinates are tied
+    (RA dims within ±ε), and the x role lies inside the partition box (the
+    x' role may leave it on RA dims only, ``property.role_boxes``).
+    """
+    x = np.asarray(x, dtype=np.int64)
+    xp = np.asarray(xp, dtype=np.int64)
+    pa = set(int(i) for i in enc.pa_idx)
+    ra = set(int(i) for i in enc.ra_idx) if enc.eps else set()
+    for i in range(len(x)):
+        if i in pa:
+            if x[i] == xp[i]:
+                return False
+            if not (lo[i] <= x[i] <= hi[i] and lo[i] <= xp[i] <= hi[i]):
+                return False
+        else:
+            if not (lo[i] <= x[i] <= hi[i]):
+                return False
+            if i in ra:
+                if abs(int(xp[i]) - int(x[i])) > enc.eps:
+                    return False
+            elif x[i] != xp[i]:
+                return False
+    return True

@@ -1,0 +1,148 @@
+"""Cross-PA verdict coincidence audit (VERDICT r2 ask #5).
+
+Round 2 observed that per-partition verdicts for AC models agree
+bit-for-bit between the PA=sex and PA=race runs (and GC between age/sex on
+its 100%-decided models) — 192,000 coinciding verdicts deserve a measured
+explanation, not silence.  This script quantifies the mechanism:
+
+* **verdict diff** — per-partition agreement counts for every model with
+  ledgers under two PA runs;
+* **PA-sensitivity vs box spread** — per partition, the sampled logit
+  spread over the shared box against the maximum logit shift induced by
+  flipping each PA.  When both PAs' shifts are tiny relative to the box
+  spread, the flip slab's position — hence the verdict — is set by the
+  *shared* geometry, and the two PAs necessarily see the same SAT/UNSAT
+  partition of the grid.
+
+Writes ``audits/cross_pa_r3.json``; ``scripts/parity.py render`` folds the
+summary into PARITY.md (so the explanation survives re-renders).
+
+Usage: python scripts/cross_pa_audit.py [--samples 256] [--parts 1024]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+PAIRS = [
+    # (family, run A, run B, preset, dataset, PA column names)
+    ("AC", "AC-sex", "AC-race", "AC", "adult", ("sex", "race")),
+    ("GC", "GC-age", "GC-sex", "GC", "german", ("age", "sex")),
+]
+
+
+def load_ledger(path):
+    led = {}
+    if not os.path.isfile(path):
+        return led
+    with open(path) as fp:
+        for line in fp:
+            try:
+                r = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            led[r["partition_id"]] = r["verdict"]
+    return led
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--samples", type=int, default=256)
+    ap.add_argument("--parts", type=int, default=1024,
+                    help="partitions sampled per model for the sensitivity stats")
+    ap.add_argument("--out", default="audits/cross_pa_r3.json")
+    args = ap.parse_args()
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fairify_tpu.models import zoo
+    from fairify_tpu.models.mlp import forward
+    from fairify_tpu.verify import presets, sweep
+
+    results = {"models": [], "summary": {}}
+    for family, run_a, run_b, preset, dataset, pa_names in PAIRS:
+        cfg = presets.get(preset)
+        _, lo, hi = sweep.build_partitions(cfg)
+        cols = list(cfg.query().columns)
+        dom = cfg.query().domain
+        # One comprehension keeps name → column → range aligned; a missing
+        # PA name raises here instead of silently shifting the zip.
+        pa_spec = [(n, cols.index(n), dom.ranges[n]) for n in pa_names]
+        dir_a = os.path.join(ROOT, "parity", run_a)
+        dir_b = os.path.join(ROOT, "parity", run_b)
+        if not (os.path.isdir(dir_a) and os.path.isdir(dir_b)):
+            continue
+        models = sorted(
+            f.split(".")[0].split(f"{preset}-", 1)[1]
+            for f in os.listdir(dir_a) if f.endswith(".ledger.jsonl"))
+        rng = np.random.default_rng(7)
+        for model in models:
+            led_a = load_ledger(os.path.join(dir_a, f"{preset}-{model}.ledger.jsonl"))
+            led_b = load_ledger(os.path.join(dir_b, f"{preset}-{model}.ledger.jsonl"))
+            common = sorted(set(led_a) & set(led_b))
+            if not common:
+                continue
+            agree = sum(1 for p in common if led_a[p] == led_b[p])
+            net = zoo.load(dataset, model)
+            P = len(common)
+            pick = rng.choice(P, size=min(args.parts, P), replace=False)
+            idx = np.array([common[i] - 1 for i in sorted(pick)])
+            blo, bhi = lo[idx], hi[idx]
+            S = args.samples
+            shared = rng.integers(blo[:, None, :], bhi[:, None, :] + 1,
+                                  size=(len(idx), S, blo.shape[1])).astype(np.float32)
+            spread = None
+            deltas = {}
+            base = np.asarray(forward(net, jnp.asarray(shared)))
+            spread = base.max(axis=1) - base.min(axis=1)
+            for name, col, (plo, phi) in pa_spec:
+                vals = []
+                for v in range(int(plo), int(phi) + 1):
+                    pts = shared.copy()
+                    pts[..., col] = float(v)
+                    vals.append(np.asarray(forward(net, jnp.asarray(pts))))
+                stack = np.stack(vals)  # (V, P, S)
+                delta = (stack.max(axis=0) - stack.min(axis=0)).max(axis=1)
+                deltas[name] = delta
+            ratios = {name: np.median(d / np.maximum(spread, 1e-9))
+                      for name, d in deltas.items()}
+            results["models"].append({
+                "family": family, "model": model,
+                "runs": [run_a, run_b],
+                "partitions_common": len(common),
+                "verdicts_agree": agree,
+                "median_box_logit_spread": round(float(np.median(spread)), 4),
+                "median_pa_shift": {n: round(float(np.median(d)), 4)
+                                    for n, d in deltas.items()},
+                "median_shift_over_spread": {n: round(float(r), 5)
+                                             for n, r in ratios.items()},
+            })
+            print(json.dumps(results["models"][-1]), flush=True)
+
+    total = sum(m["partitions_common"] for m in results["models"])
+    agree = sum(m["verdicts_agree"] for m in results["models"])
+    ratios = [r for m in results["models"]
+              for r in m["median_shift_over_spread"].values()]
+    results["summary"] = {
+        "partitions_compared": total,
+        "verdicts_agree": agree,
+        "agreement_pct": round(100.0 * agree / max(total, 1), 3),
+        "max_median_shift_over_spread": round(max(ratios), 5) if ratios else None,
+    }
+    out_path = os.path.join(ROOT, args.out)
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as fp:
+        json.dump(results, fp, indent=1)
+    print(json.dumps(results["summary"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -79,9 +79,13 @@ def main() -> int:
         t0 = time.time()
         if rec["native_verdict"] == "sat":
             x, xp = (np.asarray(v, dtype=np.int64) for v in rec["native_ce"])
-            ok = validate_pair(W, B, x, xp)
+            # Well-formedness first (legal pair, in-box), then the exact
+            # strict flip — both are what the certificate claims.
+            legal = exact_check.pair_is_legal(enc, lo[p], hi[p], x, xp)
+            ok = legal and validate_pair(W, B, x, xp)
             out = {"file": rec["file"], "expected": "sat",
                    "result": "witness_confirmed" if ok else "WITNESS_REFUTED",
+                   "legal_pair": bool(legal),
                    "time_s": round(time.time() - t0, 2)}
         else:
             r = exact_check.decide_pair_box_exact(
@@ -128,9 +132,14 @@ def main() -> int:
 
                 mid = ((lo[p] + hi[p]) // 2).astype(np.float64)
                 want_pos = float(forward_np(W, B, mid)) > 0
-                r = exact_check.confirm_sign_certificate(
-                    W, B, lo[p], hi[p], want_positive=want_pos,
-                    max_nodes=4000)
+                # The uniform-sign shortcut only implies pair-UNSAT when
+                # the box itself covers both roles — an RA shift widens the
+                # x' role by ±ε beyond it, so relaxed presets must take the
+                # pair checker.
+                r = {"verdict": "skipped"} if enc.eps else \
+                    exact_check.confirm_sign_certificate(
+                        W, B, lo[p], hi[p], want_positive=want_pos,
+                        max_nodes=4000)
                 method = "sign"
                 if r["verdict"] != "confirmed":
                     r = exact_check.decide_pair_box_exact(

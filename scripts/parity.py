@@ -206,6 +206,46 @@ def cmd_render(args):
             f"| {r['run_id']} | {r['model']} | {ref_cell} | {ours_cell} | "
             f"{ours_spp:.3f} | {ref_spp_cell} | {speed_cell} | {agree} |")
     lines += ["", f"Mismatches: {agree_fail if agree_fail else 'none'}", ""]
+
+    # Cross-PA verdict coincidence: measured explanation (ask r2 #5).
+    xpa_path = os.path.join(ROOT, "audits", "cross_pa_r3.json")
+    if os.path.isfile(xpa_path):
+        with open(xpa_path) as fp:
+            xpa = json.load(fp)
+        s = xpa["summary"]
+        ratios = [r for m in xpa["models"]
+                  for r in m["median_shift_over_spread"].values()]
+        worst = max(xpa["models"],
+                    key=lambda m: max(m["median_shift_over_spread"].values()))
+        fams: dict = {}
+        for m in xpa["models"]:
+            fams.setdefault((m["family"], tuple(m["runs"])), []).append(m)
+        fam_desc = ", ".join(
+            f"{fam} {ra.split('-', 1)[1]}-vs-{rb.split('-', 1)[1]} "
+            f"×{len(ms)} models"
+            for (fam, (ra, rb)), ms in sorted(fams.items()))
+        lines += [
+            "## Cross-PA verdict coincidence (audited)",
+            "",
+            (f"Per-partition verdicts agree across protected-attribute runs "
+             f"on **{s['verdicts_agree']:,} / {s['partitions_compared']:,}** "
+             f"compared partitions ({fam_desc}; "
+             "`audits/cross_pa_r3.json`, `scripts/cross_pa_audit.py`). "
+             "This is a *property of the zoo models*, not an artifact: per "
+             "partition, the logit shift induced by flipping the protected "
+             "attribute is small against the logit spread over the shared "
+             f"box (median shift/spread ratios "
+             f"{min(ratios):.3f}–{max(ratios):.3f} across models, worst "
+             f"{s['max_median_shift_over_spread']:.3f} on "
+             f"{worst['model']}), so the "
+             "flip slab's location — and with it each partition's SAT/UNSAT "
+             "verdict — is fixed by the shared-coordinate geometry that both "
+             "PA runs see identically.  The *witnesses* are genuinely "
+             "PA-specific (a sex run flips the sex dim, a race run the race "
+             "dim), and the reference's own published GC-3/GC-4 rows show "
+             "the same age/sex coincidence (BASELINE.md Table V)."),
+            "",
+        ]
     out = os.path.join(ROOT, "PARITY.md")
     with open(out, "w") as fp:
         fp.write("\n".join(lines))
