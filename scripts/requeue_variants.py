@@ -1,0 +1,83 @@
+"""Re-run variant rows whose attempted prefix still holds UNKNOWNs.
+
+Round-2 rows were recorded before the round-3 engine (LP sign BaB) and
+before the budget-truncation retry pass in ``_sweeplib``; their in-prefix
+UNK counts are stale engine failures.  This driver removes exactly those
+rows (results.jsonl entries + their per-config span ledgers) and re-runs
+them at the same budget tier, so the re-rendered VARIANTS.md compares like
+budgets with the current engine.
+
+Usage: python scripts/requeue_variants.py [--out variants] [--exclude
+       stress-AC:AC-3,...]  (excluded rows are left for a deeper tier)
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "scripts"))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="variants")
+    ap.add_argument("--exclude", default="",
+                    help="comma list of preset:model rows to leave alone")
+    ap.add_argument("--max-rows", type=int, default=10000)
+    args = ap.parse_args()
+
+    from _sweeplib import run_and_record_budgeted
+    from fairify_tpu.verify import presets
+
+    excl = set(tuple(x.split(":")) for x in args.exclude.split(",") if x)
+    results_path = os.path.join(args.out, "results.jsonl")
+    with open(results_path) as fp:
+        recs = [json.loads(line) for line in fp]
+
+    # Latest record per (run, model, budget) wins; requeue rows with UNK.
+    latest = {}
+    for r in recs:
+        if "skipped" in r or "attempted" not in r:
+            continue
+        latest[(r["run_id"], r["model"], r["soft_s"], r["hard_s"])] = r
+    todo = [k for k, r in latest.items()
+            if r["unknown"] > 0 and (k[0], k[1]) not in excl]
+    todo = todo[: args.max_rows]
+    print(f"{len(todo)} rows to requeue", flush=True)
+
+    keep = [r for r in recs
+            if not (("attempted" in r) and "skipped" not in r
+                    and (r["run_id"], r["model"], r["soft_s"], r["hard_s"]) in set(todo))]
+    with open(results_path, "w") as fp:
+        for r in keep:
+            fp.write(json.dumps(r) + "\n")
+
+    by_cfg: dict = {}
+    for run_id, model, soft, hard in todo:
+        # Remove the stale span artifacts so the re-run re-decides:
+        # ledgers are "{cfg.name}-{model}@{span}.ledger.jsonl", CSVs are
+        # span-qualified sink names "{model}@{span}[.csv|-counterexamples
+        # .csv]" (sweep.verify_model with partition_span).
+        led_dir = os.path.join(args.out, run_id, f"b{soft:g}-{hard:g}")
+        for p in glob.glob(os.path.join(led_dir, f"*-{model}@*")):
+            os.remove(p)
+        for p in glob.glob(os.path.join(led_dir, f"{model}@*")):
+            os.remove(p)
+        by_cfg.setdefault((run_id, soft, hard), []).append(model)
+
+    for (run_id, soft, hard), models in sorted(by_cfg.items()):
+        cfg = presets.get(run_id).with_(
+            soft_timeout_s=soft, hard_timeout_s=hard,
+            result_dir=os.path.join(args.out, run_id))
+        run_and_record_budgeted(cfg, run_id, results_path,
+                                model_filter=set(models))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
