@@ -88,9 +88,40 @@ def main() -> None:
         lines.append("| *(no records yet)* | | | | | | | |")
     else:
         worst.sort(reverse=True)
-        lines += ["",
-                  "Worst s/part rows: " + ", ".join(
-                      f"{name} ({spp:.2f}s)" for spp, name in worst[:5]) + "."]
+        lines += [
+            "",
+            "Worst s/part rows: " + ", ".join(
+                f"{name} ({spp:.2f}s)" for spp, name in worst[:5]) + ".",
+            "",
+            "Outlier s/part rows are artifacts of tiny denominators, not "
+            "slow kernels: UNKNOWN-retry passes re-enter a model to decide "
+            "a handful of leftover partitions (full stage-0 amortized over "
+            "single-digit newly-decided counts), and the first model of an "
+            "architecture in a cold process pays one-time XLA compile "
+            "(tens of seconds over a tunnelled link).  Whole-grid rows for "
+            "the same architectures run orders of magnitude faster per "
+            "partition (see the main table).",
+        ]
+
+    # Multi-device scaling record (audits/scaling_r3.json, scripts/scaling.py).
+    sc_path = os.path.join(ROOT, "audits", "scaling_r3.json")
+    if os.path.isfile(sc_path):
+        sc = json.load(open(sc_path))
+        lines += [
+            "",
+            "## Multi-device sharding record (virtual CPU mesh)",
+            "",
+            f"Kernel: {sc['kernel']}; grid: {sc['grid']}.  " + sc["caveat"],
+            "",
+            "| Devices | Parts/device | Wall (s) | Overhead vs 1 dev | "
+            "Decided (invariant) |",
+            "|---|---|---|---|---|",
+        ]
+        for r in sc["rows"]:
+            lines.append(
+                f"| {r['devices']} | {r['parts_per_device']} | "
+                f"{r['best_s']:.2f} | {r['overhead_vs_1dev']:.2f}× | "
+                f"{r['decided']} |")
     with open(args.out, "w") as fp:
         fp.write("\n".join(lines) + "\n")
     print(f"wrote {args.out} ({len(rows)} rows)")

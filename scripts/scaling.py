@@ -1,0 +1,128 @@
+"""Multi-device weak-scaling record for the stage-0 kernels (VERDICT r2 #6).
+
+Real multi-chip hardware is not reachable from this environment (one
+tunnelled chip), so the only honest multi-device *throughput* evidence is
+the virtual CPU mesh the sharding tests already use: this script times the
+stage-0 certify+attack pass (the sweep's dominant whole-grid kernel) on a
+fixed grid across 1/2/4/8 virtual devices and records throughput and
+parallel efficiency into ``audits/scaling_r3.json``, which
+``scripts/perf_table.py`` renders into PERF.md.
+
+Each device count runs in a fresh subprocess: the XLA device count is a
+process-level flag (``xla_force_host_platform_device_count``) that must be
+set before backend init.  Same-verdict invariance across mesh sizes is
+separately asserted by ``tests/test_mesh.py``; this script measures speed
+only.
+
+Usage: python scripts/scaling.py [--parts 4096] [--model GC-1] [--reps 3]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = r"""
+import json, os, sys, time
+sys.path.insert(0, {root!r})
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from fairify_tpu.models import zoo
+from fairify_tpu.parallel import mesh as mesh_mod
+from fairify_tpu.verify import presets, sweep
+from fairify_tpu.verify.property import encode
+
+n_dev = {n_dev}
+cfg = presets.get("stress-GC").with_(grid_chunk=0)
+net = zoo.load(cfg.dataset, {model!r})
+enc = encode(cfg.query())
+_, lo, hi = sweep.build_partitions(cfg)
+lo, hi = lo[: {parts}], hi[: {parts}]
+mesh = mesh_mod.make_mesh(n_parts=n_dev)
+# Warmup (compile) then timed reps.
+sweep._stage0_certify_and_attack(net, enc, lo, hi, cfg, mesh=mesh)
+times = []
+for _ in range({reps}):
+    t0 = time.perf_counter()
+    unsat, sat, wit = sweep._stage0_certify_and_attack(
+        net, enc, lo, hi, cfg, mesh=mesh)
+    times.append(time.perf_counter() - t0)
+print(json.dumps({{
+    "devices": n_dev,
+    "parts": int(lo.shape[0]),
+    "best_s": round(min(times), 4),
+    "parts_per_sec": round(lo.shape[0] / min(times), 1),
+    "decided": int(np.sum(unsat) + np.sum(sat)),
+}}))
+"""
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--parts", type=int, default=4096)
+    ap.add_argument("--model", default="GC-1")
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--out", default="audits/scaling_r3.json")
+    args = ap.parse_args()
+
+    rows = []
+    for n_dev in (1, 2, 4, 8):
+        env = dict(os.environ)
+        env.update({
+            "PYTHONPATH": "",
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": f"--xla_force_host_platform_device_count={n_dev}",
+        })
+        code = _CHILD.format(root=ROOT, n_dev=n_dev, parts=args.parts,
+                             model=args.model, reps=args.reps)
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True, timeout=1800)
+        line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+        if not line.startswith("{"):
+            print(f"devices={n_dev} FAILED:\n{out.stderr[-2000:]}",
+                  file=sys.stderr)
+            return 1
+        rec = json.loads(line)
+        rows.append(rec)
+        print(json.dumps(rec), flush=True)
+    base = rows[0]
+    for r in rows:
+        r["parts_per_device"] = r["parts"] // r["devices"]
+        r["overhead_vs_1dev"] = round(r["best_s"] / base["best_s"], 3)
+    verdict_invariant = len({r["decided"] for r in rows}) == 1
+    result = {
+        "kernel": "stage0 certify+attack (CROWN role bounds + tied-diff + "
+                  "sampling attack)",
+        "grid": f"stress-GC prefix, {args.parts} partitions, model {args.model}",
+        "platform": "virtual CPU mesh (xla_force_host_platform_device_count; "
+                    "single host)",
+        "caveat": (
+            "Virtual devices SHARE one host's physical cores, so wall-clock "
+            "speedup is structurally unobservable here — N virtual devices "
+            "run N shards on the same silicon, and the measured slowdown is "
+            "the cost of smaller per-shard batches plus collective overhead "
+            "on shared cores.  What this record demonstrates: the sharded "
+            "stage-0 path executes at every mesh size, per-device work "
+            "shrinks proportionally (the actual multi-chip scaling "
+            "mechanism: each real chip would get parts/N boxes and its own "
+            "MXU), and the decided-verdict set is mesh-size invariant "
+            "(also asserted by tests/test_mesh.py)."),
+        "verdicts_mesh_invariant": verdict_invariant,
+        "rows": rows,
+    }
+    out_path = os.path.join(ROOT, args.out)
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as fp:
+        json.dump(result, fp, indent=1)
+    print(f"wrote {out_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
