@@ -17,6 +17,10 @@ import sys
 import time
 
 REFERENCE_PARTITIONS_PER_SEC = 46 / (46 * 43.19)  # GC1/Age, Table V
+# Reference per-family decided-partition rates (BASELINE.md Table V, mean
+# s/part over a family's rows → partitions/sec on the reference CPU):
+REF_PPS_AC = 0.00917   # 24 AC rows, mean 109.05 s/part
+REF_PPS_BM = 0.0398    # 8 BM rows, mean 25.13 s/part
 
 
 def _probe_ok() -> bool:
@@ -75,6 +79,14 @@ def main() -> None:
     except Exception:
         pass
 
+    # --- Promotion-ladder configs (BASELINE.json "configs"): one JSON line
+    # each, printed BEFORE the headline (the driver parses the last line).
+    try:
+        _ladder_configs()
+    except Exception as exc:  # a ladder failure must never kill the headline
+        print(json.dumps({"metric": "ladder_error", "error": str(exc)[:200]}),
+              file=sys.stderr)
+
     t0 = time.perf_counter()
     report = sweep.verify_model(net, cfg, model_name="GC-1", resume=False)
     elapsed = time.perf_counter() - t0
@@ -89,6 +101,86 @@ def main() -> None:
         "unit": "partitions/sec",
         "vs_baseline": round(pps / REFERENCE_PARTITIONS_PER_SEC, 2),
     }))
+
+
+def _ladder_configs() -> None:
+    """The remaining BASELINE.json ladder configs, one JSON line each.
+
+    * AC suite — the 12 shipped adult models as ONE stacked pytree, stage-0
+      certify+attack vmapped over the model axis on the full 16k grid
+      (``sweep._stage0_family``); metric = stage-0-decided
+      model-partitions/sec (the suite's dominant kernel).
+    * stress-BM / relaxed-AC — 60 s budgeted prefixes at reference
+      attempt-until-budget semantics (``_sweeplib.budgeted_model_sweep``).
+
+    vs_baseline uses the family's mean Table V s/part (the reference has no
+    published stress/relaxed tables; its base-family CPU rate is the
+    closest like-for-like denominator, noted in the metric strings).
+    """
+    import os
+
+    import numpy as np
+
+    from fairify_tpu.models import zoo
+    from fairify_tpu.parallel.mesh import stack_models
+    from fairify_tpu.verify import presets, sweep
+    from fairify_tpu.verify.property import encode
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    "scripts"))
+    from _sweeplib import budgeted_model_sweep
+
+    # AC 12-model vmap suite (stacked per architecture group, the same
+    # grouping run_sweep uses — the zoo's AC nets span several depths).
+    cfg = presets.get("AC").with_(result_dir="/tmp/fairify_tpu_bench_ac")
+    nets, _ = zoo.load_matching("adult", len(cfg.query().columns))
+    names = sorted(nets)
+    enc = encode(cfg.query())
+    _, lo, hi = sweep.build_partitions(cfg)
+    from collections import defaultdict
+
+    groups = defaultdict(list)
+    for n in names:
+        groups[(nets[n].in_dim,) + nets[n].layer_sizes].append(n)
+    stacks = [stack_models([nets[n] for n in g]) for g in groups.values()]
+    for st in stacks:  # warm/compile pass per architecture
+        sweep._stage0_family(st, enc, lo[:2048], hi[:2048], cfg)
+    t0 = time.perf_counter()
+    decided = 0
+    for st in stacks:
+        fam = sweep._stage0_family(st, enc, lo, hi, cfg)
+        decided += int(sum((u | s).sum() for u, s, _ in fam))
+    dt = time.perf_counter() - t0
+    pps = decided / dt
+    print(json.dumps({
+        "metric": f"ac_suite_vmap_stage0_decided_model_partitions_per_sec "
+                  f"({len(names)} adult models x {lo.shape[0]} partitions, "
+                  f"decided {decided}; baseline = Table V AC mean s/part)",
+        "value": round(pps, 1),
+        "unit": "model-partitions/sec",
+        "vs_baseline": round(pps / REF_PPS_AC, 1),
+    }), flush=True)
+
+    # Budgeted variant prefixes (stress-BM mesh-analog + relaxed-eps).
+    for preset, model, ref_pps in (("stress-BM", "BM-1", REF_PPS_BM),
+                                   ("relaxed-AC", "AC-1", REF_PPS_AC)):
+        vcfg = presets.get(preset).with_(
+            soft_timeout_s=100.0, hard_timeout_s=60.0,
+            result_dir=f"/tmp/fairify_tpu_bench_{preset}")
+        import shutil
+
+        shutil.rmtree(vcfg.result_dir, ignore_errors=True)
+        net = zoo.load(vcfg.dataset, model)
+        row = budgeted_model_sweep(vcfg, net, model)
+        print(json.dumps({
+            "metric": f"{preset}_budgeted_decided_partitions_per_sec "
+                      f"({model}, 60s budget, attempted {row['attempted']} "
+                      f"of {row['partitions']}, unk {row['unknown']}; "
+                      f"baseline = Table V family mean s/part)",
+            "value": row["decided_per_sec"],
+            "unit": "partitions/sec",
+            "vs_baseline": round(row["decided_per_sec"] / ref_pps, 1),
+        }), flush=True)
 
 
 if __name__ == "__main__":
