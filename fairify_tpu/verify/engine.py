@@ -889,6 +889,15 @@ class EngineConfig:
     lp_sign: bool = True
     lp_sign_max_unstable: int = 64
     lp_sign_max_nodes: int = 4000
+    # Phase P: relational pair LP BaB (ops.lp.pair_bab_lp) for roots the
+    # input-split BaB leaves unknown — the certificate for boxes whose
+    # role logits straddle zero but track each other (ε-relaxed AC-7
+    # class).  lp_pair_frac of the deadline is reserved for it;
+    # max_dirs caps the assignment-pair fan-out per root.
+    lp_pair: bool = True
+    lp_pair_frac: float = 0.25
+    lp_pair_max_nodes: int = 800
+    lp_pair_max_dirs: int = 32
 
 
 @dataclass
@@ -1000,13 +1009,22 @@ def decide_many(
     open_boxes = np.ones(R, dtype=np.int64)  # root boxes still in the frontier
     cost_s = np.zeros(R, dtype=np.float64)  # per-root attributed batch time
 
+    # Phase P reserves the deadline tail: hard roots the input-split BaB
+    # cannot crack would otherwise eat the whole budget and leave nothing
+    # for the relational certificate that can.
+    n_dirs = int(enc.valid_pair.sum())
+    use_pair = (cfg.lp_pair and len(enc.pa_idx)
+                and 0 < n_dirs <= cfg.lp_pair_max_dirs)
+    main_deadline = deadline_s * (1.0 - cfg.lp_pair_frac) if use_pair \
+        else deadline_s
+
     def settle(r: int, verdict: str, ce=None):
         if verdicts[r] is None:
             verdicts[r] = verdict
             ces[r] = ce
 
     while frontier:
-        timed_out = (time.perf_counter() - t0) > deadline_s
+        timed_out = (time.perf_counter() - t0) > main_deadline
         if timed_out:
             for _, _, r in frontier:
                 settle(r, "unknown")
@@ -1142,12 +1160,96 @@ def decide_many(
         if verdicts[r] is None:
             settle(r, "unsat" if open_boxes[r] == 0 else "unknown")
 
+    if use_pair and any(v == "unknown" for v in verdicts):
+        _pair_lp_phase(net, enc, roots_lo, roots_hi, verdicts, ces,
+                       nodes, cost_s, cfg, t0, deadline_s)
+
     return [
         Decision(verdicts[r], ces[r],
                  nodes=int(nodes[r] + sign_nodes[r]), leaves=int(leaves[r]),
                  elapsed_s=float(cost_s[r] + sign_cost[r]))
         for r in range(R)
     ]
+
+
+def _pair_lp_phase(net, enc, roots_lo, roots_hi, verdicts, ces,
+                   nodes, cost_s, cfg, t0, deadline_s):
+    """Phase P: relational pair LP BaB over the roots still unknown.
+
+    Per root: CROWN pre-activation bounds for every assignment's role box
+    in one device launch, then one host LP BaB per valid ordered pair
+    (f_a > 0 ∧ f_b < 0).  Every direction killed → UNSAT; an exact-
+    validated witness → SAT; any direction left open → stays unknown.
+    """
+    from fairify_tpu.ops import lp as lp_ops
+    from fairify_tpu.verify.property import role_boxes
+
+    host_w = [np.asarray(w) for w in net.weights]
+    host_b = [np.asarray(b) for b in net.biases]
+    host_m = [np.asarray(m) for m in net.masks]
+    pending = [r for r, v in enumerate(verdicts) if v == "unknown"]
+    for r in pending:
+        remaining = deadline_s - (time.perf_counter() - t0)
+        if remaining <= 1.0:
+            break
+        t_r = time.perf_counter()
+        lo_r = np.asarray(roots_lo[r], dtype=np.int64)
+        hi_r = np.asarray(roots_hi[r], dtype=np.int64)
+        x_lo, x_hi, xp_lo, xp_hi, valid = role_boxes(
+            enc, lo_r[None].astype(np.float32), hi_r[None].astype(np.float32))
+        V = enc.n_assign
+        boxes_lo = np.concatenate([x_lo[0], xp_lo[0]], axis=0)
+        boxes_hi = np.concatenate([x_hi[0], xp_hi[0]], axis=0)
+        wl, wu = _inter_bounds_kernel(
+            net, jnp.asarray(boxes_lo), jnp.asarray(boxes_hi))
+        wl = [np.asarray(w) for w in wl]
+        wu = [np.asarray(w) for w in wu]
+        nh = net.depth - 1
+
+        def bounds_of(role_off, a):
+            return ([wl[k][role_off + a] for k in range(nh)],
+                    [wu[k][role_off + a] for k in range(nh)])
+
+        outcome = "unsat"
+        witness = None
+        # With an RA shift both flip directions must be solved per ordered
+        # pair: the shift stays attached to tower b, so the swapped pair is
+        # NOT the mirror (its witness may need the out-of-box ε band).
+        directions = (False,) if not enc.eps else (False, True)
+        for a in range(V):
+            if not valid[0, a]:
+                continue
+            for b2 in range(V):
+                if not (valid[0, b2] and enc.valid_pair[a, b2]):
+                    continue
+                for flip in directions:
+                    rem = deadline_s - (time.perf_counter() - t0)
+                    if rem <= 0.5:
+                        outcome = "open"
+                        break
+                    status, n_lp, wit = lp_ops.pair_bab_lp(
+                        host_w, host_b, host_m, enc, lo_r, hi_r,
+                        enc.assignments[a], enc.assignments[b2],
+                        bounds_of(0, a), bounds_of(V, b2),
+                        max_nodes=cfg.lp_pair_max_nodes,
+                        deadline_s=min(cfg.soft_timeout_s, rem), flip=flip)
+                    nodes[r] += n_lp
+                    if status == "sat":
+                        outcome, witness = "sat", wit
+                        break
+                    if status == "open":
+                        outcome = "open"
+                        break
+                if outcome in ("sat", "open"):
+                    break
+            if outcome in ("sat", "open"):
+                break
+        cost_s[r] += time.perf_counter() - t_r
+        if outcome == "unsat":
+            verdicts[r] = "unsat"
+        elif outcome == "sat":
+            verdicts[r] = "sat"
+            ces[r] = witness
 
 
 def decide_box(

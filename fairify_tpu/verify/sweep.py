@@ -180,6 +180,40 @@ def _stage0_block(net, enc: PairEncoding, lo, hi, cfg: SweepConfig, mesh, rng_se
     return unsat, sat, witnesses
 
 
+@partial(jax.jit, static_argnames=("alpha_iters",))
+def _family_certify_kernel(stacked, a, b, c, d, plo, phi, av, pm, rm, eps,
+                           va, vp, alpha_iters):
+    """vmapped stage-0 combined certificate over a stacked model family.
+
+    Module-level (not a closure inside ``_stage0_family``): per-chunk
+    recursive calls and repeated invocations must hit one jit cache —
+    locally-defined wrappers start with an empty cache every call and
+    re-pay retrace+compile per chunk."""
+    from fairify_tpu.models.mlp import MLP
+
+    return jax.vmap(
+        lambda net: engine._certify_impl(
+            net, a, b, c, d, plo, phi, av, pm, rm, eps, va, vp, alpha_iters)
+    )(MLP(stacked.weights, stacked.biases, stacked.masks))
+
+
+@jax.jit
+def _family_bounds_kernel(stacked, a, b, c, d, use_crown):
+    from fairify_tpu.models.mlp import MLP
+
+    return jax.vmap(
+        lambda net: engine._role_logit_bounds.__wrapped__(net, a, b, c, d, use_crown)
+    )(MLP(stacked.weights, stacked.biases, stacked.masks))
+
+
+@jax.jit
+def _family_logits_kernel(stacked, xr, pr):
+    from fairify_tpu.models.mlp import MLP, forward
+
+    net = MLP(stacked.weights, stacked.biases, stacked.masks)
+    return jax.vmap(lambda n: (forward(n, xr), forward(n, pr)))(net)
+
+
 def _stage0_family(stacked, enc: PairEncoding, lo, hi, cfg: SweepConfig, mesh=None):
     """Stage 0 for a whole same-architecture model family in one kernel.
 
@@ -225,15 +259,7 @@ def _stage0_family(stacked, enc: PairEncoding, lo, hi, cfg: SweepConfig, mesh=No
     if cfg.engine.use_crown:
         assign_vals, pa_mask, ra_mask = engine._enc_tensors(enc, lo.shape[1])
 
-        @partial(jax.jit, static_argnames=("alpha_iters",))
-        def family_certify(stacked, a, b, c, d, plo, phi, av, pm, rm, eps, va, vp,
-                           alpha_iters):
-            return jax.vmap(
-                lambda net: engine._certify_impl(
-                    net, a, b, c, d, plo, phi, av, pm, rm, eps, va, vp, alpha_iters)
-            )(MLP(stacked.weights, stacked.biases, stacked.masks))
-
-        cert, _ = family_certify(
+        cert, _ = _family_certify_kernel(
             stacked, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
             jnp.asarray(xp_hi), jnp.asarray(plo), jnp.asarray(phi),
             jnp.asarray(assign_vals), jnp.asarray(pa_mask), jnp.asarray(ra_mask),
@@ -242,14 +268,7 @@ def _stage0_family(stacked, enc: PairEncoding, lo, hi, cfg: SweepConfig, mesh=No
         )
         unsat_all = np.asarray(cert)[:, : lo.shape[0]]
     else:
-
-        @jax.jit
-        def family_bounds(stacked, a, b, c, d, use_crown):
-            return jax.vmap(
-                lambda net: engine._role_logit_bounds.__wrapped__(net, a, b, c, d, use_crown)
-            )(MLP(stacked.weights, stacked.biases, stacked.masks))
-
-        lb_x, ub_x, lb_p, ub_p = family_bounds(
+        lb_x, ub_x, lb_p, ub_p = _family_bounds_kernel(
             stacked, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
             jnp.asarray(xp_hi), cfg.engine.use_crown,
         )
@@ -262,12 +281,7 @@ def _stage0_family(stacked, enc: PairEncoding, lo, hi, cfg: SweepConfig, mesh=No
     rng = np.random.default_rng(cfg.engine.seed)
     xr, pr = engine.build_attack_candidates(enc, rng, lo, hi, cfg.engine.attack_samples)
 
-    @jax.jit
-    def family_logits(stacked, xr, pr):
-        net = MLP(stacked.weights, stacked.biases, stacked.masks)
-        return jax.vmap(lambda n: (forward(n, xr), forward(n, pr)))(net)
-
-    lx, lp = family_logits(stacked, jnp.asarray(xr), jnp.asarray(pr))
+    lx, lp = _family_logits_kernel(stacked, jnp.asarray(xr), jnp.asarray(pr))
     lx, lp = np.asarray(lx), np.asarray(lp)
 
     results = []

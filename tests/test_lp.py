@@ -13,6 +13,9 @@ import pytest
 from fairify_tpu.models import mlp
 from fairify_tpu.ops import crown as crown_ops
 from fairify_tpu.ops import lp as lp_ops
+from fairify_tpu.verify import property as prop
+
+from test_bab2 import tiny_domain  # noqa: F401 (oracle reuse)
 
 
 def crown_pre_bounds(net, lo, hi):
@@ -128,6 +131,52 @@ def test_negative_sign_path():
     outcome, _ = run_bab(net, np.array([0.0]), np.array([6.0]),
                          want_positive=False)
     assert outcome == "certified"
+
+
+def test_pair_bab_lp_flip_direction_with_ra_shift():
+    """Review repro (same class as the exact-checker's): with an RA shift
+    the mirrored flip lives in the out-of-box ε band only tower b reaches,
+    so direction 1 is killed and ONLY flip=True finds the witness.
+
+    f = ra − 4.5 over ra ∈ [0, 4], ε = 1: x = (·, ra=4) < 0 and
+    x' = (·, ra=5) > 0."""
+    import jax.numpy as jnp
+
+    from fairify_tpu.ops import crown as crown_ops
+
+    ws = [np.array([[0.0], [0.0], [1.0]], dtype=np.float32),
+          np.array([[1.0]], dtype=np.float32)]
+    bs = [np.array([0.0], dtype=np.float32), np.array([-4.5], dtype=np.float32)]
+    net = mlp.from_numpy(ws, bs)
+    dom = tiny_domain({"a": (0, 1), "pa": (0, 1), "ra": (0, 4)})
+    query = prop.FairnessQuery(domain=dom, protected=("pa",),
+                               relaxed=("ra",), relax_eps=1)
+    enc = prop.encode(query)
+    lo, hi = dom.lo_hi()
+    lo, hi = lo.astype(np.int64), hi.astype(np.int64)
+    x_lo, x_hi, xp_lo, xp_hi, valid = prop.role_boxes(
+        enc, lo[None].astype(np.float32), hi[None].astype(np.float32))
+
+    def pre_bounds(blo, bhi):
+        b = crown_ops.crown_bounds(net, jnp.asarray(blo), jnp.asarray(bhi))
+        return ([np.asarray(x)[0] for x in b.ws_lb[:-1]],
+                [np.asarray(x)[0] for x in b.ws_ub[:-1]])
+
+    W = [np.asarray(w) for w in net.weights]
+    B = [np.asarray(b) for b in net.biases]
+    M = [np.asarray(m) for m in net.masks]
+    ba = pre_bounds(x_lo[0, 0][None], x_hi[0, 0][None])
+    bb = pre_bounds(xp_lo[0, 1][None], xp_hi[0, 1][None])
+    st1, _, _ = lp_ops.pair_bab_lp(W, B, M, enc, lo, hi,
+                                   enc.assignments[0], enc.assignments[1],
+                                   ba, bb, flip=False)
+    assert st1 == "killed"  # f ≥ 0 impossible inside the box
+    st2, _, wit = lp_ops.pair_bab_lp(W, B, M, enc, lo, hi,
+                                     enc.assignments[0], enc.assignments[1],
+                                     ba, bb, flip=True)
+    assert st2 == "sat" and wit is not None
+    x, xp = wit
+    assert xp[2] == 5  # the witness uses the out-of-box ε band
 
 
 def test_forced_inactive_infeasible_region():
