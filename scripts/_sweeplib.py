@@ -277,13 +277,16 @@ def run_and_record_budgeted(cfg, run_id: str, results_path: str,
 
         from fairify_tpu.models import mlp as mlp_mod
 
+        import jax
+
         pred = np.asarray(mlp_mod.predict(
             nets[name], jnp.asarray(dataset.X_test, jnp.float32)))
         rec = {"run_id": run_id,
                **budgeted_model_sweep(cfg, nets[name], name, dataset=dataset),
                "original_acc": round(float((pred.astype(int) == dataset.y_test).mean()), 4),
                "soft_s": cfg.soft_timeout_s, "hard_s": cfg.hard_timeout_s,
-               "cap": cfg.max_partitions if cfg.capped_partitions else None}
+               "cap": cfg.max_partitions if cfg.capped_partitions else None,
+               "platform": jax.devices()[0].platform}
         recs.append(rec)
         with open(results_path, "a") as fp:
             fp.write(json.dumps(rec) + "\n")

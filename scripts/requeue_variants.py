@@ -28,6 +28,9 @@ def main() -> int:
     ap.add_argument("--out", default="variants")
     ap.add_argument("--exclude", default="",
                     help="comma list of preset:model rows to leave alone")
+    ap.add_argument("--presets", default="",
+                    help="comma list restricting which presets to requeue "
+                         "(parallel workers split the preset space)")
     ap.add_argument("--max-rows", type=int, default=10000)
     args = ap.parse_args()
 
@@ -45,8 +48,10 @@ def main() -> int:
         if "skipped" in r or "attempted" not in r:
             continue
         latest[(r["run_id"], r["model"], r["soft_s"], r["hard_s"])] = r
+    wanted = set(args.presets.split(",")) if args.presets else None
     todo = [k for k, r in latest.items()
-            if r["unknown"] > 0 and (k[0], k[1]) not in excl]
+            if r["unknown"] > 0 and (k[0], k[1]) not in excl
+            and (wanted is None or k[0] in wanted)]
     todo = todo[: args.max_rows]
     print(f"{len(todo)} rows to requeue", flush=True)
 
