@@ -55,6 +55,49 @@ def test_budgeted_prefix_and_ledger_dirs(tmp_path):
         not list((tmp_path / "out" / "b2-0").glob("*.ledger.jsonl"))
 
 
+def test_retry_span_unknowns_merges_and_counts_once(tmp_path):
+    """The soft-budget retry pass merges ALL span ledgers decided-wins
+    first (a pid any overlapping span decided is never re-counted),
+    re-decides exactly the still-unknown pids, and appends the new
+    verdicts to ONE span ledger tagged ``retry: soft`` (the glob-sorted
+    last, which for this two-span fixture is the 64-128 file)."""
+    import json
+
+    net = init_mlp((20, 6, 1), seed=1)
+    # Generous per-partition soft budget: the deadline passed to
+    # decide_many is soft_timeout_s * n_unknown and includes cold-JIT
+    # compile, so the default 2 s would make this assertion machine-speed
+    # dependent (see test_budgeted_prefix_and_ledger_dirs's note).
+    cfg = _cfg(tmp_path, 600.0).with_(soft_timeout_s=60.0)
+    os.makedirs(cfg.result_dir, exist_ok=True)
+    led_a = os.path.join(cfg.result_dir, f"{cfg.name}-m@0-64.ledger.jsonl")
+    led_b = os.path.join(cfg.result_dir, f"{cfg.name}-m@64-128.ledger.jsonl")
+    with open(led_a, "w") as fp:
+        fp.write('{"partition_id": 1, "verdict": "sat"}\n')
+        fp.write('{"partition_id": 2, "verdict": "unknown"}\n')
+        fp.write('{"partition_id": 3, "verdict": "unknown"}\n')
+    with open(led_b, "w") as fp:
+        # pid 3 was decided by a crashed run's overlapping span: the merge
+        # must treat it as settled even though ledger A holds it unknown.
+        fp.write('{"partition_id": 3, "verdict": "unsat"}\n')
+        fp.write('{"partition_id": 70, "verdict": "unknown"}\n')
+
+    fixed = _sweeplib.retry_span_unknowns(cfg, net, "m", budget_s=60.0)
+
+    # A 6-neuron net decides instantly: both genuine unknowns get verdicts.
+    assert sum(fixed.values()) == 2
+    retried = {}
+    with open(led_b) as fp:
+        for line in fp:
+            rec = json.loads(line)
+            if rec.get("retry") == "soft":
+                retried[rec["partition_id"]] = rec["verdict"]
+    assert set(retried) == {2, 70}
+    assert all(v in ("sat", "unsat") for v in retried.values())
+    # Ledger A untouched: the retry appends to one sink only.
+    assert sum(1 for _ in open(led_a)) == 3
+
+
 def test_config_key_distinguishes_budgets(tmp_path):
     results = tmp_path / "results.jsonl"
     with open(results, "w") as fp:
