@@ -184,7 +184,7 @@ def budgeted_model_sweep(cfg, net, model_name: str, dataset=None):
 
 
 def retry_span_unknowns(cfg, net, model_name: str, budget_s: float,
-                        grid=None) -> dict:
+                        grid=None, return_residual: bool = False):
     """Soft-timeout re-decision of a budgeted sweep's in-prefix UNKNOWNs.
 
     Merges every span ledger of the model under this config FIRST — a
@@ -196,7 +196,11 @@ def retry_span_unknowns(cfg, net, model_name: str, budget_s: float,
     verdicts to one ledger (last-wins merge on resume).  ``grid`` lets the
     caller pass its already-built (lo, hi) (the stress grids reach 3.3M
     boxes; rebuilding them here would double that cost).  Returns
-    ``{"sat": n, "unsat": n}`` fixed counts, each pid counted once.
+    ``{"sat": n, "unsat": n}`` fixed counts, each pid counted once; with
+    ``return_residual`` also the pre-retry residual-unknown count, so a
+    caller can tell "nothing to retry / no ledgers found" (residual 0 —
+    a no-op that must not be recorded as a deep pass) from a genuine
+    attempt.
     """
     import glob
 
@@ -210,6 +214,13 @@ def retry_span_unknowns(cfg, net, model_name: str, budget_s: float,
     else:
         lo, hi = grid
     enc = encode(cfg.query())
+    # The per-root LP/BaB deadlines inside decide_many run off the ENGINE
+    # config's soft budget; sync it to the sweep-level soft budget exactly
+    # like sweep.verify_model does, so an escalated cfg.soft_timeout_s
+    # (deep_retry_variants.py) actually reaches the engine phases.
+    from dataclasses import replace as _replace
+
+    eng = _replace(cfg.engine, soft_timeout_s=cfg.soft_timeout_s)
     t0 = time.perf_counter()
     fixed = {"sat": 0, "unsat": 0}
     paths = sorted(glob.glob(os.path.join(
@@ -221,7 +232,7 @@ def retry_span_unknowns(cfg, net, model_name: str, budget_s: float,
             (decided if rec["verdict"] != "unknown" else unknown).add(pid)
     unk = sorted(unknown - decided)
     if not unk or not paths:
-        return fixed
+        return (fixed, 0) if return_residual else fixed
     sink = paths[-1]
     for start in range(0, len(unk), 2048):
         blk = unk[start:start + 2048]
@@ -230,7 +241,7 @@ def retry_span_unknowns(cfg, net, model_name: str, budget_s: float,
             break
         idx = np.array([p - 1 for p in blk])
         decisions = engine.decide_many(
-            net, enc, lo[idx], hi[idx], cfg.engine,
+            net, enc, lo[idx], hi[idx], eng,
             deadline_s=min(left, cfg.soft_timeout_s * len(idx)))
         with open(sink, "a") as fp:
             for pid, dec in zip(blk, decisions):
@@ -242,8 +253,12 @@ def retry_span_unknowns(cfg, net, model_name: str, budget_s: float,
                     "partition_id": int(pid), "verdict": dec.verdict,
                     "ce": ([ce[0].tolist(), ce[1].tolist()] if ce else None),
                     "time_s": round(dec.elapsed_s, 4), "retry": "soft",
+                    # Effective per-partition budget of THIS decision — a
+                    # deep-tier re-decision must stay distinguishable from
+                    # base-tier retries at the ledger level too.
+                    "soft_s": cfg.soft_timeout_s,
                 }) + "\n")
-    return fixed
+    return (fixed, len(unk)) if return_residual else fixed
 
 
 def run_and_record_budgeted(cfg, run_id: str, results_path: str,
