@@ -1,0 +1,415 @@
+"""Phase E — exhaustive integer-lattice decision for finite boxes.
+
+The reference's Z3 query ranges over the *integer lattice* of the partition
+box (``ToReal(Int x)`` inputs, ``src/GC/Verify-GC.py:128-143``), so the pair
+property is decidable by finite enumeration.  The engine's input-split BaB
+diverges on exactly one box class: wide flip-slab boxes whose logit surface
+crosses zero throughout (millions of nodes without convergence, e.g.
+stress-AC box 768 on AC-1 — a 33M-point shared lattice the BaB burned 3.4M
+nodes on).  For those boxes enumeration on the MXU is *cheap*: a 16-8-1 net
+over the full lattice is a handful of batched forward launches.
+
+Tunnel-aware layout (the single-chip TPU sits behind a ~MB/s relay):
+coordinates are decoded from flat indices **on device** (mixed-radix over
+the shared dims, static per-dim gather — no scatter, which stalled XLA's
+compiler for minutes), all PA assignments are evaluated in one vmapped
+kernel, and flip/margin *detection* also runs on device — each chunk
+returns only scalars and a fixed-size margin-index buffer, never the logit
+arrays.
+
+Evidence classes (docs/DESIGN.md numeric policy):
+
+* Device pass: f32 at ``Precision.HIGHEST`` with a **per-point rigorous
+  roundoff bound** computed alongside the forward from the same ``|W|``
+  matmuls (standard running-error analysis, 4× outward on the float32 γ
+  constants).  |logit| above its bound ⇒ certain sign.
+* Margin points (|logit| ≤ bound) fall back to the host ladder
+  ``float64 → exact rational`` — the same posture as
+  ``engine.exact_logit_sign``.
+* Every SAT verdict is re-proved by ``engine.validate_pair`` in exact
+  arithmetic, so SAT never rests on float arithmetic at all.
+
+Scope: queries without relaxed attributes (RA ε pairs range over a delta
+lattice whose points leave the box — ``engine.decide_leaf`` semantics — and
+are served by Phase P instead); shared-lattice size gated by
+``EngineConfig.lattice_max``.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fairify_tpu.models.mlp import MLP
+from fairify_tpu.utils.num import matmul
+
+MARGIN_BUF = 4096  # device→host margin-index buffer per chunk
+
+
+def shared_dims(enc, d: int) -> np.ndarray:
+    """Non-PA dimensions: the coordinates a fair pair shares."""
+    mask = np.ones(d, dtype=bool)
+    if len(enc.pa_idx):
+        mask[np.asarray(enc.pa_idx)] = False
+    return np.where(mask)[0]
+
+
+def shared_lattice_size(enc, lo: np.ndarray, hi: np.ndarray) -> int:
+    """Number of shared-coordinate lattice points of the box (python int —
+    stress grids can overflow int64)."""
+    dims = shared_dims(enc, len(lo))
+    n = 1
+    for d in dims:
+        n *= int(hi[d]) - int(lo[d]) + 1
+    return n
+
+
+def _signed_forward(net: MLP, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """f32/HIGHEST forward with a running rigorous roundoff bound.
+
+        e_{l+1} = e_l·|W| + γ_l·(|h_l|·|W| + |b|),   γ_l = 4(n_in+4)·2⁻²⁴
+
+    ReLU is 1-Lipschitz so ``e`` passes through unchanged (masked like the
+    activation); integer inputs ≤ 2²⁴ are exact in f32, so e₀ = 0.  The
+    exact-rational logit differs from the returned f32 logit by at most the
+    returned bound.
+    """
+    h = x
+    e = jnp.zeros_like(x)
+    n_layers = len(net.weights)
+    u32 = jnp.float32(2.0 ** -24)
+    for i, (w, b, m) in enumerate(zip(net.weights, net.biases, net.masks)):
+        gamma = 4.0 * (w.shape[0] + 4) * u32
+        abs_acc = matmul(jnp.abs(h), jnp.abs(w)) + jnp.abs(b)
+        e = matmul(e, jnp.abs(w)) + gamma * abs_acc
+        z = matmul(h, w) + b
+        if i < n_layers - 1:
+            h = jax.nn.relu(z) * m
+            e = e * m
+        else:
+            h = z
+    return jnp.squeeze(h, axis=-1), jnp.squeeze(e, axis=-1)
+
+
+def _device_signs(net, start, strides, widths, lo_shared, bases,
+                  chunk: int, dims_tuple: tuple, d: int):
+    """(V, chunk) int8 sign tensor (0 = inside roundoff bound), on device.
+
+    Decodes flat indices ``start..start+chunk`` mixed-radix into shared
+    coordinates (indices ≥ N wrap modulo the widths — still-in-box
+    duplicates, so the tail of the last chunk is safe) and assembles the
+    input for every assignment (``bases`` (V, d) carries PA values) with
+    static per-dim gathers — a dynamic scatter here stalled XLA's compiler
+    for minutes.
+    """
+    idx = start + jnp.arange(chunk, dtype=jnp.int32)
+    coords = (idx[:, None] // strides[None, :]) % widths[None, :] \
+        + lo_shared[None, :]  # (chunk, n_shared) int32
+    pos_of = {dim: j for j, dim in enumerate(dims_tuple)}
+    cols = [coords[:, pos_of[k]].astype(jnp.float32) if k in pos_of else None
+            for k in range(d)]
+
+    def per_assignment(base):
+        x = jnp.stack(
+            [cols[k] if cols[k] is not None
+             else jnp.full((chunk,), base[k], dtype=jnp.float32)
+             for k in range(d)], axis=1)
+        return _signed_forward(net, x)
+
+    f, e = jax.vmap(per_assignment)(bases)  # (V, chunk) each
+    return jnp.where(f > e, jnp.int8(1),
+                     jnp.where(f < -e, jnp.int8(-1), jnp.int8(0)))
+
+
+@partial(jax.jit, static_argnames=("chunk", "dims_tuple", "d"))
+def _lattice_scan_kernel(net: MLP, start, strides, widths, lo_shared,
+                         bases, valid_mask, valid_pair_f, chunk: int,
+                         dims_tuple: tuple, d: int):
+    """Scan ``chunk`` lattice points on device; return only reductions.
+
+    Returns (first_flip, margin_count, margin_idx[MARGIN_BUF],
+    sign_cols[V, MARGIN_BUF+1]):
+    * ``first_flip``: first in-chunk index admitting a VALID ordered pair
+      (a, b) with certain signs (+1, −1) — computed against the full
+      ``valid_pair`` matrix (multi-PA safe), −1 if none.
+      ``sign_cols[:, -1]`` holds that index's sign column.
+    * ``margin_idx``/``margin_count``: indices whose sign is inside the
+      roundoff bound for ≥1 valid assignment; ``sign_cols[:, :MARGIN_BUF]``
+      their sign columns.  count > MARGIN_BUF ⇒ host refetches the chunk's
+      full sign tensor.
+    """
+    s = _device_signs(net, start, strides, widths, lo_shared, bases,
+                      chunk, dims_tuple, d)
+    vm = valid_mask[:, None]
+    posf = ((s == 1) & vm).astype(jnp.float32)
+    negf = ((s == -1) & vm).astype(jnp.float32)
+    # partner[a, j] > 0 ⇔ some b with valid_pair[a, b] is certainly negative
+    # at point j — the exact ordered-pair semantics, not an any-sign proxy.
+    partner = matmul(valid_pair_f, negf)
+    flip = ((posf > 0) & (partner > 0)).any(axis=0)
+    first_flip = jnp.where(flip.any(), jnp.argmax(flip), -1)
+
+    is_margin = ((s == 0) & vm).any(axis=0)
+    margin_count = is_margin.sum()
+    (margin_idx,) = jnp.nonzero(is_margin, size=MARGIN_BUF, fill_value=-1)
+
+    take = jnp.concatenate(
+        [jnp.clip(margin_idx, 0, chunk - 1),
+         jnp.clip(first_flip, 0, chunk - 1)[None]])
+    sign_cols = s[:, take]  # (V, MARGIN_BUF + 1)
+    return first_flip, margin_count, margin_idx, sign_cols
+
+
+@partial(jax.jit, static_argnames=("chunk", "dims_tuple", "d"))
+def _lattice_signs_kernel(net: MLP, start, strides, widths, lo_shared,
+                          bases, chunk: int, dims_tuple: tuple, d: int):
+    """Full (V, chunk) sign tensor — the margin-overflow fallback pull."""
+    return _device_signs(net, start, strides, widths, lo_shared, bases,
+                         chunk, dims_tuple, d)
+
+
+def _host_signs(weights, biases, pts: np.ndarray) -> np.ndarray:
+    """Signs for margin points: vectorized f64 forward, exact rational for
+    the |f64| ≤ 1e-6 residue (``exact_logit_sign``'s ladder, batched)."""
+    from fairify_tpu.models.mlp import forward_np
+    from fairify_tpu.verify.engine import exact_logit_sign
+
+    if pts.shape[0] == 0:
+        return np.zeros(0, dtype=np.int8)
+    v = np.atleast_1d(forward_np(weights, biases, pts.astype(np.float64)))
+    out = np.sign(v).astype(np.int8)
+    near = np.abs(v) <= 1e-6
+    for k in np.where(near)[0]:
+        out[k] = exact_logit_sign(weights, biases, pts[k])
+    return out
+
+
+def _pair_flip(signs: np.ndarray, valid: list, valid_pair: np.ndarray):
+    """First (a, b) valid ordered pair with signs (+1, −1), else None.
+    ``signs`` is a (V,) column over ALL encoding assignments."""
+    for a in valid:
+        if signs[a] != 1:
+            continue
+        for b in valid:
+            if valid_pair[a, b] and signs[b] == -1:
+                return a, b
+    return None
+
+
+def decide_box_exhaustive(
+    net: MLP,
+    enc,
+    lo: np.ndarray,
+    hi: np.ndarray,
+    chunk: int = 1 << 21,
+    deadline_s: Optional[float] = None,
+) -> Tuple[str, Optional[Tuple[np.ndarray, np.ndarray]]]:
+    """Complete decision of one box by lattice enumeration.
+
+    Returns ``('sat', (x, xp))`` with an exact-validated pair, ``('unsat',
+    None)`` when no exact strict flip exists anywhere on the lattice, or
+    ``('unknown', None)`` on deadline, on a lattice too large for the
+    32-bit device decode, or on an evidence-ladder disagreement (a device
+    "certain" sign failing exact validation — then no sign is trusted).
+    Caller gates RA and lattice size (``engine._lattice_phase``).
+    """
+    import time
+
+    from fairify_tpu.verify.engine import validate_pair
+
+    t0 = time.perf_counter()
+
+    def time_left() -> float:
+        if deadline_s is None:
+            return float("inf")
+        return deadline_s - (time.perf_counter() - t0)
+
+    weights = [np.asarray(w) for w in net.weights]
+    biases = [np.asarray(b) for b in net.biases]
+    lo = np.asarray(lo, dtype=np.int64)
+    hi = np.asarray(hi, dtype=np.int64)
+    d = int(lo.shape[0])
+    dims = shared_dims(enc, d)
+    widths = (hi[dims] - lo[dims] + 1).astype(np.int64)
+    N = shared_lattice_size(enc, lo, hi)
+    if N >= 2 ** 31 - (1 << 22):
+        # The device decode runs in int32 (idx, strides); a larger lattice
+        # would silently wrap and enumerate the WRONG points — soundness
+        # guard independent of the caller's configurable lattice_max.
+        return "unknown", None
+    strides = np.ones(len(dims), dtype=np.int64)
+    for k in range(len(dims) - 2, -1, -1):
+        strides[k] = strides[k + 1] * widths[k + 1]
+
+    V = enc.n_assign
+    valid = [
+        a for a in range(V)
+        if all(lo[enc.pa_idx[k]] <= enc.assignments[a, k] <= hi[enc.pa_idx[k]]
+               for k in range(len(enc.pa_idx)))
+    ]
+    if not any(enc.valid_pair[a, b] for a in valid for b in valid):
+        return "unsat", None  # no legal pair in the box — trivially fair
+
+    # Device memory cap: V × chunk × widest-layer activations in f32.
+    widest = max([d] + [int(w.shape[1]) for w in weights])
+    max_chunk = max(1 << 12, int((1 << 28) // max(V * widest, 1)))
+    chunk = int(min(chunk, max_chunk))
+
+    bases = np.tile(lo.astype(np.float32), (V, 1))
+    bases[:, np.asarray(enc.pa_idx)] = enc.assignments.astype(np.float32)
+    valid_np = np.zeros(V, dtype=bool)
+    valid_np[valid] = True
+
+    # valid_pair restricted to in-box assignments for the device reduction.
+    vp = enc.valid_pair & valid_np[:, None] & valid_np[None, :]
+    dev = dict(
+        strides=jnp.asarray(strides.astype(np.int32)),
+        widths=jnp.asarray(widths.astype(np.int32)),
+        lo_shared=jnp.asarray(lo[dims].astype(np.int32)),
+        bases=jnp.asarray(bases),
+        valid_mask=jnp.asarray(valid_np),
+        valid_pair_f=jnp.asarray(vp.astype(np.float32)),
+    )
+    dims_tuple = tuple(int(x) for x in dims)
+
+    def decode(idx_flat: np.ndarray) -> np.ndarray:
+        pts = np.tile(lo, (len(idx_flat), 1))
+        pts[:, dims] = (idx_flat[:, None] // strides[None, :]) \
+            % widths[None, :] + lo[dims][None, :]
+        return pts
+
+    def settle_sat(idx_flat: int, a: int, b: int):
+        x = decode(np.array([idx_flat]))[0]
+        xp = x.copy()
+        x[np.asarray(enc.pa_idx)] = enc.assignments[a]
+        xp[np.asarray(enc.pa_idx)] = enc.assignments[b]
+        # Already certain at the evidence-class level; re-prove exactly
+        # before any SAT settles.
+        if validate_pair(weights, biases, x, xp):
+            return "sat", (x, xp)
+        # A device "certain" sign failed exact validation: the error-bound
+        # construction is broken for this net/box, so NO device sign is
+        # trustworthy — refuse to certify anything.
+        raise _EvidenceMismatch
+
+    try:
+        for c0 in range(0, N, chunk):
+            if time_left() <= 0:
+                return "unknown", None
+            n_here = min(chunk, N - c0)
+            # One batched device→host pull per chunk — per-array pulls cost
+            # a tunnel round-trip each (~0.1 s) and dominated the scan loop.
+            first_flip, margin_count, margin_idx, sign_cols = jax.device_get(
+                _lattice_scan_kernel(
+                    net, jnp.int32(c0), dev["strides"], dev["widths"],
+                    dev["lo_shared"], dev["bases"], dev["valid_mask"],
+                    dev["valid_pair_f"], chunk, dims_tuple, d))
+
+            if 0 <= int(first_flip) < n_here:
+                pair = _pair_flip(sign_cols[:, -1], valid, enc.valid_pair)
+                if pair is None:  # device/host pair-matrix disagreement
+                    raise _EvidenceMismatch
+                return settle_sat(c0 + int(first_flip), *pair)
+
+            mc = int(margin_count)
+            if mc > MARGIN_BUF:
+                # Margin buffer overflow: pull the chunk's full sign tensor
+                # and resolve everything on host.
+                s_full = np.asarray(_lattice_signs_kernel(
+                    net, jnp.int32(c0), dev["strides"], dev["widths"],
+                    dev["lo_shared"], dev["bases"], chunk, dims_tuple,
+                    d))[:, :n_here]
+                verdict = _resolve_signs(enc, weights, biases, decode, valid,
+                                         c0, s_full, validate_pair, time_left)
+            elif mc > 0:
+                midx = margin_idx[margin_idx >= 0]
+                verdict = _resolve_margin(
+                    enc, weights, biases, decode, valid, c0, midx,
+                    sign_cols[:, :MARGIN_BUF], n_here, validate_pair,
+                    time_left)
+            else:
+                continue
+            if verdict is not None:
+                return verdict
+    except (_EvidenceMismatch, _DeadlineHit):
+        return "unknown", None
+
+    return "unsat", None
+
+
+class _EvidenceMismatch(Exception):
+    """A device 'certain' sign contradicted exact arithmetic."""
+
+
+class _DeadlineHit(Exception):
+    """Per-point host resolution ran past the deadline."""
+
+
+def _resolve_margin(enc, weights, biases, decode, valid, c0, midx,
+                    sign_cols, n_here, validate_pair, time_left):
+    """Exact-ladder the margin points of one chunk; SAT iff a strict exact
+    flip appears once their true signs replace the device zeros."""
+    for j, k in enumerate(midx):
+        k = int(k)
+        if k >= n_here:
+            continue
+        if time_left() <= 0:
+            raise _DeadlineHit
+        col = sign_cols[:, j].copy()
+        out = _settle_column(enc, weights, biases, decode, valid, c0, k,
+                             col, validate_pair)
+        if out is not None:
+            return out
+    return None
+
+
+def _resolve_signs(enc, weights, biases, decode, valid, c0, s_full,
+                   validate_pair, time_left):
+    """Host resolution of a full chunk sign tensor (overflow fallback)."""
+    vp = enc.valid_pair
+    pos = (s_full == 1)
+    neg = (s_full == -1)
+    flip_pts = np.zeros(s_full.shape[1], dtype=bool)
+    for a in valid:
+        if not pos[a].any():
+            continue
+        partners = [b for b in valid if vp[a, b]]
+        if partners:
+            flip_pts |= pos[a] & neg[partners].any(axis=0)
+    margin_pts = np.where((s_full[valid] == 0).any(axis=0))[0]
+    for k in np.where(flip_pts)[0].tolist() + margin_pts.tolist():
+        if time_left() <= 0:
+            raise _DeadlineHit
+        out = _settle_column(enc, weights, biases, decode, valid, c0,
+                             int(k), s_full[:, int(k)].copy(),
+                             validate_pair)
+        if out is not None:
+            return out
+    return None
+
+
+def _settle_column(enc, weights, biases, decode, valid, c0, k, col,
+                   validate_pair):
+    """Resolve one lattice point: exact-ladder its margin signs, then SAT
+    iff a valid ordered pair flips (exact-validated)."""
+    for a in valid:
+        if col[a] == 0:
+            pt = decode(np.array([c0 + k]))[0]
+            pt[np.asarray(enc.pa_idx)] = enc.assignments[a]
+            col[a] = _host_signs(weights, biases, pt[None])[0]
+    pair = _pair_flip(col, valid, enc.valid_pair)
+    if pair is None:
+        return None
+    a, b = pair
+    x = decode(np.array([c0 + k]))[0]
+    xp = x.copy()
+    x[np.asarray(enc.pa_idx)] = enc.assignments[a]
+    xp[np.asarray(enc.pa_idx)] = enc.assignments[b]
+    if validate_pair(weights, biases, x, xp):
+        return "sat", (x, xp)
+    # Margin entries of ``col`` were exact-laddered, so a failed validation
+    # convicts a device "certain" ±1 — no device sign is trustworthy.
+    raise _EvidenceMismatch

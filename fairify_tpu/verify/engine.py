@@ -898,6 +898,23 @@ class EngineConfig:
     lp_pair_frac: float = 0.25
     lp_pair_max_nodes: int = 800
     lp_pair_max_dirs: int = 32
+    # Phase E: exhaustive integer-lattice enumeration (ops.lattice) for
+    # RA-free roots still unknown after every other phase — the complete
+    # decision for wide flip-slab boxes the input-split BaB diverges on
+    # (stress-AC box 768: 67M lattice points beat 3.4M BaB nodes).
+    # lattice_max gates the shared-lattice size (points); lattice_chunk is
+    # the device batch per forward launch.
+    lattice_exhaustive: bool = True
+    lattice_max: float = 2.0e8
+    # Chunk size trades XLA compile time (once per architecture) against
+    # launch count; warm launches return only scalars/small buffers, so
+    # smaller chunks win on the tunnelled single-chip setup (2^18: ~75 s
+    # compile vs ~130 s at 2^21, ~3 ms per warm launch).
+    lattice_chunk: int = 1 << 18
+    # Fraction of the deadline reserved for Phase E when it is applicable —
+    # without a reserve the input-split BaB and Phase P spend the whole
+    # budget first and enumeration never runs.
+    lattice_frac: float = 0.2
 
 
 @dataclass
@@ -1009,14 +1026,34 @@ def decide_many(
     open_boxes = np.ones(R, dtype=np.int64)  # root boxes still in the frontier
     cost_s = np.zeros(R, dtype=np.float64)  # per-root attributed batch time
 
-    # Phase P reserves the deadline tail: hard roots the input-split BaB
+    # Phases P and E reserve deadline tails: hard roots the input-split BaB
     # cannot crack would otherwise eat the whole budget and leave nothing
-    # for the relational certificate that can.
+    # for the certificates that can close them.
     n_dirs = int(enc.valid_pair.sum())
     use_pair = (cfg.lp_pair and len(enc.pa_idx)
                 and 0 < n_dirs <= cfg.lp_pair_max_dirs)
-    main_deadline = deadline_s * (1.0 - cfg.lp_pair_frac) if use_pair \
-        else deadline_s
+    lat_sizes = {}
+    if cfg.lattice_exhaustive and not (len(enc.ra_idx) and enc.eps):
+        from fairify_tpu.ops import lattice as lattice_ops
+
+        for r in range(R):
+            n = lattice_ops.shared_lattice_size(
+                enc, np.asarray(roots_lo[r], dtype=np.int64),
+                np.asarray(roots_hi[r], dtype=np.int64))
+            if n <= cfg.lattice_max:
+                lat_sizes[r] = n
+    use_lattice = bool(lat_sizes)
+    # Reserve no more than Phase E could conceivably use even if EVERY
+    # eligible root stayed unknown (~1e6 pts/s conservative scan rate plus
+    # one compile) — a batch with one tiny eligible root must not tax the
+    # hard roots' BaB budget by a fixed 20%.
+    lat_frac = 0.0
+    if use_lattice:
+        est_s = 120.0 + sum(lat_sizes.values()) / 1.0e6
+        lat_frac = min(cfg.lattice_frac, est_s / max(deadline_s, 1e-9))
+    pair_deadline = deadline_s * (1.0 - lat_frac)
+    main_deadline = pair_deadline * (1.0 - cfg.lp_pair_frac) if use_pair \
+        else pair_deadline
 
     def settle(r: int, verdict: str, ce=None):
         if verdicts[r] is None:
@@ -1162,7 +1199,11 @@ def decide_many(
 
     if use_pair and any(v == "unknown" for v in verdicts):
         _pair_lp_phase(net, enc, roots_lo, roots_hi, verdicts, ces,
-                       nodes, cost_s, cfg, t0, deadline_s)
+                       nodes, cost_s, cfg, t0, pair_deadline)
+
+    if use_lattice and any(v == "unknown" for v in verdicts):
+        _lattice_phase(net, enc, roots_lo, roots_hi, verdicts, ces,
+                       cost_s, cfg, t0, deadline_s, lat_sizes=lat_sizes)
 
     return [
         Decision(verdicts[r], ces[r],
@@ -1170,6 +1211,47 @@ def decide_many(
                  elapsed_s=float(cost_s[r] + sign_cost[r]))
         for r in range(R)
     ]
+
+
+def _lattice_phase(net, enc, roots_lo, roots_hi, verdicts, ces,
+                   cost_s, cfg, t0, deadline_s, lat_sizes=None):
+    """Phase E: exhaustive lattice enumeration of the still-unknown roots.
+
+    Complete for RA-free queries on boxes whose shared lattice fits
+    ``cfg.lattice_max`` — exactly the wide flip-slab class where input
+    splitting diverges (the box is finite; enumerate it).  RA-ε queries are
+    excluded: their pair space leaves the box (``decide_leaf`` delta
+    semantics) and stays Phase P's job.  Roots are visited smallest lattice
+    first, so one near-cap root cannot starve trivially cheap ones.
+    """
+    from fairify_tpu.ops import lattice as lattice_ops
+
+    if len(enc.ra_idx) and enc.eps:
+        return
+    if lat_sizes is None:
+        lat_sizes = {}
+        for r in range(len(verdicts)):
+            n = lattice_ops.shared_lattice_size(
+                enc, np.asarray(roots_lo[r], dtype=np.int64),
+                np.asarray(roots_hi[r], dtype=np.int64))
+            if n <= cfg.lattice_max:
+                lat_sizes[r] = n
+    pending = sorted(
+        (r for r, v in enumerate(verdicts) if v == "unknown" and r in lat_sizes),
+        key=lambda r: lat_sizes[r])
+    for r in pending:
+        remaining = deadline_s - (time.perf_counter() - t0)
+        if remaining <= 1.0:
+            break
+        t_r = time.perf_counter()
+        verdict, ce = lattice_ops.decide_box_exhaustive(
+            net, enc, np.asarray(roots_lo[r], dtype=np.int64),
+            np.asarray(roots_hi[r], dtype=np.int64),
+            chunk=cfg.lattice_chunk, deadline_s=remaining)
+        cost_s[r] += time.perf_counter() - t_r
+        if verdict != "unknown":
+            verdicts[r] = verdict
+            ces[r] = ce
 
 
 def _pair_lp_phase(net, enc, roots_lo, roots_hi, verdicts, ces,

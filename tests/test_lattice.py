@@ -1,0 +1,149 @@
+"""Phase E — exhaustive lattice decision (ops/lattice.py).
+
+Oracle: brute-force enumeration of every (shared point, PA pair) with f64
+forward + exact sign at ties — the semantics of ``engine.decide_leaf``
+applied to the whole box.
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+from fairify_tpu.data.domains import DomainSpec
+from fairify_tpu.models.mlp import from_numpy
+from fairify_tpu.ops import lattice as lattice_ops
+from fairify_tpu.verify import engine
+from fairify_tpu.verify.property import FairnessQuery, encode
+
+
+def _query(d=4, pa=("p",)):
+    names = tuple([f"a{i}" for i in range(d - 1)] + ["p"])
+    ranges = {n: (0, 2) for n in names}
+    ranges["p"] = (0, 1)
+    dom = DomainSpec(name="toy", columns=names, ranges=ranges, label="y")
+    return FairnessQuery(domain=dom, protected=pa)
+
+
+def _net(seed, sizes):
+    rng = np.random.default_rng(seed)
+    ws = [rng.normal(scale=0.6, size=(sizes[i], sizes[i + 1])).astype(np.float32)
+          for i in range(len(sizes) - 1)]
+    bs = [rng.normal(scale=0.2, size=(sizes[i + 1],)).astype(np.float32)
+          for i in range(len(sizes) - 1)]
+    return from_numpy(ws, bs)
+
+
+def _oracle(net, enc, lo, hi):
+    """Exhaustive f64/exact enumeration — independent of ops.lattice."""
+    weights = [np.asarray(w) for w in net.weights]
+    biases = [np.asarray(b) for b in net.biases]
+    dims = lattice_ops.shared_dims(enc, len(lo))
+    valid = [a for a in range(enc.n_assign)
+             if all(lo[enc.pa_idx[k]] <= enc.assignments[a, k] <= hi[enc.pa_idx[k]]
+                    for k in range(len(enc.pa_idx)))]
+    spaces = [range(int(lo[d]), int(hi[d]) + 1) for d in dims]
+    for coord in itertools.product(*spaces):
+        signs = {}
+        for a in valid:
+            x = np.array(lo, dtype=np.int64)
+            x[dims] = coord
+            x[enc.pa_idx] = enc.assignments[a]
+            signs[a] = engine.exact_logit_sign(weights, biases, x)
+        for a in valid:
+            for b in valid:
+                if enc.valid_pair[a, b] and signs[a] > 0 and signs[b] < 0:
+                    return "sat"
+    return "unsat"
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_oracle_agreement(seed):
+    q = _query()
+    enc = encode(q)
+    net = _net(seed, (4, 8, 1))
+    lo = np.array([0, 0, 0, 0], dtype=np.int64)
+    hi = np.array([2, 2, 2, 1], dtype=np.int64)
+    verdict, ce = lattice_ops.decide_box_exhaustive(net, enc, lo, hi,
+                                                    chunk=8)
+    assert verdict == _oracle(net, enc, lo, hi)
+    if verdict == "sat":
+        x, xp = ce
+        weights = [np.asarray(w) for w in net.weights]
+        biases = [np.asarray(b) for b in net.biases]
+        assert engine.validate_pair(weights, biases, x, xp)
+        # Pair differs only on the PA dim and both lie in the box.
+        assert (x[:-1] == xp[:-1]).all() and x[-1] != xp[-1]
+        assert (lo <= x).all() and (x <= hi).all()
+        assert (lo <= xp).all() and (xp <= hi).all()
+
+
+def test_exact_tie_is_not_a_flip():
+    """A network whose logit is identically zero has sign 0 everywhere:
+    the strict-flip property is UNSAT, and the margin path must settle it
+    via exact signs rather than mis-classifying ±0 float noise."""
+    q = _query()
+    enc = encode(q)
+    ws = [np.zeros((4, 4), np.float32), np.zeros((4, 1), np.float32)]
+    bs = [np.zeros(4, np.float32), np.zeros(1, np.float32)]
+    net = from_numpy(ws, bs)
+    lo = np.zeros(4, dtype=np.int64)
+    hi = np.array([2, 2, 2, 1], dtype=np.int64)
+    verdict, ce = lattice_ops.decide_box_exhaustive(net, enc, lo, hi, chunk=8)
+    assert verdict == "unsat" and ce is None
+
+
+def test_no_legal_pair_is_unsat():
+    """PA collapsed to one value in the box — no pair, trivially fair."""
+    q = _query()
+    enc = encode(q)
+    net = _net(0, (4, 8, 1))
+    lo = np.array([0, 0, 0, 1], dtype=np.int64)
+    hi = np.array([2, 2, 2, 1], dtype=np.int64)
+    verdict, _ = lattice_ops.decide_box_exhaustive(net, enc, lo, hi, chunk=8)
+    assert verdict == "unsat"
+
+
+def test_decide_many_lattice_fallthrough():
+    """Roots the BaB cannot close (max_nodes=1, all other phases off) are
+    settled by Phase E, matching the oracle."""
+    q = _query()
+    enc = encode(q)
+    cfg = engine.EngineConfig(
+        soft_timeout_s=60.0, max_nodes=1, sign_bab=False, lp_sign=False,
+        lp_pair=False, attack_samples=2, bab_attack_samples=2)
+    lo = np.array([[0, 0, 0, 0]], dtype=np.int64)
+    hi = np.array([[2, 2, 2, 1]], dtype=np.int64)
+    for seed in range(4):
+        net = _net(seed, (4, 6, 1))
+        dec = engine.decide_many(net, enc, lo, hi, cfg, deadline_s=60.0)
+        assert dec[0].verdict == _oracle(net, enc, lo[0], hi[0])
+
+
+def test_lattice_gates():
+    """RA-ε queries and over-large lattices are left unknown (honest)."""
+    import time
+
+    names = ("a0", "a1", "p")
+    dom = DomainSpec(name="toy", columns=names,
+                     ranges={"a0": (0, 2), "a1": (0, 2), "p": (0, 1)},
+                     label="y")
+    q_ra = FairnessQuery(domain=dom, protected=("p",), relaxed=("a0",),
+                         relax_eps=2)
+    enc_ra = encode(q_ra)
+    net = _net(1, (3, 6, 1))
+    lo = np.array([[0, 0, 0]], dtype=np.int64)
+    hi = np.array([[2, 2, 1]], dtype=np.int64)
+
+    def run(enc, cfg):
+        verdicts, ces = ["unknown"], [None]
+        engine._lattice_phase(net, enc, lo, hi, verdicts, ces,
+                              np.zeros(1), cfg, time.perf_counter(), 30.0)
+        return verdicts[0]
+
+    # RA gate: Phase E must not run (delta pairs leave the box).
+    assert run(enc_ra, engine.EngineConfig()) == "unknown"
+    # Size gate: shared lattice is 9 > lattice_max=4.
+    enc = encode(_query(d=3))
+    assert run(enc, engine.EngineConfig(lattice_max=4)) == "unknown"
+    # Control: with the gates open the same root settles.
+    assert run(enc, engine.EngineConfig()) in ("sat", "unsat")
