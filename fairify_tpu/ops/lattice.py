@@ -45,16 +45,9 @@ import numpy as np
 
 from fairify_tpu.models.mlp import MLP
 from fairify_tpu.utils.num import matmul
+from fairify_tpu.verify.property import shared_dims, valid_assignments
 
 MARGIN_BUF = 4096  # device→host margin-index buffer per chunk
-
-
-def shared_dims(enc, d: int) -> np.ndarray:
-    """Non-PA dimensions: the coordinates a fair pair shares."""
-    mask = np.ones(d, dtype=bool)
-    if len(enc.pa_idx):
-        mask[np.asarray(enc.pa_idx)] = False
-    return np.where(mask)[0]
 
 
 def shared_lattice_size(enc, lo: np.ndarray, hi: np.ndarray) -> int:
@@ -125,9 +118,9 @@ def _device_signs(net, start, strides, widths, lo_shared, bases,
 
 
 @partial(jax.jit, static_argnames=("chunk", "dims_tuple", "d"))
-def _lattice_scan_kernel(net: MLP, start, strides, widths, lo_shared,
-                         bases, valid_mask, valid_pair_f, chunk: int,
-                         dims_tuple: tuple, d: int):
+def _lattice_scan_kernel(net: MLP, start, n_total, strides, widths,
+                         lo_shared, bases, valid_mask, valid_pair_f,
+                         chunk: int, dims_tuple: tuple, d: int):
     """Scan ``chunk`` lattice points on device; return only reductions.
 
     Returns (first_flip, margin_count, margin_idx[MARGIN_BUF],
@@ -143,16 +136,20 @@ def _lattice_scan_kernel(net: MLP, start, strides, widths, lo_shared,
     """
     s = _device_signs(net, start, strides, widths, lo_shared, bases,
                       chunk, dims_tuple, d)
+    # Tail indices ≥ n_total are modulo-wrapped duplicates of earlier points
+    # — mask them so a dup can't inflate margin_count past the buffer (a
+    # needless full-tensor refetch) or shadow an in-range first_flip.
+    in_range = (start + jnp.arange(chunk, dtype=jnp.int32)) < n_total
     vm = valid_mask[:, None]
     posf = ((s == 1) & vm).astype(jnp.float32)
     negf = ((s == -1) & vm).astype(jnp.float32)
     # partner[a, j] > 0 ⇔ some b with valid_pair[a, b] is certainly negative
     # at point j — the exact ordered-pair semantics, not an any-sign proxy.
     partner = matmul(valid_pair_f, negf)
-    flip = ((posf > 0) & (partner > 0)).any(axis=0)
+    flip = ((posf > 0) & (partner > 0)).any(axis=0) & in_range
     first_flip = jnp.where(flip.any(), jnp.argmax(flip), -1)
 
-    is_margin = ((s == 0) & vm).any(axis=0)
+    is_margin = ((s == 0) & vm).any(axis=0) & in_range
     margin_count = is_margin.sum()
     (margin_idx,) = jnp.nonzero(is_margin, size=MARGIN_BUF, fill_value=-1)
 
@@ -245,11 +242,7 @@ def decide_box_exhaustive(
         strides[k] = strides[k + 1] * widths[k + 1]
 
     V = enc.n_assign
-    valid = [
-        a for a in range(V)
-        if all(lo[enc.pa_idx[k]] <= enc.assignments[a, k] <= hi[enc.pa_idx[k]]
-               for k in range(len(enc.pa_idx)))
-    ]
+    valid = valid_assignments(enc, lo, hi)
     if not any(enc.valid_pair[a, b] for a in valid for b in valid):
         return "unsat", None  # no legal pair in the box — trivially fair
 
@@ -304,9 +297,10 @@ def decide_box_exhaustive(
             # a tunnel round-trip each (~0.1 s) and dominated the scan loop.
             first_flip, margin_count, margin_idx, sign_cols = jax.device_get(
                 _lattice_scan_kernel(
-                    net, jnp.int32(c0), dev["strides"], dev["widths"],
-                    dev["lo_shared"], dev["bases"], dev["valid_mask"],
-                    dev["valid_pair_f"], chunk, dims_tuple, d))
+                    net, jnp.int32(c0), jnp.int32(N), dev["strides"],
+                    dev["widths"], dev["lo_shared"], dev["bases"],
+                    dev["valid_mask"], dev["valid_pair_f"], chunk,
+                    dims_tuple, d))
 
             if 0 <= int(first_flip) < n_here:
                 pair = _pair_flip(sign_cols[:, -1], valid, enc.valid_pair)

@@ -483,16 +483,11 @@ def decide_leaf(enc: PairEncoding, weights, biases, point: np.ndarray, lo, hi):
     if len(enc.ra_idx) and enc.eps and \
             (2 * enc.eps + 1) ** len(enc.ra_idx) > 100_000:
         return "unknown", None
+    from fairify_tpu.verify.property import valid_assignments
+
     lo = np.asarray(lo)
     hi = np.asarray(hi)
-    valid = [
-        i
-        for i in range(enc.n_assign)
-        if all(
-            lo[enc.pa_idx[k]] <= enc.assignments[i, k] <= hi[enc.pa_idx[k]]
-            for k in range(len(enc.pa_idx))
-        )
-    ]
+    valid = valid_assignments(enc, lo, hi)
     deltas = (
         list(it.product(range(-enc.eps, enc.eps + 1), repeat=len(enc.ra_idx)))
         if (len(enc.ra_idx) and enc.eps)
@@ -928,11 +923,11 @@ class Decision:
 
 
 def _branch_dims(enc: PairEncoding, d: int) -> np.ndarray:
-    """Shared dims eligible for splitting: everything except PA (enumerated)."""
-    mask = np.ones(d, dtype=bool)
-    if len(enc.pa_idx):
-        mask[enc.pa_idx] = False
-    return np.where(mask)[0]
+    """Shared dims eligible for splitting: everything except PA (enumerated).
+    Same universe lattice enumeration scans (``property.shared_dims``)."""
+    from fairify_tpu.verify.property import shared_dims
+
+    return shared_dims(enc, d)
 
 
 def _pad(arr: np.ndarray, n: int) -> np.ndarray:
@@ -1032,16 +1027,7 @@ def decide_many(
     n_dirs = int(enc.valid_pair.sum())
     use_pair = (cfg.lp_pair and len(enc.pa_idx)
                 and 0 < n_dirs <= cfg.lp_pair_max_dirs)
-    lat_sizes = {}
-    if cfg.lattice_exhaustive and not (len(enc.ra_idx) and enc.eps):
-        from fairify_tpu.ops import lattice as lattice_ops
-
-        for r in range(R):
-            n = lattice_ops.shared_lattice_size(
-                enc, np.asarray(roots_lo[r], dtype=np.int64),
-                np.asarray(roots_hi[r], dtype=np.int64))
-            if n <= cfg.lattice_max:
-                lat_sizes[r] = n
+    lat_sizes = _eligible_lattice_roots(enc, roots_lo, roots_hi, cfg)
     use_lattice = bool(lat_sizes)
     # Reserve no more than Phase E could conceivably use even if EVERY
     # eligible root stayed unknown (~1e6 pts/s conservative scan rate plus
@@ -1213,6 +1199,24 @@ def decide_many(
     ]
 
 
+def _eligible_lattice_roots(enc, roots_lo, roots_hi, cfg) -> dict:
+    """root index → shared-lattice size, for roots Phase E can enumerate.
+    The single eligibility rule shared by decide_many's budget reserve and
+    ``_lattice_phase``'s queue — these must never disagree."""
+    if not cfg.lattice_exhaustive or (len(enc.ra_idx) and enc.eps):
+        return {}
+    from fairify_tpu.ops import lattice as lattice_ops
+
+    sizes = {}
+    for r in range(roots_lo.shape[0]):
+        n = lattice_ops.shared_lattice_size(
+            enc, np.asarray(roots_lo[r], dtype=np.int64),
+            np.asarray(roots_hi[r], dtype=np.int64))
+        if n <= cfg.lattice_max:
+            sizes[r] = n
+    return sizes
+
+
 def _lattice_phase(net, enc, roots_lo, roots_hi, verdicts, ces,
                    cost_s, cfg, t0, deadline_s, lat_sizes=None):
     """Phase E: exhaustive lattice enumeration of the still-unknown roots.
@@ -1229,13 +1233,7 @@ def _lattice_phase(net, enc, roots_lo, roots_hi, verdicts, ces,
     if len(enc.ra_idx) and enc.eps:
         return
     if lat_sizes is None:
-        lat_sizes = {}
-        for r in range(len(verdicts)):
-            n = lattice_ops.shared_lattice_size(
-                enc, np.asarray(roots_lo[r], dtype=np.int64),
-                np.asarray(roots_hi[r], dtype=np.int64))
-            if n <= cfg.lattice_max:
-                lat_sizes[r] = n
+        lat_sizes = _eligible_lattice_roots(enc, roots_lo, roots_hi, cfg)
     pending = sorted(
         (r for r, v in enumerate(verdicts) if v == "unknown" and r in lat_sizes),
         key=lambda r: lat_sizes[r])

@@ -121,6 +121,26 @@ def encode(query: FairnessQuery, max_assignments: int = 1024) -> PairEncoding:
     )
 
 
+def shared_dims(enc: PairEncoding, d: int) -> np.ndarray:
+    """Non-PA dimensions: the coordinates a fair pair shares.  The single
+    definition used by BaB branching (``engine._branch_dims``) and lattice
+    enumeration (``ops.lattice``) — these must never disagree."""
+    mask = np.ones(d, dtype=bool)
+    if len(enc.pa_idx):
+        mask[np.asarray(enc.pa_idx)] = False
+    return np.where(mask)[0]
+
+
+def valid_assignments(enc: PairEncoding, lo: np.ndarray, hi: np.ndarray):
+    """PA assignments whose values lie inside the box — the in-box pair
+    universe shared by ``engine.decide_leaf`` and ``ops.lattice``."""
+    return [
+        a for a in range(enc.n_assign)
+        if all(lo[enc.pa_idx[k]] <= enc.assignments[a, k] <= hi[enc.pa_idx[k]]
+               for k in range(len(enc.pa_idx)))
+    ]
+
+
 def role_boxes(enc: PairEncoding, lo: np.ndarray, hi: np.ndarray):
     """Role boxes for a batch of partition boxes.
 
