@@ -74,7 +74,17 @@ def main() -> int:
         # escalated for the re-decision.
         cfg = cfg.with_(result_dir=os.path.join(
             cfg.result_dir, f"b{cfg.soft_timeout_s:g}-{cfg.hard_timeout_s:g}"))
-        deep = cfg.with_(soft_timeout_s=args.soft)
+        # Escalate the engine's per-root node cap with the soft budget:
+        # stress-GC box 624 (GC-5) certifies at ~227k BaB nodes — above the
+        # 200k default — so a deeper wall budget without a deeper node cap
+        # loops forever on exactly the boxes this driver exists for.
+        from dataclasses import replace
+
+        deep = cfg.with_(
+            soft_timeout_s=args.soft,
+            engine=replace(cfg.engine,
+                           max_nodes=max(cfg.engine.max_nodes,
+                                         int(2000 * args.soft))))
         net = zoo.load(deep.dataset, r["model"])
         # One grid per (preset, cap): models of a preset share it, and the
         # stress grids reach 3.3M boxes — rebuild per row would dominate,
