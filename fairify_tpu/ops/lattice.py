@@ -47,7 +47,11 @@ from fairify_tpu.models.mlp import MLP
 from fairify_tpu.utils.num import matmul
 from fairify_tpu.verify.property import shared_dims, valid_assignments
 
-MARGIN_BUF = 4096  # device→host margin-index buffer per chunk
+# Device→host margin-index buffer per chunk.  Kept small: the buffer (plus
+# its sign columns) is most of each chunk's transfer over the ~MB/s tunnel,
+# margin points are rare (typically 0/chunk), and overflow degrades safely
+# to a full sign-tensor pull for that chunk.
+MARGIN_BUF = 512
 
 
 def shared_lattice_size(enc, lo: np.ndarray, hi: np.ndarray) -> int:
@@ -203,17 +207,29 @@ def decide_box_exhaustive(
     hi: np.ndarray,
     chunk: int = 1 << 21,
     deadline_s: Optional[float] = None,
+    pipeline_depth: int = 32,
+    int32_limit: int = 2 ** 31 - (1 << 23),
 ) -> Tuple[str, Optional[Tuple[np.ndarray, np.ndarray]]]:
     """Complete decision of one box by lattice enumeration.
 
     Returns ``('sat', (x, xp))`` with an exact-validated pair, ``('unsat',
     None)`` when no exact strict flip exists anywhere on the lattice, or
-    ``('unknown', None)`` on deadline, on a lattice too large for the
-    32-bit device decode, or on an evidence-ladder disagreement (a device
-    "certain" sign failing exact validation — then no sign is trusted).
-    Caller gates RA and lattice size (``engine._lattice_phase``).
+    ``('unknown', None)`` on deadline or on an evidence-ladder
+    disagreement (a device "certain" sign failing exact validation — then
+    no sign is trusted).  Caller gates RA and lattice size
+    (``engine._lattice_phase``).
+
+    Lattices past the 32-bit device decode are **prefix-peeled**: leading
+    shared dims are enumerated host-side (their values baked into the
+    per-sweep ``bases``) until the suffix lattice fits int32; one kernel
+    compile serves every prefix.  Chunks are **pipeline-dispatched**
+    ``pipeline_depth`` ahead — on the tunnelled chip the per-chunk cost is
+    the device→host round-trip, not compute, so overlapping transfers is
+    what makes 10^10-point boxes (stress-BM class) enumerable in minutes.
     """
+    import itertools
     import time
+    from collections import deque
 
     from fairify_tpu.verify.engine import validate_pair
 
@@ -230,51 +246,64 @@ def decide_box_exhaustive(
     hi = np.asarray(hi, dtype=np.int64)
     d = int(lo.shape[0])
     dims = shared_dims(enc, d)
-    widths = (hi[dims] - lo[dims] + 1).astype(np.int64)
     N = shared_lattice_size(enc, lo, hi)
-    if N >= 2 ** 31 - (1 << 22):
-        # The device decode runs in int32 (idx, strides); a larger lattice
-        # would silently wrap and enumerate the WRONG points — soundness
-        # guard independent of the caller's configurable lattice_max.
-        return "unknown", None
-    strides = np.ones(len(dims), dtype=np.int64)
-    for k in range(len(dims) - 2, -1, -1):
-        strides[k] = strides[k + 1] * widths[k + 1]
 
     V = enc.n_assign
     valid = valid_assignments(enc, lo, hi)
     if not any(enc.valid_pair[a, b] for a in valid for b in valid):
         return "unsat", None  # no legal pair in the box — trivially fair
 
+    # Prefix peeling: enumerate shared dims host-side until the suffix
+    # lattice fits the int32 device decode.  Peel smallest widths first —
+    # the prefix count is N/n_suf, so removing the least width necessary
+    # keeps host round-trips (and last-chunk padding waste) minimal; fixed
+    # leading-order peeling could overshoot by orders of magnitude when an
+    # early dim is very wide.
+    n_suf = N
+    by_width = sorted(range(len(dims)),
+                      key=lambda j: int(hi[dims[j]]) - int(lo[dims[j]]) + 1)
+    peeled = []
+    for j in by_width:
+        if n_suf < int32_limit - chunk:
+            break
+        n_suf //= int(hi[dims[j]]) - int(lo[dims[j]]) + 1
+        peeled.append(j)
+    peel_dims = dims[sorted(peeled)]
+    suf_dims = dims[sorted(set(range(len(dims))) - set(peeled))]
+    suf_widths = (hi[suf_dims] - lo[suf_dims] + 1).astype(np.int64)
+    suf_strides = np.ones(len(suf_dims), dtype=np.int64)
+    for k in range(len(suf_dims) - 2, -1, -1):
+        suf_strides[k] = suf_strides[k + 1] * suf_widths[k + 1]
+
     # Device memory cap: V × chunk × widest-layer activations in f32.
     widest = max([d] + [int(w.shape[1]) for w in weights])
     max_chunk = max(1 << 12, int((1 << 28) // max(V * widest, 1)))
     chunk = int(min(chunk, max_chunk))
 
-    bases = np.tile(lo.astype(np.float32), (V, 1))
-    bases[:, np.asarray(enc.pa_idx)] = enc.assignments.astype(np.float32)
     valid_np = np.zeros(V, dtype=bool)
     valid_np[valid] = True
-
     # valid_pair restricted to in-box assignments for the device reduction.
     vp = enc.valid_pair & valid_np[:, None] & valid_np[None, :]
     dev = dict(
-        strides=jnp.asarray(strides.astype(np.int32)),
-        widths=jnp.asarray(widths.astype(np.int32)),
-        lo_shared=jnp.asarray(lo[dims].astype(np.int32)),
-        bases=jnp.asarray(bases),
+        strides=jnp.asarray(suf_strides.astype(np.int32)),
+        widths=jnp.asarray(suf_widths.astype(np.int32)),
+        lo_shared=jnp.asarray(lo[suf_dims].astype(np.int32)),
         valid_mask=jnp.asarray(valid_np),
         valid_pair_f=jnp.asarray(vp.astype(np.float32)),
     )
-    dims_tuple = tuple(int(x) for x in dims)
+    dims_tuple = tuple(int(x) for x in suf_dims)
 
-    def decode(idx_flat: np.ndarray) -> np.ndarray:
-        pts = np.tile(lo, (len(idx_flat), 1))
-        pts[:, dims] = (idx_flat[:, None] // strides[None, :]) \
-            % widths[None, :] + lo[dims][None, :]
-        return pts
+    def make_decode(prefix_vals):
+        def decode(idx_flat: np.ndarray) -> np.ndarray:
+            pts = np.tile(lo, (len(idx_flat), 1))
+            if len(peel_dims):
+                pts[:, peel_dims] = np.asarray(prefix_vals, dtype=np.int64)
+            pts[:, suf_dims] = (idx_flat[:, None] // suf_strides[None, :]) \
+                % suf_widths[None, :] + lo[suf_dims][None, :]
+            return pts
+        return decode
 
-    def settle_sat(idx_flat: int, a: int, b: int):
+    def settle_sat(decode, idx_flat: int, a: int, b: int):
         x = decode(np.array([idx_flat]))[0]
         xp = x.copy()
         x[np.asarray(enc.pa_idx)] = enc.assignments[a]
@@ -288,44 +317,74 @@ def decide_box_exhaustive(
         # trustworthy — refuse to certify anything.
         raise _EvidenceMismatch
 
+    def work_items():
+        """(prefix_vals, bases_dev, c0) stream covering the full lattice."""
+        spaces = [range(int(lo[dm]), int(hi[dm]) + 1) for dm in peel_dims]
+        for prefix_vals in itertools.product(*spaces):
+            base = np.tile(lo.astype(np.float32), (V, 1))
+            if len(peel_dims):
+                base[:, peel_dims] = np.asarray(prefix_vals, np.float32)
+            base[:, np.asarray(enc.pa_idx)] = \
+                enc.assignments.astype(np.float32)
+            bases_dev = jnp.asarray(base)
+            for c0 in range(0, n_suf, chunk):
+                yield prefix_vals, bases_dev, c0
+
+    def process(prefix_vals, c0, bases_dev, results) -> Optional[tuple]:
+        first_flip, margin_count, margin_idx, sign_cols = results
+        decode = make_decode(prefix_vals)
+        n_here = min(chunk, n_suf - c0)
+        if 0 <= int(first_flip) < n_here:
+            pair = _pair_flip(sign_cols[:, -1], valid, enc.valid_pair)
+            if pair is None:  # device/host pair-matrix disagreement
+                raise _EvidenceMismatch
+            return settle_sat(decode, c0 + int(first_flip), *pair)
+        mc = int(margin_count)
+        if mc > MARGIN_BUF:
+            # Margin buffer overflow: pull the chunk's full sign tensor and
+            # resolve everything on host.
+            s_full = np.asarray(_lattice_signs_kernel(
+                net, jnp.int32(c0), dev["strides"], dev["widths"],
+                dev["lo_shared"], bases_dev, chunk, dims_tuple,
+                d))[:, :n_here]
+            return _resolve_signs(enc, weights, biases, decode, valid,
+                                  c0, s_full, validate_pair, time_left)
+        if mc > 0:
+            midx = margin_idx[margin_idx >= 0]
+            return _resolve_margin(
+                enc, weights, biases, decode, valid, c0, midx,
+                sign_cols[:, :MARGIN_BUF], n_here, validate_pair,
+                time_left)
+        return None
+
+    # Pipeline: dispatch up to `pipeline_depth` chunks ahead; collect in
+    # order.  Dispatch is async (jax futures); device_get blocks only on
+    # the oldest in-flight chunk, so transfers overlap compute and the
+    # tunnel round-trip is paid once per depth-window, not per chunk.
+    inflight: deque = deque()
+    stream = work_items()
     try:
-        for c0 in range(0, N, chunk):
+        while True:
+            while len(inflight) < pipeline_depth:
+                nxt = next(stream, None)
+                if nxt is None:
+                    break
+                if time_left() <= 0:
+                    return "unknown", None
+                prefix_vals, bases_dev, c0 = nxt
+                fut = _lattice_scan_kernel(
+                    net, jnp.int32(c0), jnp.int32(n_suf), dev["strides"],
+                    dev["widths"], dev["lo_shared"], bases_dev,
+                    dev["valid_mask"], dev["valid_pair_f"], chunk,
+                    dims_tuple, d)
+                inflight.append((prefix_vals, c0, bases_dev, fut))
+            if not inflight:
+                break
             if time_left() <= 0:
                 return "unknown", None
-            n_here = min(chunk, N - c0)
-            # One batched device→host pull per chunk — per-array pulls cost
-            # a tunnel round-trip each (~0.1 s) and dominated the scan loop.
-            first_flip, margin_count, margin_idx, sign_cols = jax.device_get(
-                _lattice_scan_kernel(
-                    net, jnp.int32(c0), jnp.int32(N), dev["strides"],
-                    dev["widths"], dev["lo_shared"], dev["bases"],
-                    dev["valid_mask"], dev["valid_pair_f"], chunk,
-                    dims_tuple, d))
-
-            if 0 <= int(first_flip) < n_here:
-                pair = _pair_flip(sign_cols[:, -1], valid, enc.valid_pair)
-                if pair is None:  # device/host pair-matrix disagreement
-                    raise _EvidenceMismatch
-                return settle_sat(c0 + int(first_flip), *pair)
-
-            mc = int(margin_count)
-            if mc > MARGIN_BUF:
-                # Margin buffer overflow: pull the chunk's full sign tensor
-                # and resolve everything on host.
-                s_full = np.asarray(_lattice_signs_kernel(
-                    net, jnp.int32(c0), dev["strides"], dev["widths"],
-                    dev["lo_shared"], dev["bases"], chunk, dims_tuple,
-                    d))[:, :n_here]
-                verdict = _resolve_signs(enc, weights, biases, decode, valid,
-                                         c0, s_full, validate_pair, time_left)
-            elif mc > 0:
-                midx = margin_idx[margin_idx >= 0]
-                verdict = _resolve_margin(
-                    enc, weights, biases, decode, valid, c0, midx,
-                    sign_cols[:, :MARGIN_BUF], n_here, validate_pair,
-                    time_left)
-            else:
-                continue
+            prefix_vals, c0, bases_dev, fut = inflight.popleft()
+            results = jax.device_get(fut)
+            verdict = process(prefix_vals, c0, bases_dev, results)
             if verdict is not None:
                 return verdict
     except (_EvidenceMismatch, _DeadlineHit):

@@ -33,6 +33,10 @@ def main() -> int:
     ap.add_argument("--budget", type=float, default=1200.0,
                     help="wall budget per (preset, model) row (s)")
     ap.add_argument("--max-unknown", type=int, default=100000)
+    ap.add_argument("--lattice-max", type=float, default=5.0e10,
+                    help="Phase E lattice ceiling for the escalated engine "
+                         "(prefix-peeled enumeration makes 10^10-class "
+                         "boxes minutes, not hours)")
     ap.add_argument("--presets", default="",
                     help="comma list restricting which presets to deepen")
     args = ap.parse_args()
@@ -84,7 +88,9 @@ def main() -> int:
             soft_timeout_s=args.soft,
             engine=replace(cfg.engine,
                            max_nodes=max(cfg.engine.max_nodes,
-                                         int(2000 * args.soft))))
+                                         int(2000 * args.soft)),
+                           lattice_max=max(cfg.engine.lattice_max,
+                                           args.lattice_max)))
         net = zoo.load(deep.dataset, r["model"])
         # One grid per (preset, cap): models of a preset share it, and the
         # stress grids reach 3.3M boxes — rebuild per row would dominate,

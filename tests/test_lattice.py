@@ -77,6 +77,27 @@ def test_oracle_agreement(seed):
         assert (lo <= xp).all() and (xp <= hi).all()
 
 
+@pytest.mark.parametrize("seed", range(4))
+def test_prefix_peeling_matches_oracle(seed):
+    """Forcing the int32 guard low makes the scan peel leading dims to the
+    host; verdicts must not change."""
+    q = _query()
+    enc = encode(q)
+    net = _net(seed, (4, 8, 1))
+    lo = np.array([0, 0, 0, 0], dtype=np.int64)
+    hi = np.array([2, 2, 2, 1], dtype=np.int64)
+    verdict, ce = lattice_ops.decide_box_exhaustive(
+        net, enc, lo, hi, chunk=4, int32_limit=8, pipeline_depth=3)
+    assert verdict == _oracle(net, enc, lo, hi)
+    if verdict == "sat":
+        x, xp = ce
+        weights = [np.asarray(w) for w in net.weights]
+        biases = [np.asarray(b) for b in net.biases]
+        assert engine.validate_pair(weights, biases, x, xp)
+        assert (lo <= x).all() and (x <= hi).all()
+        assert (lo <= xp).all() and (xp <= hi).all()
+
+
 def test_exact_tie_is_not_a_flip():
     """A network whose logit is identically zero has sign 0 everywhere:
     the strict-flip property is UNSAT, and the margin path must settle it
