@@ -57,13 +57,13 @@ def main() -> int:
         latest[(r["run_id"], r["model"], r["soft_s"], r["hard_s"],
                 r.get("cap"))] = i
     wanted = set(args.presets.split(",")) if args.presets else None
-    todo = [i for k, i in sorted(latest.items())
+    todo = [(k, i) for k, i in sorted(latest.items())
             if 0 < recs[i]["unknown"] <= args.max_unknown
             and (wanted is None or k[0] in wanted)]
     print(f"{len(todo)} rows with residual unknowns", flush=True)
 
     grids: dict = {}
-    for i in todo:
+    for k, i in todo:
         r = recs[i]
         cfg = presets.get(r["run_id"]).with_(
             soft_timeout_s=r["soft_s"], hard_timeout_s=r["hard_s"],
@@ -116,31 +116,76 @@ def main() -> int:
                                          "row not patched"}), flush=True)
             continue
         n_fixed = sum(fixed.values())
-        r["sat"] += fixed["sat"]
-        r["unsat"] += fixed["unsat"]
-        r["unknown"] -= n_fixed
-        r["total_time_s"] = round(r["total_time_s"] + dt, 2)
-        r["decided_per_sec"] = round(
-            (r["sat"] + r["unsat"]) / max(r["total_time_s"], 1e-9), 3)
-        dr = r.setdefault("deep_retry", {"soft_s": args.soft, "fixed": 0,
-                                         "wall_s": 0.0})
-        # Repeated invocations at different --soft tiers accumulate into one
-        # marker labelled with the DEEPEST per-partition budget applied
-        # (rendered as "up to N s", scripts/variants.py).
-        dr["soft_s"] = max(dr["soft_s"], args.soft)
-        dr["fixed"] += n_fixed
-        dr["wall_s"] = round(dr["wall_s"] + dt, 2)
-        print(json.dumps({"run_id": r["run_id"], "model": r["model"],
-                          **fixed, "still_unknown": r["unknown"],
-                          "wall_s": round(dt, 2)}), flush=True)
-        # Patch after every row (a crash keeps completed work); write-then-
-        # rename so a kill mid-write can never truncate the ledger.
-        tmp = results_path + ".tmp"
-        with open(tmp, "w") as fp:
-            for rec in recs:
-                fp.write(json.dumps(rec) + "\n")
-        os.replace(tmp, results_path)
+
+        def patch(row):
+            row["sat"] += fixed["sat"]
+            row["unsat"] += fixed["unsat"]
+            row["unknown"] -= n_fixed
+            row["total_time_s"] = round(row["total_time_s"] + dt, 2)
+            row["decided_per_sec"] = round(
+                (row["sat"] + row["unsat"]) / max(row["total_time_s"], 1e-9),
+                3)
+            dr = row.setdefault("deep_retry", {"soft_s": args.soft,
+                                               "fixed": 0, "wall_s": 0.0})
+            # Repeated invocations at different --soft tiers accumulate
+            # into one marker labelled with the DEEPEST per-partition
+            # budget applied (rendered as "up to N s", scripts/variants.py).
+            dr["soft_s"] = max(dr["soft_s"], args.soft)
+            dr["fixed"] += n_fixed
+            dr["wall_s"] = round(dr["wall_s"] + dt, 2)
+            return row
+
+        if _patch_results_row(results_path, k, patch):
+            print(json.dumps({"run_id": r["run_id"], "model": r["model"],
+                              **fixed,
+                              "still_unknown": r["unknown"] - n_fixed,
+                              "wall_s": round(dt, 2)}), flush=True)
+        else:
+            # The target row vanished between startup and the patch (a
+            # concurrent rewrite) — the decided boxes ARE in the span
+            # ledger, only the results-row accounting is lost; say so.
+            print(json.dumps({"run_id": r["run_id"], "model": r["model"],
+                              **fixed,
+                              "warning": "results row disappeared; deep "
+                                         "verdicts kept in span ledger "
+                                         "but row not patched"}),
+                  flush=True)
     return 0
+
+
+def _patch_results_row(results_path: str, row_key, patch_fn) -> bool:
+    """Re-read → patch one row by key → atomic replace.
+
+    The driver runs for hours; holding its startup snapshot and rewriting
+    the whole file per patch silently dropped every record another process
+    (a concurrently appending sweep) added since startup.  Re-reading
+    immediately before each patch shrinks that lost-append window from
+    hours to milliseconds, and the write-then-rename keeps a kill mid-write
+    from truncating the ledger.  (Best effort, not a lock — don't run two
+    patching drivers concurrently.)  Returns False when no row matches the
+    key (a concurrent rewrite removed it) — the caller must surface that
+    rather than report success.
+    """
+    with open(results_path) as fp:
+        rows = [json.loads(line) for line in fp]
+    # Latest-wins, like main()'s `latest` dict: duplicate-key rows are an
+    # anticipated ledger state, and the LAST one is the live row.
+    target = None
+    for i, row in enumerate(rows):
+        if "skipped" in row or "attempted" not in row:
+            continue
+        if (row["run_id"], row["model"], row["soft_s"], row["hard_s"],
+                row.get("cap")) == row_key:
+            target = i
+    if target is None:
+        return False
+    patch_fn(rows[target])
+    tmp = results_path + ".tmp"
+    with open(tmp, "w") as fp:
+        for row in rows:
+            fp.write(json.dumps(row) + "\n")
+    os.replace(tmp, results_path)
+    return True
 
 
 if __name__ == "__main__":
