@@ -29,10 +29,12 @@ Evidence classes (docs/DESIGN.md numeric policy):
 * Every SAT verdict is re-proved by ``engine.validate_pair`` in exact
   arithmetic, so SAT never rests on float arithmetic at all.
 
-Scope: queries without relaxed attributes (RA ε pairs range over a delta
-lattice whose points leave the box — ``engine.decide_leaf`` semantics — and
-are served by Phase P instead); shared-lattice size gated by
-``EngineConfig.lattice_max``.
+Scope: RA-free queries, and single-RA queries via the ε-expanded axis with
+on-device window dilation (x′ partners unclamped, ``engine.decide_leaf``
+semantics; flip candidates and margin-touched core points settle exactly
+through ``decide_leaf``).  Multi-RA queries are not enumerable here (the
+(2ε+1)^k dilation is unimplemented) and stay Phase P's job.  Scan size is
+gated by ``EngineConfig.lattice_max``.
 """
 from __future__ import annotations
 
@@ -62,6 +64,28 @@ def shared_lattice_size(enc, lo: np.ndarray, hi: np.ndarray) -> int:
     for d in dims:
         n *= int(hi[d]) - int(lo[d]) + 1
     return n
+
+
+def enumerable_size(enc, lo: np.ndarray, hi: np.ndarray) -> Optional[int]:
+    """Scan size of the box if Phase E can enumerate it, else None.
+
+    RA-free: the shared lattice.  One RA dim with ε > 0: the lattice with
+    the RA axis expanded by ±ε (x' partners range over the unclamped delta
+    window, ``engine.decide_leaf`` semantics).  More than one RA dim:
+    None — the (2ε+1)^k dilation is not implemented.
+    """
+    if len(enc.ra_idx) and enc.eps:
+        if len(enc.ra_idx) > 1:
+            return None
+        dims = shared_dims(enc, len(lo))
+        n = 1
+        for d in dims:
+            w = int(hi[d]) - int(lo[d]) + 1
+            if d == int(enc.ra_idx[0]):
+                w += 2 * int(enc.eps)
+            n *= w
+        return n
+    return shared_lattice_size(enc, lo, hi)
 
 
 def _signed_forward(net: MLP, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
@@ -172,6 +196,73 @@ def _lattice_signs_kernel(net: MLP, start, strides, widths, lo_shared,
                          chunk, dims_tuple, d)
 
 
+@partial(jax.jit,
+         static_argnames=("chunk", "dims_tuple", "d", "ra_w", "eps"))
+def _lattice_scan_kernel_ra(net: MLP, start, n_total, strides, widths,
+                            lo_shared, bases, valid_mask, valid_pair_f,
+                            chunk: int, dims_tuple: tuple, d: int,
+                            ra_w: int, eps: int):
+    """RA-aware scan: the RA axis is the innermost suffix dim, expanded by
+    ±ε, and x' partners are found by dilating the certain-negative cells
+    along it (``engine.decide_leaf`` pair semantics: x core-ranged, x' at
+    an unclamped delta within ±ε).
+
+    Returns (first_flip, margin_count, margin_idx[MARGIN_BUF],
+    sign_cols[V, MARGIN_BUF+1]):
+    * ``first_flip``: first CORE point (RA coord inside the unexpanded
+      range) admitting a valid ordered pair (a, b) with a certain positive
+      sign at x and a certain negative sign at some window partner.
+    * ``margin_idx``: expanded-lattice cells whose sign is inside the
+      roundoff bound — the host resolves every core point whose window
+      touches one, exactly, via ``decide_leaf``.
+    """
+    s = _device_signs(net, start, strides, widths, lo_shared, bases,
+                      chunk, dims_tuple, d)
+    in_range = (start + jnp.arange(chunk, dtype=jnp.int32)) < n_total
+    # start and chunk are multiples of ra_w, so the column index within the
+    # RA row is position-stable across chunks.
+    col = jnp.arange(chunk, dtype=jnp.int32) % ra_w
+    core = (col >= eps) & (col < ra_w - eps) & in_range
+    vm = valid_mask[:, None]
+    V = s.shape[0]
+    rows = chunk // ra_w
+
+    # Dilate certain signs over the ±ε window along the RA axis.  Dups
+    # (≥ n_total) are masked BEFORE dilation: a wrapped cell belongs to a
+    # different shared-coordinate row and must not donate a partner.
+    def dilate(mask):
+        m = mask.reshape(V, rows, ra_w)
+        out = jnp.zeros_like(m)
+        cidx = jnp.arange(ra_w)
+        for dlt in range(-eps, eps + 1):
+            ok = (cidx + dlt >= 0) & (cidx + dlt < ra_w)
+            out = out | (jnp.roll(m, -dlt, axis=2) & ok[None, None, :])
+        return out.reshape(V, chunk).astype(jnp.float32)
+
+    live = vm & in_range[None, :]
+    dil_neg = dilate((s == -1) & live)
+    dil_pos = dilate((s == 1) & live)
+    posc = ((s == 1) & vm & core[None, :]).astype(jnp.float32)
+    negc = ((s == -1) & vm & core[None, :]).astype(jnp.float32)
+    # decide_leaf accepts EITHER sign direction for a pair (a, b): the
+    # core point x may be the positive endpoint with a negative window
+    # partner, or the negative endpoint with a positive one (the positive
+    # cell can live only in the ε-expanded boundary ring).
+    flip = ((posc > 0) & (matmul(valid_pair_f, dil_neg) > 0)).any(axis=0) \
+        | ((negc > 0) & (matmul(valid_pair_f, dil_pos) > 0)).any(axis=0)
+    first_flip = jnp.where(flip.any(), jnp.argmax(flip), -1)
+
+    is_margin = ((s == 0) & vm).any(axis=0) & in_range
+    margin_count = is_margin.sum()
+    (margin_idx,) = jnp.nonzero(is_margin, size=MARGIN_BUF, fill_value=-1)
+
+    take = jnp.concatenate(
+        [jnp.clip(margin_idx, 0, chunk - 1),
+         jnp.clip(first_flip, 0, chunk - 1)[None]])
+    sign_cols = s[:, take]
+    return first_flip, margin_count, margin_idx, sign_cols
+
+
 def _host_signs(weights, biases, pts: np.ndarray) -> np.ndarray:
     """Signs for margin points: vectorized f64 forward, exact rational for
     the |f64| ≤ 1e-6 residue (``exact_logit_sign``'s ladder, batched)."""
@@ -216,13 +307,19 @@ def decide_box_exhaustive(
     None)`` when no exact strict flip exists anywhere on the lattice, or
     ``('unknown', None)`` on deadline or on an evidence-ladder
     disagreement (a device "certain" sign failing exact validation — then
-    no sign is trusted).  Caller gates RA and lattice size
-    (``engine._lattice_phase``).
+    no sign is trusted).  Caller gates the scan size
+    (``engine._lattice_phase``); multi-RA queries return unknown here.
 
-    Lattices past the 32-bit device decode are **prefix-peeled**: leading
-    shared dims are enumerated host-side (their values baked into the
-    per-sweep ``bases``) until the suffix lattice fits int32; one kernel
-    compile serves every prefix.  Chunks are **pipeline-dispatched**
+    One RA dim is handled completely: its axis is expanded ±ε, laid out
+    innermost, and certain-negative partner cells are dilated over the
+    delta window on device (``engine.decide_leaf`` pair semantics, x′
+    unclamped); flip candidates and margin-touched core points are settled
+    exactly by ``decide_leaf``.
+
+    Lattices past the 32-bit device decode are **prefix-peeled**: shared
+    dims are enumerated host-side (their values baked into the per-sweep
+    ``bases``) until the suffix lattice fits int32; one kernel compile
+    serves every prefix.  Chunks are **pipeline-dispatched**
     ``pipeline_depth`` ahead — on the tunnelled chip the per-chunk cost is
     the device→host round-trip, not compute, so overlapping transfers is
     what makes 10^10-point boxes (stress-BM class) enumerable in minutes.
@@ -245,8 +342,29 @@ def decide_box_exhaustive(
     lo = np.asarray(lo, dtype=np.int64)
     hi = np.asarray(hi, dtype=np.int64)
     d = int(lo.shape[0])
+
+    # RA mode: one relaxed dim is handled by expanding its axis ±ε and
+    # dilating partners along it on device; more are not implemented.
+    ra_mode = bool(len(enc.ra_idx)) and int(enc.eps) > 0
+    if ra_mode and len(enc.ra_idx) > 1:
+        return "unknown", None
+    ra_dim = int(enc.ra_idx[0]) if ra_mode else -1
+    eps = int(enc.eps) if ra_mode else 0
+    lo_eff = lo.copy()
+    hi_eff = hi.copy()
+    if ra_mode:
+        lo_eff[ra_dim] -= eps
+        hi_eff[ra_dim] += eps
+
     dims = shared_dims(enc, d)
-    N = shared_lattice_size(enc, lo, hi)
+    if ra_mode:
+        # RA axis innermost (stride 1): partner windows then live inside
+        # one contiguous row and never cross a chunk boundary.
+        dims = np.array([x for x in dims if x != ra_dim] + [ra_dim])
+    N = 1
+    for dm in dims:
+        N *= int(hi_eff[dm]) - int(lo_eff[dm]) + 1
+    ra_w = int(hi_eff[ra_dim] - lo_eff[ra_dim] + 1) if ra_mode else 1
 
     V = enc.n_assign
     valid = valid_assignments(enc, lo, hi)
@@ -258,19 +376,23 @@ def decide_box_exhaustive(
     # the prefix count is N/n_suf, so removing the least width necessary
     # keeps host round-trips (and last-chunk padding waste) minimal; fixed
     # leading-order peeling could overshoot by orders of magnitude when an
-    # early dim is very wide.
+    # early dim is very wide.  The RA axis is never peeled (its window
+    # dilation runs on device).
     n_suf = N
-    by_width = sorted(range(len(dims)),
-                      key=lambda j: int(hi[dims[j]]) - int(lo[dims[j]]) + 1)
+    by_width = sorted(
+        (j for j in range(len(dims)) if int(dims[j]) != ra_dim),
+        key=lambda j: int(hi_eff[dims[j]]) - int(lo_eff[dims[j]]) + 1)
     peeled = []
     for j in by_width:
         if n_suf < int32_limit - chunk:
             break
-        n_suf //= int(hi[dims[j]]) - int(lo[dims[j]]) + 1
+        n_suf //= int(hi_eff[dims[j]]) - int(lo_eff[dims[j]]) + 1
         peeled.append(j)
+    if n_suf >= int32_limit - chunk:
+        return "unknown", None  # RA axis alone exceeds int32 — not expected
     peel_dims = dims[sorted(peeled)]
     suf_dims = dims[sorted(set(range(len(dims))) - set(peeled))]
-    suf_widths = (hi[suf_dims] - lo[suf_dims] + 1).astype(np.int64)
+    suf_widths = (hi_eff[suf_dims] - lo_eff[suf_dims] + 1).astype(np.int64)
     suf_strides = np.ones(len(suf_dims), dtype=np.int64)
     for k in range(len(suf_dims) - 2, -1, -1):
         suf_strides[k] = suf_strides[k + 1] * suf_widths[k + 1]
@@ -279,6 +401,15 @@ def decide_box_exhaustive(
     widest = max([d] + [int(w.shape[1]) for w in weights])
     max_chunk = max(1 << 12, int((1 << 28) // max(V * widest, 1)))
     chunk = int(min(chunk, max_chunk))
+    if ra_mode:
+        # Chunks hold whole RA rows so windows never cross a boundary.
+        if ra_w > max_chunk:
+            return "unknown", None  # one RA row exceeds device memory
+        chunk = max(ra_w, chunk - chunk % ra_w)
+        if n_suf >= int32_limit - chunk:
+            # Re-check the int32 headroom with the aligned chunk (the peel
+            # guard above used the pre-alignment value).
+            return "unknown", None
 
     valid_np = np.zeros(V, dtype=bool)
     valid_np[valid] = True
@@ -287,7 +418,7 @@ def decide_box_exhaustive(
     dev = dict(
         strides=jnp.asarray(suf_strides.astype(np.int32)),
         widths=jnp.asarray(suf_widths.astype(np.int32)),
-        lo_shared=jnp.asarray(lo[suf_dims].astype(np.int32)),
+        lo_shared=jnp.asarray(lo_eff[suf_dims].astype(np.int32)),
         valid_mask=jnp.asarray(valid_np),
         valid_pair_f=jnp.asarray(vp.astype(np.float32)),
     )
@@ -299,7 +430,7 @@ def decide_box_exhaustive(
             if len(peel_dims):
                 pts[:, peel_dims] = np.asarray(prefix_vals, dtype=np.int64)
             pts[:, suf_dims] = (idx_flat[:, None] // suf_strides[None, :]) \
-                % suf_widths[None, :] + lo[suf_dims][None, :]
+                % suf_widths[None, :] + lo_eff[suf_dims][None, :]
             return pts
         return decode
 
@@ -330,11 +461,50 @@ def decide_box_exhaustive(
             for c0 in range(0, n_suf, chunk):
                 yield prefix_vals, bases_dev, c0
 
+    def leaf_core(decode, idx_flat: int) -> Optional[tuple]:
+        """Exact per-point decision (RA mode): decide_leaf enumerates every
+        assignment pair and delta at the decoded core point."""
+        from fairify_tpu.verify.engine import decide_leaf
+
+        point = decode(np.array([idx_flat]))[0]
+        verdict, ce = decide_leaf(enc, weights, biases, point, lo, hi)
+        if verdict == "sat":
+            return "sat", ce
+        return None
+
+    def ra_core_candidates(c0, cells) -> list:
+        """Core flat indices whose ±ε window touches any of ``cells``."""
+        out = set()
+        for m in cells:
+            m = int(m)
+            col = m % ra_w
+            row0 = m - col
+            for c in range(max(eps, col - eps),
+                           min(ra_w - eps - 1, col + eps) + 1):
+                out.add(c0 + row0 + c)
+        return sorted(out)
+
+    def resolve_ra_cells(decode, c0, cells) -> Optional[tuple]:
+        for idx_flat in ra_core_candidates(c0, cells):
+            if time_left() <= 0:
+                raise _DeadlineHit
+            out = leaf_core(decode, idx_flat)
+            if out is not None:
+                return out
+        return None
+
     def process(prefix_vals, c0, bases_dev, results) -> Optional[tuple]:
         first_flip, margin_count, margin_idx, sign_cols = results
         decode = make_decode(prefix_vals)
         n_here = min(chunk, n_suf - c0)
         if 0 <= int(first_flip) < n_here:
+            if ra_mode:
+                # The certain flip pairs x with a window partner; the exact
+                # per-point leaf re-derives it (and the witness) exactly.
+                out = leaf_core(decode, c0 + int(first_flip))
+                if out is None:  # certain flip refuted exactly
+                    raise _EvidenceMismatch
+                return out
             pair = _pair_flip(sign_cols[:, -1], valid, enc.valid_pair)
             if pair is None:  # device/host pair-matrix disagreement
                 raise _EvidenceMismatch
@@ -347,10 +517,16 @@ def decide_box_exhaustive(
                 net, jnp.int32(c0), dev["strides"], dev["widths"],
                 dev["lo_shared"], bases_dev, chunk, dims_tuple,
                 d))[:, :n_here]
+            if ra_mode:
+                cells = np.where((s_full[valid] == 0).any(axis=0))[0]
+                return resolve_ra_cells(decode, c0, cells)
             return _resolve_signs(enc, weights, biases, decode, valid,
                                   c0, s_full, validate_pair, time_left)
         if mc > 0:
             midx = margin_idx[margin_idx >= 0]
+            midx = midx[midx < n_here]
+            if ra_mode:
+                return resolve_ra_cells(decode, c0, midx)
             return _resolve_margin(
                 enc, weights, biases, decode, valid, c0, midx,
                 sign_cols[:, :MARGIN_BUF], n_here, validate_pair,
@@ -372,11 +548,18 @@ def decide_box_exhaustive(
                 if time_left() <= 0:
                     return "unknown", None
                 prefix_vals, bases_dev, c0 = nxt
-                fut = _lattice_scan_kernel(
-                    net, jnp.int32(c0), jnp.int32(n_suf), dev["strides"],
-                    dev["widths"], dev["lo_shared"], bases_dev,
-                    dev["valid_mask"], dev["valid_pair_f"], chunk,
-                    dims_tuple, d)
+                if ra_mode:
+                    fut = _lattice_scan_kernel_ra(
+                        net, jnp.int32(c0), jnp.int32(n_suf),
+                        dev["strides"], dev["widths"], dev["lo_shared"],
+                        bases_dev, dev["valid_mask"], dev["valid_pair_f"],
+                        chunk, dims_tuple, d, ra_w, eps)
+                else:
+                    fut = _lattice_scan_kernel(
+                        net, jnp.int32(c0), jnp.int32(n_suf),
+                        dev["strides"], dev["widths"], dev["lo_shared"],
+                        bases_dev, dev["valid_mask"], dev["valid_pair_f"],
+                        chunk, dims_tuple, d)
                 inflight.append((prefix_vals, c0, bases_dev, fut))
             if not inflight:
                 break

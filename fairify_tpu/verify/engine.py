@@ -1200,19 +1200,21 @@ def decide_many(
 
 
 def _eligible_lattice_roots(enc, roots_lo, roots_hi, cfg) -> dict:
-    """root index → shared-lattice size, for roots Phase E can enumerate.
+    """root index → enumerable scan size, for roots Phase E can decide.
     The single eligibility rule shared by decide_many's budget reserve and
-    ``_lattice_phase``'s queue — these must never disagree."""
-    if not cfg.lattice_exhaustive or (len(enc.ra_idx) and enc.eps):
+    ``_lattice_phase``'s queue — these must never disagree.  RA-free and
+    single-RA queries are enumerable (the RA axis dilates on device);
+    multi-RA is not (``lattice.enumerable_size`` returns None)."""
+    if not cfg.lattice_exhaustive:
         return {}
     from fairify_tpu.ops import lattice as lattice_ops
 
     sizes = {}
     for r in range(roots_lo.shape[0]):
-        n = lattice_ops.shared_lattice_size(
+        n = lattice_ops.enumerable_size(
             enc, np.asarray(roots_lo[r], dtype=np.int64),
             np.asarray(roots_hi[r], dtype=np.int64))
-        if n <= cfg.lattice_max:
+        if n is not None and n <= cfg.lattice_max:
             sizes[r] = n
     return sizes
 
@@ -1221,17 +1223,16 @@ def _lattice_phase(net, enc, roots_lo, roots_hi, verdicts, ces,
                    cost_s, cfg, t0, deadline_s, lat_sizes=None):
     """Phase E: exhaustive lattice enumeration of the still-unknown roots.
 
-    Complete for RA-free queries on boxes whose shared lattice fits
-    ``cfg.lattice_max`` — exactly the wide flip-slab class where input
-    splitting diverges (the box is finite; enumerate it).  RA-ε queries are
-    excluded: their pair space leaves the box (``decide_leaf`` delta
-    semantics) and stays Phase P's job.  Roots are visited smallest lattice
-    first, so one near-cap root cannot starve trivially cheap ones.
+    Complete for RA-free and single-RA queries on boxes whose enumerable
+    scan fits ``cfg.lattice_max`` — exactly the wide flip-slab class where
+    input splitting diverges (the box is finite; enumerate it).  The RA
+    axis is expanded ±ε and partner-dilated on device (``decide_leaf``
+    delta semantics, x′ unclamped); multi-RA queries are excluded.  Roots
+    are visited smallest lattice first, so one near-cap root cannot starve
+    trivially cheap ones.
     """
     from fairify_tpu.ops import lattice as lattice_ops
 
-    if len(enc.ra_idx) and enc.eps:
-        return
     if lat_sizes is None:
         lat_sizes = _eligible_lattice_roots(enc, roots_lo, roots_hi, cfg)
     pending = sorted(

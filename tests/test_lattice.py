@@ -140,17 +140,111 @@ def test_decide_many_lattice_fallthrough():
         assert dec[0].verdict == _oracle(net, enc, lo[0], hi[0])
 
 
+def _ra_query(eps):
+    names = ("ra", "a1", "a2", "p")
+    ranges = {"ra": (0, 4), "a1": (0, 2), "a2": (0, 2), "p": (0, 1)}
+    dom = DomainSpec(name="toy", columns=names, ranges=ranges, label="y")
+    return FairnessQuery(domain=dom, protected=("p",), relaxed=("ra",),
+                         relax_eps=eps)
+
+
+def _ra_oracle(net, enc, lo, hi):
+    """Per-point exact decision over every core point via decide_leaf —
+    the trusted single-point semantics applied to the whole box."""
+    weights = [np.asarray(w) for w in net.weights]
+    biases = [np.asarray(b) for b in net.biases]
+    dims = [k for k in range(len(lo)) if k not in enc.pa_idx]
+    spaces = [range(int(lo[k]), int(hi[k]) + 1) for k in dims]
+    for coord in itertools.product(*spaces):
+        pt = np.array(lo, dtype=np.int64)
+        pt[dims] = coord
+        verdict, _ = engine.decide_leaf(enc, weights, biases, pt, lo, hi)
+        if verdict == "sat":
+            return "sat"
+    return "unsat"
+
+
+@pytest.mark.parametrize("seed,eps", [(s, e) for s in range(4)
+                                      for e in (1, 2)])
+def test_ra_window_matches_per_point_oracle(seed, eps):
+    """Single-RA boxes are decided by the ε-dilated scan; verdicts must
+    match decide_leaf applied to every core point, and SAT witnesses must
+    satisfy the RA pair constraints exactly."""
+    q = _ra_query(eps)
+    enc = encode(q)
+    net = _net(seed, (4, 8, 1))
+    lo = np.array([0, 0, 0, 0], dtype=np.int64)
+    hi = np.array([4, 2, 2, 1], dtype=np.int64)
+    verdict, ce = lattice_ops.decide_box_exhaustive(net, enc, lo, hi,
+                                                    chunk=16)
+    assert verdict == _ra_oracle(net, enc, lo, hi)
+    if verdict == "sat":
+        x, xp = ce
+        weights = [np.asarray(w) for w in net.weights]
+        biases = [np.asarray(b) for b in net.biases]
+        assert engine.validate_pair(weights, biases, x, xp)
+        assert x[3] != xp[3]                      # PA differs
+        assert abs(int(x[0]) - int(xp[0])) <= eps  # RA within ε
+        assert (x[1:3] == xp[1:3]).all()          # other dims equal
+        # x is in-box; x' may leave the box on the RA axis only
+        assert (lo <= x).all() and (x <= hi).all()
+        assert (lo[1:] <= xp[1:]).all() and (xp[1:] <= hi[1:]).all()
+
+
+def test_ra_flip_with_positive_only_in_expanded_ring():
+    """Directed soundness regression: f(x) = ra − 4.5 makes every core
+    point certainly negative and only expanded-ring cells (ra = 5, 6)
+    positive.  decide_leaf accepts the (x negative, x′ positive) direction,
+    so the box is SAT — a scan that only dilates negatives returns a wrong
+    UNSAT."""
+    q = _ra_query(2)
+    enc = encode(q)
+    # logit = 1.0·ra − 4.5, ignoring every other input.
+    w1 = np.zeros((4, 2), np.float32)
+    w1[0, 0] = 1.0
+    net = from_numpy(
+        [w1, np.array([[1.0], [0.0]], np.float32)],
+        [np.zeros(2, np.float32), np.array([-4.5], np.float32)])
+    lo = np.array([0, 0, 0, 0], dtype=np.int64)
+    hi = np.array([4, 2, 2, 1], dtype=np.int64)
+    assert _ra_oracle(net, enc, lo, hi) == "sat"
+    verdict, ce = lattice_ops.decide_box_exhaustive(net, enc, lo, hi,
+                                                    chunk=16)
+    assert verdict == "sat"
+    x, xp = ce
+    weights = [np.asarray(w) for w in net.weights]
+    biases = [np.asarray(b) for b in net.biases]
+    assert engine.validate_pair(weights, biases, x, xp)
+    assert int(xp[0]) > 4  # the positive endpoint lies outside the box
+
+
+def test_ra_window_peeled_matches_oracle():
+    """RA mode composes with prefix peeling (RA axis never peeled)."""
+    q = _ra_query(1)
+    enc = encode(q)
+    net = _net(2, (4, 8, 1))
+    lo = np.array([0, 0, 0, 0], dtype=np.int64)
+    hi = np.array([4, 2, 2, 1], dtype=np.int64)
+    verdict, _ = lattice_ops.decide_box_exhaustive(
+        net, enc, lo, hi, chunk=8, int32_limit=32, pipeline_depth=2)
+    assert verdict == _ra_oracle(net, enc, lo, hi)
+
+
 def test_lattice_gates():
-    """RA-ε queries and over-large lattices are left unknown (honest)."""
+    """Multi-RA queries and over-large lattices are left unknown (honest);
+    single-RA roots are eligible and settle."""
     import time
 
     names = ("a0", "a1", "p")
     dom = DomainSpec(name="toy", columns=names,
                      ranges={"a0": (0, 2), "a1": (0, 2), "p": (0, 1)},
                      label="y")
-    q_ra = FairnessQuery(domain=dom, protected=("p",), relaxed=("a0",),
-                         relax_eps=2)
-    enc_ra = encode(q_ra)
+    q_2ra = FairnessQuery(domain=dom, protected=("p",),
+                          relaxed=("a0", "a1"), relax_eps=2)
+    enc_2ra = encode(q_2ra)
+    q_1ra = FairnessQuery(domain=dom, protected=("p",), relaxed=("a0",),
+                          relax_eps=2)
+    enc_1ra = encode(q_1ra)
     net = _net(1, (3, 6, 1))
     lo = np.array([[0, 0, 0]], dtype=np.int64)
     hi = np.array([[2, 2, 1]], dtype=np.int64)
@@ -161,10 +255,11 @@ def test_lattice_gates():
                               np.zeros(1), cfg, time.perf_counter(), 30.0)
         return verdicts[0]
 
-    # RA gate: Phase E must not run (delta pairs leave the box).
-    assert run(enc_ra, engine.EngineConfig()) == "unknown"
+    # Multi-RA gate: the (2ε+1)^k dilation is not implemented.
+    assert run(enc_2ra, engine.EngineConfig()) == "unknown"
     # Size gate: shared lattice is 9 > lattice_max=4.
     enc = encode(_query(d=3))
     assert run(enc, engine.EngineConfig(lattice_max=4)) == "unknown"
-    # Control: with the gates open the same root settles.
+    # Controls: with the gates open, RA-free and single-RA roots settle.
     assert run(enc, engine.EngineConfig()) in ("sat", "unsat")
+    assert run(enc_1ra, engine.EngineConfig()) in ("sat", "unsat")
