@@ -908,8 +908,15 @@ class EngineConfig:
     lattice_chunk: int = 1 << 18
     # Fraction of the deadline reserved for Phase E when it is applicable —
     # without a reserve the input-split BaB and Phase P spend the whole
-    # budget first and enumeration never runs.
+    # budget first and enumeration never runs.  The reserve PREEMPTS the
+    # BaB, so it only engages when some eligible root is at least
+    # lattice_reserve_min points — the flip-slab monsters BaB grinds on
+    # fruitlessly.  Small-lattice roots don't need it: when BaB gives up
+    # early (node caps), deadline is left over and Phase E runs anyway;
+    # when BaB is productive, preempting it only slows the sweep (GC-1
+    # headline: 3.4 s → 10.3 s with an unconditional reserve).
     lattice_frac: float = 0.2
+    lattice_reserve_min: float = 1.0e6
 
 
 @dataclass
@@ -1033,8 +1040,15 @@ def decide_many(
     # eligible root stayed unknown (~1e6 pts/s conservative scan rate plus
     # one compile) — a batch with one tiny eligible root must not tax the
     # hard roots' BaB budget by a fixed 20%.
+    # Deliberate tradeoff: a batch of MANY sub-threshold flip-slab roots
+    # could still grind BaB to the wall and reach Phase E with nothing
+    # left — but gating on the aggregate would re-preempt productive BaB
+    # batches (the GC-1 case).  Those leftovers are not lost: the sweep's
+    # soft-budget retry and the deep-retry ladder re-enter decide_many
+    # with a fresh deadline, where Phase E runs with room.
     lat_frac = 0.0
-    if use_lattice:
+    if use_lattice and any(n >= cfg.lattice_reserve_min
+                           for n in lat_sizes.values()):
         est_s = 120.0 + sum(lat_sizes.values()) / 1.0e6
         lat_frac = min(cfg.lattice_frac, est_s / max(deadline_s, 1e-9))
     pair_deadline = deadline_s * (1.0 - lat_frac)
