@@ -634,16 +634,19 @@ def uniform_sign_bab(
     Per root the conjectured sign comes from sampled role logits; a sample
     with the opposite sign, an exhausted node budget, or a branch whose
     bound contradicts the conjecture marks the root 'mixed' (hand it to the
-    pair BaB).  Returns ``(verdicts, nodes, cost_s)``: per-root verdicts
-    ('unsat' | 'mixed'), sign-BaB node counts, and attributed wall time
+    pair BaB).  Returns ``(verdicts, nodes, cost_s, lp_cost_s)``: per-root
+    verdicts ('unsat' | 'mixed'), sign-BaB node counts, attributed wall time
     (each batch's time split evenly over its sub-boxes, same additive
-    accounting as :func:`decide_many`, so per-root costs sum ≈ phase total).
+    accounting as :func:`decide_many`, so per-root costs sum ≈ phase total),
+    and the Phase-L (host LP) share of that time — per-phase attribution
+    surfaced through ``Decision.stats``.
     """
     t0 = time.perf_counter()
     R = roots_lo.shape[0]
     n_hidden = net.depth - 1
     if n_hidden == 0 or not len(enc.pa_idx):
-        return ["mixed"] * R, np.zeros(R, np.int64), np.zeros(R, np.float64)
+        return (["mixed"] * R, np.zeros(R, np.int64),
+                np.zeros(R, np.float64), np.zeros(R, np.float64))
     F = cfg.frontier_size
     if mesh is not None:
         from fairify_tpu.parallel import mesh as mesh_mod
@@ -697,7 +700,13 @@ def uniform_sign_bab(
     settled = np.zeros(R, dtype=bool)
     settled[~candidate] = True
     nodes = np.zeros(R, dtype=np.int64)
+    # Device-frontier splits only — the sign_max_nodes cap must not count
+    # Phase-L LP nodes (a root whose LP tree returned 'budget' with
+    # n_lp > sign_max_nodes would otherwise be failed before its first
+    # device split, losing the sign path entirely).
+    dev_nodes = np.zeros(R, dtype=np.int64)
     cost_s = np.zeros(R, dtype=np.float64)
+    lp_cost = np.zeros(R, dtype=np.float64)  # Phase-L share of cost_s
 
     # Phase L — complete LP BaB (ops.lp) on candidates with few unstable
     # ReLUs.  One batched device launch computes CROWN pre-activation bounds
@@ -753,7 +762,9 @@ def uniform_sign_bab(
                 deadline_s=min(cfg.soft_timeout_s, remaining),
             )
             nodes[r] += n_lp
-            cost_s[r] += time.perf_counter() - t_r
+            dt_r = time.perf_counter() - t_r
+            cost_s[r] += dt_r
+            lp_cost[r] += dt_r
             if outcome == "certified":
                 verdicts[r] = "unsat"
                 settled[r] = True
@@ -790,6 +801,7 @@ def uniform_sign_bab(
         # share a batch, and x[idx] -= 1 decrements duplicates only once.
         np.subtract.at(open_n, broot, 1)
         np.add.at(nodes, broot, 1)
+        np.add.at(dev_nodes, broot, 1)
         if mesh is not None:
             blo, bhi, *bsigns = mesh_mod.shard_parts(mesh, blo, bhi, *bsigns)
             bsigns = tuple(bsigns)
@@ -810,7 +822,7 @@ def uniform_sign_bab(
             elif (want_pos[r] and out_lo[k] > 0.0) or \
                     (want_neg[r] and out_hi[k] < 0.0):
                 pass  # branch certified
-            elif nodes[r] > cfg.max_nodes:
+            elif dev_nodes[r] > cfg.sign_max_nodes:
                 fail(r)
                 continue
             elif (want_pos[r] and out_hi[k] < 0.0) or \
@@ -851,7 +863,7 @@ def uniform_sign_bab(
                 verdicts[r] = "unsat"
                 settled[r] = True
         np.add.at(cost_s, broot, (time.perf_counter() - t_iter) / batch)
-    return verdicts, nodes, cost_s
+    return verdicts, nodes, cost_s, lp_cost
 
 
 # ---------------------------------------------------------------------------
@@ -872,11 +884,33 @@ class EngineConfig:
     max_nodes: int = 200_000
     soft_timeout_s: float = 100.0
     seed: int = 0
+    # Phase A: deep PGD attack on every root before any certificate work.
+    # The r4 profile (audits/profile_r4.json) showed the slow tail is
+    # mostly SAT roots whose witnesses the stage-0 attack missed: sign-BaB
+    # then burned ~10k nodes/root "certifying" boxes that have
+    # counterexamples (BM-4: 80 of 115 s), and the pair BaB spent seconds
+    # of serial kernel launches re-finding them by sub-box sampling.  One
+    # deeper PGD launch (more restarts than stage 0, fresh seed) settles
+    # 35-58% of those leftovers up front.
+    pgd_phase: bool = True
+    pgd_steps: int = 60
+    pgd_restarts: int = 96
     # Uniform-sign neuron-split BaB pre-phase (uniform_sign_bab): the
     # certificate of choice for deep nets whose logit range excludes zero
     # over most of the box; sign_bab_frac caps its share of the deadline.
+    # 0.2 (round 4, was 0.5): with Phase A settling missed-witness SATs
+    # and Phase L closing the deep UNSATs, the device frontier is a
+    # narrower specialist — r4 knob study: BM-4 sample 76.8→35.6 s and
+    # AC-7 sample 86.3→28.4 s at identical verdicts.
     sign_bab: bool = True
-    sign_bab_frac: float = 0.5
+    sign_bab_frac: float = 0.2
+    # Per-root cap on the DEVICE sign frontier (Phase L's LP trees have
+    # their own lp_sign_max_nodes): a root that has not certified by a
+    # thousand-odd sign splits almost never will (the genuinely-deep UNSAT
+    # roots close via the LP path), while SAT roots the attack missed can
+    # otherwise burn 10k+ nodes here before the pair BaB gets a chance
+    # (BM-4 class, audits/profile_r4.json).
+    sign_max_nodes: int = 1500
     # Phase L: complete triangle-relaxation LP BaB (ops.lp) for sign
     # candidates whose box has few unstable ReLUs — the AC-7-residue
     # closer.  max_unstable gates which roots take the host LP path;
@@ -894,11 +928,13 @@ class EngineConfig:
     lp_pair_max_nodes: int = 800
     lp_pair_max_dirs: int = 32
     # Phase E: exhaustive integer-lattice enumeration (ops.lattice) for
-    # RA-free roots still unknown after every other phase — the complete
-    # decision for wide flip-slab boxes the input-split BaB diverges on
-    # (stress-AC box 768: 67M lattice points beat 3.4M BaB nodes).
-    # lattice_max gates the shared-lattice size (points); lattice_chunk is
-    # the device batch per forward launch.
+    # RA-free, single-RA, and two-RA (ε-dilated) roots still unknown after
+    # every other phase — the complete decision for wide flip-slab boxes
+    # the input-split BaB diverges on (stress-AC box 768: 67M lattice
+    # points beat 3.4M BaB nodes).  Three or more RA dims are excluded
+    # (ADVICE r3 #3 scope note, generalized in round 4).  lattice_max
+    # gates the (ε-expanded) scan size (points); lattice_chunk is the
+    # device batch per forward launch.
     lattice_exhaustive: bool = True
     lattice_max: float = 2.0e8
     # Chunk size trades XLA compile time (once per architecture) against
@@ -995,6 +1031,37 @@ def decide_many(
     verdicts: list = [None] * R
     ces: list = [None] * R
 
+    # Phase A — deep PGD attack on every root (one jitted launch per 1024-
+    # root chunk; fixed chunk size so the kernel compiles once per net).
+    # Settles the SAT roots whose witnesses shallower attacks missed BEFORE
+    # the certificate phases can waste their budget on them
+    # (audits/profile_r4.json: the BM-4 sign phase and most pair-BaB
+    # seconds were spent re-discovering missed witnesses).
+    attack_cost = np.zeros(R, dtype=np.float64)
+    if cfg.pgd_phase and len(enc.pa_idx) and R:
+        t_a = time.perf_counter()
+        rng_a = np.random.default_rng(cfg.seed + 17)
+        # Chunk cap scales down for small calls (decide_box, heuristic
+        # retry: R=1) — pgd_attack pads to the next power of two itself,
+        # so tiny calls stay tiny; large calls amortize at 1024/launch.
+        CH = min(1024, 1 << max(R - 1, 0).bit_length())
+        # Budget guard: the attack must never eat the certificate phases'
+        # deadline — cap it at a quarter and stop between chunks.
+        attack_deadline = 0.25 * deadline_s
+        for s in range(0, R, CH):
+            if time.perf_counter() - t_a > attack_deadline:
+                break
+            blk = np.arange(s, min(s + CH, R))
+            w = pgd_attack(
+                net, enc, np.asarray(roots_lo[blk], dtype=np.int64),
+                np.asarray(roots_hi[blk], dtype=np.int64), rng_a,
+                steps=cfg.pgd_steps, restarts=cfg.pgd_restarts)
+            for i, ce in w.items():
+                if i < len(blk) and verdicts[s + i] is None:
+                    verdicts[s + i] = "sat"
+                    ces[s + i] = ce
+        attack_cost[:] = (time.perf_counter() - t_a) / R
+
     # Phase S — uniform-sign neuron-split BaB.  Roots whose sampled role
     # logits are one-signed get a β-CROWN-style activation-split proof
     # attempt first; input splitting on deep nets converges too slowly for
@@ -1009,14 +1076,20 @@ def decide_many(
     # governing the input-split tree alone, as before.
     sign_nodes = np.zeros(R, dtype=np.int64)
     sign_cost = np.zeros(R, dtype=np.float64)
-    if cfg.sign_bab and cfg.use_crown and cfg.alpha_iters > 0 and R:
-        sv, sign_nodes, sign_cost = uniform_sign_bab(
-            net, enc, np.asarray(roots_lo, dtype=np.int64),
-            np.asarray(roots_hi, dtype=np.int64), cfg,
+    sign_lp_cost = np.zeros(R, dtype=np.float64)
+    open_idx = np.array([r for r in range(R) if verdicts[r] is None])
+    if cfg.sign_bab and cfg.use_crown and cfg.alpha_iters > 0 \
+            and open_idx.size:
+        sv, sn, sc, slp = uniform_sign_bab(
+            net, enc, np.asarray(roots_lo)[open_idx].astype(np.int64),
+            np.asarray(roots_hi)[open_idx].astype(np.int64), cfg,
             deadline_s=cfg.sign_bab_frac * deadline_s, mesh=mesh)
-        for r, v in enumerate(sv):
+        sign_nodes[open_idx] = sn
+        sign_cost[open_idx] = sc
+        sign_lp_cost[open_idx] = slp
+        for k, v in enumerate(sv):
             if v == "unsat":
-                verdicts[r] = "unsat"
+                verdicts[int(open_idx[k])] = "unsat"
 
     frontier = deque(
         (np.asarray(roots_lo[r], dtype=np.int64), np.asarray(roots_hi[r], dtype=np.int64), r)
@@ -1168,20 +1241,40 @@ def decide_many(
                 # Coefficient-aware branching: split the dim whose width
                 # contributes most to the difference-certificate slack
                 # (score_j·width_j); zero-score frontier → widest-dim.
+                # Multi-way when the frontier is underfull: each kernel
+                # launch costs the full padded batch regardless of how many
+                # live boxes ride it, so on small frontiers (hard single
+                # roots — the r4 slow-tail profile measured 5-25 ms/node of
+                # pure launch latency) splitting the top-2/3 dims at once
+                # packs 2-3 binary levels into one launch.
                 if score is not None:
                     sc = score[k][branch_dims] * widths
-                    dim = (branch_dims[int(sc.argmax())] if float(sc.max()) > 0
-                           else branch_dims[int(widths.argmax())])
+                    if float(sc.max()) <= 0:
+                        sc = widths.astype(np.float64)
                 else:
-                    dim = branch_dims[int(widths.argmax())]
-                mid = (l[dim] + h[dim]) // 2
-                left_hi = h.copy()
-                left_hi[dim] = mid
-                right_lo = l.copy()
-                right_lo[dim] = mid + 1
-                frontier.append((l, left_hi, r))
-                frontier.append((right_lo, h, r))
-                open_boxes[r] += 2
+                    sc = widths.astype(np.float64)
+                n_dims = 1
+                if len(frontier) + 2 * undecided.size < F // 2:
+                    n_dims = 3 if len(frontier) + 4 * undecided.size < F // 4 \
+                        else 2
+                order = np.argsort(-sc, kind="stable")
+                chosen = [int(branch_dims[j]) for j in order[:n_dims]
+                          if widths[j] > 0][: n_dims]
+                children = [(l, h)]
+                for dim in chosen:
+                    nxt = []
+                    for cl, ch_ in children:
+                        mid = (cl[dim] + ch_[dim]) // 2
+                        left_hi = ch_.copy()
+                        left_hi[dim] = mid
+                        right_lo = cl.copy()
+                        right_lo[dim] = mid + 1
+                        nxt.append((cl, left_hi))
+                        nxt.append((right_lo, ch_))
+                    children = nxt
+                for cl, ch_ in children:
+                    frontier.append((cl, ch_, r))
+                open_boxes[r] += len(children)
 
         # Attribute this iteration's wall time to its roots, per sub-box, so
         # per-root costs are additive (sum ≈ total phase time).
@@ -1197,18 +1290,31 @@ def decide_many(
         if verdicts[r] is None:
             settle(r, "unsat" if open_boxes[r] == 0 else "unknown")
 
+    pair_cost = np.zeros(R, dtype=np.float64)
+    lat_cost = np.zeros(R, dtype=np.float64)
     if use_pair and any(v == "unknown" for v in verdicts):
         _pair_lp_phase(net, enc, roots_lo, roots_hi, verdicts, ces,
-                       nodes, cost_s, cfg, t0, pair_deadline)
+                       nodes, pair_cost, cfg, t0, pair_deadline)
 
     if use_lattice and any(v == "unknown" for v in verdicts):
         _lattice_phase(net, enc, roots_lo, roots_hi, verdicts, ces,
-                       cost_s, cfg, t0, deadline_s, lat_sizes=lat_sizes)
+                       lat_cost, cfg, t0, deadline_s, lat_sizes=lat_sizes)
 
+    # Per-root per-phase attribution: A = deep PGD attack (split evenly),
+    # S = sign-BaB device frontier, L = host LP inside the sign phase,
+    # bab = input-split pair BaB, P = relational pair LP, E = lattice
+    # enumeration.  Sums to elapsed_s.
     return [
         Decision(verdicts[r], ces[r],
                  nodes=int(nodes[r] + sign_nodes[r]), leaves=int(leaves[r]),
-                 elapsed_s=float(cost_s[r] + sign_cost[r]))
+                 elapsed_s=float(attack_cost[r] + cost_s[r] + sign_cost[r]
+                                 + pair_cost[r] + lat_cost[r]),
+                 stats={"t_attack": float(attack_cost[r]),
+                        "t_sign": float(sign_cost[r] - sign_lp_cost[r]),
+                        "t_lp": float(sign_lp_cost[r]),
+                        "t_bab": float(cost_s[r]),
+                        "t_pair": float(pair_cost[r]),
+                        "t_lattice": float(lat_cost[r])})
         for r in range(R)
     ]
 
@@ -1216,9 +1322,11 @@ def decide_many(
 def _eligible_lattice_roots(enc, roots_lo, roots_hi, cfg) -> dict:
     """root index → enumerable scan size, for roots Phase E can decide.
     The single eligibility rule shared by decide_many's budget reserve and
-    ``_lattice_phase``'s queue — these must never disagree.  RA-free and
-    single-RA queries are enumerable (the RA axis dilates on device);
-    multi-RA is not (``lattice.enumerable_size`` returns None)."""
+    ``_lattice_phase``'s queue — these must never disagree.  RA-free,
+    single-RA, and two-RA queries are enumerable (each RA axis dilates on
+    device; the 2-RA box window separably); three or more RA dims are not
+    (``lattice.enumerable_size`` returns None), nor are boxes whose
+    ε-expanded coordinates reach 2²⁴ (f32-exactness guard)."""
     if not cfg.lattice_exhaustive:
         return {}
     from fairify_tpu.ops import lattice as lattice_ops
@@ -1237,11 +1345,12 @@ def _lattice_phase(net, enc, roots_lo, roots_hi, verdicts, ces,
                    cost_s, cfg, t0, deadline_s, lat_sizes=None):
     """Phase E: exhaustive lattice enumeration of the still-unknown roots.
 
-    Complete for RA-free and single-RA queries on boxes whose enumerable
-    scan fits ``cfg.lattice_max`` — exactly the wide flip-slab class where
-    input splitting diverges (the box is finite; enumerate it).  The RA
-    axis is expanded ±ε and partner-dilated on device (``decide_leaf``
-    delta semantics, x′ unclamped); multi-RA queries are excluded.  Roots
+    Complete for RA-free, single-RA, and two-RA queries on boxes whose
+    enumerable scan fits ``cfg.lattice_max`` — exactly the wide flip-slab
+    class where input splitting diverges (the box is finite; enumerate
+    it).  Each RA axis is expanded ±ε and partner-dilated on device
+    (``decide_leaf`` delta semantics, x′ unclamped; the 2-RA window is
+    separable); queries with three or more RA dims are excluded.  Roots
     are visited smallest lattice first, so one near-cap root cannot starve
     trivially cheap ones.
     """

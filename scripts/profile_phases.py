@@ -1,0 +1,133 @@
+"""Per-phase attribution profile of the hard-root slow tail (VERDICT r3 #1).
+
+The round-3 PERF table shows a few roots (AC-4 both PAs, BM-4, BM-9,
+AC-2-sex, GC-5) running 15-31 s/partition — three to four orders of
+magnitude above the grid norm — with nothing recording *where inside the
+engine ladder* (Phase S sign-BaB / L sign-LP / input-split pair BaB /
+P pair-LP / E lattice) those seconds land.  This harness samples each
+model's stage-0 leftovers, runs :func:`engine.decide_many` with the
+per-phase cost attribution added in round 4 (``Decision.stats``), and
+writes ``audits/profile_r4.json``: per model, the phase-second totals,
+verdict counts, and the slowest sampled roots with their phase split.
+
+Usage: python scripts/profile_phases.py [--sample 48] [--deadline 240]
+                                        [--targets AC-sex:AC-4,...]
+                                        [--out audits/profile_r4.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+# (run_id, preset, config overrides, model) — the round-3 slow-tail rows.
+TARGETS = [
+    ("AC-sex", "AC", {}, "AC-4"),
+    ("AC-race", "AC", {"protected": ("race",)}, "AC-4"),
+    ("AC-sex", "AC", {}, "AC-2"),
+    ("BM-age", "BM", {}, "BM-4"),
+    ("BM-age", "BM", {}, "BM-9"),
+    ("GC-age", "GC", {}, "GC-5"),
+]
+
+PHASES = ("t_attack", "t_sign", "t_lp", "t_bab", "t_pair", "t_lattice")
+
+
+def profile_target(run_id, preset_name, overrides, model, sample, deadline):
+    from fairify_tpu.data import loaders
+    from fairify_tpu.models import zoo
+    from fairify_tpu.verify import engine, presets, sweep
+    from fairify_tpu.verify.property import encode
+
+    cfg = presets.get(preset_name).with_(**overrides)
+    dataset = loaders.load(cfg.dataset)
+    n_attrs = len(cfg.query().columns)
+    nets, _ = zoo.load_matching(cfg.dataset, n_attrs, models=(model,))
+    net = nets[model]
+    enc = encode(cfg.query())
+    _, lo, hi = sweep.build_partitions(cfg)
+
+    t0 = time.perf_counter()
+    unsat0, sat0, _ = sweep._stage0_certify_and_attack(net, enc, lo, hi, cfg)
+    stage0_s = time.perf_counter() - t0
+    pending = np.where(~unsat0 & ~sat0)[0]
+    sampled = pending[:sample]
+    rec = {
+        "run_id": run_id, "model": model,
+        "grid": int(lo.shape[0]), "stage0_leftover": int(pending.size),
+        "stage0_s": round(stage0_s, 2),
+        "sampled": int(sampled.size), "deadline_s": deadline,
+    }
+    if not sampled.size:
+        rec["note"] = "stage-0 decided everything; no hard roots to profile"
+        return rec
+
+    t1 = time.perf_counter()
+    decisions = engine.decide_many(
+        net, enc, lo[sampled], hi[sampled], cfg.engine, deadline_s=deadline)
+    wall = time.perf_counter() - t1
+
+    counts = {"sat": 0, "unsat": 0, "unknown": 0}
+    totals = {p: 0.0 for p in PHASES}
+    roots = []
+    for r, d in enumerate(decisions):
+        counts[d.verdict] += 1
+        for p in PHASES:
+            totals[p] += d.stats.get(p, 0.0)
+        roots.append({
+            "root": int(sampled[r]), "verdict": d.verdict,
+            "elapsed_s": round(d.elapsed_s, 3), "nodes": d.nodes,
+            **{p: round(d.stats.get(p, 0.0), 3) for p in PHASES}})
+    roots.sort(key=lambda x: -x["elapsed_s"])
+    dominant = max(totals, key=totals.get)
+    rec.update({
+        "wall_s": round(wall, 2), "verdicts": counts,
+        "s_per_part": round(wall / sampled.size, 3),
+        "phase_totals_s": {p: round(v, 2) for p, v in totals.items()},
+        "dominant_phase": dominant,
+        "slowest_roots": roots[:8],
+    })
+    return rec
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--sample", type=int, default=48)
+    ap.add_argument("--deadline", type=float, default=240.0)
+    ap.add_argument("--targets", default="")
+    ap.add_argument("--out", default=os.path.join(ROOT, "audits", "profile_r4.json"))
+    args = ap.parse_args()
+
+    wanted = None
+    if args.targets:
+        wanted = {tuple(t.split(":")) for t in args.targets.split(",")}
+    out = {"what": ("Per-phase second attribution for the round-3 slow-tail "
+                    "rows: engine.decide_many on a sample of each model's "
+                    "stage-0 leftovers, with Decision.stats phase splits "
+                    "(S=sign frontier, L=sign host LP, bab=input split, "
+                    "P=pair LP, E=lattice)."),
+           "script": "scripts/profile_phases.py",
+           "records": []}
+    for run_id, preset, overrides, model in TARGETS:
+        if wanted is not None and (run_id, model) not in wanted:
+            continue
+        print(f"== profiling {run_id}/{model}", flush=True)
+        rec = profile_target(run_id, preset, overrides, model,
+                             args.sample, args.deadline)
+        print(json.dumps(rec, indent=None), flush=True)
+        out["records"].append(rec)
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as fp:
+        json.dump(out, fp, indent=2)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -148,7 +148,7 @@ def test_uniform_sign_bab_positive_net():
     roots_hi = np.stack([hi, hi]).astype(np.int64)
     from fairify_tpu.verify.engine import EngineConfig, uniform_sign_bab
 
-    verdicts, nodes, cost = uniform_sign_bab(
+    verdicts, nodes, cost, _lp = uniform_sign_bab(
         net, enc, roots_lo, roots_hi,
         EngineConfig(alpha_iters=4), deadline_s=60.0)
     assert verdicts == ["unsat", "unsat"]
@@ -175,9 +175,9 @@ def test_uniform_sign_bab_mixed_net_bails():
     lo, hi = dom.lo_hi()
     from fairify_tpu.verify.engine import EngineConfig, uniform_sign_bab
 
-    verdicts, _, _ = uniform_sign_bab(net, enc, lo.astype(np.int64)[None],
-                                      hi.astype(np.int64)[None],
-                                      EngineConfig(alpha_iters=4), deadline_s=30.0)
+    verdicts, _, _, _ = uniform_sign_bab(net, enc, lo.astype(np.int64)[None],
+                                         hi.astype(np.int64)[None],
+                                         EngineConfig(alpha_iters=4), deadline_s=30.0)
     assert verdicts == ["mixed"]
 
 
