@@ -29,12 +29,14 @@ Evidence classes (docs/DESIGN.md numeric policy):
 * Every SAT verdict is re-proved by ``engine.validate_pair`` in exact
   arithmetic, so SAT never rests on float arithmetic at all.
 
-Scope: RA-free queries, and single-RA queries via the ε-expanded axis with
+Scope: RA-free queries, and one- or two-RA queries via ε-expanded axes with
 on-device window dilation (x′ partners unclamped, ``engine.decide_leaf``
 semantics; flip candidates and margin-touched core points settle exactly
-through ``decide_leaf``).  Multi-RA queries are not enumerable here (the
-(2ε+1)^k dilation is unimplemented) and stay Phase P's job.  Scan size is
-gated by ``EngineConfig.lattice_max``.
+through ``decide_leaf``).  The (2ε+1)² window of the two-RA case is
+**separable** — a box dilation is the composition of two per-axis
+dilations — so the kernel pays 2(2ε+1) rolls, not (2ε+1)².  Three or more
+RA dims are not enumerable here and stay Phase P's job.  Scan size is gated
+by ``EngineConfig.lattice_max``.
 """
 from __future__ import annotations
 
@@ -55,6 +57,41 @@ from fairify_tpu.verify.property import shared_dims, valid_assignments
 # to a full sign-tensor pull for that chunk.
 MARGIN_BUF = 512
 
+# Coordinate-magnitude ceiling for the roundoff-bound base case (ADVICE r3):
+# ``_signed_forward``'s e₀ = 0 assumes every input coordinate is exactly
+# representable in f32, true only for integers with |v| ≤ 2²⁴.  Decoded
+# lattice coordinates (and peeled prefix values baked into ``bases``) are
+# cast to f32, so a dim ranging past 2²⁴ would silently scan *rounded*
+# points — an unsound UNSAT.  Every current dataset dim is far below
+# (default-credit tops out ~10⁶), so the guard is cheap insurance against
+# future domains; boxes over the ceiling are not enumerable.
+COORD_EXACT_F32 = 1 << 24
+
+
+def _ra_strides(ra_ws: tuple) -> list:
+    """Mixed-radix strides of the RA tile, aligned with ``ra_ws`` order
+    (innermost axis last, stride 1).  Shared by the device scan kernel's
+    core-mask decode and the host margin-resolution decode — these MUST
+    agree or margin cells resolve at the wrong core points."""
+    strides = []
+    acc = 1
+    for w in reversed(ra_ws):
+        strides.append(acc)
+        acc *= w
+    return list(reversed(strides))
+
+
+def _coords_exceed_f32(enc, lo: np.ndarray, hi: np.ndarray) -> bool:
+    """True iff any ε-expanded coordinate magnitude reaches 2²⁴."""
+    lo_eff = np.asarray(lo, dtype=np.int64).copy()
+    hi_eff = np.asarray(hi, dtype=np.int64).copy()
+    if len(enc.ra_idx) and enc.eps:
+        ra = np.asarray(enc.ra_idx)
+        lo_eff[ra] -= int(enc.eps)
+        hi_eff[ra] += int(enc.eps)
+    return bool(max(np.abs(lo_eff).max(), np.abs(hi_eff).max())
+                >= COORD_EXACT_F32)
+
 
 def shared_lattice_size(enc, lo: np.ndarray, hi: np.ndarray) -> int:
     """Number of shared-coordinate lattice points of the box (python int —
@@ -69,19 +106,25 @@ def shared_lattice_size(enc, lo: np.ndarray, hi: np.ndarray) -> int:
 def enumerable_size(enc, lo: np.ndarray, hi: np.ndarray) -> Optional[int]:
     """Scan size of the box if Phase E can enumerate it, else None.
 
-    RA-free: the shared lattice.  One RA dim with ε > 0: the lattice with
-    the RA axis expanded by ±ε (x' partners range over the unclamped delta
-    window, ``engine.decide_leaf`` semantics).  More than one RA dim:
-    None — the (2ε+1)^k dilation is not implemented.
+    RA-free: the shared lattice.  One or two RA dims with ε > 0: the
+    lattice with each RA axis expanded by ±ε (x' partners range over the
+    unclamped delta window, ``engine.decide_leaf`` semantics; the 2-RA box
+    window dilates separably on device).  Three or more RA dims: None —
+    beyond the implemented dilation.  Boxes whose (ε-expanded) coordinates
+    reach 2²⁴ are also None: the device roundoff bound assumes exact-f32
+    integer inputs (ADVICE r3).
     """
+    if _coords_exceed_f32(enc, lo, hi):
+        return None
     if len(enc.ra_idx) and enc.eps:
-        if len(enc.ra_idx) > 1:
+        if len(enc.ra_idx) > 2:
             return None
+        ra_set = {int(j) for j in enc.ra_idx}
         dims = shared_dims(enc, len(lo))
         n = 1
         for d in dims:
             w = int(hi[d]) - int(lo[d]) + 1
-            if d == int(enc.ra_idx[0]):
+            if d in ra_set:
                 w += 2 * int(enc.eps)
             n *= w
         return n
@@ -197,21 +240,24 @@ def _lattice_signs_kernel(net: MLP, start, strides, widths, lo_shared,
 
 
 @partial(jax.jit,
-         static_argnames=("chunk", "dims_tuple", "d", "ra_w", "eps"))
+         static_argnames=("chunk", "dims_tuple", "d", "ra_ws", "eps"))
 def _lattice_scan_kernel_ra(net: MLP, start, n_total, strides, widths,
                             lo_shared, bases, valid_mask, valid_pair_f,
                             chunk: int, dims_tuple: tuple, d: int,
-                            ra_w: int, eps: int):
-    """RA-aware scan: the RA axis is the innermost suffix dim, expanded by
-    ±ε, and x' partners are found by dilating the certain-negative cells
-    along it (``engine.decide_leaf`` pair semantics: x core-ranged, x' at
-    an unclamped delta within ±ε).
+                            ra_ws: tuple, eps: int):
+    """RA-aware scan: the RA axes (one or two) are the innermost suffix
+    dims, each expanded by ±ε, and x' partners are found by dilating the
+    certain-negative cells over the delta window (``engine.decide_leaf``
+    pair semantics: x core-ranged, x' at an unclamped delta within ±ε per
+    RA dim).  The 2-RA box window is separable: per-axis dilations
+    composed, 2(2ε+1) rolls instead of (2ε+1)².
 
     Returns (first_flip, margin_count, margin_idx[MARGIN_BUF],
     sign_cols[V, MARGIN_BUF+1]):
-    * ``first_flip``: first CORE point (RA coord inside the unexpanded
-      range) admitting a valid ordered pair (a, b) with a certain positive
-      sign at x and a certain negative sign at some window partner.
+    * ``first_flip``: first CORE point (every RA coord inside its
+      unexpanded range) admitting a valid ordered pair (a, b) with a
+      certain positive sign at x and a certain negative sign at some
+      window partner.
     * ``margin_idx``: expanded-lattice cells whose sign is inside the
       roundoff bound — the host resolves every core point whose window
       touches one, exactly, via ``decide_leaf``.
@@ -219,25 +265,41 @@ def _lattice_scan_kernel_ra(net: MLP, start, n_total, strides, widths,
     s = _device_signs(net, start, strides, widths, lo_shared, bases,
                       chunk, dims_tuple, d)
     in_range = (start + jnp.arange(chunk, dtype=jnp.int32)) < n_total
-    # start and chunk are multiples of ra_w, so the column index within the
-    # RA row is position-stable across chunks.
-    col = jnp.arange(chunk, dtype=jnp.int32) % ra_w
-    core = (col >= eps) & (col < ra_w - eps) & in_range
+    # start and chunk are multiples of the RA tile (prod(ra_ws)), so cell
+    # coordinates within the tile are position-stable across chunks.
+    tile = 1
+    for w in ra_ws:
+        tile *= w
+    idxs = jnp.arange(chunk, dtype=jnp.int32)
+    core = in_range
+    rem = idxs % tile
+    # Per-axis in-core masks: decode each RA coordinate from the in-tile
+    # remainder (mixed radix, innermost = last of ra_ws).
+    strides_ra = _ra_strides(ra_ws)
+    for w, st in zip(ra_ws, strides_ra):
+        col = (rem // st) % w
+        core = core & (col >= eps) & (col < w - eps)
     vm = valid_mask[:, None]
     V = s.shape[0]
-    rows = chunk // ra_w
+    rows = chunk // tile
 
-    # Dilate certain signs over the ±ε window along the RA axis.  Dups
+    # Dilate certain signs over the ±ε box window along the RA axes.  Dups
     # (≥ n_total) are masked BEFORE dilation: a wrapped cell belongs to a
     # different shared-coordinate row and must not donate a partner.
+    # Separable: dilate one axis at a time.
     def dilate(mask):
-        m = mask.reshape(V, rows, ra_w)
-        out = jnp.zeros_like(m)
-        cidx = jnp.arange(ra_w)
-        for dlt in range(-eps, eps + 1):
-            ok = (cidx + dlt >= 0) & (cidx + dlt < ra_w)
-            out = out | (jnp.roll(m, -dlt, axis=2) & ok[None, None, :])
-        return out.reshape(V, chunk).astype(jnp.float32)
+        m = mask.reshape((V, rows) + tuple(ra_ws))
+        for ax, w in enumerate(ra_ws):
+            axis = 2 + ax
+            out = jnp.zeros_like(m)
+            cidx_shape = [1] * m.ndim
+            cidx_shape[axis] = w
+            cidx = jnp.arange(w).reshape(cidx_shape)
+            for dlt in range(-eps, eps + 1):
+                ok = (cidx + dlt >= 0) & (cidx + dlt < w)
+                out = out | (jnp.roll(m, -dlt, axis=axis) & ok)
+            m = out
+        return m.reshape(V, chunk).astype(jnp.float32)
 
     live = vm & in_range[None, :]
     dil_neg = dilate((s == -1) & live)
@@ -342,29 +404,38 @@ def decide_box_exhaustive(
     lo = np.asarray(lo, dtype=np.int64)
     hi = np.asarray(hi, dtype=np.int64)
     d = int(lo.shape[0])
-
-    # RA mode: one relaxed dim is handled by expanding its axis ±ε and
-    # dilating partners along it on device; more are not implemented.
-    ra_mode = bool(len(enc.ra_idx)) and int(enc.eps) > 0
-    if ra_mode and len(enc.ra_idx) > 1:
+    if _coords_exceed_f32(enc, lo, hi):
+        # e₀ = 0 in the device roundoff recurrence requires exact-f32
+        # integer coordinates (|v| < 2²⁴); a wider dim would scan rounded
+        # points and could return an unsound UNSAT (ADVICE r3).
         return "unknown", None
-    ra_dim = int(enc.ra_idx[0]) if ra_mode else -1
+
+    # RA mode: one or two relaxed dims are handled by expanding each axis
+    # ±ε and dilating partners over the (separable) window on device;
+    # three or more are not implemented.
+    ra_mode = bool(len(enc.ra_idx)) and int(enc.eps) > 0
+    if ra_mode and len(enc.ra_idx) > 2:
+        return "unknown", None
+    ra_dims = [int(j) for j in enc.ra_idx] if ra_mode else []
     eps = int(enc.eps) if ra_mode else 0
     lo_eff = lo.copy()
     hi_eff = hi.copy()
-    if ra_mode:
-        lo_eff[ra_dim] -= eps
-        hi_eff[ra_dim] += eps
+    for rd in ra_dims:
+        lo_eff[rd] -= eps
+        hi_eff[rd] += eps
 
     dims = shared_dims(enc, d)
     if ra_mode:
-        # RA axis innermost (stride 1): partner windows then live inside
-        # one contiguous row and never cross a chunk boundary.
-        dims = np.array([x for x in dims if x != ra_dim] + [ra_dim])
+        # RA axes innermost (the last one stride 1): partner windows then
+        # live inside one contiguous tile and never cross a chunk boundary.
+        dims = np.array([x for x in dims if x not in ra_dims] + ra_dims)
     N = 1
     for dm in dims:
         N *= int(hi_eff[dm]) - int(lo_eff[dm]) + 1
-    ra_w = int(hi_eff[ra_dim] - lo_eff[ra_dim] + 1) if ra_mode else 1
+    ra_ws = tuple(int(hi_eff[rd] - lo_eff[rd] + 1) for rd in ra_dims)
+    tile = 1
+    for w in ra_ws:
+        tile *= w
 
     V = enc.n_assign
     valid = valid_assignments(enc, lo, hi)
@@ -380,7 +451,7 @@ def decide_box_exhaustive(
     # dilation runs on device).
     n_suf = N
     by_width = sorted(
-        (j for j in range(len(dims)) if int(dims[j]) != ra_dim),
+        (j for j in range(len(dims)) if int(dims[j]) not in ra_dims),
         key=lambda j: int(hi_eff[dims[j]]) - int(lo_eff[dims[j]]) + 1)
     peeled = []
     for j in by_width:
@@ -402,10 +473,10 @@ def decide_box_exhaustive(
     max_chunk = max(1 << 12, int((1 << 28) // max(V * widest, 1)))
     chunk = int(min(chunk, max_chunk))
     if ra_mode:
-        # Chunks hold whole RA rows so windows never cross a boundary.
-        if ra_w > max_chunk:
-            return "unknown", None  # one RA row exceeds device memory
-        chunk = max(ra_w, chunk - chunk % ra_w)
+        # Chunks hold whole RA tiles so windows never cross a boundary.
+        if tile > max_chunk:
+            return "unknown", None  # one RA tile exceeds device memory
+        chunk = max(tile, chunk - chunk % tile)
         if n_suf >= int32_limit - chunk:
             # Re-check the int32 headroom with the aligned chunk (the peel
             # guard above used the pre-alignment value).
@@ -473,15 +544,20 @@ def decide_box_exhaustive(
         return None
 
     def ra_core_candidates(c0, cells) -> list:
-        """Core flat indices whose ±ε window touches any of ``cells``."""
+        """Core flat indices whose ±ε window touches any of ``cells``.
+        Mixed-radix over the RA tile (ra_ws order, innermost last)."""
+        strides_ra = _ra_strides(ra_ws)
         out = set()
         for m in cells:
             m = int(m)
-            col = m % ra_w
-            row0 = m - col
-            for c in range(max(eps, col - eps),
-                           min(ra_w - eps - 1, col + eps) + 1):
-                out.add(c0 + row0 + c)
+            rem = m % tile
+            row0 = m - rem
+            cols = [(rem // st) % w for w, st in zip(ra_ws, strides_ra)]
+            spans = [range(max(eps, c - eps), min(w - eps - 1, c + eps) + 1)
+                     for c, w in zip(cols, ra_ws)]
+            for combo in itertools.product(*spans):
+                out.add(c0 + row0
+                        + sum(c * st for c, st in zip(combo, strides_ra)))
         return sorted(out)
 
     def resolve_ra_cells(decode, c0, cells) -> Optional[tuple]:
@@ -553,7 +629,7 @@ def decide_box_exhaustive(
                         net, jnp.int32(c0), jnp.int32(n_suf),
                         dev["strides"], dev["widths"], dev["lo_shared"],
                         bases_dev, dev["valid_mask"], dev["valid_pair_f"],
-                        chunk, dims_tuple, d, ra_w, eps)
+                        chunk, dims_tuple, d, ra_ws, eps)
                 else:
                     fut = _lattice_scan_kernel(
                         net, jnp.int32(c0), jnp.int32(n_suf),

@@ -76,6 +76,17 @@ PRESETS = {
                               relaxed=("duration",), relax_eps=5,
                               partition_threshold=10, heuristic_threshold=20,
                               soft_timeout_s=100.0, sim_size=1000, **_HOUR),
+    # Framework-native two-RA variant (the reference's relaxed drivers stop
+    # at one relaxed attribute, ``relaxed/BM/Verify-BM.py:51-54``; this
+    # generalizes the same ε mechanism to two).  Exercises the round-4
+    # multi-RA paths end to end: the (2ε+1)² decide_leaf window, the
+    # separable two-axis Phase E dilation, and the pair-property RA
+    # constraints on both dims.
+    "relaxed2-BM": SweepConfig(name="relaxed2-BM", dataset="bank",
+                               protected=("age",),
+                               relaxed=("duration", "campaign"), relax_eps=5,
+                               partition_threshold=10, heuristic_threshold=20,
+                               soft_timeout_s=100.0, sim_size=1000, **_HOUR),
     # ----- targeted/ (sub-population domains) -----
     "targeted-GC": SweepConfig(name="targeted-GC", dataset="german", protected=("sex",),
                                domain_overrides={"number_of_credits": (2, 2)},

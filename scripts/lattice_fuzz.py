@@ -1,0 +1,135 @@
+"""Phase E soundness fuzz: random boxes vs the exact per-point oracle.
+
+Random tiny MLPs × random integer boxes × random queries — RA-free,
+single-RA, and (round 4, VERDICT r3 #6) two-RA — decided by
+``ops.lattice.decide_box_exhaustive`` and cross-checked against
+``engine.decide_leaf`` applied to every core shared point (the trusted
+exact single-point semantics).  Any disagreement is a soundness bug in the
+device scan / window dilation; SAT witnesses are additionally replayed in
+exact arithmetic.  Writes ``audits/lattice_fuzz_r4.json``.
+
+Usage: python scripts/lattice_fuzz.py [--trials 150] [--seed0 0]
+"""
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def oracle(net, enc, lo, hi):
+    """decide_leaf at every core shared point — exact, lattice-independent."""
+    import numpy as np
+
+    from fairify_tpu.verify import engine
+
+    weights = [np.asarray(w) for w in net.weights]
+    biases = [np.asarray(b) for b in net.biases]
+    dims = [k for k in range(len(lo)) if k not in enc.pa_idx]
+    spaces = [range(int(lo[k]), int(hi[k]) + 1) for k in dims]
+    for coord in itertools.product(*spaces):
+        pt = np.array(lo, dtype=np.int64)
+        pt[dims] = coord
+        verdict, _ = engine.decide_leaf(enc, weights, biases, pt, lo, hi)
+        if verdict == "sat":
+            return "sat"
+    return "unsat"
+
+
+def one_trial(seed: int) -> dict:
+    import numpy as np
+
+    from fairify_tpu.ops import lattice as lattice_ops
+    from fairify_tpu.verify import engine, property as prop
+    from fairify_tpu.verify.oracle import random_net, tiny_domain
+
+    rng = np.random.default_rng(seed)
+    d = int(rng.integers(3, 6))
+    names = [f"a{i}" for i in range(d)]
+    ranges = {}
+    for nm in names:
+        lo0 = int(rng.integers(0, 2))
+        ranges[nm] = (lo0, lo0 + int(rng.integers(1, 4)))
+    pa = (names[int(rng.integers(0, d))],)
+    rest = [nm for nm in names if nm not in pa]
+    # Trial mix: ~1/3 RA-free, ~1/3 single-RA, ~1/3 two-RA (when possible).
+    n_ra = int(rng.integers(0, 3))
+    n_ra = min(n_ra, len(rest))
+    ra = tuple(rng.choice(rest, size=n_ra, replace=False).tolist()) if n_ra else ()
+    eps = int(rng.integers(1, 3)) if n_ra else 0
+    dom = tiny_domain(ranges)
+    query = prop.FairnessQuery(domain=dom, protected=pa, relaxed=ra,
+                               relax_eps=eps)
+    hidden = [int(rng.integers(2, 7)) for _ in range(int(rng.integers(1, 3)))]
+    scale = float(rng.choice([0.3, 1.0, 3.0]))
+    net = random_net(rng, (d, *hidden, 1), scale=scale)
+    enc = prop.encode(query)
+    lo, hi = dom.lo_hi()
+    lo, hi = lo.astype(np.int64), hi.astype(np.int64)
+    got, ce = lattice_ops.decide_box_exhaustive(
+        net, enc, lo, hi, chunk=int(rng.choice([16, 64, 256])))
+    want = oracle(net, enc, lo, hi)
+    rec = {"seed": seed, "n_ra": n_ra, "eps": eps, "got": got, "want": want}
+    if got == "sat":
+        ws = [np.asarray(w) for w in net.weights]
+        bs = [np.asarray(b) for b in net.biases]
+        rec["witness_valid"] = bool(engine.validate_pair(ws, bs, *ce))
+    return rec
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--trials", type=int, default=150)
+    ap.add_argument("--seed0", type=int, default=0)
+    ap.add_argument("--out", default=os.path.join(ROOT, "audits",
+                                                  "lattice_fuzz_r4.json"))
+    args = ap.parse_args()
+    import jax
+
+    t0 = time.perf_counter()
+    counts = {"sat": 0, "unsat": 0, "unknown": 0}
+    ra_counts = {0: 0, 1: 0, 2: 0}
+    mismatches, bad_witness = [], []
+    for i in range(args.trials):
+        if i and i % 10 == 0:
+            jax.clear_caches()
+        if i and i % 25 == 0:
+            print(json.dumps({"progress": i,
+                              "mismatches": len(mismatches)}), flush=True)
+        rec = one_trial(args.seed0 + i)
+        counts[rec["got"]] += 1
+        ra_counts[rec["n_ra"]] += 1
+        if rec["got"] != "unknown" and rec["got"] != rec["want"]:
+            mismatches.append(rec)
+        if rec.get("witness_valid") is False:
+            bad_witness.append(rec)
+    out = {
+        "round": 4,
+        "component": "ops/lattice.decide_box_exhaustive",
+        "oracle": "engine.decide_leaf at every core shared point (exact)",
+        "script": "scripts/lattice_fuzz.py",
+        "trials": args.trials,
+        "agree": args.trials - len(mismatches) - counts["unknown"],
+        **counts,
+        "trials_by_ra_count": {str(k): v for k, v in ra_counts.items()},
+        "mismatches": len(mismatches),
+        "invalid_witnesses": len(bad_witness),
+        "elapsed_s": round(time.perf_counter() - t0, 1),
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as fp:
+        json.dump(out, fp, indent=1)
+    print(json.dumps(out))
+    for rec in mismatches + bad_witness:
+        print("FAIL " + json.dumps(rec), file=sys.stderr)
+    return 1 if (mismatches or bad_witness) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

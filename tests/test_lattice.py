@@ -231,23 +231,27 @@ def test_ra_window_peeled_matches_oracle():
 
 
 def test_lattice_gates():
-    """Multi-RA queries and over-large lattices are left unknown (honest);
-    single-RA roots are eligible and settle."""
+    """Three-RA queries and over-large lattices are left unknown (honest);
+    single- and two-RA roots are eligible and settle (VERDICT r3 #6)."""
     import time
 
-    names = ("a0", "a1", "p")
+    names = ("a0", "a1", "a2", "p")
     dom = DomainSpec(name="toy", columns=names,
-                     ranges={"a0": (0, 2), "a1": (0, 2), "p": (0, 1)},
+                     ranges={"a0": (0, 2), "a1": (0, 2), "a2": (0, 2),
+                             "p": (0, 1)},
                      label="y")
+    q_3ra = FairnessQuery(domain=dom, protected=("p",),
+                          relaxed=("a0", "a1", "a2"), relax_eps=2)
+    enc_3ra = encode(q_3ra)
     q_2ra = FairnessQuery(domain=dom, protected=("p",),
                           relaxed=("a0", "a1"), relax_eps=2)
     enc_2ra = encode(q_2ra)
     q_1ra = FairnessQuery(domain=dom, protected=("p",), relaxed=("a0",),
                           relax_eps=2)
     enc_1ra = encode(q_1ra)
-    net = _net(1, (3, 6, 1))
-    lo = np.array([[0, 0, 0]], dtype=np.int64)
-    hi = np.array([[2, 2, 1]], dtype=np.int64)
+    net = _net(1, (4, 6, 1))
+    lo = np.array([[0, 0, 0, 0]], dtype=np.int64)
+    hi = np.array([[2, 2, 2, 1]], dtype=np.int64)
 
     def run(enc, cfg):
         verdicts, ces = ["unknown"], [None]
@@ -255,11 +259,188 @@ def test_lattice_gates():
                               np.zeros(1), cfg, time.perf_counter(), 30.0)
         return verdicts[0]
 
-    # Multi-RA gate: the (2ε+1)^k dilation is not implemented.
-    assert run(enc_2ra, engine.EngineConfig()) == "unknown"
-    # Size gate: shared lattice is 9 > lattice_max=4.
-    enc = encode(_query(d=3))
+    # Multi-RA gate: k ≥ 3 dilation is not implemented.
+    assert run(enc_3ra, engine.EngineConfig()) == "unknown"
+    assert lattice_ops.enumerable_size(enc_3ra, lo[0], hi[0]) is None
+    # Size gate: shared lattice is 27 > lattice_max=4.
+    enc = encode(_query(d=4))
     assert run(enc, engine.EngineConfig(lattice_max=4)) == "unknown"
-    # Controls: with the gates open, RA-free and single-RA roots settle.
+    # Controls: with the gates open, RA-free, 1-RA and 2-RA roots settle.
     assert run(enc, engine.EngineConfig()) in ("sat", "unsat")
     assert run(enc_1ra, engine.EngineConfig()) in ("sat", "unsat")
+    assert run(enc_2ra, engine.EngineConfig()) in ("sat", "unsat")
+
+
+def test_coord_magnitude_gate():
+    """ADVICE r3: coordinates at/past 2^24 are not exactly representable in
+    f32, so the roundoff recurrence's e0 = 0 base case breaks — such boxes
+    must be ineligible (enumerable_size None, decide unknown), including
+    when only the ε expansion crosses the line."""
+    names = ("a0", "p")
+    big = 1 << 24
+    dom = DomainSpec(name="wide", columns=names,
+                     ranges={"a0": (0, big), "p": (0, 1)}, label="y")
+    enc = encode(FairnessQuery(domain=dom, protected=("p",)))
+    net = _net(0, (2, 4, 1))
+    lo = np.array([0, 0], dtype=np.int64)
+    hi = np.array([big, 1], dtype=np.int64)
+    assert lattice_ops.enumerable_size(enc, lo, hi) is None
+    assert lattice_ops.decide_box_exhaustive(net, enc, lo, hi)[0] == "unknown"
+    # One below the line (and a tiny lattice): eligible again.
+    hi_ok = np.array([3, 1], dtype=np.int64)
+    assert lattice_ops.enumerable_size(enc, lo, hi_ok) == 4
+    # ε expansion alone crossing 2^24 also trips the gate.
+    dom2 = DomainSpec(name="edge", columns=names,
+                      ranges={"a0": (0, big - 1), "p": (0, 1)}, label="y")
+    enc_ra = encode(FairnessQuery(domain=dom2, protected=("p",),
+                                  relaxed=("a0",), relax_eps=2))
+    assert lattice_ops.enumerable_size(
+        enc_ra, np.array([0, 0], np.int64),
+        np.array([big - 1, 1], np.int64)) is None
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_roundoff_bound_margin_dominates_f32_evaluation(seed):
+    """ADVICE r3: the device roundoff bound is itself evaluated in f32 and
+    uses computed |h| rather than true |h|; the claim is that the 4x margin
+    on the γ constants dominates both second-order effects.  Checked two
+    ways on random nets/points:
+
+    1. soundness: |f32 logit − f64 logit| ≤ f32-computed bound, every point;
+    2. headroom: the f32-computed bound stays ≥ 2× a *tightened* f64
+       recurrence using the standard first-order constant γ = (n+1)u —
+       i.e. even after paying f32 evaluation error and the |h|-proxy, at
+       least half the 4× inflation survives as margin.
+    """
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(100 + seed)
+    sizes = (5, 16, 8, 1)
+    net = _net(200 + seed, sizes)
+    weights = [np.asarray(w, np.float64) for w in net.weights]
+    biases = [np.asarray(b, np.float64) for b in net.biases]
+    pts = rng.integers(-50, 1000, size=(64, sizes[0])).astype(np.float64)
+
+    f32_logit, e32 = (np.asarray(v) for v in
+                      lattice_ops._signed_forward(net, jnp.asarray(pts, jnp.float32)))
+
+    # f64 forward (true value to ~1e-16 — far finer than the ~1e-5 bound).
+    h = pts.copy()
+    e64_tight = np.zeros_like(pts)
+    u = 2.0 ** -24
+    for i, (w, b) in enumerate(zip(weights, biases)):
+        gamma_tight = (w.shape[0] + 1) * u  # standard constant, no 4x
+        abs_acc = np.abs(h) @ np.abs(w) + np.abs(b)
+        e64_tight = e64_tight @ np.abs(w) + gamma_tight * abs_acc
+        z = h @ w + b
+        if i < len(weights) - 1:
+            h = np.maximum(z, 0.0)
+            e64_tight = e64_tight  # ReLU is 1-Lipschitz; mask is all-ones here
+        else:
+            h = z
+    f64_logit = h[:, 0]
+    e64_tight = e64_tight[:, 0]
+
+    true_err = np.abs(f32_logit - f64_logit)
+    assert (true_err <= e32).all(), \
+        f"bound violated: max err {true_err.max()} vs bound {e32.min()}"
+    assert (e32 >= 2.0 * e64_tight).all(), \
+        "4x margin eroded below 2x by f32 evaluation of the recurrence"
+
+
+def _ra2_query(eps):
+    names = ("r1", "r2", "a1", "p")
+    ranges = {"r1": (0, 3), "r2": (0, 3), "a1": (0, 2), "p": (0, 1)}
+    dom = DomainSpec(name="toy2", columns=names, ranges=ranges, label="y")
+    return FairnessQuery(domain=dom, protected=("p",), relaxed=("r1", "r2"),
+                         relax_eps=eps)
+
+
+@pytest.mark.parametrize("seed,eps", [(s, e) for s in range(4)
+                                      for e in (1, 2)])
+def test_ra2_window_matches_per_point_oracle(seed, eps):
+    """Two-RA boxes (VERDICT r3 #6): the separable (2ε+1)² dilation must
+    match decide_leaf applied to every core point, and SAT witnesses must
+    satisfy the pair constraints on BOTH relaxed dims exactly."""
+    q = _ra2_query(eps)
+    enc = encode(q)
+    net = _net(seed, (4, 8, 1))
+    lo = np.array([0, 0, 0, 0], dtype=np.int64)
+    hi = np.array([3, 3, 2, 1], dtype=np.int64)
+    verdict, ce = lattice_ops.decide_box_exhaustive(net, enc, lo, hi,
+                                                    chunk=32)
+    assert verdict == _ra_oracle(net, enc, lo, hi)
+    if verdict == "sat":
+        x, xp = ce
+        weights = [np.asarray(w) for w in net.weights]
+        biases = [np.asarray(b) for b in net.biases]
+        assert engine.validate_pair(weights, biases, x, xp)
+        assert x[3] != xp[3]                        # PA differs
+        assert abs(int(x[0]) - int(xp[0])) <= eps   # RA 1 within ε
+        assert abs(int(x[1]) - int(xp[1])) <= eps   # RA 2 within ε
+        assert x[2] == xp[2]                        # shared dim equal
+        assert (lo <= x).all() and (x <= hi).all()  # x core-ranged
+
+
+def test_ra2_positive_only_in_expanded_corner():
+    """Directed 2-RA soundness analog of the single-RA ring regression:
+    f = r1 + r2 − 7.5 is negative at every core point (max 6) and positive
+    only where BOTH expanded coordinates exceed their core range
+    (r1 + r2 ≥ 8, e.g. (5, 4))."""
+    q = _ra2_query(2)
+    enc = encode(q)
+    w1 = np.zeros((4, 2), np.float32)
+    w1[0, 0] = 1.0
+    w1[1, 0] = 1.0
+    net = from_numpy(
+        [w1, np.array([[1.0], [0.0]], np.float32)],
+        [np.zeros(2, np.float32), np.array([-7.5], np.float32)])
+    lo = np.array([0, 0, 0, 0], dtype=np.int64)
+    hi = np.array([3, 3, 2, 1], dtype=np.int64)
+    assert _ra_oracle(net, enc, lo, hi) == "sat"
+    verdict, ce = lattice_ops.decide_box_exhaustive(net, enc, lo, hi,
+                                                    chunk=64)
+    assert verdict == "sat"
+    x, xp = ce
+    weights = [np.asarray(w) for w in net.weights]
+    biases = [np.asarray(b) for b in net.biases]
+    assert engine.validate_pair(weights, biases, x, xp)
+    assert int(xp[0]) + int(xp[1]) >= 8  # witness partner in the corner
+
+
+def test_ra2_peeled_matches_oracle():
+    """2-RA mode composes with prefix peeling (RA axes never peeled)."""
+    q = _ra2_query(1)
+    enc = encode(q)
+    net = _net(5, (4, 8, 1))
+    lo = np.array([0, 0, 0, 0], dtype=np.int64)
+    hi = np.array([3, 3, 2, 1], dtype=np.int64)
+    verdict, _ = lattice_ops.decide_box_exhaustive(
+        net, enc, lo, hi, chunk=36, int32_limit=128, pipeline_depth=2)
+    assert verdict == _ra_oracle(net, enc, lo, hi)
+
+
+def test_decide_leaf_delta_lattice_guard():
+    """VERDICT r3 #6: the decide_leaf (2ε+1)^|RA| > 100k guard is a tested
+    boundary — a window just under the cap enumerates, just over returns an
+    honest unknown instead of stalling."""
+    names = ("r1", "r2", "r3", "p")
+    dom = DomainSpec(name="toy3", columns=names,
+                     ranges={"r1": (0, 3), "r2": (0, 3), "r3": (0, 3),
+                             "p": (0, 1)}, label="y")
+    net = _net(3, (4, 6, 1))
+    weights = [np.asarray(w) for w in net.weights]
+    biases = [np.asarray(b) for b in net.biases]
+    pt = np.array([1, 1, 1, 0], dtype=np.int64)
+    lo = np.array([0, 0, 0, 0], dtype=np.int64)
+    hi = np.array([3, 3, 3, 1], dtype=np.int64)
+    # (2·23+1)^3 = 103,823 > 100k → unknown.
+    q_over = FairnessQuery(domain=dom, protected=("p",),
+                           relaxed=("r1", "r2", "r3"), relax_eps=23)
+    v, _ = engine.decide_leaf(encode(q_over), weights, biases, pt, lo, hi)
+    assert v == "unknown"
+    # (2·22+1)^3 = 91,125 ≤ 100k → enumerates to a real verdict.
+    q_under = FairnessQuery(domain=dom, protected=("p",),
+                            relaxed=("r1", "r2", "r3"), relax_eps=22)
+    v, _ = engine.decide_leaf(encode(q_under), weights, biases, pt, lo, hi)
+    assert v in ("sat", "unsat")
