@@ -87,6 +87,19 @@ def consistency(X, y_pred, n_neighbors: int = 5) -> float:
     return float(1.0 - np.mean(np.abs(y_pred - y_pred[idx].mean(axis=1))))
 
 
+def f1_score(y_true, y_pred) -> float:
+    """Binary F1 (favorable label 1) — the reference's per-partition metric
+    CSV carries original/pruned F1 next to accuracy
+    (``src/CP/Verify-CP.py:448-451``)."""
+    yt = np.asarray(y_true) == 1
+    yp = np.asarray(y_pred) == 1
+    tp = float(np.sum(yt & yp))
+    fp = float(np.sum(~yt & yp))
+    fn = float(np.sum(yt & ~yp))
+    denom = 2 * tp + fp + fn
+    return float(2 * tp / denom) if denom > 0 else 0.0
+
+
 def theil_index(y_true, y_pred) -> float:
     """Generalized entropy (α=1) of benefit b = ŷ − y + 1 (AIF360 definition)."""
     b = np.asarray(y_pred, dtype=np.float64) - np.asarray(y_true, dtype=np.float64) + 1.0

@@ -68,6 +68,8 @@ def _overridden_cfg(args):
         # (``utils/input_partition.py:111-182`` with max_partitions=N).
         overrides["capped_partitions"] = True
         overrides["max_partitions"] = int(args.max_partitions)
+    if getattr(args, "partition_metrics", False):
+        overrides["partition_metrics"] = True
     return cfg.with_(**overrides) if overrides else cfg
 
 
@@ -240,6 +242,9 @@ def main(argv=None) -> int:
     run.add_argument("--data-root", default=None)
     run.add_argument("--decode-counterexamples", action="store_true",
                      help="also write raw-category decoded counterexample CSVs")
+    run.add_argument("--partition-metrics", action="store_true",
+                     help="emit <model>-metrics.csv per partition "
+                          "(src/CP/Verify-CP.py:398-458 artifact shape)")
     run.add_argument("--retry-unknown", action="store_true",
                      help="re-attempt partitions a previous run left UNKNOWN")
     run.add_argument("--host-index", type=int, default=None,

@@ -39,6 +39,12 @@ class SweepConfig:
     engine: EngineConfig = field(default_factory=EngineConfig)
     result_dir: str = "res"
     profile_dir: Optional[str] = None  # XLA trace output (TensorBoard/XProf)
+    # Per-partition group-metric CSV (``<sink>-metrics.csv``), reproducing
+    # the reference CP driver's artifact shape (``src/CP/Verify-CP.py:
+    # 398-458``: Partition ID, orig/pruned acc+F1, DI/SPD/EOD/AOD/ERD/CNT/
+    # TI).  Flag-gated: the consistency column is an O(|test|²) kNN per
+    # partition, which only makes sense on modest grids.
+    partition_metrics: bool = False
 
     def query(self) -> FairnessQuery:
         domain = get_domain(self.dataset)

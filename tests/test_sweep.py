@@ -80,8 +80,9 @@ def test_sweep_verdicts_mesh_invariant(tmp_path, tiny_registered):
 def test_presets_cover_all_drivers():
     names = presets.names()
     # 5 base + CP12 (task4's 12-input family) + LSAC + 3 stress + 3 relaxed
-    # + 3+3 targeted + targeted-DF (framework-native certificate-path DF)
-    assert len(names) == 20
+    # + relaxed2-BM (framework-native two-RA variant) + 3+3 targeted
+    # + targeted-DF (framework-native certificate-path DF)
+    assert len(names) == 21
     for n in names:
         cfg = presets.get(n)
         q = cfg.query()  # builds without error, drops phantom attributes
@@ -210,3 +211,44 @@ def test_retry_unknown_csv_counters_recomputed(tmp_path):
         assert [int(row[2]), int(row[3]), int(row[4])] == [
             counts["sat"], counts["unsat"], counts["unknown"]]
     assert counts == rep.counts
+
+
+def test_partition_metrics_csv_schema(tmp_path, tiny_registered):
+    """VERDICT r3 #4: the flag-gated per-partition group-metric CSV must
+    appear next to the 24-col CSV with the reference CP driver's columns
+    (``src/CP/Verify-CP.py:448-458``), one row per newly-decided
+    partition, with finite metric values."""
+    import pandas as pd
+
+    from fairify_tpu.data.loaders import LoadedDataset
+
+    rng = np.random.default_rng(7)
+    net = random_net(rng, (3, 6, 1))
+    X = rng.integers(0, 5, size=(60, 3)).astype(np.float64)
+    X[:, 1] = rng.integers(0, 2, size=60)  # pa column
+    y = rng.integers(0, 2, size=60)
+    ds = LoadedDataset(name="tinysweep", df=pd.DataFrame(X),
+                       X_train=X, y_train=y, X_test=X, y_test=y, label="y")
+    cfg = make_cfg(tmp_path, partition_metrics=True)
+    report = sweep.verify_model(net, cfg, model_name="tiny-1", dataset=ds)
+
+    path = os.path.join(str(tmp_path), "tiny-1-metrics.csv")
+    with open(path) as fp:
+        rows = list(csv.reader(fp))
+    assert rows[0] == ["Partition ID", "Original Accuracy",
+                       "Original F1 Score", "Pruned Accuracy", "Pruned F1",
+                       "DI", "SPD", "EOD", "AOD", "ERD", "CNT", "TI"]
+    assert len(rows) == 1 + report.partitions_total
+    ids = sorted(int(r[0]) for r in rows[1:])
+    assert ids == [o.partition_id for o in sorted(
+        report.outcomes, key=lambda o: o.partition_id)]
+    for r in rows[1:]:
+        vals = [float(v) for v in r[1:]]
+        # DI is legitimately inf when the privileged base rate is 0
+        # (AIF360 semantics); everything else must be finite.
+        assert all(np.isfinite(vals[:4]))
+        assert all(np.isfinite(vals[5:]))
+    # Resume adds no duplicate rows (append-once like the CE CSV).
+    sweep.verify_model(net, cfg, model_name="tiny-1", dataset=ds)
+    with open(path) as fp:
+        assert len(list(csv.reader(fp))) == len(rows)
