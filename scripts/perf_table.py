@@ -103,8 +103,10 @@ def main() -> None:
             "partition (see the main table).",
         ]
 
-    # Multi-device scaling record (audits/scaling_r3.json, scripts/scaling.py).
-    sc_path = os.path.join(ROOT, "audits", "scaling_r3.json")
+    # Multi-device scaling record (audits/scaling_r4.json, scripts/scaling.py).
+    sc_path = os.path.join(ROOT, "audits", "scaling_r4.json")
+    if not os.path.isfile(sc_path):
+        sc_path = os.path.join(ROOT, "audits", "scaling_r3.json")
     if os.path.isfile(sc_path):
         sc = json.load(open(sc_path))
         lines += [
@@ -114,14 +116,18 @@ def main() -> None:
             f"Kernel: {sc['kernel']}; grid: {sc['grid']}.  " + sc["caveat"],
             "",
             "| Devices | Parts/device | Wall (s) | Overhead vs 1 dev | "
-            "Decided (invariant) |",
-            "|---|---|---|---|---|",
+            "Decided (invariant) | Input MB/device | HLO collectives |",
+            "|---|---|---|---|---|---|---|",
         ]
         for r in sc["rows"]:
+            mb = r.get("input_mb_per_device")
+            mb_cell = f"{mb:.3f}" if mb is not None else "—"
+            colls = r.get("hlo_collectives")
+            coll_cell = str(sum(colls.values())) if colls else "—"
             lines.append(
                 f"| {r['devices']} | {r['parts_per_device']} | "
                 f"{r['best_s']:.2f} | {r['overhead_vs_1dev']:.2f}× | "
-                f"{r['decided']} |")
+                f"{r['decided']} | {mb_cell} | {coll_cell} |")
     with open(args.out, "w") as fp:
         fp.write("\n".join(lines) + "\n")
     print(f"wrote {args.out} ({len(rows)} rows)")
