@@ -1,0 +1,167 @@
+"""Gradient-boosted shallow trees — the from-scratch strong tabular teacher.
+
+The reference's ``experimentData/task3`` notebooks train MLP students
+against labels predicted by TabPFN, a pretrained-transformer tabular
+classifier.  TabPFN's checkpoint is unfetchable in this environment, so
+the task3 analog needs a strong tabular teacher built from scratch
+(VERDICT r3 #7).  Gradient boosting over depth-2 trees with Newton leaf
+steps is the classical strong baseline on exactly these small tabular
+datasets (adult/bank-class); depth 2 matters — depth-1 stumps yield an
+additive model that cannot represent feature interactions (XOR-class
+structure), which is what separates a strong teacher from logistic
+regression.
+
+Training is host-side numpy by design: teachers label datasets once at
+experiment setup; the TPU path of this framework is verification of the
+*students*.  The split search is fully vectorized per feature (prefix-sum
+gain scan over the sorted column), so fitting 300 rounds on the adult
+train split takes seconds.
+
+Semantics: binary logistic loss.  Per round, a depth-``max_depth`` tree is
+grown by exact greedy split search on the gradient/hessian statistics
+(g = y − p, h = p(1−p)); leaf values are shrunken Newton steps
+lr·Σg/(Σh+λ).  Prediction is the signed logit margin; ``predict``
+thresholds at 0.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+
+@dataclass
+class _Node:
+    # Internal node: feature/threshold set, value unset.  Leaf: value set.
+    feature: int = -1
+    threshold: float = 0.0
+    left: Optional["_Node"] = None
+    right: Optional["_Node"] = None
+    value: float = 0.0
+
+    @property
+    def is_leaf(self) -> bool:
+        return self.feature < 0
+
+
+@dataclass
+class GradientBoostedTrees:
+    n_rounds: int = 300
+    learning_rate: float = 0.1
+    max_depth: int = 2
+    reg_lambda: float = 1.0
+    min_child_weight: float = 1.0
+    bias: float = 0.0
+    trees: List[_Node] = field(default_factory=list)
+
+    def fit(self, X: np.ndarray, y: np.ndarray) -> "GradientBoostedTrees":
+        X = np.asarray(X, dtype=np.float64)
+        y = np.asarray(y, dtype=np.float64).ravel()
+        n, _ = X.shape
+        p0 = float(np.clip(y.mean(), 1e-6, 1 - 1e-6))
+        self.bias = float(np.log(p0 / (1.0 - p0)))
+        F = np.full(n, self.bias)
+        self.trees = []
+        for _ in range(self.n_rounds):
+            p = 1.0 / (1.0 + np.exp(-F))
+            g = y - p
+            h = np.maximum(p * (1.0 - p), 1e-12)
+            root = self._grow(X, g, h, np.arange(n), self.max_depth)
+            if root.is_leaf:
+                # No split with positive gain anywhere: boosting has
+                # converged — appending further (constant-leaf) trees only
+                # bloats the model.  The leaf's Newton value is absorbed
+                # into nothing; stop cleanly.
+                break
+            self.trees.append(root)
+            F = F + self._tree_margin(root, X)
+        return self
+
+    # -- tree growing ------------------------------------------------------
+
+    def _leaf(self, g, h, idx) -> _Node:
+        val = self.learning_rate * g[idx].sum() / (h[idx].sum() + self.reg_lambda)
+        return _Node(value=float(val))
+
+    def _grow(self, X, g, h, idx, depth) -> _Node:
+        if depth == 0 or idx.size < 2 * int(self.min_child_weight):
+            return self._leaf(g, h, idx)
+        split = self._best_split(X, g, h, idx)
+        if split is None:
+            return self._leaf(g, h, idx)
+        j, thr = split
+        go_left = X[idx, j] <= thr
+        node = _Node(feature=j, threshold=thr)
+        node.left = self._grow(X, g, h, idx[go_left], depth - 1)
+        node.right = self._grow(X, g, h, idx[~go_left], depth - 1)
+        return node
+
+    def _best_split(self, X, g, h, idx):
+        """Exact greedy (feature, threshold) maximizing the gain
+        gl²/(hl+λ) + gr²/(hr+λ) − (G²/(H+λ)); vectorized prefix-sum scan
+        over each sorted column restricted to ``idx``."""
+        G, H = g[idx].sum(), h[idx].sum()
+        lam = self.reg_lambda
+        base = (G * G) / (H + lam)
+        best_gain, best = 1e-12, None
+        for j in range(X.shape[1]):
+            xs_all = X[idx, j]
+            o = np.argsort(xs_all, kind="stable")
+            xs = xs_all[o]
+            gl = np.cumsum(g[idx][o])[:-1]
+            hl = np.cumsum(h[idx][o])[:-1]
+            distinct = xs[1:] != xs[:-1]
+            hr = H - hl
+            ok = distinct & (hl >= self.min_child_weight) \
+                & (hr >= self.min_child_weight)
+            if not ok.any():
+                continue
+            gain = gl * gl / (hl + lam) + (G - gl) ** 2 / (hr + lam) - base
+            gain = np.where(ok, gain, -np.inf)
+            k = int(gain.argmax())
+            if gain[k] > best_gain:
+                best_gain = float(gain[k])
+                best = (j, float(0.5 * (xs[k] + xs[k + 1])))
+        return best
+
+    # -- inference ---------------------------------------------------------
+
+    def _tree_margin(self, node: _Node, X: np.ndarray) -> np.ndarray:
+        if node.is_leaf:
+            return np.full(X.shape[0], node.value)
+        go_left = X[:, node.feature] <= node.threshold
+        out = np.empty(X.shape[0])
+        out[go_left] = self._tree_margin(node.left, X[go_left])
+        out[~go_left] = self._tree_margin(node.right, X[~go_left])
+        return out
+
+    def decision_function(self, X: np.ndarray) -> np.ndarray:
+        X = np.asarray(X, dtype=np.float64)
+        F = np.full(X.shape[0], self.bias)
+        for t in self.trees:
+            F += self._tree_margin(t, X)
+        return F
+
+    def predict_proba(self, X: np.ndarray) -> np.ndarray:
+        return 1.0 / (1.0 + np.exp(-self.decision_function(X)))
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        return (self.decision_function(X) > 0.0).astype(np.int64)
+
+
+def feature_importances(model: GradientBoostedTrees, d: int) -> np.ndarray:
+    """Split-count importances (diagnostic parity with sklearn teachers)."""
+    counts = np.zeros(d, dtype=np.float64)
+
+    def walk(node):
+        if node is None or node.is_leaf:
+            return
+        counts[node.feature] += 1.0
+        walk(node.left)
+        walk(node.right)
+
+    for t in model.trees:
+        walk(t)
+    total = counts.sum()
+    return counts / total if total else counts

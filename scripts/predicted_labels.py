@@ -2,13 +2,19 @@
 
 The reference's ``experimentData/task2`` notebooks study Fairify on MLPs
 trained against labels *predicted* by KNN / random-forest models instead of
-the ground truth (SURVEY.md §4.3).  This script is that pipeline as a
+the ground truth, and ``task3`` repeats it with a strong pretrained tabular
+teacher (TabPFN) (SURVEY.md §4.3).  This script is both pipelines as one
 first-class command: fit the teacher, relabel the training split, train an
 MLP student, export it as Keras-compatible ``.h5``, and run the dataset's
 verification preset on it.
 
+Teachers: ``knn`` / ``rf`` (task2), ``gbt`` (task3 analog — TabPFN's
+checkpoint is unfetchable here, so the strong-teacher role is filled by
+from-scratch gradient-boosted stumps, ``fairify_tpu/models/gbt.py``;
+``tabpfn`` stays a gated option for environments that have it).
+
 Usage:
-    python scripts/predicted_labels.py [--preset GC] [--teacher knn|rf]
+    python scripts/predicted_labels.py [--preset GC] [--teacher knn|rf|gbt]
         [--hidden 50] [--epochs 30] [--out res/predicted]
 """
 from __future__ import annotations
@@ -25,7 +31,8 @@ sys.path.insert(0, ROOT)
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--preset", default="GC")
-    ap.add_argument("--teacher", choices=("knn", "rf", "tabpfn"), default="knn")
+    ap.add_argument("--teacher", choices=("knn", "rf", "gbt", "tabpfn"),
+                    default="knn")
     ap.add_argument("--hidden", type=int, nargs="*", default=[50])
     ap.add_argument("--epochs", type=int, default=30)
     ap.add_argument("--soft", type=float, default=10.0)
@@ -52,6 +59,11 @@ def main() -> None:
         from sklearn.ensemble import RandomForestClassifier
 
         teacher = RandomForestClassifier(n_estimators=100, random_state=42)
+    elif args.teacher == "gbt":
+        from fairify_tpu.models.gbt import GradientBoostedTrees
+
+        teacher = GradientBoostedTrees(n_rounds=300, learning_rate=0.1,
+                                       max_depth=2)
     else:
         # task3's teacher; the package (and its pretrained prior) is not in
         # this image, so the option is gated rather than stubbed.

@@ -1,0 +1,240 @@
+"""task5 analog: cross-tool counterexample comparison (VERDICT r3 #7).
+
+The reference's ``experimentData/task5`` compares counterexample sets
+across verification tools: per model it ships decoded CE CSVs from Fairify
+(``counterexamples-fairify-<M>.csv``) and FairQuant
+(``counterexamples-fairquant-<M>.csv``) plus comparison notebooks.  This
+harness rebuilds that artifact family around our framework:
+
+1. **Replay the reference tools' committed CEs on the shared models.**
+   Each decoded row pair is re-encoded through our loaders' fitted
+   encoders (the exact mappings of ``utils/standard_data.py:4-65`` /
+   ``utils/verif_utils.py``) and the pair is checked by the engine's exact
+   rational replay (``engine.validate_pair``) — the strongest possible
+   cross-tool statement: *their* witnesses judged by *our* ground-truth
+   checker.  Rows whose categories/values fall outside the dataset's
+   fitted domain are counted ``unencodable`` (FairQuant's GC rows use
+   e.g. ``month=78`` and purpose codes absent from german.data — it
+   verifies a wider domain).
+2. **Emit our own CE sets in the same decoded shape**
+   (``counterexamples-fairify_tpu-<M>.csv``: decoded feature columns +
+   ``output`` probability + ``prediction``; two rows per pair) from a
+   fresh budgeted sweep of each model.
+
+Writes ``audits/task5_compare_r4.json`` and per-model CSVs under --out.
+
+Usage: python scripts/task5_compare.py [--out res/task5] [--soft 5]
+           [--hard 600] [--families GC,AC,BM]
+"""
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import os
+import sys
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+REF = "/root/reference/experimentData/task5"
+
+# (family, model, preset, PA overrides) — the models task5 ships CSVs for.
+TARGETS = {
+    "GC": [("GC-1", "GC", {}), ("GC-2", "GC", {}), ("GC-3", "GC", {})],
+    "AC": [("AC-1", "AC", {}), ("AC-2", "AC", {}), ("AC-3", "AC", {})],
+    "BM": [("BM-1", "BM", {}), ("BM-2", "BM", {}), ("BM-3", "BM", {})],
+}
+
+# German re-encode maps (duplicating data/loaders._german_preprocess, which
+# mirrors utils/standard_data.py:4-65 — the task5 GC CSVs carry raw codes).
+_GC_GROUPS = {
+    "credit_history": {"A30": "None/Paid", "A31": "None/Paid",
+                       "A32": "None/Paid", "A33": "Delay", "A34": "Other"},
+    "savings": {"A61": "<500", "A62": "<500", "A63": "500+", "A64": "500+",
+                "A65": "Unknown/None"},
+    "employment": {"A71": "Unemployed", "A72": "1-4 years",
+                   "A73": "1-4 years", "A74": "4+ years", "A75": "4+ years"},
+    "status": {"A11": "<200", "A12": "<200", "A13": "200+", "A14": "None"},
+}
+_GC_SEX = {"A91": 1, "A93": 1, "A94": 1, "A92": 0, "A95": 0}
+
+
+def _encode_row(ds, family: str, row: dict):
+    """Decoded CSV row → encoded int vector in our feature order, or None
+    (with a reason) when a value falls outside the fitted domain."""
+    out = np.zeros(len(ds.feature_columns), dtype=np.int64)
+    for i, col in enumerate(ds.feature_columns):
+        if col not in row:
+            return None, f"missing column {col}"
+        raw = str(row[col]).strip()
+        if family == "GC" and col in _GC_GROUPS:
+            if raw not in _GC_GROUPS[col]:
+                return None, f"{col}={raw} outside german.data codes"
+            raw = _GC_GROUPS[col][raw]
+        if family == "GC" and col == "sex" and raw in _GC_SEX:
+            out[i] = _GC_SEX[raw]
+            continue
+        enc = ds.encoders.get(col)
+        if enc is not None and hasattr(enc, "classes_"):
+            classes = list(enc.classes_)
+            if raw in classes:
+                out[i] = classes.index(raw)
+                continue
+            # numeric-coded categorical (e.g. "1") stored as number
+            try:
+                val = float(raw)
+            except ValueError:
+                return None, f"{col}={raw} not in fitted classes"
+            if val in [float(c) if not isinstance(c, str) else None
+                       for c in classes]:
+                out[i] = [float(c) if not isinstance(c, str) else None
+                          for c in classes].index(val)
+                continue
+            return None, f"{col}={raw} not in fitted classes"
+        try:
+            out[i] = int(round(float(raw)))
+        except ValueError:
+            return None, f"{col}={raw} not numeric"
+    return out, None
+
+
+def _pairs_from_csv(path: str, pair_key: str | None):
+    """Consecutive-row pairs (fairify shape) or CE_ID-grouped pairs
+    (fairquant shape)."""
+    with open(path, newline="") as fp:
+        rows = list(csv.DictReader(fp))
+    pairs = []
+    if pair_key and rows and pair_key in rows[0]:
+        by_id: dict = {}
+        for r in rows:
+            by_id.setdefault(r[pair_key], []).append(r)
+        for rid, grp in by_id.items():
+            if len(grp) == 2:
+                pairs.append((grp[0], grp[1]))
+    else:
+        for k in range(0, len(rows) - 1, 2):
+            pairs.append((rows[k], rows[k + 1]))
+    return pairs
+
+
+def replay_tool_csv(ds, family, weights, biases, path, pair_key=None):
+    from fairify_tpu.verify import engine
+
+    pairs = _pairs_from_csv(path, pair_key)
+    confirmed = refuted = unencodable = 0
+    reasons: dict = {}
+    for ra, rb in pairs:
+        xa, why_a = _encode_row(ds, family, ra)
+        xb, why_b = _encode_row(ds, family, rb)
+        if xa is None or xb is None:
+            unencodable += 1
+            why = why_a or why_b
+            reasons[why] = reasons.get(why, 0) + 1
+            continue
+        if engine.validate_pair(weights, biases, xa, xb):
+            confirmed += 1
+        else:
+            refuted += 1
+    top = sorted(reasons.items(), key=lambda kv: -kv[1])[:3]
+    return {"pairs": len(pairs), "confirmed": confirmed, "refuted": refuted,
+            "unencodable": unencodable,
+            "top_unencodable_reasons": [f"{k} (x{v})" for k, v in top]}
+
+
+def our_ce_csv(ds, net, cfg, model, out_dir) -> dict:
+    """Budgeted sweep → decoded CE CSV in the task5 fairify shape."""
+    from fairify_tpu.analysis.decode import decode_point
+    from fairify_tpu.models.mlp import forward_np
+    from fairify_tpu.verify import sweep
+
+    rep = sweep.verify_model(net, cfg, model_name=model, dataset=ds,
+                             resume=True)
+    weights = [np.asarray(w) for w in net.weights]
+    biases = [np.asarray(b) for b in net.biases]
+    path = os.path.join(out_dir, f"counterexamples-fairify_tpu-{model}.csv")
+    n_pairs = 0
+    with open(path, "w", newline="") as fp:
+        wr = csv.writer(fp)
+        cols = list(ds.feature_columns) + ["output", "prediction"]
+        wr.writerow(cols)
+        for o in rep.outcomes:
+            if o.verdict != "sat" or o.counterexample is None:
+                continue
+            for pt in o.counterexample:
+                dec = decode_point(ds, np.asarray(pt))
+                logit = float(forward_np(weights, biases,
+                                         np.asarray(pt, dtype=np.float64)))
+                prob = 1.0 / (1.0 + np.exp(-logit))
+                wr.writerow([dec[c] for c in ds.feature_columns]
+                            + [prob, int(prob > 0.5)])
+            n_pairs += 1
+    counts = rep.counts
+    return {"csv": path, "ce_pairs": n_pairs, **counts}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="res/task5")
+    ap.add_argument("--soft", type=float, default=5.0)
+    ap.add_argument("--hard", type=float, default=600.0)
+    ap.add_argument("--families", default="GC,AC,BM")
+    ap.add_argument("--audit-out",
+                    default=os.path.join(ROOT, "audits",
+                                         "task5_compare_r4.json"))
+    args = ap.parse_args()
+
+    from fairify_tpu.data import loaders
+    from fairify_tpu.models import zoo
+    from fairify_tpu.verify import presets
+
+    os.makedirs(args.out, exist_ok=True)
+    records = []
+    for family in args.families.split(","):
+        for model, preset, overrides in TARGETS[family]:
+            cfg = presets.get(preset).with_(
+                soft_timeout_s=args.soft, hard_timeout_s=args.hard,
+                result_dir=os.path.join(args.out, family), **overrides)
+            ds = loaders.load(cfg.dataset)
+            net = zoo.load(cfg.dataset, model)
+            weights = [np.asarray(w) for w in net.weights]
+            biases = [np.asarray(b) for b in net.biases]
+            rec = {"model": model, "family": family}
+            for tool, pair_key in (("fairify", None), ("fairquant", "CE_ID")):
+                path = os.path.join(REF, family,
+                                    f"counterexamples-{tool}-{model}.csv")
+                if os.path.isfile(path):
+                    rec[tool] = replay_tool_csv(ds, family, weights, biases,
+                                                path, pair_key)
+            rec["ours"] = our_ce_csv(ds, net, cfg, model,
+                                     os.path.join(args.out, family))
+            print(json.dumps(rec), flush=True)
+            records.append(rec)
+    out = {
+        "what": ("Cross-tool counterexample comparison in the reference's "
+                 "task5 shape: the committed Fairify/FairQuant CE CSVs "
+                 "re-encoded through our loaders and re-judged by exact "
+                 "rational replay, plus our own decoded CE sets per model."),
+        "caveat": ("'refuted' means the pair does not strictly flip the "
+                   "shared .h5 under OUR loader's encoding — for Fairify "
+                   "rows that is a like-for-like judgement (same "
+                   "preprocessing lineage, and its rows replay ~100%); "
+                   "FairQuant rows carry values outside the dataset's "
+                   "fitted domain (e.g. german month=78, purpose=A47), so "
+                   "its refuted counts primarily measure an encoding/"
+                   "domain mismatch between tools, NOT FairQuant "
+                   "unsoundness."),
+        "script": "scripts/task5_compare.py",
+        "reference": REF,
+        "records": records,
+    }
+    os.makedirs(os.path.dirname(args.audit_out), exist_ok=True)
+    with open(args.audit_out, "w") as fp:
+        json.dump(out, fp, indent=1)
+    print(f"wrote {args.audit_out}")
+    return 0
+
+
+if __name__ == "__main__":
+    main()
