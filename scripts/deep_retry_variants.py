@@ -117,10 +117,31 @@ def main() -> int:
             continue
         n_fixed = sum(fixed.values())
 
+        # ADVICE r3 (+ r4 review): the span ledgers are the record of truth
+        # for EVERY count, not just unknown — after a crash between a prior
+        # deep run's ledger append and its row patch, the row's sat/unsat
+        # are stale too (blindly adding `fixed` would silently drop the
+        # crash-decided partitions).  Recompute all three counts from the
+        # merged last-wins ledgers; unknown additionally covers the
+        # never-attempted suffix excluded from the ledgers (= 0 here since
+        # budgeted rows ledger every attempted box, and unattempted boxes
+        # are not counted as unknown by the row semantics).
+        import glob as _glob
+
+        from fairify_tpu.verify.sweep import _load_ledger as _ll
+
+        merged: dict = {}
+        for path in sorted(_glob.glob(os.path.join(
+                cfg.result_dir, f"{cfg.name}-{r['model']}@*.ledger.jsonl"))):
+            merged.update(_ll(path))
+        led_counts = {"sat": 0, "unsat": 0, "unknown": 0}
+        for rec_l in merged.values():
+            led_counts[rec_l["verdict"]] += 1
+
         def patch(row):
-            row["sat"] += fixed["sat"]
-            row["unsat"] += fixed["unsat"]
-            row["unknown"] -= n_fixed
+            row["sat"] = led_counts["sat"]
+            row["unsat"] = led_counts["unsat"]
+            row["unknown"] = led_counts["unknown"]
             row["total_time_s"] = round(row["total_time_s"] + dt, 2)
             row["decided_per_sec"] = round(
                 (row["sat"] + row["unsat"]) / max(row["total_time_s"], 1e-9),
@@ -138,7 +159,7 @@ def main() -> int:
         if _patch_results_row(results_path, k, patch):
             print(json.dumps({"run_id": r["run_id"], "model": r["model"],
                               **fixed,
-                              "still_unknown": r["unknown"] - n_fixed,
+                              "still_unknown": max(residual - n_fixed, 0),
                               "wall_s": round(dt, 2)}), flush=True)
         else:
             # The target row vanished between startup and the patch (a

@@ -1,0 +1,61 @@
+"""Round-4 slow-tail parity re-runs (VERDICT r3 #1 'done' criterion).
+
+Re-sweeps the round-3 slow-tail models — AC-4 (both PAs), AC-2, BM-4,
+BM-9, GC-5 — on their FULL grids with the round-4 engine (Phase A deep
+PGD, sign-frontier cap, multi-way splits), writing fresh throughput
+records (with per-phase attribution) under ``parity/`` and appending to
+``parity/results.jsonl``.  Done = every row ≥ 1 decided partition/sec.
+
+Usage: python scripts/rerun_slow_parity.py [--out parity] [--targets ...]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+# (run_id, preset, overrides, model, hard_s) — cheap rows first so a crash
+# late in the queue loses the least.
+TARGETS = [
+    ("GC-age", "GC", {}, "GC-5", 900.0),
+    ("BM-age", "BM", {}, "BM-4", 1200.0),
+    ("BM-age", "BM", {}, "BM-9", 1200.0),
+    ("AC-race", "AC", {"protected": ("race",)}, "AC-4", 5400.0),
+    ("AC-sex", "AC", {}, "AC-2", 5400.0),
+    ("AC-sex", "AC", {}, "AC-4", 7200.0),
+]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="parity")
+    ap.add_argument("--soft", type=float, default=5.0)
+    ap.add_argument("--targets", default="",
+                    help="comma list run_id:model restricting the queue")
+    args = ap.parse_args()
+
+    from _sweeplib import run_and_record
+    from fairify_tpu.verify import presets
+
+    sys.path.insert(0, os.path.join(ROOT, "scripts"))
+    os.makedirs(args.out, exist_ok=True)
+    results_path = os.path.join(args.out, "results.jsonl")
+    wanted = ({tuple(t.split(":")) for t in args.targets.split(",")}
+              if args.targets else None)
+    for run_id, preset, overrides, model, hard in TARGETS:
+        if wanted is not None and (run_id, model) not in wanted:
+            continue
+        cfg = presets.get(preset).with_(
+            soft_timeout_s=args.soft, hard_timeout_s=hard,
+            result_dir=os.path.join(args.out, run_id), **overrides)
+        run_and_record(cfg, run_id, results_path,
+                       extra={"pa": overrides.get("protected", cfg.protected)[0]},
+                       model_filter={model})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
