@@ -183,6 +183,35 @@ def budgeted_model_sweep(cfg, net, model_name: str, dataset=None):
     }
 
 
+def merge_span_ledgers(cfg, model_name: str):
+    """Decided-wins union of a model's span ledgers under this config.
+
+    Crashed runs can leave OVERLAPPING span files (different adaptive span
+    boundaries); a partition any file records as decided stays decided —
+    a later file's budget-cut 'unknown' must never demote it.  This is the
+    single merge semantics shared by :func:`retry_span_unknowns` and the
+    deep-retry row recount (round-4 review: a file-order last-wins merge
+    there could corrupt published counts).  Returns
+    ``(paths, decided: {pid: rec}, unknown_pids: set)``.
+    """
+    import glob
+
+    paths = sorted(glob.glob(os.path.join(
+        cfg.result_dir, f"{cfg.name}-{model_name}@*.ledger.jsonl")))
+    from fairify_tpu.verify import sweep as sweep_mod
+
+    decided: dict = {}
+    unknown: set = set()
+    for path in paths:
+        for pid, rec in sweep_mod._load_ledger(path).items():
+            if rec["verdict"] != "unknown":
+                decided[pid] = rec
+                unknown.discard(pid)
+            elif pid not in decided:
+                unknown.add(pid)
+    return paths, decided, unknown
+
+
 def retry_span_unknowns(cfg, net, model_name: str, budget_s: float,
                         grid=None, return_residual: bool = False):
     """Soft-timeout re-decision of a budgeted sweep's in-prefix UNKNOWNs.
@@ -202,8 +231,6 @@ def retry_span_unknowns(cfg, net, model_name: str, budget_s: float,
     a no-op that must not be recorded as a deep pass) from a genuine
     attempt.
     """
-    import glob
-
     import numpy as np
 
     from fairify_tpu.verify import engine, sweep as sweep_mod
@@ -223,14 +250,8 @@ def retry_span_unknowns(cfg, net, model_name: str, budget_s: float,
     eng = _replace(cfg.engine, soft_timeout_s=cfg.soft_timeout_s)
     t0 = time.perf_counter()
     fixed = {"sat": 0, "unsat": 0}
-    paths = sorted(glob.glob(os.path.join(
-        cfg.result_dir, f"{cfg.name}-{model_name}@*.ledger.jsonl")))
-    decided = set()
-    unknown = set()
-    for path in paths:
-        for pid, rec in sweep_mod._load_ledger(path).items():
-            (decided if rec["verdict"] != "unknown" else unknown).add(pid)
-    unk = sorted(unknown - decided)
+    paths, decided, unknown = merge_span_ledgers(cfg, model_name)
+    unk = sorted(unknown)
     if not unk or not paths:
         return (fixed, 0) if return_residual else fixed
     sink = paths[-1]

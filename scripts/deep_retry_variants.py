@@ -121,21 +121,16 @@ def main() -> int:
         # for EVERY count, not just unknown — after a crash between a prior
         # deep run's ledger append and its row patch, the row's sat/unsat
         # are stale too (blindly adding `fixed` would silently drop the
-        # crash-decided partitions).  Recompute all three counts from the
-        # merged last-wins ledgers; unknown additionally covers the
-        # never-attempted suffix excluded from the ledgers (= 0 here since
-        # budgeted rows ledger every attempted box, and unattempted boxes
-        # are not counted as unknown by the row semantics).
-        import glob as _glob
+        # crash-decided partitions).  Recompute all three counts with the
+        # SAME decided-wins merge retry_span_unknowns uses
+        # (_sweeplib.merge_span_ledgers) — a file-order last-wins merge
+        # could demote a decided pid behind an overlapping span's
+        # budget-cut 'unknown'.
+        from _sweeplib import merge_span_ledgers
 
-        from fairify_tpu.verify.sweep import _load_ledger as _ll
-
-        merged: dict = {}
-        for path in sorted(_glob.glob(os.path.join(
-                cfg.result_dir, f"{cfg.name}-{r['model']}@*.ledger.jsonl"))):
-            merged.update(_ll(path))
-        led_counts = {"sat": 0, "unsat": 0, "unknown": 0}
-        for rec_l in merged.values():
+        _, led_decided, led_unknown = merge_span_ledgers(cfg, r["model"])
+        led_counts = {"sat": 0, "unsat": 0, "unknown": len(led_unknown)}
+        for rec_l in led_decided.values():
             led_counts[rec_l["verdict"]] += 1
 
         def patch(row):
@@ -159,7 +154,7 @@ def main() -> int:
         if _patch_results_row(results_path, k, patch):
             print(json.dumps({"run_id": r["run_id"], "model": r["model"],
                               **fixed,
-                              "still_unknown": max(residual - n_fixed, 0),
+                              "still_unknown": led_counts["unknown"],
                               "wall_s": round(dt, 2)}), flush=True)
         else:
             # The target row vanished between startup and the patch (a

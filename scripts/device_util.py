@@ -1,0 +1,185 @@
+"""Device-utilization record for the stage-0 kernels (VERDICT r3 #3).
+
+Measures, on the real chip, the stage-0 certify kernel (CROWN role bounds
++ tied-diff) and the attack forward for GC-1 and AC-1 on real grid chunks:
+
+* XLA's own ``compiled.cost_analysis()`` FLOP and logical bytes-accessed
+  counts (the compiler's static model; logical bytes count fused
+  intermediates, so they are an upper bound on physical HBM traffic);
+* measured warm-launch wall time (median over reps of 8 back-to-back
+  launches, each synced by a device→host output fetch — on the tunnelled
+  chip ``block_until_ready`` returns before remote completion);
+* achieved FLOP/s and its fraction of the chip's nominal peak — the
+  roofline position.  Also captures a real ``jax.profiler`` trace
+  directory for XProf/TensorBoard inspection.
+
+The point (SURVEY.md §5.1's profiling mandate): substantiate with numbers
+that stage 0 is HBM-bound at tiny arithmetic intensity — the partitions
+axis streams role boxes through small matmuls — so throughput scales with
+the partition batch, and `frontier_size`/`grid_chunk` tuning is about
+launch amortization, not MXU saturation.
+
+Writes ``audits/device_util_r4.json``.
+
+Usage: python scripts/device_util.py [--chunk 2048] [--reps 5]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+# Nominal per-chip peaks by device kind (public spec sheets).  Fallback is
+# conservative; the record states which row was used.
+PEAKS = {
+    # device_kind substring: (peak f32 TFLOP/s, HBM GB/s)
+    "v2": (11.5, 300.0),
+    "v3": (61.0, 900.0),
+    "v4": (137.5, 1200.0),
+    "v5 lite": (98.0, 820.0),
+    "v5": (197.0, 1600.0),
+    "v6 lite": (460.0, 1640.0),
+    "v6": (460.0, 1640.0),
+}
+
+
+def measure(kernel_name, lowered, run, reps, inner=8):
+    """Time ``inner`` back-to-back launches per rep, each synced by a
+    device→host fetch of an output (``run`` must end in np.asarray /
+    device_get — on the tunnelled chip ``block_until_ready`` returns
+    before remote completion, which round 4 caught as a 5×-over-peak
+    'measured' HBM rate).  cost_analysis 'bytes accessed' is XLA's
+    LOGICAL per-op traffic (counts fused intermediates), reported as
+    such, not as physical HBM bytes."""
+    compiled = lowered.compile()
+    cost = compiled.cost_analysis()
+    if isinstance(cost, list):  # older jax returns [dict]
+        cost = cost[0] if cost else {}
+    flops = float(cost.get("flops", 0.0))
+    bytes_acc = float(cost.get("bytes accessed", 0.0))
+    run()  # warmup beyond compile (cache effects)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            run()
+        times.append((time.perf_counter() - t0) / inner)
+    wall = statistics.median(times)
+    return {
+        "kernel": kernel_name,
+        "xla_flops": flops,
+        "xla_logical_bytes": bytes_acc,
+        "arithmetic_intensity_flops_per_logical_byte":
+            round(flops / bytes_acc, 3) if bytes_acc else None,
+        "warm_launch_s_median": round(wall, 6),
+        "achieved_gflops": round(flops / wall / 1e9, 2),
+        "logical_gbps": round(bytes_acc / wall / 1e9, 2),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--chunk", type=int, default=2048)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--trace-dir", default="res/xla_trace_r4")
+    ap.add_argument("--out", default=os.path.join(ROOT, "audits",
+                                                  "device_util_r4.json"))
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fairify_tpu.models import zoo
+    from fairify_tpu.verify import engine, presets, sweep
+    from fairify_tpu.verify.property import encode, role_boxes
+
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", str(dev))
+    peak = next((v for k, v in PEAKS.items() if k in kind.lower()), None)
+
+    records = []
+    for preset_name, model in (("GC", "GC-1"), ("AC", "AC-1")):
+        cfg = presets.get(preset_name)
+        net = zoo.load(cfg.dataset, model)
+        enc = encode(cfg.query())
+        _, lo, hi = sweep.build_partitions(cfg)
+        lo, hi = lo[: args.chunk], hi[: args.chunk]
+        flo, fhi = lo.astype(np.float32), hi.astype(np.float32)
+        x_lo, x_hi, xp_lo, xp_hi, valid = role_boxes(enc, flo, fhi)
+        av, pm, rm = engine._enc_tensors(enc, lo.shape[1])
+        cert_args = (net, jnp.asarray(x_lo), jnp.asarray(x_hi),
+                     jnp.asarray(xp_lo), jnp.asarray(xp_hi),
+                     jnp.asarray(flo), jnp.asarray(fhi), jnp.asarray(av),
+                     jnp.asarray(pm), jnp.asarray(rm), float(enc.eps),
+                     jnp.asarray(valid), jnp.asarray(enc.valid_pair))
+
+        lowered = engine._role_certify_kernel.lower(*cert_args, alpha_iters=0)
+
+        def run_cert():
+            out = engine._role_certify_kernel(*cert_args, alpha_iters=0)
+            np.asarray(out[0])  # device->host fetch = true completion sync
+
+        rec = measure(f"{model} stage0 certify ({lo.shape[0]} boxes)",
+                      lowered, run_cert, args.reps)
+        rec["parts"] = int(lo.shape[0])
+        rec["boxes_per_sec"] = round(lo.shape[0] / rec["warm_launch_s_median"], 1)
+        records.append(rec)
+
+        rng = np.random.default_rng(0)
+        xr, pr = engine.build_attack_candidates(enc, rng, lo, hi, 32)
+        att_args = (net, jnp.asarray(xr), jnp.asarray(pr))
+        lowered_a = engine._attack_logits.lower(*att_args)
+
+        def run_att():
+            out = engine._attack_logits(*att_args)
+            np.asarray(out[0])  # device->host fetch = true completion sync
+
+        rec = measure(f"{model} attack forward ({xr.shape[0]}x{xr.shape[1]}"
+                      f"x{xr.shape[2]} candidates)", lowered_a, run_att,
+                      args.reps)
+        records.append(rec)
+
+    # One real profiler trace around a certify launch (XProf-viewable).
+    os.makedirs(args.trace_dir, exist_ok=True)
+    with jax.profiler.trace(args.trace_dir):
+        run_cert()
+    trace_files = sum(len(fs) for _, _, fs in os.walk(args.trace_dir))
+
+    for r in records:
+        if peak:
+            r["pct_peak_flops"] = round(100.0 * r["achieved_gflops"] / (peak[0] * 1e3), 2)
+    out = {
+        "what": ("Roofline position of the stage-0 kernels on the real "
+                 "chip: XLA cost_analysis FLOPs/logical-bytes + measured "
+                 "warm-launch wall time (device-fetch-synced).  "
+                 "Arithmetic intensity of a few FLOP/logical-byte puts "
+                 "stage 0 deep in the memory/launch-bound region — the "
+                 "partitions axis streams small role-box tensors through "
+                 "small matmuls — so tuning is launch/batch amortization "
+                 "(grid_chunk, frontier_size), not MXU saturation; the "
+                 "MXU headroom is what the vmapped model-family kernels "
+                 "exploit."),
+        "script": "scripts/device_util.py",
+        "device_kind": kind,
+        "platform": dev.platform,
+        "nominal_peaks": ({"tflops_f32": peak[0], "hbm_gbps": peak[1]}
+                          if peak else "unknown device kind"),
+        "profiler_trace": {"dir": args.trace_dir, "files": trace_files},
+        "records": records,
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as fp:
+        json.dump(out, fp, indent=1)
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    main()

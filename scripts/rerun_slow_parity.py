@@ -37,10 +37,11 @@ def main() -> int:
                     help="comma list run_id:model restricting the queue")
     args = ap.parse_args()
 
+    sys.path.insert(0, os.path.join(ROOT, "scripts"))
+
     from _sweeplib import run_and_record
     from fairify_tpu.verify import presets
 
-    sys.path.insert(0, os.path.join(ROOT, "scripts"))
     os.makedirs(args.out, exist_ok=True)
     results_path = os.path.join(args.out, "results.jsonl")
     wanted = ({tuple(t.split(":")) for t in args.targets.split(",")}
@@ -51,6 +52,20 @@ def main() -> int:
         cfg = presets.get(preset).with_(
             soft_timeout_s=args.soft, hard_timeout_s=hard,
             result_dir=os.path.join(args.out, run_id), **overrides)
+        # "Fresh ledgers" must mean fresh: verify_model resumes by default,
+        # so a pre-existing ledger (an earlier round's run) would be
+        # fast-forwarded and re-reported as a re-verification with
+        # bookkeeping timings.  Move any prior sinks aside first.
+        for suffix in (f"{cfg.name}-{model}.ledger.jsonl", f"{model}.csv",
+                       f"{model}-counterexamples.csv",
+                       f"{cfg.name}-{model}.throughput.json"):
+            path = os.path.join(cfg.result_dir, suffix)
+            if os.path.isfile(path):
+                n = 1
+                while os.path.isfile(f"{path}.prev{n}"):
+                    n += 1
+                os.rename(path, f"{path}.prev{n}")
+                print(f"moved aside stale {path} -> .prev{n}", flush=True)
         run_and_record(cfg, run_id, results_path,
                        extra={"pa": overrides.get("protected", cfg.protected)[0]},
                        model_filter={model})

@@ -120,10 +120,12 @@ def _pairs_from_csv(path: str, pair_key: str | None):
 
 
 def replay_tool_csv(ds, family, weights, biases, path, pair_key=None):
+    from fairify_tpu.models.mlp import forward_np
     from fairify_tpu.verify import engine
 
     pairs = _pairs_from_csv(path, pair_key)
     confirmed = refuted = unencodable = 0
+    out_match = out_total = 0
     reasons: dict = {}
     for ra, rb in pairs:
         xa, why_a = _encode_row(ds, family, ra)
@@ -133,14 +135,38 @@ def replay_tool_csv(ds, family, weights, biases, path, pair_key=None):
             why = why_a or why_b
             reasons[why] = reasons.get(why, 0) + 1
             continue
+        # Lineage self-diagnosis: when the CSV records the tool's own
+        # output probability, compare it with OUR forward at the
+        # re-encoded point.  A low match rate means the tool's encoding
+        # of these columns differs from ours — then refuted counts
+        # measure the encoding mismatch, not the tool's soundness.
+        for row, x in ((ra, xa), (rb, xb)):
+            if "output" in row and row["output"]:
+                try:
+                    rec_out = float(row["output"])
+                except ValueError:
+                    continue
+                lg = float(forward_np(weights, biases,
+                                      np.asarray(x, dtype=np.float64)))
+                ours = 1.0 / (1.0 + np.exp(-lg))
+                out_total += 1
+                if abs(ours - rec_out) < 1e-3:
+                    out_match += 1
         if engine.validate_pair(weights, biases, xa, xb):
             confirmed += 1
         else:
             refuted += 1
     top = sorted(reasons.items(), key=lambda kv: -kv[1])[:3]
-    return {"pairs": len(pairs), "confirmed": confirmed, "refuted": refuted,
-            "unencodable": unencodable,
-            "top_unencodable_reasons": [f"{k} (x{v})" for k, v in top]}
+    rec = {"pairs": len(pairs), "confirmed": confirmed, "refuted": refuted,
+           "unencodable": unencodable,
+           "top_unencodable_reasons": [f"{k} (x{v})" for k, v in top]}
+    if out_total:
+        rec["output_match_rate"] = round(out_match / out_total, 4)
+        rec["encoding_lineage"] = ("matched" if out_match / out_total > 0.9
+                                   else "MISMATCHED — refuted counts are an "
+                                        "encoding-lineage artifact, not a "
+                                        "soundness judgement")
+    return rec
 
 
 def our_ce_csv(ds, net, cfg, model, out_dir) -> dict:
