@@ -27,3 +27,36 @@ def test_model_natkey_orders_families_and_odd_names():
     ordered = sorted(names, key=_sweeplib.model_natkey)
     assert ordered.index("CP-1") < ordered.index("CP-2") < ordered.index("CP-10")
     assert "aCP-1-Old" in ordered  # non-standard name sorts without crashing
+
+
+def test_merge_span_ledgers_decided_wins(tmp_path):
+    """r4 review: overlapping span ledgers from crashed runs must merge
+    decided-wins — a later file's budget-cut 'unknown' can never demote a
+    pid another file decided, regardless of file order."""
+    from _sweeplib import merge_span_ledgers
+    from fairify_tpu.verify import presets
+
+    cfg = presets.get("GC").with_(result_dir=str(tmp_path))
+
+    def write(name, recs):
+        with open(tmp_path / name, "w") as fp:
+            for pid, verdict in recs:
+                fp.write(json.dumps({"partition_id": pid, "verdict": verdict,
+                                     "ce": None, "time_s": 0.0}) + "\n")
+
+    # Earlier span decides 3000 SAT; a later overlapping span (sorts after)
+    # recorded the same pid unknown (hard budget cut it mid-batch).
+    write("GC-m@0-2048.ledger.jsonl", [(3000, "sat"), (1, "unsat")])
+    write("GC-m@2048-34816.ledger.jsonl",
+          [(3000, "unknown"), (2, "unknown"), (4, "unsat")])
+    paths, decided, unknown = merge_span_ledgers(cfg, "m")
+    assert len(paths) == 2
+    assert decided[3000]["verdict"] == "sat"     # decided-wins
+    assert decided[1]["verdict"] == "unsat"
+    assert decided[4]["verdict"] == "unsat"
+    assert unknown == {2}                        # only the genuinely open pid
+    # Reverse arrival order: unknown first, decided later — still decided.
+    write("GC-m2@0-9999.ledger.jsonl", [(7, "unknown")])
+    write("GC-m2@5000-9999.ledger.jsonl", [(7, "sat")])
+    _, decided2, unknown2 = merge_span_ledgers(cfg, "m2")
+    assert decided2[7]["verdict"] == "sat" and unknown2 == set()
