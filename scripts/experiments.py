@@ -69,21 +69,77 @@ def cmd_render(args):
                 f"{r['total_time_s']} |")
         lines.append("")
 
-    pred = _load_jsonl(os.path.join(args.predicted, "summary.jsonl")) if args.predicted else []
+    pred_all = _load_jsonl(os.path.join(args.predicted, "summary.jsonl")) if args.predicted else []
     # re-runs append; keep the latest record per model
-    pred = list({r["model"]: r for r in pred}.values())
-    if pred:
-        lines += [
-            "## Teacher-labelled students (task2 analog)",
-            "",
+    pred_all = list({r["model"]: r for r in pred_all}.values())
+    # task2 = classical teachers; task3 = strong teachers (gbt stands in
+    # for TabPFN, whose checkpoint is unfetchable here — models/gbt.py).
+    pred = [r for r in pred_all if r["teacher"] in ("knn", "rf")]
+    strong = [r for r in pred_all if r["teacher"] not in ("knn", "rf")]
+
+    def teacher_table(rows):
+        out = [
             "| Model | Teacher | Teacher acc | #P | SAT | UNSAT | UNK | Student acc | Time (s) |",
             "|---|---|---|---|---|---|---|---|---|",
         ]
-        for r in pred:
-            lines.append(
+        for r in rows:
+            out.append(
                 f"| {r['model']} | {r['teacher']} | {r['teacher_acc']} | {r['partitions']} | "
                 f"{r['sat']} | {r['unsat']} | {r['unknown']} | {r['student_acc']} | "
                 f"{r['total_time_s']} |")
+        return out + [""]
+
+    if pred:
+        lines += ["## Teacher-labelled students (task2 analog)", ""]
+        lines += teacher_table(pred)
+    if strong:
+        lines += [
+            "## Strong-teacher students (task3 analog)",
+            "",
+            "Reference task3 uses TabPFN (unfetchable checkpoint); the "
+            "strong-teacher role is filled by from-scratch gradient-boosted "
+            "depth-2 trees (`fairify_tpu/models/gbt.py` — depth 2 so the "
+            "teacher captures feature interactions an additive model "
+            "cannot).  Same pipeline: fit teacher → relabel → train MLP "
+            "student → export `.h5` → verify "
+            "(`scripts/predicted_labels.py --teacher gbt`).",
+            "",
+        ]
+        lines += teacher_table(strong)
+
+    t5_path = args.task5 or os.path.join(ROOT, "audits",
+                                         "task5_compare_r4.json")
+    if os.path.isfile(t5_path):
+        t5 = _load_json(t5_path)
+        lines += [
+            "## Cross-tool counterexample comparison (task5 analog)",
+            "",
+            "`scripts/task5_compare.py` rebuilds the reference's task5 "
+            "artifact family: its committed Fairify/FairQuant CE CSVs are "
+            "re-encoded through our loaders and re-judged by exact "
+            "rational replay, and our own decoded CE sets are emitted per "
+            "model in the same CSV shape.  Each replay self-diagnoses its "
+            "encoding lineage by comparing the CSV's recorded output "
+            "probability with OUR forward at the re-encoded point "
+            "(`output_match_rate`); only lineage-matched rows are a "
+            "like-for-like judgement.  " + t5.get("caveat", ""),
+            "",
+            "| Model | Fairify conf/pairs (lineage match) | "
+            "FairQuant conf/refuted/unencodable (lineage match) | Our CE pairs |",
+            "|---|---|---|---|",
+        ]
+        def t5_cell(rec, tool):
+            if tool not in rec:
+                return "—"
+            t = rec[tool]
+            m = t.get("output_match_rate")
+            mtxt = f", match {m}" if m is not None else ", no output col"
+            return (f"{t['confirmed']}/{t['pairs']}"
+                    f" ({t['refuted']} ref, {t['unencodable']} unenc{mtxt})")
+
+        for r in t5["records"]:
+            lines.append(f"| {r['model']} | {t5_cell(r, 'fairify')} | "
+                         f"{t5_cell(r, 'fairquant')} | {r['ours']['ce_pairs']} |")
         lines.append("")
 
     exps = [_load_json(p) for p in args.experiment.split(",")] if args.experiment else []
@@ -171,6 +227,9 @@ def main():
     rend.add_argument("--predicted", default=None)
     rend.add_argument("--experiment", default=None)
     rend.add_argument("--platform", default="CPU (virtual mesh)")
+    rend.add_argument("--task5", default=None,
+                      help="task5 comparison audit JSON (default: "
+                           "audits/task5_compare_r4.json)")
     rend.set_defaults(fn=cmd_render)
     app = sub.add_parser("append")
     app.add_argument("--experiment", required=True)
