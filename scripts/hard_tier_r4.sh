@@ -1,0 +1,34 @@
+#!/bin/bash
+# Hard-tier (reference-budget) re-runs of the slow variant rows — VERDICT r3 #2.
+#
+# Each entry re-runs a (preset, models) group at the reference's own budget
+# (soft 100 s / hard 3600 s, `INSTALL.md:45-71`) with the round-4 engine.
+# The resume key in variants/results.jsonl carries the budget tier, so these
+# append fresh 3600 s rows next to the existing 120 s rows instead of
+# resuming past them.  Order: the rows VERDICT r3 named first, then the
+# remaining dec/s < 5 rows.
+set -u
+cd "$(dirname "$0")/.."
+
+QUEUE=(
+  "stress-GC GC-5"
+  "stress-BM BM-4,BM-11"
+  "stress-AC AC-1,AC-12"
+  "relaxed-GC GC-5"
+  "relaxed-AC AC-1"
+  "relaxed-BM BM-4,BM-11"
+  "targeted-GC GC-5"
+  "targeted-AC AC-8"
+  "targeted2-AC AC-1,AC-8"
+  "targeted2-BM BM-4,BM-7,BM-11"
+)
+
+for entry in "${QUEUE[@]}"; do
+  preset=${entry%% *}
+  models=${entry#* }
+  echo "=== hard tier: $preset $models ($(date -u +%H:%M:%S)) ==="
+  PYTHONUNBUFFERED=1 python scripts/variants.py run --out variants \
+    --soft 100 --hard 3600 --presets "$preset" --models "$models" \
+    || echo "!! $preset $models exited $?"
+done
+echo "=== hard tier queue complete ($(date -u +%H:%M:%S)) ==="
