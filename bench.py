@@ -71,13 +71,20 @@ def main() -> None:
     import shutil
 
     shutil.rmtree("/tmp/fairify_tpu_bench", ignore_errors=True)
-    # Warm-up: compile the stage-0 kernels on a 2-partition slice.
-    warm = cfg.with_(hard_timeout_s=1e-9, result_dir="/tmp/fairify_tpu_bench_warm")
+    # Warm-up: ONE FULL untimed run of the exact headline sweep.  The r4
+    # regression (BENCH_r04 25.96 vs r3 54.73 parts/s) was cold-process
+    # compiles/traces of the round-4 phase-ladder kernels landing inside the
+    # timed region — a stage-0-only warmup misses the PGD scan+grad, sign-BaB
+    # and LP-phase kernels.  Running the identical sweep once compiles every
+    # kernel at its exact shapes; the timed run then measures the engine, not
+    # the tracer (VERDICT r5 #1).
+    warm = cfg.with_(result_dir="/tmp/fairify_tpu_bench_warm")
     shutil.rmtree("/tmp/fairify_tpu_bench_warm", ignore_errors=True)
     try:
         sweep.verify_model(net, warm, model_name="warmup", resume=False)
-    except Exception:
-        pass
+    except Exception as exc:
+        print(json.dumps({"metric": "warmup_error", "error": str(exc)[:200]}),
+              file=sys.stderr)
 
     # --- Promotion-ladder configs (BASELINE.json "configs"): one JSON line
     # each, printed BEFORE the headline (the driver parses the last line).
@@ -162,19 +169,27 @@ def _ladder_configs() -> None:
     }), flush=True)
 
     # Budgeted variant prefixes (stress-BM mesh-analog + relaxed-eps).
+    # Each config runs TWICE: one full untimed warm pass (identical config,
+    # so every kernel the timed pass will launch is compiled at its exact
+    # shapes), then the timed pass — same warm-vs-timed discipline as the
+    # headline (VERDICT r5 #1: the r4 stress/relaxed collapse was compiles
+    # inside the 60 s budget).
+    import shutil
+
     for preset, model, ref_pps in (("stress-BM", "BM-1", REF_PPS_BM),
                                    ("relaxed-AC", "AC-1", REF_PPS_AC)):
         vcfg = presets.get(preset).with_(
             soft_timeout_s=100.0, hard_timeout_s=60.0,
             result_dir=f"/tmp/fairify_tpu_bench_{preset}")
-        import shutil
-
-        shutil.rmtree(vcfg.result_dir, ignore_errors=True)
         net = zoo.load(vcfg.dataset, model)
+        shutil.rmtree(vcfg.result_dir, ignore_errors=True)
+        budgeted_model_sweep(vcfg, net, model)  # warm (untimed)
+        shutil.rmtree(vcfg.result_dir, ignore_errors=True)
         row = budgeted_model_sweep(vcfg, net, model)
         print(json.dumps({
             "metric": f"{preset}_budgeted_decided_partitions_per_sec "
-                      f"({model}, 60s budget, attempted {row['attempted']} "
+                      f"({model}, 60s budget, wall {row['total_time_s']}s, "
+                      f"attempted {row['attempted']} "
                       f"of {row['partitions']}, unk {row['unknown']}; "
                       f"baseline = Table V family mean s/part)",
             "value": row["decided_per_sec"],

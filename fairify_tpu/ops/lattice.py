@@ -48,6 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from fairify_tpu.models.mlp import MLP
+from fairify_tpu.utils import profiling
 from fairify_tpu.utils.num import matmul
 from fairify_tpu.verify.property import shared_dims, valid_assignments
 
@@ -625,12 +626,14 @@ def decide_box_exhaustive(
                     return "unknown", None
                 prefix_vals, bases_dev, c0 = nxt
                 if ra_mode:
+                    profiling.bump_launch()
                     fut = _lattice_scan_kernel_ra(
                         net, jnp.int32(c0), jnp.int32(n_suf),
                         dev["strides"], dev["widths"], dev["lo_shared"],
                         bases_dev, dev["valid_mask"], dev["valid_pair_f"],
                         chunk, dims_tuple, d, ra_ws, eps)
                 else:
+                    profiling.bump_launch()
                     fut = _lattice_scan_kernel(
                         net, jnp.int32(c0), jnp.int32(n_suf),
                         dev["strides"], dev["widths"], dev["lo_shared"],

@@ -28,6 +28,23 @@ def xla_trace(trace_dir: Optional[str]):
         yield
 
 
+# Process-wide device-launch counter.  On the tunnelled single-chip setup
+# every kernel launch pays a ~110 ms relay round-trip regardless of batch
+# size (audits/device_util_r4.json), so launch COUNT — not FLOPs — is the
+# throughput governor; hot call sites bump this so each sweep can regress
+# its launch economy (VERDICT r4 #3).  Host-side numpy/LP work is excluded.
+_LAUNCHES = 0
+
+
+def bump_launch(n: int = 1) -> None:
+    global _LAUNCHES
+    _LAUNCHES += n
+
+
+def launch_count() -> int:
+    return _LAUNCHES
+
+
 @dataclass
 class ThroughputCounter:
     """Decided-partitions/sec accounting, per phase and per chip."""
@@ -38,6 +55,7 @@ class ThroughputCounter:
     bab_decided: int = 0
     unknown: int = 0
     n_devices: int = 1
+    launches: int = 0  # device-launch delta over this sweep (bump_launch)
 
     def record(self, verdict: str, via_stage0: bool) -> None:
         if verdict in ("sat", "unsat"):
@@ -60,6 +78,7 @@ class ThroughputCounter:
             "unknown": self.unknown,
             "partitions_per_sec": round(pps, 4),
             "partitions_per_sec_per_chip": round(pps / max(self.n_devices, 1), 4),
+            "device_launches": self.launches,
         }
 
     def dump(self, path: str, phases: Optional[Dict[str, float]] = None) -> None:

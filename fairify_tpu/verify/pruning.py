@@ -28,6 +28,7 @@ from fairify_tpu.ops import exact as exact_ops
 from fairify_tpu.ops import interval as interval_ops
 from fairify_tpu.ops import masks as mask_ops
 from fairify_tpu.ops import simulate as sim_ops
+from fairify_tpu.utils import profiling
 
 
 @dataclass
@@ -117,6 +118,7 @@ def sound_prune_grid(
         clo = pad_rows(lo_np[s:e], step)
         chi = pad_rows(hi_np[s:e], step)
         keys = grid_keys(seed, index_offset + s, step)
+        profiling.bump_launch()
         stats, sim, bounds = _sim_and_bounds(
             net, keys, jnp.asarray(clo, jnp.float32), jnp.asarray(chi, jnp.float32),
             sim_size, pallas=use_pallas, with_sim=keep_sim,

@@ -38,6 +38,7 @@ from fairify_tpu.models import zoo
 from fairify_tpu.ops import heuristic as heur_ops
 from fairify_tpu.ops import masks as mask_ops
 from fairify_tpu.partition import grid as grid_mod
+from fairify_tpu.utils import profiling
 from fairify_tpu.utils.prng import shuffled_order
 from fairify_tpu.utils.timing import PhaseTimer
 from fairify_tpu.verify import csvio, engine, pruning
@@ -148,10 +149,27 @@ def _stage0_block(net, enc: PairEncoding, lo, hi, cfg: SweepConfig, mesh, rng_se
         x_lo, x_hi, xp_lo, xp_hi, plo, phi, valid_in = mesh_mod.shard_parts(
             mesh, x_lo, x_hi, xp_lo, xp_hi, flo, fhi, valid)
         net = mesh_mod.replicated(mesh, net)
-    if cfg.engine.use_crown:
-        # Combined certificate: separate role bounds + tied pair-difference
-        # kills (engine._certify_impl) — one kernel for the whole block.
+    rng = np.random.default_rng(rng_seed)
+    xr, pr = engine.build_attack_candidates(enc, rng, lo, hi, cfg.engine.attack_samples)
+    if cfg.engine.use_crown and mesh is None:
+        # Combined certificate (separate role bounds + tied pair-difference
+        # kills, engine._certify_impl) AND the attack forwards in ONE launch
+        # per block — on the tunnelled chip each launch costs ~110 ms flat
+        # (audits/device_util_r4.json), so stage 0 pays one round-trip, not
+        # two (VERDICT r4 #3).
         assign_vals, pa_mask, ra_mask = engine._enc_tensors(enc, lo.shape[1])
+        profiling.bump_launch()
+        cert, _, lx, lp = engine._certify_attack_kernel(
+            net, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
+            jnp.asarray(xp_hi), jnp.asarray(plo), jnp.asarray(phi),
+            jnp.asarray(assign_vals), jnp.asarray(pa_mask), jnp.asarray(ra_mask),
+            float(enc.eps), jnp.asarray(valid_in), jnp.asarray(enc.valid_pair),
+            jnp.asarray(xr), jnp.asarray(pr), alpha_iters=0,
+        )
+        unsat = np.asarray(cert)[: lo.shape[0]]
+    elif cfg.engine.use_crown:
+        assign_vals, pa_mask, ra_mask = engine._enc_tensors(enc, lo.shape[1])
+        profiling.bump_launch()
         cert, _ = engine._role_certify_kernel(
             net, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
             jnp.asarray(xp_hi), jnp.asarray(plo), jnp.asarray(phi),
@@ -160,17 +178,18 @@ def _stage0_block(net, enc: PairEncoding, lo, hi, cfg: SweepConfig, mesh, rng_se
             alpha_iters=0,
         )
         unsat = np.asarray(cert)[: lo.shape[0]]
+        profiling.bump_launch()
+        lx, lp = engine._attack_logits(net, jnp.asarray(xr), jnp.asarray(pr))
     else:
+        profiling.bump_launch()
         lb_x, ub_x, lb_p, ub_p = engine._role_logit_bounds(
             net, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo), jnp.asarray(xp_hi),
             cfg.engine.use_crown,
         )
         lb_x, ub_x, lb_p, ub_p = (np.asarray(v)[: lo.shape[0]] for v in (lb_x, ub_x, lb_p, ub_p))
         unsat = engine.no_flip_certified(lb_x, ub_x, lb_p, ub_p, valid, enc.valid_pair)
-
-    rng = np.random.default_rng(rng_seed)
-    xr, pr = engine.build_attack_candidates(enc, rng, lo, hi, cfg.engine.attack_samples)
-    lx, lp = engine._attack_logits(net, jnp.asarray(xr), jnp.asarray(pr))
+        profiling.bump_launch()
+        lx, lp = engine._attack_logits(net, jnp.asarray(xr), jnp.asarray(pr))
     found, wit = engine.find_flips(enc, np.asarray(lx), np.asarray(lp), valid)
     weights = [np.asarray(w) for w in net.weights]
     biases = [np.asarray(b) for b in net.biases]
@@ -420,6 +439,7 @@ def verify_model(
     from fairify_tpu.utils.profiling import ThroughputCounter, xla_trace
 
     counter = ThroughputCounter(n_devices=1 if mesh is None else int(np.prod(list(mesh.shape.values()))))
+    launch0 = profiling.launch_count()
     with xla_trace(cfg.profile_dir):
         with timer.phase("stage0_prune"):
             prune = pruning.sound_prune_grid(
@@ -441,6 +461,7 @@ def verify_model(
                     jnp.asarray(_pad_rows(1.0 - d[s:e], step), jnp.float32)
                     for d in prune.st_deads)
                 keys = pruning.grid_keys(cfg.seed, span_start + s, step)
+                profiling.bump_launch()
                 block = _parity_grid_from_keys(
                     net, keys,
                     jnp.asarray(_pad_rows(lo[s:e], step), jnp.float32),
@@ -466,9 +487,11 @@ def verify_model(
         # Gradient attack on the stage-0 leftovers: counterexamples the
         # random sampler misses (logit zero-crossings on thin slabs) are
         # found by batched PGD in one jit, sparing those roots the BaB tree.
+        pgd_covered_all = False  # every pending root got the deep PGD pass
         if pending:
             with timer.phase("stage0_pgd"):
                 pgd_wit = {}
+                pgd_covered_all = True
                 # The slab refinement below is serial host work (exact
                 # arithmetic per seed); on hard models with thousands of
                 # near-zero boxes it would otherwise dwarf the hard budget
@@ -484,10 +507,21 @@ def verify_model(
                 step = min(cfg.grid_chunk, len(pending)) if cfg.grid_chunk > 0 \
                     else len(pending)
                 for s in range(0, len(pending), step):
+                    if timer.total() > cfg.hard_timeout_s:
+                        # Budget honesty: leftovers keep their BaB path, and
+                        # decide_many must NOT be told they were attacked.
+                        pgd_covered_all = False
+                        break
                     blk = pending[s:s + step]
+                    # Deep settings (Phase-A depth, engine.EngineConfig
+                    # pgd_steps/pgd_restarts): this is THE attack pass for
+                    # these roots — decide_many is told attacked=True below
+                    # and skips its Phase A re-launch (VERDICT r5 #1).
                     w, near_zero, near_abs = engine.pgd_attack(
                         net, enc, lo[blk], hi[blk],
                         np.random.default_rng(cfg.engine.seed + 1 + span_start + s),
+                        steps=cfg.engine.pgd_steps,
+                        restarts=cfg.engine.pgd_restarts,
                         return_points=True,
                     )
                     pgd_wit.update({s + k: v for k, v in w.items()})
@@ -535,7 +569,7 @@ def verify_model(
             with timer.phase("bab"):
                 decisions = engine.decide_many(
                     net, enc, lo[pending], hi[pending], cfg.engine,
-                    deadline_s=deadline, mesh=mesh,
+                    deadline_s=deadline, mesh=mesh, attacked=pgd_covered_all,
                 )
             bab = dict(zip(pending, decisions))
             # Per-phase attribution (VERDICT r3): where inside the engine
@@ -759,6 +793,7 @@ def verify_model(
                 wr.writerow(header)
                 for k in sorted(last, key=lambda v: int(v)):
                     wr.writerow(last[k])
+    counter.launches = profiling.launch_count() - launch0
     counter.dump(os.path.join(cfg.result_dir, f"{cfg.name}-{sink_name}.throughput.json"),
                  phases=timer.phases)
     return ModelReport(
