@@ -29,13 +29,14 @@ Evidence classes (docs/DESIGN.md numeric policy):
 * Every SAT verdict is re-proved by ``engine.validate_pair`` in exact
   arithmetic, so SAT never rests on float arithmetic at all.
 
-Scope: RA-free queries, and one- or two-RA queries via ε-expanded axes with
+Scope: RA-free queries, and k-RA queries via ε-expanded axes with
 on-device window dilation (x′ partners unclamped, ``engine.decide_leaf``
 semantics; flip candidates and margin-touched core points settle exactly
-through ``decide_leaf``).  The (2ε+1)² window of the two-RA case is
-**separable** — a box dilation is the composition of two per-axis
-dilations — so the kernel pays 2(2ε+1) rolls, not (2ε+1)².  Three or more
-RA dims are not enumerable here and stay Phase P's job.  Scan size is gated
+through ``decide_leaf``).  The (2ε+1)^k window is **separable** — an L∞
+box dilation is the composition of k per-axis dilations — so the kernel
+pays k(2ε+1) rolls, not (2ε+1)^k, for any k.  Queries whose delta window
+exceeds the margin resolver's 10⁵ cap (``decide_leaf``) stay Phase P's
+job.  Scan size is gated
 by ``EngineConfig.lattice_max``.
 """
 from __future__ import annotations
@@ -107,18 +108,20 @@ def shared_lattice_size(enc, lo: np.ndarray, hi: np.ndarray) -> int:
 def enumerable_size(enc, lo: np.ndarray, hi: np.ndarray) -> Optional[int]:
     """Scan size of the box if Phase E can enumerate it, else None.
 
-    RA-free: the shared lattice.  One or two RA dims with ε > 0: the
-    lattice with each RA axis expanded by ±ε (x' partners range over the
-    unclamped delta window, ``engine.decide_leaf`` semantics; the 2-RA box
-    window dilates separably on device).  Three or more RA dims: None —
-    beyond the implemented dilation.  Boxes whose (ε-expanded) coordinates
-    reach 2²⁴ are also None: the device roundoff bound assumes exact-f32
-    integer inputs (ADVICE r3).
+    RA-free: the shared lattice.  k RA dims with ε > 0: the lattice with
+    each RA axis expanded by ±ε (x' partners range over the unclamped delta
+    window, ``engine.decide_leaf`` semantics).  The k-dim box window is an
+    L∞ ball, so its device dilation is separable — per-axis dilations
+    composed — for ANY k; the limit is the margin-point resolver
+    (``decide_leaf`` enumerates (2ε+1)^k deltas, honest-unknown past 10⁵,
+    which also bounds the device tile).  Boxes whose (ε-expanded)
+    coordinates reach 2²⁴ are also None: the device roundoff bound assumes
+    exact-f32 integer inputs (ADVICE r3).
     """
     if _coords_exceed_f32(enc, lo, hi):
         return None
     if len(enc.ra_idx) and enc.eps:
-        if len(enc.ra_idx) > 2:
+        if (2 * int(enc.eps) + 1) ** len(enc.ra_idx) > 100_000:
             return None
         ra_set = {int(j) for j in enc.ra_idx}
         dims = shared_dims(enc, len(lo))
@@ -246,12 +249,12 @@ def _lattice_scan_kernel_ra(net: MLP, start, n_total, strides, widths,
                             lo_shared, bases, valid_mask, valid_pair_f,
                             chunk: int, dims_tuple: tuple, d: int,
                             ra_ws: tuple, eps: int):
-    """RA-aware scan: the RA axes (one or two) are the innermost suffix
+    """RA-aware scan: the RA axes are the innermost suffix
     dims, each expanded by ±ε, and x' partners are found by dilating the
     certain-negative cells over the delta window (``engine.decide_leaf``
     pair semantics: x core-ranged, x' at an unclamped delta within ±ε per
-    RA dim).  The 2-RA box window is separable: per-axis dilations
-    composed, 2(2ε+1) rolls instead of (2ε+1)².
+    RA dim).  The k-RA box window is separable: per-axis dilations
+    composed, k(2ε+1) rolls instead of (2ε+1)^k.
 
     Returns (first_flip, margin_count, margin_idx[MARGIN_BUF],
     sign_cols[V, MARGIN_BUF+1]):
@@ -371,13 +374,15 @@ def decide_box_exhaustive(
     ``('unknown', None)`` on deadline or on an evidence-ladder
     disagreement (a device "certain" sign failing exact validation — then
     no sign is trusted).  Caller gates the scan size
-    (``engine._lattice_phase``); multi-RA queries return unknown here.
+    (``engine._lattice_phase``); queries whose (2ε+1)^k delta window
+    exceeds the 10⁵ margin-resolver cap return unknown here.
 
-    One RA dim is handled completely: its axis is expanded ±ε, laid out
-    innermost, and certain-negative partner cells are dilated over the
+    k RA dims are handled completely: each axis is expanded ±ε, laid out
+    innermost, and certain-sign partner cells are dilated over the L∞
     delta window on device (``engine.decide_leaf`` pair semantics, x′
-    unclamped); flip candidates and margin-touched core points are settled
-    exactly by ``decide_leaf``.
+    unclamped; separable per-axis dilation for any k — round 5); flip
+    candidates and margin-touched core points are settled exactly by
+    ``decide_leaf``.
 
     Lattices past the 32-bit device decode are **prefix-peeled**: shared
     dims are enumerated host-side (their values baked into the per-sweep
@@ -411,11 +416,13 @@ def decide_box_exhaustive(
         # points and could return an unsound UNSAT (ADVICE r3).
         return "unknown", None
 
-    # RA mode: one or two relaxed dims are handled by expanding each axis
-    # ±ε and dilating partners over the (separable) window on device;
-    # three or more are not implemented.
+    # RA mode: k relaxed dims are handled by expanding each axis ±ε and
+    # dilating partners over the L∞ window on device (separable for any k:
+    # per-axis dilations composed).  The same (2ε+1)^k ≤ 10⁵ guard as
+    # ``enumerable_size``/``decide_leaf`` keeps the margin resolver and the
+    # device tile bounded — past it, honest unknown.
     ra_mode = bool(len(enc.ra_idx)) and int(enc.eps) > 0
-    if ra_mode and len(enc.ra_idx) > 2:
+    if ra_mode and (2 * int(enc.eps) + 1) ** len(enc.ra_idx) > 100_000:
         return "unknown", None
     ra_dims = [int(j) for j in enc.ra_idx] if ra_mode else []
     eps = int(enc.eps) if ra_mode else 0

@@ -186,20 +186,59 @@ def _certify_impl(net: MLP, x_lo, x_hi, xp_lo, xp_hi, lo, hi, assign_vals,
 _role_certify_kernel = jax.jit(_certify_impl, static_argnames=("alpha_iters",))
 
 
+def _find_flips_impl(xp, lx, lp, valid, valid_pair):
+    """Strict-flip detection, backend-agnostic (``xp`` = numpy or jnp).
+
+    ONE implementation serves both the host path (:func:`find_flips`) and
+    the fused device kernels (:func:`_find_flips_dev`) — the two must never
+    diverge in flip semantics (strict signs, valid-ordered-pair masking,
+    first-hit argmax tie-break), since the device path feeds the same
+    ``extract_witnesses`` exact validation as the host path."""
+    va = valid[:, None, :]
+    pos_x = (lx > 0.0) & va
+    neg_x = (lx < 0.0) & va
+    pos_p = (lp > 0.0) & va
+    neg_p = (lp < 0.0) & va
+    flips = (pos_x[..., :, None] & neg_p[..., None, :]) | (
+        neg_x[..., :, None] & pos_p[..., None, :]
+    )
+    flips = flips & valid_pair[None, None, :, :]
+    B, S, V, _ = flips.shape
+    flat = flips.reshape(B, -1)
+    found = flat.any(axis=1)
+    idx = flat.argmax(axis=1).astype(xp.int32)
+    s, rem = idx // (V * V), idx % (V * V)
+    a, b = rem // V, rem % V
+    return found, xp.stack([s, a, b], axis=1)
+
+
+def _find_flips_dev(lx, lp, valid, valid_pair):
+    """Device strict-flip detection: (found (B,), wit (B, 3)) in jnp.
+
+    Flip DETECTION stays on device so only a boolean plus three indices per
+    box cross the tunnel — the (B, S, V) logit tensors are ~MB-scale per
+    chunk and were the stage-0 transfer bottleneck on the family sweeps."""
+    return _find_flips_impl(jnp, lx, lp, valid, valid_pair)
+
+
 def _certify_attack_impl(net: MLP, x_lo, x_hi, xp_lo, xp_hi, lo, hi,
                          assign_vals, pa_mask, ra_mask, eps, valid, valid_pair,
                          xr, pr, alpha_iters: int):
-    """Certificate + attack logits in ONE launch (launch-bound economy).
+    """Certificate + attack + flip detection in ONE launch.
 
     The BaB loop and stage 0 both pay ~110 ms relay round-trip per launch on
     the tunnelled chip regardless of batch size; evaluating the attack
     forwards for every box inside the certificate kernel costs negligible
-    MXU time and removes a whole launch per iteration/chunk."""
+    MXU time and removes a whole launch per iteration/chunk, and returning
+    only ``(found, wit)`` instead of the logits removes the dominant
+    device→host transfer (attack candidates stay host-built, so witness
+    extraction needs no pull)."""
     cert, score = _certify_impl(net, x_lo, x_hi, xp_lo, xp_hi, lo, hi,
                                 assign_vals, pa_mask, ra_mask, eps, valid,
                                 valid_pair, alpha_iters)
     lx, lp = _attack_logits(net, xr, pr)
-    return cert, score, lx, lp
+    found, wit = _find_flips_dev(lx, lp, valid, valid_pair)
+    return cert, score, found, wit
 
 
 _certify_attack_kernel = jax.jit(_certify_attack_impl,
@@ -268,22 +307,8 @@ def find_flips(
     ``logit_x``/``logit_p``: (B, S, V).  ``valid_assign``: (B, V).
     Returns (found (B,), witness (B, 3) of [sample, a, b]).
     """
-    va = valid_assign[:, None, :]
-    pos_x = (logit_x > 0.0) & va
-    neg_x = (logit_x < 0.0) & va
-    pos_p = (logit_p > 0.0) & va
-    neg_p = (logit_p < 0.0) & va
-    flips = (pos_x[..., :, None] & neg_p[..., None, :]) | (
-        neg_x[..., :, None] & pos_p[..., None, :]
-    )
-    flips &= enc.valid_pair[None, None, :, :]
-    B, S, V, _ = flips.shape
-    flat = flips.reshape(B, -1)
-    found = flat.any(axis=1)
-    idx = flat.argmax(axis=1)
-    s, rem = np.divmod(idx, V * V)
-    a, b = np.divmod(rem, V)
-    return found, np.stack([s, a, b], axis=1)
+    return _find_flips_impl(np, logit_x, logit_p, valid_assign,
+                            enc.valid_pair)
 
 
 # ---------------------------------------------------------------------------
@@ -953,11 +978,12 @@ class EngineConfig:
     lp_pair_max_nodes: int = 800
     lp_pair_max_dirs: int = 32
     # Phase E: exhaustive integer-lattice enumeration (ops.lattice) for
-    # RA-free, single-RA, and two-RA (ε-dilated) roots still unknown after
-    # every other phase — the complete decision for wide flip-slab boxes
-    # the input-split BaB diverges on (stress-AC box 768: 67M lattice
-    # points beat 3.4M BaB nodes).  Three or more RA dims are excluded
-    # (ADVICE r3 #3 scope note, generalized in round 4).  lattice_max
+    # RA-free and k-RA (ε-dilated, separable window) roots still unknown
+    # after every other phase — the complete decision for wide flip-slab
+    # boxes the input-split BaB diverges on (stress-AC box 768: 67M lattice
+    # points beat 3.4M BaB nodes).  Queries whose (2ε+1)^k delta window
+    # exceeds the 10^5 margin-resolver cap are excluded (ADVICE r3 #3
+    # scope note; 2-RA in round 4, any k within the cap in round 5).  lattice_max
     # gates the (ε-expanded) scan size (points); lattice_chunk is the
     # device batch per forward launch.
     lattice_exhaustive: bool = True
@@ -978,6 +1004,17 @@ class EngineConfig:
     # headline: 3.4 s → 10.3 s with an unconditional reserve).
     lattice_frac: float = 0.2
     lattice_reserve_min: float = 1.0e6
+    # Phase E0: roots whose (ε-dilated) enumerable lattice is at most this
+    # many points get a TIME-BOXED exhaustive-enumeration probe BEFORE the
+    # input-split BaB.  The scan early-exits on the first flip, so SAT
+    # flip-slab boxes (the class BaB grinds 15-30 s on — r5 relaxed-AC
+    # profile: 3 SAT roots burned 30.5 s of BaB before Phase E closed them)
+    # usually settle in a chunk or two; a probe that neither flips nor
+    # completes within lattice_first_cap_s returns unknown and the root
+    # keeps its full BaB/P/E path, so at most the cap is wasted per root
+    # (total bounded by 40% of the batch deadline).  Exact either way.
+    lattice_first_max: float = 6.4e7
+    lattice_first_cap_s: float = 5.0
 
 
 @dataclass
@@ -1121,6 +1158,37 @@ def decide_many(
             if v == "unsat":
                 verdicts[int(open_idx[k])] = "unsat"
 
+    # Phase E0 — immediate exhaustive enumeration of CHEAP enumerable roots.
+    # A root whose (ε-dilated) lattice fits a few scan chunks is decided
+    # completely in one or two warm launches (~110 ms each); the input-split
+    # BaB diverges on exactly these wide flip-slab boxes and burned 30+ s per
+    # batch on the relaxed-AC ladder before giving Phase E the leftovers
+    # (r5 profile).  Enumeration is the exact oracle, so verdicts settled
+    # here can only be right; the expensive-root reserve logic below still
+    # governs the big lattices.
+    lat_sizes = _eligible_lattice_roots(enc, roots_lo, roots_hi, cfg)
+    lat_cost = np.zeros(R, dtype=np.float64)
+    if cfg.lattice_exhaustive and lat_sizes:
+        from fairify_tpu.ops import lattice as lattice_ops
+
+        cheap = sorted((r for r in range(R) if verdicts[r] is None
+                        and lat_sizes.get(r, np.inf) <= cfg.lattice_first_max),
+                       key=lambda r: lat_sizes[r])
+        for r in cheap:
+            spent = time.perf_counter() - t0
+            if spent > 0.4 * deadline_s:
+                break
+            t_r = time.perf_counter()
+            verdict, ce = lattice_ops.decide_box_exhaustive(
+                net, enc, np.asarray(roots_lo[r], dtype=np.int64),
+                np.asarray(roots_hi[r], dtype=np.int64),
+                chunk=cfg.lattice_chunk,
+                deadline_s=min(deadline_s - spent, cfg.lattice_first_cap_s))
+            lat_cost[r] += time.perf_counter() - t_r
+            if verdict != "unknown":
+                verdicts[r] = verdict
+                ces[r] = ce
+
     frontier = deque(
         (np.asarray(roots_lo[r], dtype=np.int64), np.asarray(roots_hi[r], dtype=np.int64), r)
         for r in range(R)
@@ -1137,7 +1205,7 @@ def decide_many(
     n_dirs = int(enc.valid_pair.sum())
     use_pair = (cfg.lp_pair and len(enc.pa_idx)
                 and 0 < n_dirs <= cfg.lp_pair_max_dirs)
-    lat_sizes = _eligible_lattice_roots(enc, roots_lo, roots_hi, cfg)
+    lat_sizes = {r: n for r, n in lat_sizes.items() if verdicts[r] is None}
     use_lattice = bool(lat_sizes)
     # Reserve no more than Phase E could conceivably use even if EVERY
     # eligible root stayed unknown (~1e6 pts/s conservative scan rate plus
@@ -1217,7 +1285,7 @@ def decide_many(
             # bill (VERDICT r4 #3).
             xr, pr = build_attack_candidates(enc, rng, _pad(blo, F),
                                              _pad(bhi, F), cfg.bab_attack_samples)
-            cert_dev, score_dev, lx_dev, lp_dev = _certify_attack_kernel(
+            cert_dev, score_dev, found_dev, wit_dev = _certify_attack_kernel(
                 bound_net, jnp.asarray(x_lo), jnp.asarray(x_hi),
                 jnp.asarray(xp_lo), jnp.asarray(xp_hi),
                 jnp.asarray(plo_in), jnp.asarray(phi_in),
@@ -1229,7 +1297,7 @@ def decide_many(
             profiling.bump_launch()
             certified = np.asarray(cert_dev)[:batch]
             score = np.asarray(score_dev)[:F]
-            lx_all, lp_all = np.asarray(lx_dev), np.asarray(lp_dev)
+            found_all, wit_all = np.asarray(found_dev), np.asarray(wit_dev)
         elif cfg.use_crown:
             cert_dev, score_dev = _role_certify_kernel(
                 bound_net, jnp.asarray(x_lo), jnp.asarray(x_hi),
@@ -1254,8 +1322,7 @@ def decide_many(
         undecided = np.where(~certified & live)[0]
         if undecided.size:
             if fused:
-                lx, lp = lx_all[undecided], lp_all[undecided]
-                found, wit = find_flips(enc, lx, lp, valid[undecided])
+                found, wit = found_all[undecided], wit_all[undecided]
                 xr_u, pr_u = xr[undecided], pr[undecided]
             else:
                 # Attack the undecided boxes (padded so the forward compiles
@@ -1353,8 +1420,7 @@ def decide_many(
         if verdicts[r] is None:
             settle(r, "unsat" if open_boxes[r] == 0 else "unknown")
 
-    pair_cost = np.zeros(R, dtype=np.float64)
-    lat_cost = np.zeros(R, dtype=np.float64)
+    pair_cost = np.zeros(R, dtype=np.float64)  # lat_cost init'd at Phase E0
     if use_pair and any(v == "unknown" for v in verdicts):
         _pair_lp_phase(net, enc, roots_lo, roots_hi, verdicts, ces,
                        nodes, pair_cost, cfg, t0, pair_deadline)
@@ -1386,8 +1452,9 @@ def _eligible_lattice_roots(enc, roots_lo, roots_hi, cfg) -> dict:
     """root index → enumerable scan size, for roots Phase E can decide.
     The single eligibility rule shared by decide_many's budget reserve and
     ``_lattice_phase``'s queue — these must never disagree.  RA-free,
-    single-RA, and two-RA queries are enumerable (each RA axis dilates on
-    device; the 2-RA box window separably); three or more RA dims are not
+    single-RA, and k-RA queries are enumerable (each RA axis dilates on
+    device; the L∞ window separably); queries whose (2ε+1)^k window
+    exceeds the margin resolver's 10⁵ cap are not
     (``lattice.enumerable_size`` returns None), nor are boxes whose
     ε-expanded coordinates reach 2²⁴ (f32-exactness guard)."""
     if not cfg.lattice_exhaustive:
@@ -1408,12 +1475,12 @@ def _lattice_phase(net, enc, roots_lo, roots_hi, verdicts, ces,
                    cost_s, cfg, t0, deadline_s, lat_sizes=None):
     """Phase E: exhaustive lattice enumeration of the still-unknown roots.
 
-    Complete for RA-free, single-RA, and two-RA queries on boxes whose
-    enumerable scan fits ``cfg.lattice_max`` — exactly the wide flip-slab
-    class where input splitting diverges (the box is finite; enumerate
-    it).  Each RA axis is expanded ±ε and partner-dilated on device
-    (``decide_leaf`` delta semantics, x′ unclamped; the 2-RA window is
-    separable); queries with three or more RA dims are excluded.  Roots
+    Complete for RA-free and k-RA queries on boxes whose enumerable scan
+    fits ``cfg.lattice_max`` — exactly the wide flip-slab class where
+    input splitting diverges (the box is finite; enumerate it).  Each RA
+    axis is expanded ±ε and partner-dilated on device (``decide_leaf``
+    delta semantics, x′ unclamped; the L∞ window is separable for any k);
+    queries past the 10⁵ delta-window cap are excluded.  Roots
     are visited smallest lattice first, so one near-cap root cannot starve
     trivially cheap ones.
     """

@@ -87,6 +87,16 @@ PRESETS = {
                                relaxed=("duration", "campaign"), relax_eps=5,
                                partition_threshold=10, heuristic_threshold=20,
                                soft_timeout_s=100.0, sim_size=1000, **_HOUR),
+    # Three-RA variant (round 5): the ε mechanism over three relaxed
+    # attributes.  Exercises k-RA completeness end to end — the (2ε+1)³
+    # decide_leaf delta window, the three-axis separable Phase E dilation
+    # (``ops/lattice.py``), and the RA constraints on all three dims.
+    "relaxed3-BM": SweepConfig(name="relaxed3-BM", dataset="bank",
+                               protected=("age",),
+                               relaxed=("duration", "campaign", "previous"),
+                               relax_eps=5,
+                               partition_threshold=10, heuristic_threshold=20,
+                               soft_timeout_s=100.0, sim_size=1000, **_HOUR),
     # ----- targeted/ (sub-population domains) -----
     "targeted-GC": SweepConfig(name="targeted-GC", dataset="german", protected=("sex",),
                                domain_overrides={"number_of_credits": (2, 2)},

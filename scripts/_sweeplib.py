@@ -31,7 +31,11 @@ def _config_key(rec: dict):
         # capped runs): give them a sentinel key so a new uncapped full-grid
         # run never resumes past them.
         return ("legacy", rec.get("soft_s"), rec.get("hard_s"))
-    return (rec.get("soft_s"), rec.get("hard_s"), rec.get("cap"))
+    # ``engine_tag`` (ADVICE r4 #2): rows recorded by an older engine carry
+    # no tag (None); a harness passing a fresh tag re-EXECUTES instead of
+    # silently resuming past stale-engine rows.
+    return (rec.get("soft_s"), rec.get("hard_s"), rec.get("cap"),
+            rec.get("engine_tag"))
 
 
 def done_set(results_path: str) -> set:
@@ -64,7 +68,8 @@ def run_and_record(cfg, run_id: str, results_path: str, extra=None,
     if done is None:
         done = done_set(results_path)
     cfg_key = (cfg.soft_timeout_s, cfg.hard_timeout_s,
-               cfg.max_partitions if cfg.capped_partitions else None)
+               cfg.max_partitions if cfg.capped_partitions else None,
+               (extra or {}).get("engine_tag"))
     names = [p.stem for p in zoo.model_paths(cfg.dataset)]
     if cfg.models is not None:
         names = [n for n in names if n in cfg.models]
@@ -104,7 +109,8 @@ def run_and_record(cfg, run_id: str, results_path: str, extra=None,
     return recs
 
 
-def budgeted_model_sweep(cfg, net, model_name: str, dataset=None):
+def budgeted_model_sweep(cfg, net, model_name: str, dataset=None,
+                         ledger_tag=None):
     """Attempt-until-hard-budget semantics over the full grid (one model).
 
     The reference's variant drivers iterate the shuffled partition list and
@@ -121,10 +127,14 @@ def budgeted_model_sweep(cfg, net, model_name: str, dataset=None):
 
     # Ledgers are per-config: a re-run with different budgets must re-decide,
     # not resume past, the old config's verdicts (the resume inside one
-    # config still gives crash recovery).
-    cfg = cfg.with_(result_dir=os.path.join(
-        cfg.result_dir,
-        f"b{cfg.soft_timeout_s:g}-{cfg.hard_timeout_s:g}"))
+    # config still gives crash recovery).  ``ledger_tag`` (the engine tag)
+    # namespaces the ledgers too — without it, a tagged re-run would
+    # resume=True straight through the OLD engine's per-partition verdicts
+    # and record bookkeeping-speed rows as fresh results.
+    sub = f"b{cfg.soft_timeout_s:g}-{cfg.hard_timeout_s:g}"
+    if ledger_tag:
+        sub += f"-{ledger_tag}"
+    cfg = cfg.with_(result_dir=os.path.join(cfg.result_dir, sub))
     _, lo, hi = sweep.build_partitions(cfg)
     P = lo.shape[0]
     t0 = time.perf_counter()
@@ -138,11 +148,15 @@ def budgeted_model_sweep(cfg, net, model_name: str, dataset=None):
         if left <= 0:
             break
         # Budget honesty (VERDICT r4 weak #2): once a rate is measured,
-        # never START a span predicted to blow the remaining budget — the
-        # reference's loop breaks BETWEEN partitions when cumulative time
-        # passes the hard budget (``stress/GC/Verify-GC.py:31-35``); a span
-        # is this harness's partition-granule analog.
-        if rate is not None and chunk / rate > 1.5 * left:
+        # never START a span that cannot finish comfortably inside the
+        # remaining budget — the reference's loop breaks BETWEEN partitions
+        # when cumulative time passes the hard budget
+        # (``stress/GC/Verify-GC.py:31-35``); a span is this harness's
+        # partition-granule analog.  The 0.5 factor absorbs rate
+        # misestimates (a span that hits a hard-root tail can run ~2× its
+        # stage-0-dominated prediction) so the wall stays within ~10% of
+        # the label instead of overshooting on a last-minute span.
+        if rate is not None and chunk / rate > 0.5 * left:
             break
         stop = min(P, span + K)
         t_block = time.perf_counter()
@@ -300,14 +314,15 @@ def retry_span_unknowns(cfg, net, model_name: str, budget_s: float,
 
 
 def run_and_record_budgeted(cfg, run_id: str, results_path: str,
-                            model_filter=None) -> list:
+                            model_filter=None, extra=None) -> list:
     """Budgeted (attempt-until-hard-budget) sweep of a zoo under ``cfg``."""
     from fairify_tpu.data import loaders
     from fairify_tpu.models import zoo
 
     done = done_set(results_path)
     cfg_key = (cfg.soft_timeout_s, cfg.hard_timeout_s,
-               cfg.max_partitions if cfg.capped_partitions else None)
+               cfg.max_partitions if cfg.capped_partitions else None,
+               (extra or {}).get("engine_tag"))
     n_attrs = len(cfg.query().columns)
     names = [p.stem for p in zoo.model_paths(cfg.dataset)]
     if cfg.models is not None:
@@ -334,8 +349,9 @@ def run_and_record_budgeted(cfg, run_id: str, results_path: str,
 
         pred = np.asarray(mlp_mod.predict(
             nets[name], jnp.asarray(dataset.X_test, jnp.float32)))
-        rec = {"run_id": run_id,
-               **budgeted_model_sweep(cfg, nets[name], name, dataset=dataset),
+        rec = {"run_id": run_id, **(extra or {}),
+               **budgeted_model_sweep(cfg, nets[name], name, dataset=dataset,
+                                      ledger_tag=(extra or {}).get("engine_tag")),
                "original_acc": round(float((pred.astype(int) == dataset.y_test).mean()), 4),
                "soft_s": cfg.soft_timeout_s, "hard_s": cfg.hard_timeout_s,
                "cap": cfg.max_partitions if cfg.capped_partitions else None,

@@ -1,7 +1,7 @@
 """Phase E soundness fuzz: random boxes vs the exact per-point oracle.
 
 Random tiny MLPs × random integer boxes × random queries — RA-free,
-single-RA, and (round 4, VERDICT r3 #6) two-RA — decided by
+single-RA, two-RA, and (round 5, VERDICT r4 #8) three-RA — decided by
 ``ops.lattice.decide_box_exhaustive`` and cross-checked against
 ``engine.decide_leaf`` applied to every core shared point (the trusted
 exact single-point semantics).  Any disagreement is a soundness bug in the
@@ -58,8 +58,9 @@ def one_trial(seed: int) -> dict:
         ranges[nm] = (lo0, lo0 + int(rng.integers(1, 4)))
     pa = (names[int(rng.integers(0, d))],)
     rest = [nm for nm in names if nm not in pa]
-    # Trial mix: ~1/3 RA-free, ~1/3 single-RA, ~1/3 two-RA (when possible).
-    n_ra = int(rng.integers(0, 3))
+    # Trial mix: ~1/4 each of RA-free, single-, two- and three-RA (when
+    # the dimensionality allows).
+    n_ra = int(rng.integers(0, 4))
     n_ra = min(n_ra, len(rest))
     ra = tuple(rng.choice(rest, size=n_ra, replace=False).tolist()) if n_ra else ()
     eps = int(rng.integers(1, 3)) if n_ra else 0
@@ -88,13 +89,13 @@ def main() -> int:
     ap.add_argument("--trials", type=int, default=150)
     ap.add_argument("--seed0", type=int, default=0)
     ap.add_argument("--out", default=os.path.join(ROOT, "audits",
-                                                  "lattice_fuzz_r4.json"))
+                                                  "lattice_fuzz_r5.json"))
     args = ap.parse_args()
     import jax
 
     t0 = time.perf_counter()
     counts = {"sat": 0, "unsat": 0, "unknown": 0}
-    ra_counts = {0: 0, 1: 0, 2: 0}
+    ra_counts = {0: 0, 1: 0, 2: 0, 3: 0}
     mismatches, bad_witness = [], []
     for i in range(args.trials):
         if i and i % 10 == 0:
@@ -110,7 +111,7 @@ def main() -> int:
         if rec.get("witness_valid") is False:
             bad_witness.append(rec)
     out = {
-        "round": 4,
+        "round": 5,
         "component": "ops/lattice.decide_box_exhaustive",
         "oracle": "engine.decide_leaf at every core shared point (exact)",
         "script": "scripts/lattice_fuzz.py",

@@ -106,10 +106,17 @@ def test_config_key_distinguishes_budgets(tmp_path):
         fp.write('{"run_id": "x", "model": "legacy", "soft_s": 5.0,'
                  ' "hard_s": 60.0}\n')
         fp.write('{"run_id": "x", "model": "sk", "skipped": "mismatch"}\n')
+        fp.write('{"run_id": "x", "model": "tagged", "soft_s": 5.0,'
+                 ' "hard_s": 60.0, "cap": null, "attempted": 10,'
+                 ' "engine_tag": "r5"}\n')
     done = _sweeplib.done_set(str(results))
-    assert ("x", "m", (5.0, 60.0, None)) in done
+    # Untagged rows key with engine_tag None (ADVICE r4 #2: a harness
+    # passing a fresh tag re-executes instead of resuming past them).
+    assert ("x", "m", (5.0, 60.0, None, None)) in done
+    assert ("x", "tagged", (5.0, 60.0, None, "r5")) in done
+    assert ("x", "tagged", (5.0, 60.0, None, None)) not in done
     # Legacy rows (pre-cap/attempted fields) get a sentinel key: a new
     # full-grid run with the same budgets must NOT be skipped.
-    assert ("x", "legacy", (5.0, 60.0, None)) not in done
+    assert ("x", "legacy", (5.0, 60.0, None, None)) not in done
     assert ("x", "legacy", ("legacy", 5.0, 60.0)) in done
     assert ("x", "sk", "skipped") in done

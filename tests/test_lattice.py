@@ -230,9 +230,34 @@ def test_ra_window_peeled_matches_oracle():
     assert verdict == _ra_oracle(net, enc, lo, hi)
 
 
+def test_three_ra_matches_oracle():
+    """k = 3 RA dilation agrees with the exact per-point oracle (round 5:
+    the separable L∞ window generalizes past the round-4 two-RA gate)."""
+    names = ("a0", "a1", "a2", "p")
+    dom = DomainSpec(name="toy3", columns=names,
+                     ranges={"a0": (0, 2), "a1": (0, 2), "a2": (0, 2),
+                             "p": (0, 1)},
+                     label="y")
+    q = FairnessQuery(domain=dom, protected=("p",),
+                      relaxed=("a0", "a1", "a2"), relax_eps=2)
+    enc = encode(q)
+    lo = np.array([0, 0, 0, 0], dtype=np.int64)
+    hi = np.array([2, 2, 2, 1], dtype=np.int64)
+    for seed in (3, 7, 11):
+        net = _net(seed, (4, 6, 1))
+        verdict, ce = lattice_ops.decide_box_exhaustive(
+            net, enc, lo, hi, chunk=1024)
+        assert verdict == _ra_oracle(net, enc, lo, hi)
+        if verdict == "sat":
+            ws = [np.asarray(w) for w in net.weights]
+            bs = [np.asarray(b) for b in net.biases]
+            assert engine.validate_pair(ws, bs, *ce)
+
+
 def test_lattice_gates():
-    """Three-RA queries and over-large lattices are left unknown (honest);
-    single- and two-RA roots are eligible and settle (VERDICT r3 #6)."""
+    """Over-large delta windows and lattices are left unknown (honest);
+    k-RA roots within the (2ε+1)^k ≤ 1e5 window cap are eligible and
+    settle — including k = 3 since round 5 (VERDICT r4 #8)."""
     import time
 
     names = ("a0", "a1", "a2", "p")
@@ -259,16 +284,23 @@ def test_lattice_gates():
                               np.zeros(1), cfg, time.perf_counter(), 30.0)
         return verdicts[0]
 
-    # Multi-RA gate: k ≥ 3 dilation is not implemented.
-    assert run(enc_3ra, engine.EngineConfig()) == "unknown"
-    assert lattice_ops.enumerable_size(enc_3ra, lo[0], hi[0]) is None
+    # Window-cap gate: (2ε+1)^k > 1e5 (k=3, ε=24 → 49³ ≈ 1.18e5) is past
+    # the decide_leaf margin resolver — honest unknown, not a stall.
+    q_cap = FairnessQuery(domain=dom, protected=("p",),
+                          relaxed=("a0", "a1", "a2"), relax_eps=24)
+    enc_cap = encode(q_cap)
+    assert run(enc_cap, engine.EngineConfig()) == "unknown"
+    assert lattice_ops.enumerable_size(enc_cap, lo[0], hi[0]) is None
     # Size gate: shared lattice is 27 > lattice_max=4.
     enc = encode(_query(d=4))
     assert run(enc, engine.EngineConfig(lattice_max=4)) == "unknown"
-    # Controls: with the gates open, RA-free, 1-RA and 2-RA roots settle.
+    # Controls: with the gates open, RA-free and 1/2/3-RA roots settle.
     assert run(enc, engine.EngineConfig()) in ("sat", "unsat")
     assert run(enc_1ra, engine.EngineConfig()) in ("sat", "unsat")
     assert run(enc_2ra, engine.EngineConfig()) in ("sat", "unsat")
+    assert lattice_ops.enumerable_size(enc_3ra, lo[0], hi[0]) is not None
+    got_3ra = run(enc_3ra, engine.EngineConfig())
+    assert got_3ra in ("sat", "unsat")
 
 
 def test_coord_magnitude_gate():

@@ -80,9 +80,9 @@ def test_sweep_verdicts_mesh_invariant(tmp_path, tiny_registered):
 def test_presets_cover_all_drivers():
     names = presets.names()
     # 5 base + CP12 (task4's 12-input family) + LSAC + 3 stress + 3 relaxed
-    # + relaxed2-BM (framework-native two-RA variant) + 3+3 targeted
-    # + targeted-DF (framework-native certificate-path DF)
-    assert len(names) == 21
+    # + relaxed2-BM / relaxed3-BM (framework-native two-/three-RA variants)
+    # + 3+3 targeted + targeted-DF (framework-native certificate-path DF)
+    assert len(names) == 22
     for n in names:
         cfg = presets.get(n)
         q = cfg.query()  # builds without error, drops phantom attributes
