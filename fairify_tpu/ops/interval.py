@@ -91,28 +91,6 @@ def output_bounds(params: MLP, lb: jax.Array, ub: jax.Array):
     return bounds.ws_lb[-1][..., 0], bounds.ws_ub[-1][..., 0]
 
 
-def network_bounds_pallas(params: MLP, lb: jax.Array, ub: jax.Array) -> LayerBounds:
-    """:func:`network_bounds` computed by the fused Pallas kernel.
-
-    Same LayerBounds contract (widened ws, masked post-ReLU pl); selected by
-    ``FAIRIFY_TPU_PALLAS_IBP=1`` in the pruning pass.  Post-activation bounds
-    are derived from the kernel's ws output with the same ReLU/mask rule.
-    """
-    from fairify_tpu.ops import pallas_ibp
-
-    ws_lb, ws_ub = pallas_ibp.network_ws_bounds(params, lb, ub)
-    pl_lb, pl_ub = [], []
-    n = params.depth
-    for i, m in enumerate(params.masks):
-        if i == n - 1:
-            pl_lb.append(ws_lb[i])
-            pl_ub.append(ws_ub[i])
-        else:
-            pl_lb.append(jax.nn.relu(ws_lb[i]) * m)
-            pl_ub.append(jax.nn.relu(ws_ub[i]) * m)
-    return LayerBounds(tuple(ws_lb), tuple(ws_ub), tuple(pl_lb), tuple(pl_ub))
-
-
 def dead_from_ws_ub(bounds: LayerBounds) -> list:
     """Provably-dead masks from WS upper bounds (1 = dead).
 

@@ -14,7 +14,6 @@ parity stats of the CSV schema and the pruned-network replay (C-check).
 """
 from __future__ import annotations
 
-import os
 import time
 from dataclasses import dataclass
 from typing import List, Optional
@@ -52,14 +51,13 @@ class PruneResult:
 from functools import partial
 
 
-@partial(jax.jit, static_argnames=("sim_size", "pallas", "with_sim"))
-def _sim_and_bounds(net: MLP, keys, lo, hi, sim_size: int, pallas: bool = False,
+@partial(jax.jit, static_argnames=("sim_size", "with_sim"))
+def _sim_and_bounds(net: MLP, keys, lo, hi, sim_size: int,
                     with_sim: bool = True):
     stats, sim = jax.vmap(
         lambda k, l, h: sim_ops.simulate_and_stats(net, k, l, h, sim_size)
     )(keys, lo, hi)
-    bounds_fn = interval_ops.network_bounds_pallas if pallas else interval_ops.network_bounds
-    bounds = bounds_fn(net, lo, hi)
+    bounds = interval_ops.network_bounds(net, lo, hi)
     # ``with_sim=False`` drops the (P, S, d) sample tensor from the jit
     # outputs: XLA dead-code-eliminates its materialization and — the real
     # win on a tunnelled TPU — it is never transferred to the host (the
@@ -107,11 +105,6 @@ def sound_prune_grid(
 
     P = lo.shape[0]
     step, spans = chunk_spans(P, chunk)
-    use_pallas = bool(int(os.environ.get("FAIRIFY_TPU_PALLAS_IBP", "0")))
-    if use_pallas:
-        from fairify_tpu.ops import pallas_ibp
-
-        use_pallas = pallas_ibp.available(net)  # wide nets fall back to XLA
     lo_np, hi_np = np.asarray(lo), np.asarray(hi)
     cand_c, pos_c, lb_c, ub_c, sim_c = [], [], [], [], []
     for s, e in spans:
@@ -121,7 +114,7 @@ def sound_prune_grid(
         profiling.bump_launch()
         stats, sim, bounds = _sim_and_bounds(
             net, keys, jnp.asarray(clo, jnp.float32), jnp.asarray(chi, jnp.float32),
-            sim_size, pallas=use_pallas, with_sim=keep_sim,
+            sim_size, with_sim=keep_sim,
         )
         n = e - s
         cand_c.append([np.asarray(c)[:n] for c in stats.candidates])
