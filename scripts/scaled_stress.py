@@ -30,6 +30,7 @@ import sys
 
 ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
 SCALED_ROOT = os.path.join(ROOT, "models_scaled")
+sys.path.insert(0, ROOT)
 
 # (dataset, name, hidden sizes): widest shipped AC is 64-32-16-8-4 and BM
 # 64-32-16-8 (PARITY.md model column) → S1 doubles every hidden width, S2
@@ -86,6 +87,14 @@ def cmd_run(args) -> None:
     from _sweeplib import run_and_record_budgeted
     from fairify_tpu.verify import presets
 
+    from fairify_tpu.models import zoo
+
+    missing = [n for _, n, _ in SCALED
+               if not any(p.stem == n for d in ("adult", "bank")
+                          for p in zoo.model_paths(d))]
+    if missing:
+        raise SystemExit(f"scaled zoo incomplete (missing {missing}) — run "
+                         "`python scripts/scaled_stress.py make` first")
     out = os.path.join(ROOT, "variants")
     os.makedirs(out, exist_ok=True)
     results_path = os.path.join(out, "results_scaled.jsonl")
