@@ -50,10 +50,10 @@ for entry in \
   "relaxed-BM BM-4" \
   "relaxed2-BM BM-4" \
   "relaxed3-BM BM-4" \
-  "stress-BM BM-1,BM-2,BM-3,BM-5,BM-6,BM-7,BM-9,BM-10,BM-12,BM-13" \
-  "stress-AC AC-2,AC-3,AC-4,AC-5,AC-6,AC-7,AC-9,AC-10,AC-11" \
-  "relaxed-AC AC-2,AC-3,AC-4,AC-5,AC-6,AC-7,AC-9,AC-10,AC-11,AC-12" \
-  "relaxed-BM BM-1,BM-2,BM-3,BM-5,BM-6,BM-7,BM-9,BM-10,BM-11,BM-12,BM-13" \
+  "stress-BM BM-1,BM-2,BM-3,BM-5,BM-6,BM-7,BM-8,BM-9,BM-10,BM-12,BM-13" \
+  "stress-AC AC-2,AC-3,AC-4,AC-5,AC-6,AC-7,AC-8,AC-9,AC-10,AC-11" \
+  "relaxed-AC AC-2,AC-3,AC-4,AC-5,AC-6,AC-7,AC-8,AC-9,AC-10,AC-11,AC-12" \
+  "relaxed-BM BM-1,BM-2,BM-3,BM-5,BM-6,BM-7,BM-8,BM-9,BM-10,BM-11,BM-12,BM-13" \
   ; do
   preset=${entry%% *}
   models=${entry#* }
