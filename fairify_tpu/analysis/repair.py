@@ -112,6 +112,42 @@ def masked_repair(
     return RepairResult(repaired, history)
 
 
+def same_label_relabel_retrain(
+    net: MLP,
+    ce_pairs: Sequence[Tuple[np.ndarray, np.ndarray]],
+    epochs: int = 5,
+    lr: float = 1e-3,
+    batch_size: int = 32,
+    seed: int = 0,
+) -> RepairResult:
+    """The reference's conservative same-label relabeling retrain —
+    faithfully, as a measured BASELINE arm (``src/AC/detect_bias.py:
+    412-433``; VERDICT r4 missing #2).
+
+    Each counterexample pair ``(x, x')`` contributes BOTH points labeled
+    with the *max* of the model's two predictions ("more conservative" in
+    the reference's words — a flip pair always relabels to 1), then the
+    net is retrained on exactly that relabeled set with plain BCE for 5
+    epochs.  No consensus labels, no pair-consistency loss, no guarded
+    checkpoint selection — those are the consensus arm's departures
+    (:func:`counterexample_retrain`), and keeping this arm faithful is the
+    point: the experiment record measures the departures' value instead of
+    asserting it.
+    """
+    if not ce_pairs:
+        return RepairResult(net, [])
+    xs = np.stack([p[0] for p in ce_pairs]).astype(np.float32)
+    xps = np.stack([p[1] for p in ce_pairs]).astype(np.float32)
+    px = np.asarray(forward(net, jnp.asarray(xs)) > 0.0).astype(np.float32)
+    pp = np.asarray(forward(net, jnp.asarray(xps)) > 0.0).astype(np.float32)
+    labels = np.maximum(px, pp)  # detect_bias.py:421 ``max(...)``
+    X_ce = np.concatenate([xs, xps], axis=0)
+    y_ce = np.concatenate([labels, labels], axis=0)
+    repaired, history = _fit(
+        net, X_ce, y_ce, optax.adam(lr), epochs, batch_size, seed)
+    return RepairResult(repaired, history)
+
+
 def _group_snapshot(netp: MLP, Xv, yv, prot: np.ndarray) -> dict:
     """Val accuracy + the group metrics the success criteria guard."""
     from fairify_tpu.analysis import metrics as gm

@@ -99,11 +99,16 @@ def main() -> int:
     manifest.sort(key=lambda m: os.path.getsize(
         os.path.join(args.smt_dir, m["file"])))
     log_path = args.out + ".jsonl"
+    manifest_files = {m["file"] for m in manifest}
     done = {}
+    foreign = []  # records from other manifests/--smt-dirs: preserved verbatim
     if os.path.isfile(log_path):
         for line in open(log_path):
             rec = json.loads(line)
-            done[rec["file"]] = rec
+            if rec["file"] in manifest_files:
+                done[rec["file"]] = rec
+            else:
+                foreign.append(line)
     for m in manifest:
         if m["file"] in done:
             continue
@@ -152,11 +157,9 @@ def main() -> int:
     # ledger for solves costing up to 1200 s each — a crash mid-rewrite
     # must not truncate it.  Records for files outside the current
     # manifest (e.g. a different --smt-dir) are preserved verbatim.
-    keep = [l for l in (open(log_path) if os.path.isfile(log_path) else [])
-            if json.loads(l)["file"] not in done]
     tmp = log_path + ".tmp"
     with open(tmp, "w") as fp:
-        for l in keep:
+        for l in foreign:
             fp.write(l)
         for m in manifest:
             if m["file"] in done:
@@ -174,9 +177,15 @@ def main() -> int:
         "agree_with_native": agree,
         "pinned_witness_validated": sum(
             1 for r in done.values() if r.get("z3_pinned") == "sat"),
+        # A pinned-witness REFUTATION (z3: unsat for the asserted native
+        # counterexample) is the most alarming outcome this audit can
+        # produce — surfaced here and in ``disagree`` below, never buried.
+        "pinned_witness_refuted": [
+            r["file"] for r in done.values() if r.get("z3_pinned") == "unsat"],
         "disagree": [r for r in done.values()
-                     if r.get("z3_verdict") in ("sat", "unsat")
-                     and not r["agree"]],
+                     if (r.get("z3_verdict") in ("sat", "unsat")
+                         and not r["agree"])
+                     or r.get("z3_pinned") == "unsat"],
         "undecided": [r["file"] for r in done.values()
                       if r.get("z3_verdict") not in ("sat", "unsat")],
     }

@@ -183,6 +183,32 @@ def test_masked_repair_touches_only_target_columns():
     assert np.allclose(w1_old, w1_new)  # output layer frozen
 
 
+def test_same_label_relabel_retrain_matches_reference_semantics():
+    """The faithful baseline arm (src/AC/detect_bias.py:412-433): every pair
+    point relabeled to the MAX of the model's two predictions (a flip pair
+    always relabels to 1) and retrained on exactly that set — after training,
+    the mean sigmoid over the pair points must move TOWARD 1 (the relabel
+    direction), and an empty pair list is a no-op returning the input net."""
+    import jax
+    import jax.numpy as jnp
+
+    net = _net_with_pa_neuron()
+    rng = np.random.default_rng(5)
+    pairs = []
+    for _ in range(16):
+        x = rng.integers(0, 4, size=4)
+        xp = x.copy()
+        x[1], xp[1] = 0, 1
+        pairs.append((x.astype(np.float32), xp.astype(np.float32)))
+    xs = np.stack([p[0] for p in pairs])
+    before = float(jax.nn.sigmoid(mlp.forward(net, jnp.asarray(xs))).mean())
+    res = repair.same_label_relabel_retrain(net, pairs, epochs=4, lr=5e-2)
+    after = float(jax.nn.sigmoid(mlp.forward(res.net, jnp.asarray(xs))).mean())
+    assert res.net.layer_sizes == net.layer_sizes
+    assert after > before  # trained toward the max-relabel (label 1)
+    assert repair.same_label_relabel_retrain(net, []).net is net
+
+
 def test_counterexample_retrain_respects_floor():
     net = _net_with_pa_neuron()
     rng = np.random.default_rng(3)
