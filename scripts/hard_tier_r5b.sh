@@ -1,0 +1,36 @@
+#!/bin/bash
+# Round-5 hard-tier remainder — reprioritized after wall-clock measurement.
+#
+# The stage-B full grid ran every exhaustible german preset to cov 100% at
+# the reference budget, but targeted-AC measured ~19 min/model (12 adult
+# models), which would have starved the named stage-C rows.  This remainder
+# puts the round-5 flagship rows first (relaxed3-BM's first-ever record and
+# the ADVICE-corrected soft-200 stress-BM BM-4), then breadth over the
+# remaining targeted presets at a 600 s tier (still 2.5-5x the r4 120/240 s
+# tiers), then the BM-S2 scaled re-run (its first record ran while a zombie
+# round-4 queue contended for the chip).
+set -u
+cd "$(dirname "$0")/.." || exit 1
+TAG="r5-$(git rev-parse --short HEAD 2>/dev/null || echo untagged)"
+echo "=== hard tier r5b, tag $TAG ($(date -u +%H:%M:%S)) ==="
+
+PYTHONUNBUFFERED=1 python scripts/variants.py run --out variants \
+  --hard 3600 --tag "$TAG" --presets relaxed3-BM --models BM-4 \
+  || echo "!! relaxed3 exited $?"
+PYTHONUNBUFFERED=1 python scripts/variants.py run --out variants \
+  --hard 3600 --tag "$TAG" --presets stress-BM --models BM-4 \
+  || echo "!! stressbm exited $?"
+for p in targeted-BM targeted2-GC targeted2-AC targeted2-BM targeted-DF; do
+  echo "--- $p (600s tier) ($(date -u +%H:%M:%S)) ---"
+  PYTHONUNBUFFERED=1 python scripts/variants.py run --out variants \
+    --hard 600 --tag "$TAG" --presets "$p" || echo "!! $p exited $?"
+done
+echo "--- BM-S2 scaled clean re-run ($(date -u +%H:%M:%S)) ---"
+# make is idempotent; guarantees the zoo exists on a fresh checkout (the
+# run stage fails loudly on an empty zoo, and || echo would swallow it).
+PYTHONUNBUFFERED=1 python scripts/scaled_stress.py make \
+  || echo "!! scaled make exited $?"
+FAIRIFY_TPU_MODEL_ROOT="$PWD/models_scaled" PYTHONUNBUFFERED=1 \
+  python scripts/scaled_stress.py run --hard 900 --tag "$TAG-clean" \
+  || echo "!! scaled rerun exited $?"
+echo "=== r5b complete ($(date -u +%H:%M:%S)) ==="
