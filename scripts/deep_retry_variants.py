@@ -55,7 +55,7 @@ def main() -> int:
         if "skipped" in r or "attempted" not in r:
             continue
         latest[(r["run_id"], r["model"], r["soft_s"], r["hard_s"],
-                r.get("cap"))] = i
+                r.get("cap"), r.get("engine_tag"))] = i
     wanted = set(args.presets.split(",")) if args.presets else None
     todo = [(k, i) for k, i in sorted(latest.items())
             if 0 < recs[i]["unknown"] <= args.max_unknown
@@ -74,10 +74,14 @@ def main() -> int:
             # identically or lo[idx]/hi[idx] would be different boxes.
             cfg = cfg.with_(capped_partitions=True, max_partitions=r["cap"])
         # The span ledgers live under the ORIGINAL config's budget-suffixed
-        # dir (budgeted_model_sweep); only the per-partition soft budget is
-        # escalated for the re-decision.
-        cfg = cfg.with_(result_dir=os.path.join(
-            cfg.result_dir, f"b{cfg.soft_timeout_s:g}-{cfg.hard_timeout_s:g}"))
+        # dir (budgeted_model_sweep) — engine-tagged rows (round 5+) add the
+        # tag to that dir, so the deep pass must follow it or it silently
+        # no-ops on exactly the rows it should deepen.  Only the
+        # per-partition soft budget is escalated for the re-decision.
+        sub = f"b{cfg.soft_timeout_s:g}-{cfg.hard_timeout_s:g}"
+        if r.get("engine_tag"):
+            sub += f"-{r['engine_tag']}"
+        cfg = cfg.with_(result_dir=os.path.join(cfg.result_dir, sub))
         # Escalate the engine's per-root node cap with the soft budget:
         # stress-GC box 624 (GC-5) certifies at ~227k BaB nodes — above the
         # 200k default — so a deeper wall budget without a deeper node cap
@@ -107,13 +111,51 @@ def main() -> int:
             return_residual=True)
         dt = time.perf_counter() - t0
         if residual == 0:
-            # Nothing was actually attempted (no span ledgers found, or the
-            # ledgers disagree with the row's unknown count): stamping a
-            # deep_retry marker here would claim an escalation that never
-            # touched a box.
-            print(json.dumps({"run_id": r["run_id"], "model": r["model"],
-                              "warning": "no residual unknowns in ledgers; "
-                                         "row not patched"}), flush=True)
+            # Nothing left to attempt.  Two sub-cases: (a) no ledgers at
+            # all — genuine no-op; (b) the ledgers already hold MORE
+            # decided verdicts than the row (e.g. a prior deep pass whose
+            # row patch failed) — the decided-wins ledger merge is the
+            # record of truth, so recount the row WITHOUT stamping a
+            # deep_retry marker (no escalation ran in this invocation).
+            from _sweeplib import merge_span_ledgers
+
+            paths_l, led_dec, led_unk = merge_span_ledgers(cfg, r["model"])
+            if paths_l and (len(led_unk) < recs[i]["unknown"]):
+                # Tier honesty (r5 review): ledger entries record their own
+                # per-decision soft budget; any decided entry deeper than
+                # the row's base soft means a prior deep pass's verdicts
+                # are being recovered — the row MUST carry the deep_retry
+                # marker (its wall was lost with the crashed patch; say so)
+                # or the Budget column would pass deep work off as base
+                # tier.
+                deep_entries = [rec_l for rec_l in led_dec.values()
+                                if rec_l.get("soft_s", r["soft_s"])
+                                > r["soft_s"]]
+                deep_soft = max((rec_l["soft_s"] for rec_l in deep_entries),
+                                default=0.0)
+
+                def recount(row):
+                    _rollup_counts(row, led_dec, led_unk)
+                    if deep_entries:
+                        dr = row.setdefault(
+                            "deep_retry",
+                            {"soft_s": deep_soft, "fixed": 0, "wall_s": 0.0})
+                        dr["soft_s"] = max(dr["soft_s"], deep_soft)
+                        dr["fixed"] = max(dr["fixed"], len(deep_entries))
+                        dr["wall_unrecorded"] = True
+                    return row
+
+                ok = _patch_results_row(results_path, k, recount)
+                print(json.dumps({"run_id": r["run_id"],
+                                  "model": r["model"],
+                                  "recounted_from_ledgers": ok,
+                                  "deep_entries": len(deep_entries),
+                                  "unknown": len(led_unk)}), flush=True)
+            else:
+                print(json.dumps({"run_id": r["run_id"], "model": r["model"],
+                                  "warning": "no residual unknowns in "
+                                             "ledgers; row not patched"}),
+                      flush=True)
             continue
         n_fixed = sum(fixed.values())
 
@@ -134,9 +176,7 @@ def main() -> int:
             led_counts[rec_l["verdict"]] += 1
 
         def patch(row):
-            row["sat"] = led_counts["sat"]
-            row["unsat"] = led_counts["unsat"]
-            row["unknown"] = led_counts["unknown"]
+            _rollup_counts(row, led_decided, led_unknown)
             row["total_time_s"] = round(row["total_time_s"] + dt, 2)
             row["decided_per_sec"] = round(
                 (row["sat"] + row["unsat"]) / max(row["total_time_s"], 1e-9),
@@ -169,6 +209,21 @@ def main() -> int:
     return 0
 
 
+def _rollup_counts(row: dict, led_decided: dict, led_unknown) -> dict:
+    """Decided-wins ledger counts -> row (the ONE row-accounting rule,
+    shared by the post-escalation patch and the ledger recount so the two
+    paths cannot diverge)."""
+    cts = {"sat": 0, "unsat": 0}
+    for rec_l in led_decided.values():
+        cts[rec_l["verdict"]] += 1
+    row["sat"] = cts["sat"]
+    row["unsat"] = cts["unsat"]
+    row["unknown"] = len(led_unknown)
+    row["decided_per_sec"] = round(
+        (row["sat"] + row["unsat"]) / max(row["total_time_s"], 1e-9), 3)
+    return row
+
+
 def _patch_results_row(results_path: str, row_key, patch_fn) -> bool:
     """Re-read → patch one row by key → atomic replace.
 
@@ -191,7 +246,7 @@ def _patch_results_row(results_path: str, row_key, patch_fn) -> bool:
         if "skipped" in row or "attempted" not in row:
             continue
         if (row["run_id"], row["model"], row["soft_s"], row["hard_s"],
-                row.get("cap")) == row_key:
+                row.get("cap"), row.get("engine_tag")) == row_key:
             target = i
     if target is None:
         return False

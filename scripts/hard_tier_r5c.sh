@@ -1,0 +1,40 @@
+#!/bin/bash
+# Round-5 hard-tier finale — representative-model breadth.
+#
+# Second wall-clock correction: the r5b breadth loop ran whole zoos at the
+# 600 s tier, but 5 presets x ~12 models x up to 600 s is another ~10 h.
+# This finale records 2-3 representative models per remaining preset (the
+# reference-named slow ones plus the first of each family), the easy-model
+# relaxed3 companion row (BM-4's 62 residual unknowns deserve an easy-model
+# UNK=0 counterpart), and the clean BM-S2 scaled re-run.
+set -u
+cd "$(dirname "$0")/.." || exit 1
+TAG="r5-$(git rev-parse --short HEAD 2>/dev/null || echo untagged)"
+echo "=== hard tier r5c, tag $TAG ($(date -u +%H:%M:%S)) ==="
+
+for entry in \
+  "relaxed3-BM BM-2,BM-10" \
+  "targeted-BM BM-4,BM-11" \
+  "targeted2-GC GC-3,GC-5" \
+  "targeted2-AC AC-1,AC-8" \
+  "targeted2-BM BM-4,BM-7,BM-11" \
+  ; do
+  preset=${entry%% *}
+  models=${entry#* }
+  echo "--- $preset $models (600s tier) ($(date -u +%H:%M:%S)) ---"
+  PYTHONUNBUFFERED=1 python scripts/variants.py run --out variants \
+    --hard 600 --tag "$TAG" --presets "$preset" --models "$models" \
+    || echo "!! $preset exited $?"
+done
+echo "--- targeted-DF (tiny grids, whole zoo) ($(date -u +%H:%M:%S)) ---"
+PYTHONUNBUFFERED=1 python scripts/variants.py run --out variants \
+  --hard 600 --tag "$TAG" --presets targeted-DF \
+  || echo "!! targeted-DF exited $?"
+
+echo "--- BM-S2 scaled clean re-run ($(date -u +%H:%M:%S)) ---"
+PYTHONUNBUFFERED=1 python scripts/scaled_stress.py make \
+  || echo "!! scaled make exited $?"
+FAIRIFY_TPU_MODEL_ROOT="$PWD/models_scaled" PYTHONUNBUFFERED=1 \
+  python scripts/scaled_stress.py run --hard 900 --tag "$TAG-clean" \
+  || echo "!! scaled rerun exited $?"
+echo "=== r5c complete ($(date -u +%H:%M:%S)) ==="
