@@ -43,7 +43,7 @@ def _probe_ok() -> bool:
         return False
 
 
-def main() -> None:
+def main(trace_out=None, heartbeat_s: float = 0.0) -> None:
     import os
 
     if not os.environ.get("FAIRIFY_TPU_BENCH_FALLBACK") and not _probe_ok():
@@ -51,7 +51,12 @@ def main() -> None:
                    JAX_PLATFORMS="cpu")
         import subprocess
 
-        raise SystemExit(subprocess.run([sys.executable, __file__], env=env).returncode)
+        cmd = [sys.executable, __file__]
+        if trace_out:
+            cmd += ["--trace-out", trace_out]
+        if heartbeat_s:
+            cmd += ["--heartbeat-interval", str(heartbeat_s)]
+        raise SystemExit(subprocess.run(cmd, env=env).returncode)
 
     import numpy as np
 
@@ -94,9 +99,31 @@ def main() -> None:
         print(json.dumps({"metric": "ladder_error", "error": str(exc)[:200]}),
               file=sys.stderr)
 
+    from fairify_tpu import obs
+
+    if heartbeat_s:
+        cfg = cfg.with_(heartbeat_s=heartbeat_s)
     t0 = time.perf_counter()
-    report = sweep.verify_model(net, cfg, model_name="GC-1", resume=False)
+    # Tracer scope covers only the timed headline run (the warm pass above
+    # must not pollute the event log's phase totals).
+    with obs.tracing(trace_out, run_id="bench-GC-1"):
+        report = sweep.verify_model(net, cfg, model_name="GC-1", resume=False)
     elapsed = time.perf_counter() - t0
+
+    # Per-run observability summary for the BENCH record: the sweep's
+    # throughput dump carries the phase breakdown and the launch delta, so
+    # future BENCH_r*.json rounds can regress launch economy and per-phase
+    # wall time alongside partitions/sec.
+    launches = None
+    phases_s = None
+    try:
+        with open(os.path.join(cfg.result_dir,
+                               f"{cfg.name}-GC-1.throughput.json")) as fp:
+            thr = json.load(fp)
+        launches = thr.get("device_launches")
+        phases_s = thr.get("phases_s")
+    except (OSError, ValueError):
+        pass
 
     counts = report.counts
     decided = counts["sat"] + counts["unsat"]
@@ -107,6 +134,8 @@ def main() -> None:
         "value": round(pps, 4),
         "unit": "partitions/sec",
         "vs_baseline": round(pps / REFERENCE_PARTITIONS_PER_SEC, 2),
+        "device_launches": launches,
+        "phases_s": phases_s,
     }))
 
 
@@ -199,4 +228,10 @@ def _ladder_configs() -> None:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    import argparse
+
+    _ap = argparse.ArgumentParser()
+    _ap.add_argument("--trace-out", default=None)
+    _ap.add_argument("--heartbeat-interval", type=float, default=0.0)
+    _a = _ap.parse_args()
+    sys.exit(main(trace_out=_a.trace_out, heartbeat_s=_a.heartbeat_interval))

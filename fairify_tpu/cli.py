@@ -70,13 +70,26 @@ def _overridden_cfg(args):
         overrides["max_partitions"] = int(args.max_partitions)
     if getattr(args, "partition_metrics", False):
         overrides["partition_metrics"] = True
+    if getattr(args, "trace_out", None):
+        overrides["trace_out"] = args.trace_out
+    if getattr(args, "heartbeat_interval", None) is not None:
+        overrides["heartbeat_s"] = float(args.heartbeat_interval)
     return cfg.with_(**overrides) if overrides else cfg
 
 
 def _cmd_run(args) -> int:
-    from fairify_tpu.verify import sweep
+    from fairify_tpu import obs
 
     cfg = _overridden_cfg(args)
+
+    # CLI-level tracer scope: one event log + Chrome trace for the whole
+    # sweep (the nested per-model scopes see the active tracer and no-op).
+    with obs.tracing(cfg.trace_out, run_id=cfg.name):
+        return _run_traced(args, cfg)
+
+
+def _run_traced(args, cfg) -> int:
+    from fairify_tpu.verify import sweep
 
     mesh = None
     if args.mesh:
@@ -128,11 +141,19 @@ def _cmd_run(args) -> int:
     return 0
 
 
-def _cmd_bench(_args) -> int:
+def _cmd_bench(args) -> int:
     import bench
 
-    bench.main()
+    bench.main(trace_out=getattr(args, "trace_out", None),
+               heartbeat_s=float(getattr(args, "heartbeat_interval", None) or 0.0))
     return 0
+
+
+def _cmd_report(args) -> int:
+    """Aggregate ``--trace-out`` event logs into phase/verdict/launch tables."""
+    from fairify_tpu.obs import report
+
+    return report.main(args.logs, json_out=args.json_out, as_json=args.json)
 
 
 def _cmd_experiment(args) -> int:
@@ -142,11 +163,18 @@ def _cmd_experiment(args) -> int:
     (``src/AC/Verify-AC-experiment-new2.py``, ``src/AC/detect_bias.py``,
     ``src/AC/new_model.py``) as one command.
     """
+    from fairify_tpu import obs
+
+    cfg = _overridden_cfg(args)
+    with obs.tracing(cfg.trace_out, run_id=f"{cfg.name}-experiment"):
+        return _experiment_traced(args, cfg)
+
+
+def _experiment_traced(args, cfg) -> int:
     from fairify_tpu.analysis import experiment
     from fairify_tpu.data import loaders
     from fairify_tpu.models import zoo
 
-    cfg = _overridden_cfg(args)
     net = zoo.load(cfg.dataset, args.model, root=args.model_root)
     dataset = loaders.load(cfg.dataset, root=args.data_root)
     res = experiment.run_experiment(
@@ -253,8 +281,26 @@ def main(argv=None) -> int:
                      help="total hosts; each sweeps its slice of the grid")
     run.add_argument("--mesh", action="store_true",
                      help="shard stage 0 over all visible devices")
+    run.add_argument("--trace-out", default=None,
+                     help="write a JSONL span/event log here plus a Chrome "
+                          "trace alongside (<path>.chrome.json)")
+    run.add_argument("--heartbeat-interval", type=float, default=None,
+                     help="stderr progress line every N seconds (0 = off)")
 
-    sub.add_parser("bench", help="run the headline benchmark")
+    ben = sub.add_parser("bench", help="run the headline benchmark")
+    ben.add_argument("--trace-out", default=None,
+                     help="JSONL span/event log for the timed headline run")
+    ben.add_argument("--heartbeat-interval", type=float, default=None,
+                     help="stderr progress line every N seconds (0 = off)")
+
+    rpt = sub.add_parser(
+        "report", help="aggregate --trace-out event logs into phase/verdict/"
+                       "launch breakdown tables")
+    rpt.add_argument("logs", nargs="+", help="one or more JSONL event logs")
+    rpt.add_argument("--json", action="store_true",
+                     help="print the aggregate as one JSON line instead of tables")
+    rpt.add_argument("--json-out", default=None,
+                     help="also write the aggregate JSON to this file")
 
     exp = sub.add_parser(
         "experiment", help="verify + localize + repair + hybrid-route + audit")
@@ -275,6 +321,11 @@ def main(argv=None) -> int:
                      help="also write the summary JSON to this file")
     exp.add_argument("--save-fairer", default=None,
                      help="write the repaired model as Keras-compatible .h5")
+    exp.add_argument("--trace-out", default=None,
+                     help="write a JSONL span/event log here plus a Chrome "
+                          "trace alongside (<path>.chrome.json)")
+    exp.add_argument("--heartbeat-interval", type=float, default=None,
+                     help="stderr progress line every N seconds (0 = off)")
 
     met = sub.add_parser("metrics", help="group-fairness report per zoo model")
     met.add_argument("preset")
@@ -284,7 +335,8 @@ def main(argv=None) -> int:
 
     args = ap.parse_args(argv)
     return {"list": _cmd_list, "run": _cmd_run, "bench": _cmd_bench,
-            "experiment": _cmd_experiment, "metrics": _cmd_metrics}[args.cmd](args)
+            "experiment": _cmd_experiment, "metrics": _cmd_metrics,
+            "report": _cmd_report}[args.cmd](args)
 
 
 if __name__ == "__main__":

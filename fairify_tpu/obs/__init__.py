@@ -1,0 +1,59 @@
+"""Observability layer: span tracing, metrics registry, heartbeat, report.
+
+The verification pipeline's throughput is governed by device-launch economy
+and per-phase wall time (each launch costs ~110 ms flat on the tunnelled
+single-chip setup — audits/device_util_r4.json); this package makes both
+first-class instead of ad-hoc:
+
+* :mod:`fairify_tpu.obs.trace` — nested, thread-safe spans appended to a
+  per-run JSONL event log, exportable as a Chrome trace
+  (``chrome://tracing`` / Perfetto).  Disabled by default; the off path is
+  one global read per span.
+* :mod:`fairify_tpu.obs.metrics` — named counters / gauges / histograms
+  with labels, resettable per run (absorbs the old module-global
+  ``_LAUNCHES`` and the ``ThroughputCounter`` fields).
+* :mod:`fairify_tpu.obs.heartbeat` — a throttled stderr progress line for
+  long sweeps.
+* :mod:`fairify_tpu.obs.report` — aggregates event logs into phase /
+  verdict / launch breakdown tables (the ``fairify_tpu report``
+  subcommand).
+
+Instrumented code imports this package only (``from fairify_tpu import
+obs``) and uses :func:`obs.span` / :func:`obs.timed_span` /
+:func:`obs.event` / :func:`obs.registry`; tracers are owned by entry
+points via :func:`obs.tracing`.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from fairify_tpu.obs.heartbeat import Heartbeat  # noqa: F401
+from fairify_tpu.obs.metrics import MetricsRegistry, registry  # noqa: F401
+from fairify_tpu.obs.trace import (  # noqa: F401
+    Tracer,
+    chrome_trace_path,
+    current,
+    event,
+    load_events,
+    maybe_tracing,
+    span,
+    tracing,
+    write_chrome_trace,
+)
+
+
+@contextlib.contextmanager
+def timed_span(timer, name: str, **attrs):
+    """A span that also accumulates into a :class:`PhaseTimer` phase.
+
+    The sweep's budget math (hard-timeout enforcement, per-row amortized
+    stage-0 share) runs off ``PhaseTimer`` totals whether or not tracing is
+    enabled; this keeps that always-on accounting and the optional event
+    log in one instrumentation point.
+    """
+    with span(name, **attrs) as sp:
+        if timer is None:
+            yield sp
+        else:
+            with timer.phase(name):
+                yield sp

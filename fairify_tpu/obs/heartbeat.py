@@ -1,0 +1,75 @@
+"""Live heartbeat: one throttled stderr line per interval during long sweeps.
+
+The stress/relaxed grids run for minutes to hours with no output between
+the stage-0 JSON lines; operators had to tail ledger files to see whether
+a sweep was alive.  The heartbeat prints a single line at most once per
+``interval_s``::
+
+    [hb GC-1] 1536/3360 attempted (45.7%) | 1510 decided, 12 unknown | 24.1 pps | +38 launches | eta 79s
+
+Throttling is clock-based (no output when the interval has not elapsed),
+so per-partition call sites can beat unconditionally.  The launch delta
+comes from the ``device_launches`` counter; ETA extrapolates the measured
+attempt rate over the remaining partitions.  This module is the obs
+layer's sanctioned progress ``print`` (see ``scripts/lint_obs.py``).
+"""
+from __future__ import annotations
+
+import sys
+import time
+from typing import Callable, Optional
+
+from fairify_tpu.obs import metrics as metrics_mod
+
+
+class Heartbeat:
+    """Throttled progress reporter; ``interval_s <= 0`` disables it."""
+
+    def __init__(self, interval_s: float, total: Optional[int] = None,
+                 label: str = "", stream=None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.interval_s = float(interval_s)
+        self.total = total
+        self.label = label
+        self.stream = stream  # None → sys.stderr at beat time (testable)
+        self._clock = clock
+        self._start = clock()
+        self._last: Optional[float] = None
+        self._last_launches = self._launches()
+
+    @staticmethod
+    def _launches() -> float:
+        return metrics_mod.registry().counter("device_launches").total()
+
+    def beat(self, decided: int, attempted: int, unknown: int = 0,
+             force: bool = False) -> bool:
+        """Emit one line if the interval elapsed (or ``force``); else no-op.
+
+        Returns whether a line was emitted.
+        """
+        if self.interval_s <= 0 and not force:
+            return False
+        now = self._clock()
+        if not force and self._last is not None \
+                and now - self._last < self.interval_s:
+            return False
+        elapsed = max(now - self._start, 1e-9)
+        pps = decided / elapsed
+        launches = self._launches()
+        d_launch = int(launches - self._last_launches)
+        parts = [f"[hb{' ' + self.label if self.label else ''}]"]
+        if self.total:
+            parts.append(f"{attempted}/{self.total} attempted "
+                         f"({100.0 * attempted / self.total:.1f}%)")
+        else:
+            parts.append(f"{attempted} attempted")
+        parts.append(f"| {decided} decided, {unknown} unknown")
+        parts.append(f"| {pps:.2f} pps")
+        parts.append(f"| +{d_launch} launches")
+        if self.total and attempted and attempted < self.total:
+            rate = attempted / elapsed
+            parts.append(f"| eta {(self.total - attempted) / rate:.0f}s")
+        print(" ".join(parts), file=self.stream or sys.stderr, flush=True)
+        self._last = now
+        self._last_launches = launches
+        return True

@@ -1,0 +1,149 @@
+"""Aggregate run event logs into phase / verdict / launch breakdowns.
+
+Powers the ``fairify_tpu report`` subcommand: given one or more ``--trace-out``
+JSONL logs (a single run, a multi-host run's per-host logs, or a whole
+results directory's worth), produce
+
+* a **phase table** — per span name: count, total seconds, device-launch
+  attribution (spans nest, so a parent's totals include its children —
+  the table is a breakdown by instrumentation point, not a partition of
+  wall time);
+* a **verdict table** — per model: sat / unsat / unknown, decided-vs-
+  attempted, split by the deciding stage (the per-partition ``verdict``
+  events the sweep emits carry a ``via`` attr);
+* the run's **device-launch total** (from the closing metrics snapshot).
+
+The same aggregate is emitted as JSON (``--json-out`` / ``--json``) so
+BENCH/PERF tooling can consume it without re-parsing tables.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, List
+
+from fairify_tpu.obs import trace as trace_mod
+
+
+def _counter_total(metrics: dict, name: str) -> float:
+    inst = metrics.get(name)
+    if not inst:
+        return 0.0
+    return sum(s.get("value", 0) for s in inst.get("series", []))
+
+
+def aggregate(paths: Iterable[str]) -> dict:
+    """Merge one or more event logs into a single summary dict.
+
+    Per-partition verdict events are deduplicated on ``(model,
+    partition_id)`` with last-record-wins: a resumed run appends
+    ``via="ledger"`` replays of partitions the crashed run already logged,
+    and a retry run re-decides previously-unknown partitions — in both
+    cases the latest record is the record of truth, and counts stay equal
+    to the final ModelReport's.  (Multi-host logs have disjoint partition
+    spans, so cross-file dedup never collides.)
+    """
+    phases: Dict[str, dict] = {}
+    span_count = 0
+    launches = 0.0
+    files = 0
+    keyed: Dict[tuple, dict] = {}  # (model, partition_id) -> attrs, last wins
+    anon: List[dict] = []  # verdict events without a partition id
+    for path in paths:
+        files += 1
+        for rec in trace_mod.load_events(path):
+            rtype = rec.get("type")
+            if rtype == "span":
+                span_count += 1
+                ph = phases.setdefault(
+                    rec["name"], {"count": 0, "total_s": 0.0, "launches": 0})
+                ph["count"] += 1
+                ph["total_s"] += rec.get("dur_s", 0.0)
+                ph["launches"] += int(rec.get("attrs", {}).get("launches", 0))
+            elif rtype == "event" and rec.get("name") == "verdict":
+                attrs = rec.get("attrs", {})
+                if attrs.get("verdict") not in ("sat", "unsat", "unknown"):
+                    continue
+                pid = attrs.get("partition_id")
+                if pid is None:
+                    anon.append(attrs)
+                else:
+                    keyed[(attrs.get("model", "?"), pid)] = attrs
+            elif rtype == "metrics":
+                # Each record is a per-run delta (tracer close), so multiple
+                # runs appended to one file sum correctly.
+                launches += _counter_total(rec.get("metrics", {}),
+                                           "device_launches")
+
+    models: Dict[str, dict] = {}
+    verdicts = {"sat": 0, "unsat": 0, "unknown": 0}
+    via: Dict[str, int] = {}
+    for attrs in list(keyed.values()) + anon:
+        v = attrs["verdict"]
+        verdicts[v] += 1
+        models.setdefault(attrs.get("model", "?"),
+                          {"sat": 0, "unsat": 0, "unknown": 0})[v] += 1
+        if v != "unknown":  # the breakdown is of DECIDED partitions
+            via[attrs.get("via", "?")] = via.get(attrs.get("via", "?"), 0) + 1
+    decided = verdicts["sat"] + verdicts["unsat"]
+    return {
+        "files": files,
+        "span_count": span_count,
+        "phases": {k: {"count": v["count"],
+                       "total_s": round(v["total_s"], 3),
+                       "launches": v["launches"]}
+                   for k, v in sorted(phases.items(),
+                                      key=lambda kv: -kv[1]["total_s"])},
+        "verdicts": verdicts,
+        "decided": decided,
+        "attempted": decided + verdicts["unknown"],
+        "via": via,
+        "models": models,
+        "device_launches": int(launches),
+    }
+
+
+def render(agg: dict) -> str:
+    """Human-readable tables for one aggregate (monospace, stdout-ready)."""
+    lines: List[str] = []
+    lines.append(f"event logs: {agg['files']}   spans: {agg['span_count']}   "
+                 f"device launches: {agg['device_launches']}")
+    if agg["phases"]:
+        w = max(len(k) for k in agg["phases"])
+        lines.append("")
+        lines.append(f"{'phase':<{w}}  {'count':>7}  {'total_s':>10}  {'launches':>8}")
+        for name, ph in agg["phases"].items():
+            lines.append(f"{name:<{w}}  {ph['count']:>7}  "
+                         f"{ph['total_s']:>10.3f}  {ph['launches']:>8}")
+    if agg["models"]:
+        w = max(max(len(k) for k in agg["models"]), len("TOTAL"))
+        lines.append("")
+        lines.append(f"{'model':<{w}}  {'sat':>6}  {'unsat':>6}  "
+                     f"{'unknown':>7}  {'decided':>7}")
+        for name, c in sorted(agg["models"].items()):
+            lines.append(f"{name:<{w}}  {c['sat']:>6}  {c['unsat']:>6}  "
+                         f"{c['unknown']:>7}  {c['sat'] + c['unsat']:>7}")
+        v = agg["verdicts"]
+        lines.append(f"{'TOTAL':<{w}}  {v['sat']:>6}  {v['unsat']:>6}  "
+                     f"{v['unknown']:>7}  {agg['decided']:>7}")
+    if agg.get("via"):
+        lines.append("")
+        lines.append("decided via: " + ", ".join(
+            f"{k}={n}" for k, n in sorted(agg["via"].items())))
+    return "\n".join(lines)
+
+
+def main(paths: List[str], json_out: str = None, as_json: bool = False) -> int:
+    """CLI body for ``fairify_tpu report`` (returns an exit code)."""
+    import os
+    import sys
+
+    missing = [p for p in paths if not os.path.isfile(p)]
+    if missing:
+        print(f"no such event log: {missing}", file=sys.stderr)
+        return 2
+    agg = aggregate(paths)
+    print(json.dumps(agg) if as_json else render(agg))
+    if json_out:
+        with open(json_out, "w") as fp:
+            json.dump(agg, fp, indent=2)
+    return 0

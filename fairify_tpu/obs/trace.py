@@ -1,0 +1,326 @@
+"""Structured span tracer: nested, thread-safe spans → JSONL + Chrome trace.
+
+One :class:`Tracer` per run (usually activated by the CLI's ``--trace-out``
+or ``SweepConfig.trace_out``).  Instrumented code never holds a tracer —
+it calls the module-level :func:`span` / :func:`event`, which route to the
+active tracer or to a shared no-op when tracing is off, so the disabled
+path costs one global read per span (the acceptance bar: no measurable
+overhead on the bench numbers).
+
+Event-log schema (one JSON object per line, append-only and crash-safe
+like the verdict ledgers; truncated trailing lines are tolerated on read):
+
+* ``{"type": "meta", "version": 1, "run_id": ..., "wall_time": ...}`` —
+  written once per tracer activation.
+* ``{"type": "span", "name", "span_id", "parent_id", "tid", "ts",
+  "dur_s", "attrs"}`` — written when a span closes.  ``ts`` is wall-clock
+  epoch seconds at span start (so logs from sequential runs appended to
+  one file stay ordered); ``dur_s`` is a monotonic perf-counter delta.
+  Spans that covered device work carry an automatic ``launches`` attr —
+  the delta of the ``device_launches`` counter over the span.
+* ``{"type": "event", "name", "ts", "tid", "attrs"}`` — instant events
+  (per-partition verdicts, retries).
+* ``{"type": "metrics", "ts", "metrics"}`` — the run's registry delta
+  (:func:`fairify_tpu.obs.metrics.snapshot_delta` of activation-time vs
+  close-time snapshots, so a warm-up pass or earlier run in the same
+  process never pollutes it), appended when the tracer closes.
+
+:func:`write_chrome_trace` converts an event log into the Chrome
+``traceEvents`` JSON that ``chrome://tracing`` / Perfetto load directly;
+:mod:`fairify_tpu.obs.report` aggregates the same log into tables.
+
+This module is the obs layer's clock shim: it is the one place allowed to
+call ``time.time()`` (wall-clock span timestamps) — everything else goes
+through spans (see ``scripts/lint_obs.py``).
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+from typing import Optional
+
+from fairify_tpu.obs import metrics as metrics_mod
+
+EVENT_VERSION = 1
+
+
+def _round(v: float, nd: int = 6) -> float:
+    # Raw floats internally, rounding only at serialization (the PhaseTimer
+    # 2-vs-3-decimal inconsistency this layer replaces).
+    return round(float(v), nd)
+
+
+class _NullSpan:
+    """Shared do-nothing span for the tracing-disabled path."""
+
+    __slots__ = ()
+
+    def set(self, **attrs) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+class Span:
+    """One open span; created by :meth:`Tracer.span`, closed on ``__exit__``."""
+
+    __slots__ = ("name", "attrs", "span_id", "parent_id", "tid",
+                 "_tracer", "_t0", "_ts", "_launch0")
+
+    def __init__(self, tracer: "Tracer", name: str, attrs: dict):
+        self.name = name
+        self.attrs = attrs
+        self._tracer = tracer
+        self.span_id = next(tracer._ids)
+        self.parent_id: Optional[int] = None
+        self.tid = 0
+
+    def set(self, **attrs) -> None:
+        """Attach/overwrite attributes on the open span."""
+        self.attrs.update(attrs)
+
+    def __enter__(self):
+        tr = self._tracer
+        stack = tr._stack()
+        self.parent_id = stack[-1].span_id if stack else None
+        self.tid = tr._tid()
+        self._ts = time.time()
+        self._launch0 = tr._launches()
+        self._t0 = time.perf_counter()
+        stack.append(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur = time.perf_counter() - self._t0
+        tr = self._tracer
+        stack = tr._stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        launches = tr._launches() - self._launch0
+        if launches > 0:
+            self.attrs.setdefault("launches", int(launches))
+        if exc_type is not None:
+            self.attrs.setdefault("error", exc_type.__name__)
+        tr._write({
+            "type": "span", "name": self.name, "span_id": self.span_id,
+            "parent_id": self.parent_id, "tid": self.tid,
+            "ts": _round(self._ts), "dur_s": _round(dur),
+            "attrs": self.attrs,
+        })
+        return False
+
+
+class Tracer:
+    """Appends span/event records to a JSONL file, one line per record."""
+
+    def __init__(self, path: str, run_id: Optional[str] = None):
+        import os
+
+        self.path = path
+        self.run_id = run_id
+        parent = os.path.dirname(path)
+        if parent:  # e.g. --trace-out inside a result_dir not yet created
+            os.makedirs(parent, exist_ok=True)
+        self._fp = open(path, "a")
+        self._write_lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._tls = threading.local()
+        self._tid_lock = threading.Lock()
+        self._tid_map: dict = {}
+        self._closed = False
+        # Baseline for the closing per-run metrics delta: the process
+        # registry is cumulative (a warm-up sweep or a previous run in the
+        # same process has already bumped it).
+        self._metrics0 = metrics_mod.registry().snapshot()
+        self._write({"type": "meta", "version": EVENT_VERSION,
+                     "run_id": run_id, "wall_time": _round(time.time())})
+
+    # -- internals ---------------------------------------------------------
+    def _stack(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def _tid(self) -> int:
+        ident = threading.get_ident()
+        with self._tid_lock:
+            tid = self._tid_map.get(ident)
+            if tid is None:
+                tid = self._tid_map[ident] = len(self._tid_map)
+            return tid
+
+    @staticmethod
+    def _launches() -> float:
+        return metrics_mod.registry().counter("device_launches").total()
+
+    def _write(self, rec: dict) -> None:
+        line = json.dumps(rec) + "\n"
+        with self._write_lock:
+            if self._closed:
+                return
+            self._fp.write(line)
+            self._fp.flush()  # crash-safe, like the verdict ledger
+
+    # -- public API --------------------------------------------------------
+    def span(self, name: str, **attrs) -> Span:
+        return Span(self, name, attrs)
+
+    def event(self, name: str, **attrs) -> None:
+        self._write({"type": "event", "name": name, "ts": _round(time.time()),
+                     "tid": self._tid(), "attrs": attrs})
+
+    def close(self, snapshot_metrics: bool = True) -> None:
+        if self._closed:
+            return
+        if snapshot_metrics:
+            delta = metrics_mod.snapshot_delta(
+                self._metrics0, metrics_mod.registry().snapshot())
+            self._write({"type": "metrics", "ts": _round(time.time()),
+                         "metrics": delta})
+        with self._write_lock:
+            self._closed = True
+            self._fp.close()
+
+
+# ---------------------------------------------------------------------------
+# Active-tracer plumbing (module-level; instrumented code calls these)
+# ---------------------------------------------------------------------------
+
+_active: Optional[Tracer] = None
+_active_lock = threading.Lock()
+
+
+def activate(tracer: Tracer) -> None:
+    global _active
+    with _active_lock:
+        _active = tracer
+
+
+def deactivate() -> None:
+    global _active
+    with _active_lock:
+        _active = None
+
+
+def current() -> Optional[Tracer]:
+    return _active
+
+
+def span(name: str, **attrs):
+    """A span on the active tracer, or the shared no-op when tracing is off."""
+    tr = _active
+    if tr is None:
+        return NULL_SPAN
+    return tr.span(name, **attrs)
+
+
+def event(name: str, **attrs) -> None:
+    tr = _active
+    if tr is not None:
+        tr.event(name, **attrs)
+
+
+class _TracingScope:
+    """Context manager behind :func:`tracing` / :func:`maybe_tracing`."""
+
+    def __init__(self, path: Optional[str], run_id: Optional[str]):
+        self._path = path
+        self._run_id = run_id
+        self._tracer: Optional[Tracer] = None
+
+    def __enter__(self) -> Optional[Tracer]:
+        if not self._path or current() is not None:
+            # Tracing off, or an outer scope (e.g. the CLI) already owns the
+            # tracer — nested sweeps must not re-open/re-export it.
+            return current()
+        self._tracer = Tracer(self._path, run_id=self._run_id)
+        activate(self._tracer)
+        return self._tracer
+
+    def __exit__(self, *exc):
+        if self._tracer is None:
+            return False
+        deactivate()
+        self._tracer.close()
+        try:
+            write_chrome_trace(self._path, chrome_trace_path(self._path))
+        except (OSError, ValueError):
+            pass  # the event log is the record of truth; the view is best-effort
+        return False
+
+
+def tracing(path: Optional[str], run_id: Optional[str] = None) -> _TracingScope:
+    """Own a tracer for the scope: open + activate, close + Chrome-export.
+
+    No-op when ``path`` is falsy or a tracer is already active (so per-model
+    scopes nest cleanly under a CLI-level ``--trace-out`` scope).
+    """
+    return _TracingScope(path, run_id)
+
+
+maybe_tracing = tracing
+
+
+# ---------------------------------------------------------------------------
+# Readers / exporters
+# ---------------------------------------------------------------------------
+
+
+def load_events(path: str) -> list:
+    """Event-log records; tolerates the truncated trailing line a crashed
+    run leaves behind (the same convention as the verdict ledger)."""
+    out = []
+    with open(path) as fp:
+        for line in fp:
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+def chrome_trace_path(jsonl_path: str) -> str:
+    base = jsonl_path[:-len(".jsonl")] if jsonl_path.endswith(".jsonl") \
+        else jsonl_path
+    return base + ".chrome.json"
+
+
+def write_chrome_trace(jsonl_path: str, out_path: str,
+                       include_instants: bool = True) -> int:
+    """Convert an event log to Chrome ``traceEvents`` JSON (Perfetto-ready).
+
+    Timestamps are rebased to the log's earliest record so the viewer opens
+    at t=0.  Returns the number of trace events written.
+    """
+    records = load_events(jsonl_path)
+    ts0 = min((r["ts"] for r in records if "ts" in r), default=0.0)
+    trace = [{"name": "process_name", "ph": "M", "pid": 0,
+              "args": {"name": "fairify_tpu"}}]
+    for r in records:
+        if r.get("type") == "span":
+            trace.append({
+                "name": r["name"], "ph": "X", "pid": 0, "tid": r.get("tid", 0),
+                "ts": _round((r["ts"] - ts0) * 1e6, 3),
+                "dur": _round(r["dur_s"] * 1e6, 3),
+                "args": r.get("attrs", {}),
+            })
+        elif r.get("type") == "event" and include_instants:
+            trace.append({
+                "name": r["name"], "ph": "i", "s": "t", "pid": 0,
+                "tid": r.get("tid", 0),
+                "ts": _round((r["ts"] - ts0) * 1e6, 3),
+                "args": r.get("attrs", {}),
+            })
+    with open(out_path, "w") as fp:
+        json.dump({"traceEvents": trace, "displayTimeUnit": "ms"}, fp)
+    return len(trace) - 1

@@ -31,4 +31,8 @@ class PhaseTimer:
         return time.perf_counter() - self.t0
 
     def get(self, name: str) -> float:
-        return round(self.phases.get(name, 0.0), 2)
+        # Raw float: rounding happens only at serialization (e.g.
+        # ``ThroughputCounter.dump``) — ``get`` used to round to 2 decimals
+        # while ``dump`` rounded to 3, so sums over phases disagreed with
+        # the dumped per-phase values.
+        return self.phases.get(name, 0.0)

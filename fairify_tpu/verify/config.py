@@ -39,6 +39,15 @@ class SweepConfig:
     engine: EngineConfig = field(default_factory=EngineConfig)
     result_dir: str = "res"
     profile_dir: Optional[str] = None  # XLA trace output (TensorBoard/XProf)
+    # Structured span/event log (fairify_tpu.obs): JSONL event log at this
+    # path plus a Chrome-trace export alongside (<path>.chrome.json).
+    # Composes with profile_dir: obs spans cover host-side phase structure,
+    # the XLA trace covers device internals.  None = tracing off (default,
+    # no measurable overhead).
+    trace_out: Optional[str] = None
+    # Throttled stderr progress line every N seconds during the partition
+    # loop (obs.heartbeat); 0 = off.
+    heartbeat_s: float = 0.0
     # Per-partition group-metric CSV (``<sink>-metrics.csv``), reproducing
     # the reference CP driver's artifact shape (``src/CP/Verify-CP.py:
     # 398-458``: Partition ID, orig/pruned acc+F1, DI/SPD/EOD/AOD/ERD/CNT/

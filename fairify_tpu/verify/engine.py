@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from fairify_tpu import obs
 from fairify_tpu.models.mlp import MLP
 from fairify_tpu.ops import crown as crown_ops
 from fairify_tpu.ops import interval as interval_ops
@@ -1106,28 +1107,30 @@ def decide_many(
     # Phase A re-ran a kernel that had just failed to find witnesses).
     attack_cost = np.zeros(R, dtype=np.float64)
     if cfg.pgd_phase and not attacked and len(enc.pa_idx) and R:
-        t_a = time.perf_counter()
-        rng_a = np.random.default_rng(cfg.seed + 17)
-        # Chunk cap scales down for small calls (decide_box, heuristic
-        # retry: R=1) — pgd_attack pads to the next power of two itself,
-        # so tiny calls stay tiny; large calls amortize at 1024/launch.
-        CH = min(1024, 1 << max(R - 1, 0).bit_length())
-        # Budget guard: the attack must never eat the certificate phases'
-        # deadline — cap it at a quarter and stop between chunks.
-        attack_deadline = 0.25 * deadline_s
-        for s in range(0, R, CH):
-            if time.perf_counter() - t_a > attack_deadline:
-                break
-            blk = np.arange(s, min(s + CH, R))
-            w = pgd_attack(
-                net, enc, np.asarray(roots_lo[blk], dtype=np.int64),
-                np.asarray(roots_hi[blk], dtype=np.int64), rng_a,
-                steps=cfg.pgd_steps, restarts=cfg.pgd_restarts)
-            for i, ce in w.items():
-                if i < len(blk) and verdicts[s + i] is None:
-                    verdicts[s + i] = "sat"
-                    ces[s + i] = ce
-        attack_cost[:] = (time.perf_counter() - t_a) / R
+        with obs.span("engine.attack", roots=R) as sp_a:
+            t_a = time.perf_counter()
+            rng_a = np.random.default_rng(cfg.seed + 17)
+            # Chunk cap scales down for small calls (decide_box, heuristic
+            # retry: R=1) — pgd_attack pads to the next power of two itself,
+            # so tiny calls stay tiny; large calls amortize at 1024/launch.
+            CH = min(1024, 1 << max(R - 1, 0).bit_length())
+            # Budget guard: the attack must never eat the certificate phases'
+            # deadline — cap it at a quarter and stop between chunks.
+            attack_deadline = 0.25 * deadline_s
+            for s in range(0, R, CH):
+                if time.perf_counter() - t_a > attack_deadline:
+                    break
+                blk = np.arange(s, min(s + CH, R))
+                w = pgd_attack(
+                    net, enc, np.asarray(roots_lo[blk], dtype=np.int64),
+                    np.asarray(roots_hi[blk], dtype=np.int64), rng_a,
+                    steps=cfg.pgd_steps, restarts=cfg.pgd_restarts)
+                for i, ce in w.items():
+                    if i < len(blk) and verdicts[s + i] is None:
+                        verdicts[s + i] = "sat"
+                        ces[s + i] = ce
+            attack_cost[:] = (time.perf_counter() - t_a) / R
+            sp_a.set(sat=sum(1 for v in verdicts if v == "sat"))
 
     # Phase S — uniform-sign neuron-split BaB.  Roots whose sampled role
     # logits are one-signed get a β-CROWN-style activation-split proof
@@ -1147,16 +1150,20 @@ def decide_many(
     open_idx = np.array([r for r in range(R) if verdicts[r] is None])
     if cfg.sign_bab and cfg.use_crown and cfg.alpha_iters > 0 \
             and open_idx.size:
-        sv, sn, sc, slp = uniform_sign_bab(
-            net, enc, np.asarray(roots_lo)[open_idx].astype(np.int64),
-            np.asarray(roots_hi)[open_idx].astype(np.int64), cfg,
-            deadline_s=cfg.sign_bab_frac * deadline_s, mesh=mesh)
-        sign_nodes[open_idx] = sn
-        sign_cost[open_idx] = sc
-        sign_lp_cost[open_idx] = slp
-        for k, v in enumerate(sv):
-            if v == "unsat":
-                verdicts[int(open_idx[k])] = "unsat"
+        with obs.span("engine.sign_bab", roots=int(open_idx.size)) as sp_s:
+            sv, sn, sc, slp = uniform_sign_bab(
+                net, enc, np.asarray(roots_lo)[open_idx].astype(np.int64),
+                np.asarray(roots_hi)[open_idx].astype(np.int64), cfg,
+                deadline_s=cfg.sign_bab_frac * deadline_s, mesh=mesh)
+            sign_nodes[open_idx] = sn
+            sign_cost[open_idx] = sc
+            sign_lp_cost[open_idx] = slp
+            unsat_n = 0
+            for k, v in enumerate(sv):
+                if v == "unsat":
+                    verdicts[int(open_idx[k])] = "unsat"
+                    unsat_n += 1
+            sp_s.set(unsat=unsat_n, nodes=int(sn.sum()))
 
     # Phase E0 — immediate exhaustive enumeration of CHEAP enumerable roots.
     # A root whose (ε-dilated) lattice fits a few scan chunks is decided
@@ -1174,20 +1181,24 @@ def decide_many(
         cheap = sorted((r for r in range(R) if verdicts[r] is None
                         and lat_sizes.get(r, np.inf) <= cfg.lattice_first_max),
                        key=lambda r: lat_sizes[r])
-        for r in cheap:
-            spent = time.perf_counter() - t0
-            if spent > 0.4 * deadline_s:
-                break
-            t_r = time.perf_counter()
-            verdict, ce = lattice_ops.decide_box_exhaustive(
-                net, enc, np.asarray(roots_lo[r], dtype=np.int64),
-                np.asarray(roots_hi[r], dtype=np.int64),
-                chunk=cfg.lattice_chunk,
-                deadline_s=min(deadline_s - spent, cfg.lattice_first_cap_s))
-            lat_cost[r] += time.perf_counter() - t_r
-            if verdict != "unknown":
-                verdicts[r] = verdict
-                ces[r] = ce
+        with obs.span("engine.lattice_first", roots=len(cheap)) as sp_e0:
+            decided_e0 = 0
+            for r in cheap:
+                spent = time.perf_counter() - t0
+                if spent > 0.4 * deadline_s:
+                    break
+                t_r = time.perf_counter()
+                verdict, ce = lattice_ops.decide_box_exhaustive(
+                    net, enc, np.asarray(roots_lo[r], dtype=np.int64),
+                    np.asarray(roots_hi[r], dtype=np.int64),
+                    chunk=cfg.lattice_chunk,
+                    deadline_s=min(deadline_s - spent, cfg.lattice_first_cap_s))
+                lat_cost[r] += time.perf_counter() - t_r
+                if verdict != "unknown":
+                    verdicts[r] = verdict
+                    ces[r] = ce
+                    decided_e0 += 1
+            sp_e0.set(decided=decided_e0)
 
     frontier = deque(
         (np.asarray(roots_lo[r], dtype=np.int64), np.asarray(roots_hi[r], dtype=np.int64), r)
@@ -1231,190 +1242,192 @@ def decide_many(
             verdicts[r] = verdict
             ces[r] = ce
 
-    while frontier:
-        timed_out = (time.perf_counter() - t0) > main_deadline
-        if timed_out:
-            for _, _, r in frontier:
-                settle(r, "unknown")
-            break
-
-        t_iter = time.perf_counter()
-        # Pop a batch, dropping sub-boxes of roots that settled meanwhile.
-        blo_l, bhi_l, broot_l = [], [], []
-        while frontier and len(blo_l) < F:
-            l, h, r = frontier.popleft()
-            if verdicts[r] is not None:
-                continue
-            blo_l.append(l)
-            bhi_l.append(h)
-            broot_l.append(r)
-        if not blo_l:
-            break
-        batch = len(blo_l)
-        blo, bhi, broot = np.stack(blo_l), np.stack(bhi_l), np.array(broot_l)
-        for r in broot:
-            open_boxes[r] -= 1
-        np.add.at(nodes, broot, 1)
-
-        live = np.array([verdicts[r] is None for r in broot])
-
-        plo = _pad(blo, F).astype(np.float32)
-        phi = _pad(bhi, F).astype(np.float32)
-        x_lo, x_hi, xp_lo, xp_hi, valid = role_boxes(enc, plo, phi)
-        bound_net = net
-        valid_in = valid
-        if mesh is not None:
-            x_lo, x_hi, xp_lo, xp_hi, plo_in, phi_in, valid_in = \
-                mesh_mod.shard_parts(mesh, x_lo, x_hi, xp_lo, xp_hi, plo, phi, valid)
-            bound_net = net_sharded
-        else:
-            plo_in, phi_in = plo, phi
-        # Escalation: plain CROWN clears the easy boxes in one cheap pass;
-        # once a fifth of the deadline is spent the survivors are the hard
-        # ones, where α-CROWN's extra backward passes pay for themselves.
-        use_alpha = (cfg.use_crown and cfg.alpha_iters > 0
-                     and time.perf_counter() - t0 > 0.2 * deadline_s)
-        score = None
-        fused = cfg.use_crown and mesh is None
-        if fused:
-            # One launch per iteration: certificate + attack logits for ALL
-            # boxes.  A launch costs ~110 ms flat on the tunnelled chip
-            # (audits/device_util_r4.json) while the extra attack forwards on
-            # to-be-certified boxes are microseconds of MXU time — attacking
-            # unconditionally in the certify kernel halves the loop's launch
-            # bill (VERDICT r4 #3).
-            xr, pr = build_attack_candidates(enc, rng, _pad(blo, F),
-                                             _pad(bhi, F), cfg.bab_attack_samples)
-            cert_dev, score_dev, found_dev, wit_dev = _certify_attack_kernel(
-                bound_net, jnp.asarray(x_lo), jnp.asarray(x_hi),
-                jnp.asarray(xp_lo), jnp.asarray(xp_hi),
-                jnp.asarray(plo_in), jnp.asarray(phi_in),
-                assign_vals, pa_mask, ra_mask, float(enc.eps),
-                jnp.asarray(valid_in), valid_pair_dev,
-                jnp.asarray(xr), jnp.asarray(pr),
-                alpha_iters=cfg.alpha_iters if use_alpha else 0,
-            )
-            profiling.bump_launch()
-            certified = np.asarray(cert_dev)[:batch]
-            score = np.asarray(score_dev)[:F]
-            found_all, wit_all = np.asarray(found_dev), np.asarray(wit_dev)
-        elif cfg.use_crown:
-            cert_dev, score_dev = _role_certify_kernel(
-                bound_net, jnp.asarray(x_lo), jnp.asarray(x_hi),
-                jnp.asarray(xp_lo), jnp.asarray(xp_hi),
-                jnp.asarray(plo_in), jnp.asarray(phi_in),
-                assign_vals, pa_mask, ra_mask, float(enc.eps),
-                jnp.asarray(valid_in), valid_pair_dev,
-                alpha_iters=cfg.alpha_iters if use_alpha else 0,
-            )
-            profiling.bump_launch()
-            certified = np.asarray(cert_dev)[:batch]
-            score = np.asarray(score_dev)[:F]
-        else:
-            lb_x, ub_x, lb_p, ub_p = _role_logit_bounds(
-                bound_net, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
-                jnp.asarray(xp_hi), cfg.use_crown,
-            )
-            profiling.bump_launch()
-            lb_x, ub_x, lb_p, ub_p = (np.asarray(v)[:F] for v in (lb_x, ub_x, lb_p, ub_p))
-            certified = no_flip_certified(lb_x, ub_x, lb_p, ub_p, valid, enc.valid_pair)[:batch]
-
-        undecided = np.where(~certified & live)[0]
-        if undecided.size:
-            if fused:
-                found, wit = found_all[undecided], wit_all[undecided]
-                xr_u, pr_u = xr[undecided], pr[undecided]
-            else:
-                # Attack the undecided boxes (padded so the forward compiles
-                # once).
-                ulo, uhi = _pad(blo[undecided], F), _pad(bhi[undecided], F)
-                xr_u, pr_u = build_attack_candidates(enc, rng, ulo, uhi,
-                                                     cfg.bab_attack_samples)
-                if mesh is not None:
-                    xr_s, pr_s = mesh_mod.shard_parts(mesh, xr_u, pr_u)
-                    lx, lp = _attack_logits(bound_net, xr_s, pr_s)
-                    lx, lp = np.asarray(lx)[:F], np.asarray(lp)[:F]
-                else:
-                    lx, lp = _attack_logits(net, jnp.asarray(xr_u), jnp.asarray(pr_u))
-                profiling.bump_launch()
-                found, wit = find_flips(
-                    enc, np.asarray(lx), np.asarray(lp), _pad(valid[undecided], F)
-                )
-            found = found[: undecided.size]
-            for k in np.where(found)[0]:
-                r = int(broot[undecided[k]])
-                if verdicts[r] is not None:
-                    continue
-                s, a, b = wit[k]
-                x = xr_u[k, s, a].astype(np.int64)
-                xp = pr_u[k, s, b].astype(np.int64)
-                if validate_pair(weights, biases, x, xp):
-                    settle(r, "sat", (x, xp))
-
-            for k in undecided:
-                r = int(broot[k])
-                if verdicts[r] is not None:
-                    continue
-                if nodes[r] > cfg.max_nodes:
+    with obs.span("engine.bab", roots=int(len(frontier))) as sp_bab:
+        while frontier:
+            timed_out = (time.perf_counter() - t0) > main_deadline
+            if timed_out:
+                for _, _, r in frontier:
                     settle(r, "unknown")
+                break
+
+            t_iter = time.perf_counter()
+            # Pop a batch, dropping sub-boxes of roots that settled meanwhile.
+            blo_l, bhi_l, broot_l = [], [], []
+            while frontier and len(blo_l) < F:
+                l, h, r = frontier.popleft()
+                if verdicts[r] is not None:
                     continue
-                l, h = blo[k], bhi[k]
-                widths = h[branch_dims] - l[branch_dims]
-                if widths.size == 0 or widths.max() == 0:
-                    leaves[r] += 1
-                    verdict, ce = decide_leaf(enc, weights, biases, l.copy(), l, h)
-                    if verdict == "sat":
-                        settle(r, "sat", ce)
-                    elif verdict == "unknown":
-                        settle(r, "unknown")
-                    continue
-                # Coefficient-aware branching: split the dim whose width
-                # contributes most to the difference-certificate slack
-                # (score_j·width_j); zero-score frontier → widest-dim.
-                # Multi-way when the frontier is underfull: each kernel
-                # launch costs the full padded batch regardless of how many
-                # live boxes ride it, so on small frontiers (hard single
-                # roots — the r4 slow-tail profile measured 5-25 ms/node of
-                # pure launch latency) splitting the top-2/3 dims at once
-                # packs 2-3 binary levels into one launch.
-                if score is not None:
-                    sc = score[k][branch_dims] * widths
-                    if float(sc.max()) <= 0:
-                        sc = widths.astype(np.float64)
+                blo_l.append(l)
+                bhi_l.append(h)
+                broot_l.append(r)
+            if not blo_l:
+                break
+            batch = len(blo_l)
+            blo, bhi, broot = np.stack(blo_l), np.stack(bhi_l), np.array(broot_l)
+            for r in broot:
+                open_boxes[r] -= 1
+            np.add.at(nodes, broot, 1)
+
+            live = np.array([verdicts[r] is None for r in broot])
+
+            plo = _pad(blo, F).astype(np.float32)
+            phi = _pad(bhi, F).astype(np.float32)
+            x_lo, x_hi, xp_lo, xp_hi, valid = role_boxes(enc, plo, phi)
+            bound_net = net
+            valid_in = valid
+            if mesh is not None:
+                x_lo, x_hi, xp_lo, xp_hi, plo_in, phi_in, valid_in = \
+                    mesh_mod.shard_parts(mesh, x_lo, x_hi, xp_lo, xp_hi, plo, phi, valid)
+                bound_net = net_sharded
+            else:
+                plo_in, phi_in = plo, phi
+            # Escalation: plain CROWN clears the easy boxes in one cheap pass;
+            # once a fifth of the deadline is spent the survivors are the hard
+            # ones, where α-CROWN's extra backward passes pay for themselves.
+            use_alpha = (cfg.use_crown and cfg.alpha_iters > 0
+                         and time.perf_counter() - t0 > 0.2 * deadline_s)
+            score = None
+            fused = cfg.use_crown and mesh is None
+            if fused:
+                # One launch per iteration: certificate + attack logits for ALL
+                # boxes.  A launch costs ~110 ms flat on the tunnelled chip
+                # (audits/device_util_r4.json) while the extra attack forwards on
+                # to-be-certified boxes are microseconds of MXU time — attacking
+                # unconditionally in the certify kernel halves the loop's launch
+                # bill (VERDICT r4 #3).
+                xr, pr = build_attack_candidates(enc, rng, _pad(blo, F),
+                                                 _pad(bhi, F), cfg.bab_attack_samples)
+                cert_dev, score_dev, found_dev, wit_dev = _certify_attack_kernel(
+                    bound_net, jnp.asarray(x_lo), jnp.asarray(x_hi),
+                    jnp.asarray(xp_lo), jnp.asarray(xp_hi),
+                    jnp.asarray(plo_in), jnp.asarray(phi_in),
+                    assign_vals, pa_mask, ra_mask, float(enc.eps),
+                    jnp.asarray(valid_in), valid_pair_dev,
+                    jnp.asarray(xr), jnp.asarray(pr),
+                    alpha_iters=cfg.alpha_iters if use_alpha else 0,
+                )
+                profiling.bump_launch()
+                certified = np.asarray(cert_dev)[:batch]
+                score = np.asarray(score_dev)[:F]
+                found_all, wit_all = np.asarray(found_dev), np.asarray(wit_dev)
+            elif cfg.use_crown:
+                cert_dev, score_dev = _role_certify_kernel(
+                    bound_net, jnp.asarray(x_lo), jnp.asarray(x_hi),
+                    jnp.asarray(xp_lo), jnp.asarray(xp_hi),
+                    jnp.asarray(plo_in), jnp.asarray(phi_in),
+                    assign_vals, pa_mask, ra_mask, float(enc.eps),
+                    jnp.asarray(valid_in), valid_pair_dev,
+                    alpha_iters=cfg.alpha_iters if use_alpha else 0,
+                )
+                profiling.bump_launch()
+                certified = np.asarray(cert_dev)[:batch]
+                score = np.asarray(score_dev)[:F]
+            else:
+                lb_x, ub_x, lb_p, ub_p = _role_logit_bounds(
+                    bound_net, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
+                    jnp.asarray(xp_hi), cfg.use_crown,
+                )
+                profiling.bump_launch()
+                lb_x, ub_x, lb_p, ub_p = (np.asarray(v)[:F] for v in (lb_x, ub_x, lb_p, ub_p))
+                certified = no_flip_certified(lb_x, ub_x, lb_p, ub_p, valid, enc.valid_pair)[:batch]
+
+            undecided = np.where(~certified & live)[0]
+            if undecided.size:
+                if fused:
+                    found, wit = found_all[undecided], wit_all[undecided]
+                    xr_u, pr_u = xr[undecided], pr[undecided]
                 else:
-                    sc = widths.astype(np.float64)
-                n_dims = 1
-                if len(frontier) + 2 * undecided.size < F // 2:
-                    n_dims = 3 if len(frontier) + 4 * undecided.size < F // 4 \
-                        else 2
-                order = np.argsort(-sc, kind="stable")
-                chosen = [int(branch_dims[j]) for j in order[:n_dims]
-                          if widths[j] > 0][: n_dims]
-                children = [(l, h)]
-                for dim in chosen:
-                    nxt = []
+                    # Attack the undecided boxes (padded so the forward compiles
+                    # once).
+                    ulo, uhi = _pad(blo[undecided], F), _pad(bhi[undecided], F)
+                    xr_u, pr_u = build_attack_candidates(enc, rng, ulo, uhi,
+                                                         cfg.bab_attack_samples)
+                    if mesh is not None:
+                        xr_s, pr_s = mesh_mod.shard_parts(mesh, xr_u, pr_u)
+                        lx, lp = _attack_logits(bound_net, xr_s, pr_s)
+                        lx, lp = np.asarray(lx)[:F], np.asarray(lp)[:F]
+                    else:
+                        lx, lp = _attack_logits(net, jnp.asarray(xr_u), jnp.asarray(pr_u))
+                    profiling.bump_launch()
+                    found, wit = find_flips(
+                        enc, np.asarray(lx), np.asarray(lp), _pad(valid[undecided], F)
+                    )
+                found = found[: undecided.size]
+                for k in np.where(found)[0]:
+                    r = int(broot[undecided[k]])
+                    if verdicts[r] is not None:
+                        continue
+                    s, a, b = wit[k]
+                    x = xr_u[k, s, a].astype(np.int64)
+                    xp = pr_u[k, s, b].astype(np.int64)
+                    if validate_pair(weights, biases, x, xp):
+                        settle(r, "sat", (x, xp))
+
+                for k in undecided:
+                    r = int(broot[k])
+                    if verdicts[r] is not None:
+                        continue
+                    if nodes[r] > cfg.max_nodes:
+                        settle(r, "unknown")
+                        continue
+                    l, h = blo[k], bhi[k]
+                    widths = h[branch_dims] - l[branch_dims]
+                    if widths.size == 0 or widths.max() == 0:
+                        leaves[r] += 1
+                        verdict, ce = decide_leaf(enc, weights, biases, l.copy(), l, h)
+                        if verdict == "sat":
+                            settle(r, "sat", ce)
+                        elif verdict == "unknown":
+                            settle(r, "unknown")
+                        continue
+                    # Coefficient-aware branching: split the dim whose width
+                    # contributes most to the difference-certificate slack
+                    # (score_j·width_j); zero-score frontier → widest-dim.
+                    # Multi-way when the frontier is underfull: each kernel
+                    # launch costs the full padded batch regardless of how many
+                    # live boxes ride it, so on small frontiers (hard single
+                    # roots — the r4 slow-tail profile measured 5-25 ms/node of
+                    # pure launch latency) splitting the top-2/3 dims at once
+                    # packs 2-3 binary levels into one launch.
+                    if score is not None:
+                        sc = score[k][branch_dims] * widths
+                        if float(sc.max()) <= 0:
+                            sc = widths.astype(np.float64)
+                    else:
+                        sc = widths.astype(np.float64)
+                    n_dims = 1
+                    if len(frontier) + 2 * undecided.size < F // 2:
+                        n_dims = 3 if len(frontier) + 4 * undecided.size < F // 4 \
+                            else 2
+                    order = np.argsort(-sc, kind="stable")
+                    chosen = [int(branch_dims[j]) for j in order[:n_dims]
+                              if widths[j] > 0][: n_dims]
+                    children = [(l, h)]
+                    for dim in chosen:
+                        nxt = []
+                        for cl, ch_ in children:
+                            mid = (cl[dim] + ch_[dim]) // 2
+                            left_hi = ch_.copy()
+                            left_hi[dim] = mid
+                            right_lo = cl.copy()
+                            right_lo[dim] = mid + 1
+                            nxt.append((cl, left_hi))
+                            nxt.append((right_lo, ch_))
+                        children = nxt
                     for cl, ch_ in children:
-                        mid = (cl[dim] + ch_[dim]) // 2
-                        left_hi = ch_.copy()
-                        left_hi[dim] = mid
-                        right_lo = cl.copy()
-                        right_lo[dim] = mid + 1
-                        nxt.append((cl, left_hi))
-                        nxt.append((right_lo, ch_))
-                    children = nxt
-                for cl, ch_ in children:
-                    frontier.append((cl, ch_, r))
-                open_boxes[r] += len(children)
+                        frontier.append((cl, ch_, r))
+                    open_boxes[r] += len(children)
 
-        # Attribute this iteration's wall time to its roots, per sub-box, so
-        # per-root costs are additive (sum ≈ total phase time).
-        iter_dt = time.perf_counter() - t_iter
-        np.add.at(cost_s, broot, iter_dt / batch)
+            # Attribute this iteration's wall time to its roots, per sub-box, so
+            # per-root costs are additive (sum ≈ total phase time).
+            iter_dt = time.perf_counter() - t_iter
+            np.add.at(cost_s, broot, iter_dt / batch)
 
-        # Roots whose sub-tree emptied without a counterexample are fair.
-        for r in set(int(x) for x in broot):
-            if verdicts[r] is None and open_boxes[r] == 0:
-                settle(r, "unsat")
+            # Roots whose sub-tree emptied without a counterexample are fair.
+            for r in set(int(x) for x in broot):
+                if verdicts[r] is None and open_boxes[r] == 0:
+                    settle(r, "unsat")
+        sp_bab.set(nodes=int(nodes.sum()), leaves=int(leaves.sum()))
 
     for r in range(R):
         if verdicts[r] is None:
@@ -1422,12 +1435,18 @@ def decide_many(
 
     pair_cost = np.zeros(R, dtype=np.float64)  # lat_cost init'd at Phase E0
     if use_pair and any(v == "unknown" for v in verdicts):
-        _pair_lp_phase(net, enc, roots_lo, roots_hi, verdicts, ces,
-                       nodes, pair_cost, cfg, t0, pair_deadline)
+        n_unk = sum(1 for v in verdicts if v == "unknown")
+        with obs.span("engine.pair_lp", roots=n_unk) as sp_p:
+            _pair_lp_phase(net, enc, roots_lo, roots_hi, verdicts, ces,
+                           nodes, pair_cost, cfg, t0, pair_deadline)
+            sp_p.set(decided=n_unk - sum(1 for v in verdicts if v == "unknown"))
 
     if use_lattice and any(v == "unknown" for v in verdicts):
-        _lattice_phase(net, enc, roots_lo, roots_hi, verdicts, ces,
-                       lat_cost, cfg, t0, deadline_s, lat_sizes=lat_sizes)
+        n_unk = sum(1 for v in verdicts if v == "unknown")
+        with obs.span("engine.lattice", roots=n_unk) as sp_e:
+            _lattice_phase(net, enc, roots_lo, roots_hi, verdicts, ces,
+                           lat_cost, cfg, t0, deadline_s, lat_sizes=lat_sizes)
+            sp_e.set(decided=n_unk - sum(1 for v in verdicts if v == "unknown"))
 
     # Per-root per-phase attribution: A = deep PGD attack (split evenly),
     # S = sign-BaB device frontier, L = host LP inside the sign phase,

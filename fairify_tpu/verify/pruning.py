@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from fairify_tpu import obs
 from fairify_tpu.models.mlp import MLP
 from fairify_tpu.ops import exact as exact_ops
 from fairify_tpu.ops import interval as interval_ops
@@ -105,24 +106,27 @@ def sound_prune_grid(
 
     P = lo.shape[0]
     step, spans = chunk_spans(P, chunk)
+    span_obs = obs.span("prune.sim_and_bounds", partitions=P,
+                        chunks=len(spans))
     lo_np, hi_np = np.asarray(lo), np.asarray(hi)
     cand_c, pos_c, lb_c, ub_c, sim_c = [], [], [], [], []
-    for s, e in spans:
-        clo = pad_rows(lo_np[s:e], step)
-        chi = pad_rows(hi_np[s:e], step)
-        keys = grid_keys(seed, index_offset + s, step)
-        profiling.bump_launch()
-        stats, sim, bounds = _sim_and_bounds(
-            net, keys, jnp.asarray(clo, jnp.float32), jnp.asarray(chi, jnp.float32),
-            sim_size, with_sim=keep_sim,
-        )
-        n = e - s
-        cand_c.append([np.asarray(c)[:n] for c in stats.candidates])
-        pos_c.append([np.asarray(p) [:n] for p in stats.positive_prob])
-        lb_c.append([np.asarray(b)[:n] for b in bounds.ws_lb])
-        ub_c.append([np.asarray(b)[:n] for b in bounds.ws_ub])
-        if keep_sim:
-            sim_c.append(np.asarray(sim)[:n])
+    with span_obs:
+        for s, e in spans:
+            clo = pad_rows(lo_np[s:e], step)
+            chi = pad_rows(hi_np[s:e], step)
+            keys = grid_keys(seed, index_offset + s, step)
+            profiling.bump_launch()
+            stats, sim, bounds = _sim_and_bounds(
+                net, keys, jnp.asarray(clo, jnp.float32), jnp.asarray(chi, jnp.float32),
+                sim_size, with_sim=keep_sim,
+            )
+            n = e - s
+            cand_c.append([np.asarray(c)[:n] for c in stats.candidates])
+            pos_c.append([np.asarray(p) [:n] for p in stats.positive_prob])
+            lb_c.append([np.asarray(b)[:n] for b in bounds.ws_lb])
+            ub_c.append([np.asarray(b)[:n] for b in bounds.ws_ub])
+            if keep_sim:
+                sim_c.append(np.asarray(sim)[:n])
 
     L = len(cand_c[0])
     _cat = lambda parts: [np.concatenate([p[l] for p in parts]) for l in range(L)]
@@ -141,24 +145,25 @@ def sound_prune_grid(
     s_deads = [np.zeros_like(c) for c in candidates]
     certified = b_deads
     if exact_certify:
-        from fairify_tpu.ops import exact_native
+        with obs.span("prune.exact_certify", partitions=P):
+            from fairify_tpu.ops import exact_native
 
-        weights = [np.asarray(w) for w in net.weights]
-        biases = [np.asarray(b) for b in net.biases]
-        batched = exact_native.certify_dead_batch(weights, biases, lo, hi, candidates)
-        if batched is not None:
-            certified = batched[: len(candidates)]
-        else:
-            certified = []
-            for p in range(P):
-                cert = exact_ops.certify_dead_masks(
-                    weights, biases, lo[p], hi[p], [c[p] for c in candidates]
-                )
-                certified.append(cert)
-            certified = [
-                np.stack([certified[p][l] for p in range(P)]) for l in range(len(candidates))
-            ]
-        s_deads = [np.maximum(c - b, 0.0) for c, b in zip(certified, b_deads)]
+            weights = [np.asarray(w) for w in net.weights]
+            biases = [np.asarray(b) for b in net.biases]
+            batched = exact_native.certify_dead_batch(weights, biases, lo, hi, candidates)
+            if batched is not None:
+                certified = batched[: len(candidates)]
+            else:
+                certified = []
+                for p in range(P):
+                    cert = exact_ops.certify_dead_masks(
+                        weights, biases, lo[p], hi[p], [c[p] for c in candidates]
+                    )
+                    certified.append(cert)
+                certified = [
+                    np.stack([certified[p][l] for p in range(P)]) for l in range(len(candidates))
+                ]
+            s_deads = [np.maximum(c - b, 0.0) for c, b in zip(certified, b_deads)]
     sv_time = time.perf_counter() - t0
 
     merged = [np.maximum(b, s) for b, s in zip(b_deads, s_deads)]

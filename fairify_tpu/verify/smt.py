@@ -29,6 +29,7 @@ except ImportError:
     z3 = None
     HAVE_Z3 = False
 
+from fairify_tpu import obs
 from fairify_tpu.models.mlp import MLP, excise
 from fairify_tpu.verify.property import PairEncoding
 
@@ -98,18 +99,24 @@ def decide_box_smt(
     yp = _z3_net(xp, weights, biases)
     s.add(z3.Or(z3.And(y < 0, yp > 0), z3.And(y > 0, yp < 0)))
 
-    res = s.check()
-    if res == z3.sat:
-        m = s.model()
+    with obs.span("smt.z3_query", timeout_s=soft_timeout_s, dims=d) as sp:
+        res = s.check()
+        if res == z3.sat:
+            verdict = "sat"
+            m = s.model()
 
-        def val(v):
-            return int(m.eval(v, model_completion=True).as_long())
+            def val(v):
+                return int(m.eval(v, model_completion=True).as_long())
 
-        return "sat", (np.array([val(v) for v in x], dtype=np.int64),
-                       np.array([val(v) for v in xp], dtype=np.int64))
-    if res == z3.unsat:
-        return "unsat", None
-    return "unknown", None
+            ce = (np.array([val(v) for v in x], dtype=np.int64),
+                  np.array([val(v) for v in xp], dtype=np.int64))
+        elif res == z3.unsat:
+            verdict, ce = "unsat", None
+        else:
+            verdict, ce = "unknown", None
+        sp.set(verdict=verdict)
+    obs.registry().counter("smt_queries").inc(verdict=verdict)
+    return verdict, ce
 
 
 # ---------------------------------------------------------------------------

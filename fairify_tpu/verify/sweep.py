@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from fairify_tpu import obs
 from fairify_tpu.data import loaders
 from fairify_tpu.models import mlp as mlp_mod
 from fairify_tpu.models import zoo
@@ -450,7 +451,35 @@ def verify_model(
     host_index=None,
     host_count=None,
 ) -> ModelReport:
-    """Run the full sweep for one model; write CSV + ledger rows as we go."""
+    """Run the full sweep for one model; write CSV + ledger rows as we go.
+
+    ``cfg.trace_out`` activates the obs span tracer for this call unless an
+    outer scope (CLI ``--trace-out``, ``run_sweep``) already owns one; the
+    model-level span carries the final verdict counts as attributes.
+    """
+    with obs.maybe_tracing(cfg.trace_out, run_id=f"{cfg.name}-{model_name}"):
+        with obs.span("verify_model", model=model_name, dataset=cfg.dataset,
+                      preset=cfg.name) as sp:
+            rep = _verify_model_impl(
+                net, cfg, model_name, dataset, mesh, resume, retry_unknown,
+                stage0, partition_span, host_index, host_count)
+            sp.set(partitions=rep.partitions_total, **rep.counts)
+            return rep
+
+
+def _verify_model_impl(
+    net,
+    cfg: SweepConfig,
+    model_name: str,
+    dataset: Optional[loaders.LoadedDataset],
+    mesh,
+    resume: bool,
+    retry_unknown: bool,
+    stage0,
+    partition_span,
+    host_index,
+    host_count,
+) -> ModelReport:
     from fairify_tpu.utils.cache import enable_persistent_cache
 
     enable_persistent_cache()
@@ -498,20 +527,24 @@ def verify_model(
 
     counter = ThroughputCounter(n_devices=1 if mesh is None else int(np.prod(list(mesh.shape.values()))))
     launch0 = profiling.launch_count()
+    heartbeat = obs.Heartbeat(cfg.heartbeat_s, total=P, label=sink_name) \
+        if cfg.heartbeat_s > 0 else None
     with xla_trace(cfg.profile_dir):
-        with timer.phase("stage0_prune"):
+        with obs.timed_span(timer, "stage0_prune", partitions=P):
             prune = pruning.sound_prune_grid(
                 net, lo, hi, cfg.sim_size, cfg.seed,
                 exact_certify=cfg.exact_certify_masks, chunk=cfg.grid_chunk,
                 index_offset=span_start, keep_sim=False,
             )
-        with timer.phase("stage0_decide"):
+        with obs.timed_span(timer, "stage0_decide", partitions=P) as sp0:
             if stage0 is not None:  # precomputed by the stacked family kernel
                 unsat0, sat0, witnesses = stage0
+                sp0.set(precomputed=True)
             else:
                 unsat0, sat0, witnesses = _stage0_certify_and_attack(
                     net, enc, lo, hi, cfg, mesh=mesh, seed_offset=span_start)
-        with timer.phase("stage0_parity"):
+            sp0.set(unsat=int(unsat0.sum()), sat=int(sat0.sum()))
+        with obs.timed_span(timer, "stage0_parity"):
             step, spans = _chunk_spans(P, cfg.grid_chunk)
             parity = np.empty(P, dtype=np.float32)
             for s, e in spans:
@@ -547,7 +580,7 @@ def verify_model(
         # found by batched PGD in one jit, sparing those roots the BaB tree.
         pgd_covered_all = False  # every pending root got the deep PGD pass
         if pending:
-            with timer.phase("stage0_pgd"):
+            with obs.timed_span(timer, "stage0_pgd", pending=len(pending)):
                 pgd_wit = {}
                 pgd_covered_all = True
                 # The slab refinement below is serial host work (exact
@@ -624,7 +657,8 @@ def verify_model(
         if pending:
             hard_left = max(cfg.hard_timeout_s - timer.total(), 1.0)
             deadline = min(cfg.soft_timeout_s * len(pending), hard_left)
-            with timer.phase("bab"):
+            with obs.timed_span(timer, "bab", roots=len(pending),
+                                deadline_s=round(deadline, 3)):
                 decisions = engine.decide_many(
                     net, enc, lo[pending], hi[pending], cfg.engine,
                     deadline_s=deadline, mesh=mesh, attacked=pgd_covered_all,
@@ -633,12 +667,13 @@ def verify_model(
             # Per-phase attribution (VERDICT r3): where inside the engine
             # ladder the BaB seconds went, summed over roots — S (sign
             # frontier) / L (sign-phase host LP) / bab (input split) /
-            # P (pair LP) / E (lattice).  Lands in the throughput record.
+            # P (pair LP) / E (lattice).  Lands in the throughput record
+            # (raw floats; rounding happens at serialization).
             for ph in ("t_attack", "t_sign", "t_lp", "t_bab", "t_pair",
                        "t_lattice"):
                 tot = sum(d.stats.get(ph, 0.0) for d in decisions)
                 if tot > 0.0:
-                    timer.phases[f"engine_{ph[2:]}"] = round(tot, 3)
+                    timer.phases[f"engine_{ph[2:]}"] = tot
     cumulative = timer.total()
 
     orig_acc = 0.0
@@ -675,6 +710,11 @@ def verify_model(
             counts = {"sat": sat_count, "unsat": unsat_count, "unknown": unk_count}
             counts[rec["verdict"]] += 1
             sat_count, unsat_count, unk_count = counts["sat"], counts["unsat"], counts["unknown"]
+            obs.event("verdict", model=model_name, partition_id=pid,
+                      verdict=rec["verdict"], via="ledger")
+            if heartbeat is not None:
+                heartbeat.beat(decided=sat_count + unsat_count,
+                               attempted=len(outcomes), unknown=unk_count)
             continue
         t_part = time.perf_counter()
         dead = pruning.partition_masks(prune, p)
@@ -696,6 +736,7 @@ def verify_model(
                 # Heuristic retry: kill borderline-quiet neurons, re-decide on
                 # the masked net (``src/GC/Verify-GC.py:172-211``).
                 h_attempt = 1
+                obs.registry().counter("unknown_retries").inc()
                 t_h = time.perf_counter()
                 h_dead, merged = heur_ops.heuristic_prune(
                     [l[p] for l in prune.ws_lb], [l[p] for l in prune.ws_ub],
@@ -742,11 +783,18 @@ def verify_model(
         else:
             unk_count += 1
         counter.record(verdict, via_stage0=bool(sat0[p] or unsat0[p]))
+        if h_success:
+            obs.registry().counter("unknown_retry_success").inc()
+        obs.event("verdict", model=model_name, partition_id=pid,
+                  verdict=verdict,
+                  via="stage0" if (sat0[p] or unsat0[p])
+                  else ("heuristic" if h_success else "bab"))
 
         # Per-row accounting: amortized stage-0 share + this row's attributed
         # BaB cost (sv_time) + its own loop work (heuristic retry, replay).
         total_time = stage0_per_part + sv_time + (time.perf_counter() - t_part)
         cumulative += time.perf_counter() - t_part
+        obs.registry().histogram("partition_latency_s").observe(total_time)
         comp = {
             "b": mask_ops.compression_ratio([l[p] for l in prune.b_deads]),
             "s": mask_ops.compression_ratio([l[p] for l in prune.s_deads]),
@@ -762,6 +810,9 @@ def verify_model(
             pruned_acc=pruned_acc,
         )
         outcomes.append(out)
+        if heartbeat is not None:
+            heartbeat.beat(decided=sat_count + unsat_count,
+                           attempted=len(outcomes), unknown=unk_count)
 
         if pm is not None:
             # Reference artifact shape (``src/CP/Verify-CP.py:448-458``):
@@ -854,6 +905,9 @@ def verify_model(
     counter.launches = profiling.launch_count() - launch0
     counter.dump(os.path.join(cfg.result_dir, f"{cfg.name}-{sink_name}.throughput.json"),
                  phases=timer.phases)
+    if heartbeat is not None:  # final line regardless of throttle state
+        heartbeat.beat(decided=sat_count + unsat_count, attempted=len(outcomes),
+                       unknown=unk_count, force=True)
     return ModelReport(
         model=model_name, dataset=cfg.dataset, outcomes=outcomes,
         original_acc=orig_acc, total_time_s=timer.total(), partitions_total=P,
@@ -876,6 +930,16 @@ def run_sweep(
     span of every model (family stacking is disabled — stage-0 results are
     span-local).
     """
+    with obs.maybe_tracing(cfg.trace_out, run_id=cfg.name):
+        with obs.span("run_sweep", preset=cfg.name, dataset=cfg.dataset) as sp:
+            reports = _run_sweep_impl(cfg, model_root, data_root, mesh, stack,
+                                      host_index, host_count, retry_unknown)
+            sp.set(models=len(reports))
+            return reports
+
+
+def _run_sweep_impl(cfg, model_root, data_root, mesh, stack,
+                    host_index, host_count, retry_unknown) -> List[ModelReport]:
     import sys
 
     from fairify_tpu.utils.cache import enable_persistent_cache
@@ -909,7 +973,10 @@ def run_sweep(
             if len(names) < 2:
                 continue
             stacked = stack_models([nets[n] for n in names])
-            for name, s0 in zip(names, _stage0_family(stacked, enc, lo, hi, cfg, mesh=mesh)):
+            with obs.span("stage0_family", models=len(names),
+                          partitions=int(lo.shape[0])):
+                fam = _stage0_family(stacked, enc, lo, hi, cfg, mesh=mesh)
+            for name, s0 in zip(names, fam):
                 stage0_by_model[name] = s0
 
     reports = []

@@ -60,7 +60,9 @@ def run_and_record(cfg, run_id: str, results_path: str, extra=None,
     """Sweep every not-yet-recorded zoo model under ``cfg``; append records.
 
     Returns the newly appended records (verified rows plus ``skipped``
-    markers for width-mismatched models).
+    markers for width-mismatched models).  Observability flows through the
+    config: set ``cfg.trace_out`` / ``cfg.heartbeat_s`` and
+    ``sweep.run_sweep`` owns the tracer scope.
     """
     from fairify_tpu.models import zoo
     from fairify_tpu.verify import sweep
@@ -113,6 +115,10 @@ def budgeted_model_sweep(cfg, net, model_name: str, dataset=None,
                          ledger_tag=None):
     """Attempt-until-hard-budget semantics over the full grid (one model).
 
+    ``cfg.trace_out`` / ``cfg.heartbeat_s`` flow through: one obs tracer
+    scope covers every span of the budgeted run (the per-span
+    ``verify_model`` calls see the active tracer and nest under it).
+
     The reference's variant drivers iterate the shuffled partition list and
     break when cumulative time passes HARD_TIMEOUT, leaving the tail
     *unattempted* (``stress/GC/Verify-GC.py:31-35``; Table V's Cov%% column).
@@ -135,6 +141,20 @@ def budgeted_model_sweep(cfg, net, model_name: str, dataset=None,
     if ledger_tag:
         sub += f"-{ledger_tag}"
     cfg = cfg.with_(result_dir=os.path.join(cfg.result_dir, sub))
+    from fairify_tpu import obs
+
+    with obs.maybe_tracing(cfg.trace_out,
+                           run_id=f"{cfg.name}-{model_name}-budgeted"):
+        with obs.span("budgeted_model_sweep", preset=cfg.name,
+                      model=model_name, budget_s=cfg.hard_timeout_s) as sp:
+            row = _budgeted_model_sweep_impl(cfg, net, model_name, dataset)
+            sp.set(attempted=row["attempted"], unknown=row["unknown"])
+            return row
+
+
+def _budgeted_model_sweep_impl(cfg, net, model_name, dataset):
+    from fairify_tpu.verify import sweep
+
     _, lo, hi = sweep.build_partitions(cfg)
     P = lo.shape[0]
     t0 = time.perf_counter()

@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Observability lint: timing/progress in ``fairify_tpu/`` must use the obs layer.
+
+Fast AST-based check (no imports of the package, runs in milliseconds; wired
+into the tier-1 test run via ``tests/test_observability.py``).  Two rules:
+
+* **No raw ``time.time()``** — wall-clock subtraction for timing belongs in
+  ``PhaseTimer`` / obs spans (monotonic clocks, rounding only at
+  serialization).  The one sanctioned caller is the obs layer's own clock
+  shim (``obs/trace.py``, wall-clock span timestamps).
+* **No bare ``print()``** for timing/progress — progress lines go through
+  ``obs.heartbeat`` (throttled) and structured results through the event
+  log.  Allowlisted: the CLI and report renderer (user-facing output is
+  their job), the heartbeat itself, and two legacy shims that predate the
+  obs layer (``verify/sweep.py``'s stderr skip warning,
+  ``verify/exact_check.py``'s debug prints — shrink, don't grow, this list).
+
+AST-based, so docstrings/comments mentioning the patterns don't trip it.
+``scripts/`` and ``tests/`` are out of scope: the rule protects the
+library's hot paths, not one-off harnesses.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+# Paths are repo-relative, '/'-separated.
+ALLOW_TIME_TIME = {
+    "fairify_tpu/obs/trace.py",  # the obs layer's wall-clock shim
+}
+ALLOW_PRINT = {
+    "fairify_tpu/cli.py",            # user-facing command output
+    "fairify_tpu/obs/heartbeat.py",  # the sanctioned progress line
+    "fairify_tpu/obs/report.py",     # report renderer (CLI body)
+    "fairify_tpu/verify/sweep.py",   # legacy: stderr width-mismatch warning
+    "fairify_tpu/verify/exact_check.py",  # legacy: gated debug prints
+}
+
+
+def _is_time_time(node: ast.Call) -> bool:
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr == "time"
+            and isinstance(f.value, ast.Name) and f.value.id == "time")
+
+
+def _is_print(node: ast.Call) -> bool:
+    return isinstance(node.func, ast.Name) and node.func.id == "print"
+
+
+def check_file(path: str, rel: str) -> list:
+    with open(path) as fp:
+        src = fp.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as exc:
+        return [f"{rel}:{exc.lineno}: syntax error: {exc.msg}"]
+    errors = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_time_time(node) and rel not in ALLOW_TIME_TIME:
+            errors.append(
+                f"{rel}:{node.lineno}: raw time.time() — use "
+                f"time.perf_counter() via PhaseTimer/obs spans "
+                f"(or extend ALLOW_TIME_TIME for a sanctioned shim)")
+        elif _is_print(node) and rel not in ALLOW_PRINT:
+            errors.append(
+                f"{rel}:{node.lineno}: bare print() — progress goes through "
+                f"fairify_tpu.obs.heartbeat, structured output through the "
+                f"event log (or extend ALLOW_PRINT for user-facing output)")
+    return errors
+
+
+def main(argv=None) -> int:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pkg = os.path.join(root, "fairify_tpu")
+    errors = []
+    for dirpath, _dirnames, filenames in os.walk(pkg):
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            errors.extend(check_file(path, rel))
+    for e in errors:
+        print(e, file=sys.stderr)
+    if errors:
+        print(f"lint_obs: {len(errors)} violation(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,434 @@
+"""Obs layer: spans (threads, nesting, Chrome export), metrics, heartbeat,
+report CLI round-trip, launch-counter shims, lint, traced sweep end-to-end."""
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from fairify_tpu import obs
+from fairify_tpu.obs import heartbeat as hb_mod
+from fairify_tpu.obs import metrics as metrics_mod
+from fairify_tpu.obs import report as report_mod
+from fairify_tpu.obs import trace as trace_mod
+from fairify_tpu.utils import profiling
+from fairify_tpu.utils.timing import PhaseTimer
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state():
+    """Each test gets a quiescent registry and no active tracer."""
+    trace_mod.deactivate()
+    metrics_mod.registry().reset()
+    yield
+    trace_mod.deactivate()
+    metrics_mod.registry().reset()
+
+
+# ---------------------------------------------------------------------------
+# Spans
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_and_attributes(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    tr = trace_mod.Tracer(path, run_id="r1")
+    with tr.span("outer", model="m") as outer:
+        with tr.span("inner") as inner:
+            inner.set(verdict="unsat", n=3)
+    tr.close()
+
+    events = trace_mod.load_events(path)
+    assert events[0]["type"] == "meta" and events[0]["run_id"] == "r1"
+    spans = {e["name"]: e for e in events if e["type"] == "span"}
+    assert set(spans) == {"outer", "inner"}
+    # Inner closes first (JSONL order), nests under outer, keeps attrs.
+    assert spans["inner"]["parent_id"] == spans["outer"]["span_id"]
+    assert spans["outer"]["parent_id"] is None
+    assert spans["inner"]["attrs"] == {"verdict": "unsat", "n": 3}
+    assert spans["outer"]["attrs"] == {"model": "m"}
+    assert spans["inner"]["dur_s"] <= spans["outer"]["dur_s"]
+    # Closing record is a registry snapshot.
+    assert events[-1]["type"] == "metrics"
+
+
+def test_span_thread_safety(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    tr = trace_mod.Tracer(path)
+
+    def work(i):
+        with tr.span("worker", idx=i):
+            with tr.span("child", idx=i):
+                pass
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    tr.close()
+
+    spans = [e for e in trace_mod.load_events(path) if e["type"] == "span"]
+    workers = {e["attrs"]["idx"]: e for e in spans if e["name"] == "worker"}
+    children = {e["attrs"]["idx"]: e for e in spans if e["name"] == "child"}
+    assert len(workers) == len(children) == 8
+    for i in range(8):
+        # Parentage never crosses threads: each child nests under ITS
+        # thread's worker span and shares its tid.
+        assert children[i]["parent_id"] == workers[i]["span_id"]
+        assert children[i]["tid"] == workers[i]["tid"]
+
+
+def test_span_launch_delta_attribute(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    with trace_mod.tracing(path):
+        with obs.span("devwork"):
+            profiling.bump_launch(3)
+        with obs.span("hostwork"):
+            pass
+    spans = {e["name"]: e for e in trace_mod.load_events(path)
+             if e["type"] == "span"}
+    assert spans["devwork"]["attrs"]["launches"] == 3
+    assert "launches" not in spans["hostwork"]["attrs"]
+
+
+def test_disabled_spans_are_noops():
+    assert trace_mod.current() is None
+    with obs.span("nothing", a=1) as sp:
+        sp.set(b=2)  # must not raise, must not record anywhere
+    obs.event("verdict", verdict="sat")
+
+
+def test_chrome_trace_valid(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    with trace_mod.tracing(path):
+        with obs.span("phase_a"):
+            with obs.span("phase_b"):
+                pass
+        obs.event("verdict", verdict="sat")
+    chrome = trace_mod.chrome_trace_path(path)
+    assert chrome == str(tmp_path / "t.chrome.json")
+    with open(chrome) as fp:
+        doc = json.load(fp)
+    events = doc["traceEvents"]
+    complete = [e for e in events if e.get("ph") == "X"]
+    assert {e["name"] for e in complete} == {"phase_a", "phase_b"}
+    for e in complete:
+        assert e["ts"] >= 0 and e["dur"] >= 0 and isinstance(e["tid"], int)
+    assert any(e.get("ph") == "i" and e["name"] == "verdict" for e in events)
+
+
+def test_tracing_scope_nesting(tmp_path):
+    """An inner maybe_tracing must defer to the outer scope's tracer."""
+    outer_path = str(tmp_path / "outer.jsonl")
+    inner_path = str(tmp_path / "inner.jsonl")
+    with trace_mod.tracing(outer_path) as outer:
+        with trace_mod.maybe_tracing(inner_path) as inner:
+            assert inner is outer
+            with obs.span("nested"):
+                pass
+    assert not os.path.exists(inner_path)
+    assert any(e.get("name") == "nested"
+               for e in trace_mod.load_events(outer_path))
+
+
+# ---------------------------------------------------------------------------
+# Metrics registry
+# ---------------------------------------------------------------------------
+
+
+def test_counter_labels_and_reset():
+    reg = metrics_mod.MetricsRegistry()
+    c = reg.counter("decisions")
+    c.inc(verdict="sat")
+    c.inc(2, verdict="unsat")
+    assert c.value(verdict="sat") == 1
+    assert c.value(verdict="unsat") == 2
+    assert c.total() == 3
+    reg.reset()
+    assert c.total() == 0
+    # Registration survives reset: same object comes back.
+    assert reg.counter("decisions") is c
+
+
+def test_histogram_bucket_counts():
+    reg = metrics_mod.MetricsRegistry()
+    h = reg.histogram("lat", buckets=(1.0, 2.0, 5.0))
+    for v in (0.5, 1.5, 1.9, 4.0, 100.0):
+        h.observe(v)
+    assert h.counts() == [1, 2, 1, 1]  # ≤1, ≤2, ≤5, overflow
+    assert h.count() == 5
+    assert h.sum() == pytest.approx(107.9)
+    snap = h.snapshot()[0]
+    assert snap["buckets"] == [1.0, 2.0, 5.0]
+    assert snap["counts"] == [1, 2, 1, 1]
+
+
+def test_kind_collision_raises():
+    reg = metrics_mod.MetricsRegistry()
+    reg.counter("x")
+    with pytest.raises(TypeError):
+        reg.gauge("x")
+
+
+def test_launch_counter_shims_resettable():
+    profiling.reset_launches()
+    assert profiling.launch_count() == 0
+    profiling.bump_launch()
+    profiling.bump_launch(4)
+    assert profiling.launch_count() == 5
+    # The same count is visible through the registry instrument...
+    assert obs.registry().counter("device_launches").total() == 5
+    # ...and a per-run reset zeroes absolute reads (the old module-global
+    # accumulated forever).
+    profiling.reset_launches()
+    assert profiling.launch_count() == 0
+
+
+def test_throughput_counter_mirrors_registry():
+    from fairify_tpu.utils.profiling import ThroughputCounter
+
+    c = ThroughputCounter()
+    c.record("sat", via_stage0=True)
+    c.record("unsat", via_stage0=False)
+    c.record("unknown", via_stage0=False)
+    dec = obs.registry().counter("decisions")
+    assert dec.value(verdict="sat", via="stage0") == 1
+    assert dec.value(verdict="unsat", via="bab") == 1
+    assert dec.value(verdict="unknown", via="bab") == 1
+
+
+def test_phase_timer_get_returns_raw_float():
+    t = PhaseTimer()
+    t.phases["x"] = 0.123456789
+    assert t.get("x") == 0.123456789  # no 2-decimal rounding (serialization rounds)
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat
+# ---------------------------------------------------------------------------
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_heartbeat_throttles(capsys):
+    import io
+
+    clock = _FakeClock()
+    out = io.StringIO()
+    hb = hb_mod.Heartbeat(10.0, total=100, label="m", stream=out, clock=clock)
+    clock.t += 1.0
+    assert hb.beat(decided=1, attempted=1) is True  # first beat emits
+    clock.t += 5.0
+    assert hb.beat(decided=2, attempted=2) is False  # interval not elapsed
+    assert out.getvalue().count("\n") == 1
+    clock.t += 6.0
+    assert hb.beat(decided=3, attempted=3) is True
+    lines = out.getvalue().strip().splitlines()
+    assert len(lines) == 2
+    assert "3/100 attempted" in lines[1] and "eta" in lines[1]
+    # force=True bypasses the throttle (the sweep's final line).
+    assert hb.beat(decided=3, attempted=3, force=True) is True
+
+
+def test_heartbeat_disabled_interval():
+    import io
+
+    out = io.StringIO()
+    hb = hb_mod.Heartbeat(0.0, stream=out)
+    assert hb.beat(decided=1, attempted=1) is False
+    assert out.getvalue() == ""
+
+
+def test_heartbeat_launch_delta():
+    import io
+
+    clock = _FakeClock()
+    out = io.StringIO()
+    hb = hb_mod.Heartbeat(1.0, stream=out, clock=clock)
+    profiling.bump_launch(7)
+    clock.t += 2.0
+    hb.beat(decided=0, attempted=1)
+    assert "+7 launches" in out.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# Report CLI
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_log(path):
+    tr = trace_mod.Tracer(str(path), run_id="synth")
+    with tr.span("stage0_decide", partitions=4):
+        profiling.bump_launch(2)
+    for pid, v in ((1, "sat"), (2, "unsat"), (3, "unsat"), (4, "unknown")):
+        tr.event("verdict", model="m-1", partition_id=pid, verdict=v,
+                 via="stage0" if pid < 4 else "bab")
+    tr.close()
+
+
+def test_report_cli_roundtrip(tmp_path, capsys):
+    from fairify_tpu import cli
+
+    log = tmp_path / "run.jsonl"
+    _synthetic_log(log)
+    json_out = tmp_path / "agg.json"
+    rc = cli.main(["report", str(log), "--json-out", str(json_out)])
+    assert rc == 0
+    table = capsys.readouterr().out
+    assert "m-1" in table and "stage0_decide" in table
+    agg = json.loads(json_out.read_text())
+    assert agg["verdicts"] == {"sat": 1, "unsat": 2, "unknown": 1}
+    assert agg["decided"] == 3 and agg["attempted"] == 4
+    assert agg["models"]["m-1"]["unsat"] == 2
+    assert agg["phases"]["stage0_decide"]["launches"] == 2
+    assert agg["device_launches"] == 2  # from the closing metrics snapshot
+
+
+def test_report_cli_missing_file(tmp_path, capsys):
+    from fairify_tpu import cli
+
+    rc = cli.main(["report", str(tmp_path / "nope.jsonl")])
+    assert rc == 2
+
+
+def test_report_tolerates_truncated_line(tmp_path):
+    log = tmp_path / "run.jsonl"
+    _synthetic_log(log)
+    with open(log, "a") as fp:
+        fp.write('{"type": "event", "name": "verdi')  # crash mid-write
+    agg = report_mod.aggregate([str(log)])
+    assert agg["attempted"] == 4
+
+
+def test_report_dedupes_resumed_and_retried_partitions(tmp_path):
+    """A resumed run appends ledger replays (and a retry re-decides an
+    unknown) to the same log; each partition must count exactly once, with
+    the LAST record winning."""
+    log = tmp_path / "run.jsonl"
+    tr = trace_mod.Tracer(str(log))
+    tr.event("verdict", model="m", partition_id=1, verdict="sat", via="stage0")
+    tr.event("verdict", model="m", partition_id=2, verdict="unknown", via="bab")
+    tr.close()
+    tr2 = trace_mod.Tracer(str(log))  # resumed run, same file (append)
+    tr2.event("verdict", model="m", partition_id=1, verdict="sat", via="ledger")
+    tr2.event("verdict", model="m", partition_id=2, verdict="unsat", via="bab")
+    tr2.close()
+    agg = report_mod.aggregate([str(log)])
+    assert agg["attempted"] == 2
+    assert agg["verdicts"] == {"sat": 1, "unsat": 1, "unknown": 0}
+    # The 'via' breakdown covers decided partitions only and reflects the
+    # winning records.
+    assert agg["via"] == {"ledger": 1, "bab": 1}
+
+
+def test_report_via_excludes_unknowns(tmp_path):
+    log = tmp_path / "run.jsonl"
+    tr = trace_mod.Tracer(str(log))
+    tr.event("verdict", model="m", partition_id=1, verdict="unsat", via="bab")
+    tr.event("verdict", model="m", partition_id=2, verdict="unknown", via="bab")
+    tr.close()
+    agg = report_mod.aggregate([str(log)])
+    assert agg["via"] == {"bab": 1}  # unknowns are not "decided via" anything
+
+
+def test_metrics_snapshot_is_per_run_delta(tmp_path):
+    """Launches bumped BEFORE the tracer opens (warm-up pass, earlier runs)
+    must not appear in the closing metrics record."""
+    profiling.bump_launch(50)  # pre-run noise
+    log = tmp_path / "run.jsonl"
+    with trace_mod.tracing(str(log)):
+        profiling.bump_launch(4)
+    agg = report_mod.aggregate([str(log)])
+    assert agg["device_launches"] == 4
+    # Two runs appended to one file: their per-run deltas sum.
+    with trace_mod.tracing(str(log)):
+        profiling.bump_launch(3)
+    agg = report_mod.aggregate([str(log)])
+    assert agg["device_launches"] == 7
+
+
+def test_snapshot_delta_histograms_and_gauges():
+    reg = metrics_mod.MetricsRegistry()
+    h = reg.histogram("lat", buckets=(1.0,))
+    g = reg.gauge("g")
+    h.observe(0.5)
+    g.set(10)
+    before = reg.snapshot()
+    h.observe(2.0)
+    g.set(20)
+    delta = metrics_mod.snapshot_delta(before, reg.snapshot())
+    s = delta["lat"]["series"][0]
+    assert s["counts"] == [0, 1] and s["count"] == 1
+    assert s["sum"] == pytest.approx(2.0)
+    assert delta["g"]["series"][0]["value"] == 20  # gauges: last write wins
+
+
+# ---------------------------------------------------------------------------
+# Lint + end-to-end traced sweep
+# ---------------------------------------------------------------------------
+
+
+def test_lint_obs_clean():
+    """The obs lint (tier-1-wired) passes on the current tree."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(root, "scripts"))
+    try:
+        import lint_obs
+    finally:
+        sys.path.pop(0)
+    assert lint_obs.main([]) == 0
+
+
+def test_traced_sweep_matches_report(tmp_path, monkeypatch):
+    """Acceptance: a traced sweep writes JSONL + Chrome trace whose spans
+    cover the stage-0 phases and whose report reproduces the ModelReport."""
+    from fairify_tpu.data import domains as dom_mod
+    from fairify_tpu.data.domains import DomainSpec
+    from fairify_tpu.verify import engine, sweep
+    from fairify_tpu.verify.config import SweepConfig
+    from fairify_tpu.verify.oracle import random_net
+
+    dom = DomainSpec(name="tinyobs", label="y",
+                     ranges={"a": (0, 9), "pa": (0, 1), "b": (0, 4)})
+    monkeypatch.setitem(dom_mod.DOMAINS, "tinyobs", dom)
+    trace_path = str(tmp_path / "run.jsonl")
+    cfg = SweepConfig(
+        name="tinyobs", dataset="tinyobs", protected=("pa",),
+        partition_threshold=5, sim_size=64, soft_timeout_s=30.0,
+        hard_timeout_s=600.0, result_dir=str(tmp_path),
+        trace_out=trace_path,
+        engine=engine.EngineConfig(frontier_size=64, attack_samples=32,
+                                   bab_attack_samples=8, soft_timeout_s=30.0))
+    net = random_net(np.random.default_rng(7), (3, 6, 1))
+    report = sweep.verify_model(net, cfg, model_name="tiny-1")
+
+    events = trace_mod.load_events(trace_path)
+    names = {e["name"] for e in events if e["type"] == "span"}
+    assert {"verify_model", "stage0_prune", "stage0_decide",
+            "stage0_parity"} <= names
+    model_span = next(e for e in events if e["type"] == "span"
+                      and e["name"] == "verify_model")
+    assert model_span["attrs"]["partitions"] == report.partitions_total
+    # Device work is attributed: some span carries a launches attr.
+    assert any(e["attrs"].get("launches", 0) > 0
+               for e in events if e["type"] == "span")
+
+    # Chrome trace loads and covers the same spans.
+    with open(trace_mod.chrome_trace_path(trace_path)) as fp:
+        doc = json.load(fp)
+    assert {"verify_model", "stage0_decide"} <= {
+        e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"}
+
+    # `report` over the log reproduces the run's verdict counts.
+    agg = report_mod.aggregate([trace_path])
+    assert agg["verdicts"] == report.counts
+    assert agg["attempted"] == len(report.outcomes)
