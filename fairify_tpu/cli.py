@@ -74,6 +74,8 @@ def _overridden_cfg(args):
         overrides["trace_out"] = args.trace_out
     if getattr(args, "heartbeat_interval", None) is not None:
         overrides["heartbeat_s"] = float(args.heartbeat_interval)
+    if getattr(args, "pipeline_depth", None) is not None:
+        overrides["pipeline_depth"] = int(args.pipeline_depth)
     return cfg.with_(**overrides) if overrides else cfg
 
 
@@ -284,6 +286,9 @@ def main(argv=None) -> int:
     run.add_argument("--trace-out", default=None,
                      help="write a JSONL span/event log here plus a Chrome "
                           "trace alongside (<path>.chrome.json)")
+    run.add_argument("--pipeline-depth", type=int, default=None,
+                     help="async launch pipeline depth (chunk launches kept "
+                          "in flight; 1 = synchronous, default 2)")
     run.add_argument("--heartbeat-interval", type=float, default=None,
                      help="stderr progress line every N seconds (0 = off)")
 
@@ -321,6 +326,8 @@ def main(argv=None) -> int:
                      help="also write the summary JSON to this file")
     exp.add_argument("--save-fairer", default=None,
                      help="write the repaired model as Keras-compatible .h5")
+    exp.add_argument("--pipeline-depth", type=int, default=None,
+                     help="async launch pipeline depth (1 = synchronous)")
     exp.add_argument("--trace-out", default=None,
                      help="write a JSONL span/event log here plus a Chrome "
                           "trace alongside (<path>.chrome.json)")

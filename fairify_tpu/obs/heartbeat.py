@@ -9,9 +9,13 @@ a sweep was alive.  The heartbeat prints a single line at most once per
 
 Throttling is clock-based (no output when the interval has not elapsed),
 so per-partition call sites can beat unconditionally.  The launch delta
-comes from the ``device_launches`` counter; ETA extrapolates the measured
-attempt rate over the remaining partitions.  This module is the obs
-layer's sanctioned progress ``print`` (see ``scripts/lint_obs.py``).
+comes from the ``device_launches`` counter; ETA extrapolates a RECENT
+attempt rate (EMA over the last emitted beats) over the remaining
+partitions — the whole-run mean lies by design on budgeted sweeps, where
+the stage-0 burst (thousands of partitions per launch) is followed by the
+BaB tail (seconds per partition): a mean-based ETA then promises minutes
+while hours remain.  This module is the obs layer's sanctioned progress
+``print`` (see ``scripts/lint_obs.py``).
 """
 from __future__ import annotations
 
@@ -25,6 +29,11 @@ from fairify_tpu.obs import metrics as metrics_mod
 class Heartbeat:
     """Throttled progress reporter; ``interval_s <= 0`` disables it."""
 
+    # Recent-rate EMA weight for the ETA: one beat-to-beat window carries
+    # this much, history the rest — after a phase transition (stage-0 →
+    # BaB) the ETA converges to the new rate within a few beats.
+    ETA_ALPHA = 0.5
+
     def __init__(self, interval_s: float, total: Optional[int] = None,
                  label: str = "", stream=None,
                  clock: Callable[[], float] = time.monotonic):
@@ -36,6 +45,8 @@ class Heartbeat:
         self._start = clock()
         self._last: Optional[float] = None
         self._last_launches = self._launches()
+        self._last_attempted: Optional[int] = None
+        self._rate_ema: Optional[float] = None
 
     @staticmethod
     def _launches() -> float:
@@ -66,10 +77,20 @@ class Heartbeat:
         parts.append(f"| {decided} decided, {unknown} unknown")
         parts.append(f"| {pps:.2f} pps")
         parts.append(f"| +{d_launch} launches")
+        if self._last is not None and now > self._last:
+            # Fold this beat's window into the recent-rate EMA (the first
+            # beat has no window → whole-run-mean fallback below).
+            inst = max(attempted - (self._last_attempted or 0), 0) \
+                / (now - self._last)
+            self._rate_ema = inst if self._rate_ema is None else (
+                self.ETA_ALPHA * inst + (1.0 - self.ETA_ALPHA) * self._rate_ema)
         if self.total and attempted and attempted < self.total:
-            rate = attempted / elapsed
-            parts.append(f"| eta {(self.total - attempted) / rate:.0f}s")
+            rate = self._rate_ema if self._rate_ema is not None \
+                else attempted / elapsed
+            if rate > 0:
+                parts.append(f"| eta {(self.total - attempted) / rate:.0f}s")
         print(" ".join(parts), file=self.stream or sys.stderr, flush=True)
         self._last = now
+        self._last_attempted = attempted
         self._last_launches = launches
         return True

@@ -45,6 +45,8 @@ def aggregate(paths: Iterable[str]) -> dict:
     phases: Dict[str, dict] = {}
     span_count = 0
     launches = 0.0
+    inflight_max = 0.0
+    inflight_means: List[float] = []
     files = 0
     keyed: Dict[tuple, dict] = {}  # (model, partition_id) -> attrs, last wins
     anon: List[dict] = []  # verdict events without a partition id
@@ -73,6 +75,18 @@ def aggregate(paths: Iterable[str]) -> dict:
                 # runs appended to one file sum correctly.
                 launches += _counter_total(rec.get("metrics", {}),
                                            "device_launches")
+                # Async-pipeline overlap gauge (labels stat=max / stat=mean,
+                # last-write-wins per run): across runs, aggregate the peak
+                # of the maxes and the unweighted average of per-run means
+                # (per-run drain durations aren't in the snapshot, so a
+                # time-weighted cross-run mean isn't reconstructible).
+                for s in rec.get("metrics", {}).get(
+                        "launches_in_flight", {}).get("series", []):
+                    stat = dict(s.get("labels", {})).get("stat")
+                    if stat == "max":
+                        inflight_max = max(inflight_max, s.get("value", 0))
+                    elif stat == "mean":
+                        inflight_means.append(s.get("value", 0))
 
     models: Dict[str, dict] = {}
     verdicts = {"sat": 0, "unsat": 0, "unknown": 0}
@@ -99,6 +113,10 @@ def aggregate(paths: Iterable[str]) -> dict:
         "via": via,
         "models": models,
         "device_launches": int(launches),
+        "launches_in_flight_max": int(inflight_max),
+        "launches_in_flight_mean": round(
+            sum(inflight_means) / len(inflight_means), 3)
+        if inflight_means else 0.0,
     }
 
 
@@ -107,6 +125,10 @@ def render(agg: dict) -> str:
     lines: List[str] = []
     lines.append(f"event logs: {agg['files']}   spans: {agg['span_count']}   "
                  f"device launches: {agg['device_launches']}")
+    if agg.get("launches_in_flight_max"):
+        lines.append(f"launches in flight: max {agg['launches_in_flight_max']}"
+                     f"   mean {agg['launches_in_flight_mean']:.2f}"
+                     f"   (async pipeline overlap)")
     if agg["phases"]:
         w = max(len(k) for k in agg["phases"])
         lines.append("")

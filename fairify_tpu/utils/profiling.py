@@ -100,9 +100,17 @@ class ThroughputCounter:
             "device_launches": self.launches,
         }
 
-    def dump(self, path: str, phases: Optional[Dict[str, float]] = None) -> None:
+    def dump(self, path: str, phases: Optional[Dict[str, float]] = None,
+             pipeline: Optional[Dict[str, float]] = None) -> None:
         out = self.summary()
         if phases:
             out["phases_s"] = {k: round(v, 3) for k, v in phases.items()}
+        if pipeline:
+            # Async-dispatch overlap record (parallel.pipeline): configured
+            # depth plus the max / time-weighted-mean launches actually in
+            # flight — the evidence the sweep hid its launch round-trips.
+            out["pipeline_depth"] = int(pipeline.get("depth", 1))
+            out["launches_in_flight_max"] = int(pipeline.get("max", 0))
+            out["launches_in_flight_mean"] = float(pipeline.get("mean", 0.0))
         with open(path, "w") as fp:
             json.dump(out, fp, indent=2)

@@ -36,6 +36,13 @@ class SweepConfig:
     # Stage-0 kernels process the grid in fixed-size partition chunks so HBM
     # stays bounded on huge grids (adult: 16k partitions); 0 = whole grid.
     grid_chunk: int = 2048
+    # Async launch pipeline depth (parallel.pipeline.LaunchPipeline): how
+    # many chunk launches may be in flight before the oldest is drained.
+    # 2 overlaps each chunk's host decode (flip extraction, exact replay,
+    # ledger writes) with the next chunk's device work; 1 restores strict
+    # synchronous order.  Verdict maps are depth-invariant (chunk RNG
+    # streams are keyed to global chunk starts, not fetch order).
+    pipeline_depth: int = 2
     engine: EngineConfig = field(default_factory=EngineConfig)
     result_dir: str = "res"
     profile_dir: Optional[str] = None  # XLA trace output (TensorBoard/XProf)

@@ -391,7 +391,7 @@ def _enc_tensors(enc: PairEncoding, d: int):
     return assign_vals, pa_mask, ra_mask
 
 
-def pgd_attack(
+def pgd_attack_submit(
     net: MLP,
     enc: PairEncoding,
     lo: np.ndarray,
@@ -399,14 +399,15 @@ def pgd_attack(
     rng: np.random.Generator,
     steps: int = 30,
     restarts: int = 32,
-    return_points: bool = False,
 ):
-    """Gradient attack over a batch of boxes → exact-validated witnesses.
+    """Dispatch one PGD attack launch without syncing on its results.
 
-    Returns ``{box_index: (x, xp)}`` for every box where a rounded PGD point
-    is a genuine strict flip (checked in exact arithmetic).  The batch is
-    padded to the next power of two so the scan+grad kernel compiles once
-    per (net, padded-size), not once per leftover count.
+    Returns ``(payload, ctx)`` for :class:`parallel.pipeline.LaunchPipeline`:
+    ``payload`` is the kernel's device-array tuple (materializing
+    asynchronously), ``ctx`` the host-side state :func:`pgd_attack_decode`
+    needs.  The batch is padded to the next power of two so the scan+grad
+    kernel compiles once per (net, padded-size), not once per leftover
+    count.
     """
     lo = np.asarray(lo, dtype=np.int64)
     hi = np.asarray(hi, dtype=np.int64)
@@ -423,18 +424,25 @@ def pgd_attack(
         valid = np.zeros((pad_to, enc.n_assign), dtype=bool)
     key = jax.random.PRNGKey(int(rng.integers(2**31)))
     profiling.bump_launch()
-    fx, fp, x, xp = _pgd_attack_kernel(
+    payload = _pgd_attack_kernel(
         net,
         jnp.asarray(lo_p, jnp.float32), jnp.asarray(hi_p, jnp.float32),
         jnp.asarray(assign_vals), jnp.asarray(pa_mask), jnp.asarray(ra_mask),
         jnp.asarray(valid), float(enc.eps), key, steps, restarts,
     )
-    found, wit = find_flips(enc, np.asarray(fx), np.asarray(fp), valid)
+    ctx = {"net": net, "enc": enc, "valid": valid, "B": B, "pad_to": pad_to}
+    return payload, ctx
+
+
+def pgd_attack_decode(host_payload, ctx, return_points: bool = False):
+    """Host decode of a drained PGD launch: flip extraction + exact checks."""
+    fx, fp, x, xp = (np.asarray(v) for v in host_payload)
+    enc, valid, B, pad_to = ctx["enc"], ctx["valid"], ctx["B"], ctx["pad_to"]
+    found, wit = find_flips(enc, fx, fp, valid)
+    net = ctx["net"]
     weights = [np.asarray(w) for w in net.weights]
     biases = [np.asarray(b) for b in net.biases]
-    witnesses = extract_witnesses(
-        found, wit, np.asarray(x), np.asarray(xp), weights, biases, limit=B
-    )
+    witnesses = extract_witnesses(found, wit, x, xp, weights, biases, limit=B)
     if not return_points:
         return witnesses
     # Per box, the role point with the smallest |logit| among valid
@@ -445,9 +453,33 @@ def pgd_attack(
     idx = flat.argmin(axis=1)
     V = fx_np.shape[2]
     si, vi = np.divmod(idx, V)
-    pts = np.asarray(x)[np.arange(pad_to), si, vi][:B]
+    pts = x[np.arange(pad_to), si, vi][:B]
     best_abs = flat[np.arange(pad_to), idx][:B]
     return witnesses, pts, best_abs
+
+
+def pgd_attack(
+    net: MLP,
+    enc: PairEncoding,
+    lo: np.ndarray,
+    hi: np.ndarray,
+    rng: np.random.Generator,
+    steps: int = 30,
+    restarts: int = 32,
+    return_points: bool = False,
+):
+    """Gradient attack over a batch of boxes → exact-validated witnesses.
+
+    Returns ``{box_index: (x, xp)}`` for every box where a rounded PGD point
+    is a genuine strict flip (checked in exact arithmetic).  Synchronous
+    composition of :func:`pgd_attack_submit` + :func:`pgd_attack_decode`;
+    pipelined callers use the split form so the next chunk's launch is in
+    flight while this one's witnesses are validated.
+    """
+    payload, ctx = pgd_attack_submit(net, enc, lo, hi, rng,
+                                     steps=steps, restarts=restarts)
+    return pgd_attack_decode(jax.device_get(payload), ctx,
+                             return_points=return_points)
 
 
 def extract_witnesses(found, wit, x_cand, xp_cand, weights, biases, limit=None) -> dict:
@@ -1016,6 +1048,11 @@ class EngineConfig:
     # (total bounded by 40% of the batch deadline).  Exact either way.
     lattice_first_max: float = 6.4e7
     lattice_first_cap_s: float = 5.0
+    # Async launch pipeline depth for the engine's independent-batch loops
+    # (Phase A PGD chunks): how many chunk launches stay in flight while the
+    # host validates the previous chunk's witnesses.  The sweep syncs this
+    # to SweepConfig.pipeline_depth; 1 restores synchronous order.
+    pipeline_depth: int = 2
 
 
 @dataclass
@@ -1116,19 +1153,46 @@ def decide_many(
             CH = min(1024, 1 << max(R - 1, 0).bit_length())
             # Budget guard: the attack must never eat the certificate phases'
             # deadline — cap it at a quarter and stop between chunks.
+            # Chunks are independent roots, so they ride the async launch
+            # pipeline: chunk N+1's scan+grad kernel is in flight while
+            # chunk N's witnesses go through exact validation on host.
+            # Submission order is the synchronous order, so the shared
+            # ``rng_a`` stream (consumed at submit time) is depth-invariant.
+            from fairify_tpu.parallel.pipeline import LaunchPipeline
+
+            pipe = LaunchPipeline(cfg.pipeline_depth, gauge=False)
+
+            def _consume(meta, ctx, host):
+                s_blk, n_blk = meta
+                for i, ce in pgd_attack_decode(host, ctx).items():
+                    if i < n_blk and verdicts[s_blk + i] is None:
+                        verdicts[s_blk + i] = "sat"
+                        ces[s_blk + i] = ce
+
             attack_deadline = 0.25 * deadline_s
+            submitted = 0
             for s in range(0, R, CH):
-                if time.perf_counter() - t_a > attack_deadline:
+                # Backlog-aware admission: in-flight chunks are committed
+                # work that will drain (and decode) past any break, so the
+                # deadline gates elapsed PLUS the estimated backlog cost —
+                # without this, depth-1 overshoot of one in-progress chunk
+                # becomes depth chunks of post-deadline exact validation.
+                elapsed = time.perf_counter() - t_a
+                est = elapsed / max(submitted, 1)
+                if elapsed + len(pipe) * est > attack_deadline:
                     break
+                submitted += 1
                 blk = np.arange(s, min(s + CH, R))
-                w = pgd_attack(
-                    net, enc, np.asarray(roots_lo[blk], dtype=np.int64),
-                    np.asarray(roots_hi[blk], dtype=np.int64), rng_a,
-                    steps=cfg.pgd_steps, restarts=cfg.pgd_restarts)
-                for i, ce in w.items():
-                    if i < len(blk) and verdicts[s + i] is None:
-                        verdicts[s + i] = "sat"
-                        ces[s + i] = ce
+                for item in pipe.submit(
+                        lambda blk=blk: pgd_attack_submit(
+                            net, enc,
+                            np.asarray(roots_lo[blk], dtype=np.int64),
+                            np.asarray(roots_hi[blk], dtype=np.int64), rng_a,
+                            steps=cfg.pgd_steps, restarts=cfg.pgd_restarts),
+                        meta=(s, len(blk))):
+                    _consume(*item)
+            for item in pipe.drain():
+                _consume(*item)
             attack_cost[:] = (time.perf_counter() - t_a) / R
             sp_a.set(sat=sum(1 for v in verdicts if v == "sat"))
 

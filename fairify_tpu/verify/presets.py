@@ -54,15 +54,23 @@ PRESETS = {
                       capped_partitions=True, max_partitions=100,
                       soft_timeout_s=100.0, sim_size=1000, **_HOUR),
     # ----- stress/ -----
+    # pipeline_depth 4 (default 2): the stress grids run to millions of
+    # boxes — thousands of grid_chunk launches per model — and their
+    # stage-0 results are tiny (bool masks + witness indices), so a deeper
+    # in-flight queue hides host decode jitter at negligible HBM cost.
+    # Verdict maps are depth-invariant (chunk RNG keyed to global starts).
     "stress-GC": SweepConfig(name="stress-GC", dataset="german", protected=("age",),
                              partition_threshold=10, heuristic_threshold=20,
-                             soft_timeout_s=200.0, sim_size=1000, **_HOUR),
+                             soft_timeout_s=200.0, sim_size=1000,
+                             pipeline_depth=4, **_HOUR),
     "stress-AC": SweepConfig(name="stress-AC", dataset="adult", protected=("sex",),
                              partition_threshold=6, heuristic_threshold=20,
-                             soft_timeout_s=200.0, sim_size=1000, **_HOUR),
+                             soft_timeout_s=200.0, sim_size=1000,
+                             pipeline_depth=4, **_HOUR),
     "stress-BM": SweepConfig(name="stress-BM", dataset="bank", protected=("age",),
                              partition_threshold=10, heuristic_threshold=20,
-                             soft_timeout_s=200.0, sim_size=1000, **_HOUR),
+                             soft_timeout_s=200.0, sim_size=1000,
+                             pipeline_depth=4, **_HOUR),
     # ----- relaxed/ -----
     "relaxed-GC": SweepConfig(name="relaxed-GC", dataset="german",
                               protected=("sex", "marital-status"),

@@ -178,7 +178,14 @@ def _budgeted_model_sweep_impl(cfg, net, model_name, dataset):
         # the label instead of overshooting on a last-minute span.  0.4
         # (was 0.5): a measured 77 s wall on a 60 s relaxed-AC row came
         # from a third span admitted on a noisy rate estimate.
-        if rate is not None and chunk / rate > 0.4 * left:
+        #
+        # In-flight admission: with the async launch pipeline the moment a
+        # span starts, up to ``pipeline_depth`` chunk launches are committed
+        # device work that must drain even if the budget trips mid-span —
+        # so the minimum admissible cost of STARTING a span is the whole
+        # in-flight backlog, not one chunk.
+        depth = max(1, int(getattr(cfg, "pipeline_depth", 1)))
+        if rate is not None and (depth * chunk) / rate > 0.4 * left:
             break
         stop = min(P, span + K)
         t_block = time.perf_counter()

@@ -14,6 +14,19 @@ into the tier-1 test run via ``tests/test_observability.py``).  Two rules:
   their job), the heartbeat itself, and two legacy shims that predate the
   obs layer (``verify/sweep.py``'s stderr skip warning,
   ``verify/exact_check.py``'s debug prints — shrink, don't grow, this list).
+* **No synchronous device fetch in ``fairify_tpu/verify/`` loops** —
+  ``np.asarray(...)`` / ``jax.device_get(...)`` / ``.block_until_ready()``
+  inside a ``for``/``while`` body stalls the launch queue exactly where
+  the async pipeline (``parallel/pipeline.py``) exists to keep it full;
+  chunk loops must submit through a :class:`LaunchPipeline` and convert
+  only at dequeue.  The allowlist (``ALLOW_LOOP_FETCH``, keyed
+  ``file::function``) names the remaining legitimate sync points — drain-
+  API decode bodies, sequentially-dependent BaB iterations, single-
+  partition retries — each with its reason.  Shrink, don't grow, it.
+  Deliberately NOT matched: ``np.array`` (22 in-tree uses are host list
+  construction; flagging them would bury the signal) — a reviewer must
+  still catch ``np.array(device_array)``, as with any other blocking
+  read (``float(x)``, ``int(x)``) the AST can't distinguish.
 
 AST-based, so docstrings/comments mentioning the patterns don't trip it.
 ``scripts/`` and ``tests/`` are out of scope: the rule protects the
@@ -37,6 +50,44 @@ ALLOW_PRINT = {
     "fairify_tpu/verify/exact_check.py",  # legacy: gated debug prints
 }
 
+# Hot-loop fetch rule scope: chunk/frontier loops of the verification core.
+LOOP_FETCH_SCOPE = "fairify_tpu/verify/"
+# ``file::function`` sync points reviewed as legitimate.  Everything else in
+# a verify/ loop must route through parallel.pipeline.LaunchPipeline.
+ALLOW_LOOP_FETCH = {
+    # Drain-API decode bodies: the pipeline hands them HOST payloads; the
+    # remaining np.asarray calls pull already-materialized model weights.
+    "fairify_tpu/verify/sweep.py::_family_block_decode",
+    # Per-partition heuristic-retry re-sim: one tiny launch whose result
+    # this row's CSV needs immediately — scoped to its own helper so the
+    # sweep's main loop body stays under the lint.
+    "fairify_tpu/verify/sweep.py::_parity_resim",
+    # BaB frontier iterations are sequentially dependent (each batch's
+    # branching decides the next batch) — no independent work to overlap.
+    "fairify_tpu/verify/engine.py::decide_many",
+    "fairify_tpu/verify/engine.py::uniform_sign_bab",
+    "fairify_tpu/verify/engine.py::_run_lp_phase",
+    # Sound-prune chunk results feed the immediately-following host mask
+    # assembly per chunk; candidate for pipelining, not yet converted.
+    "fairify_tpu/verify/pruning.py::sound_prune_grid",
+    "fairify_tpu/verify/exact_check.py::exact_certify_grid",
+    # Pure-host numpy coercions of weights/points inside exact/LP/SMT
+    # loops — ``np.asarray`` on data that never lived on device.
+    "fairify_tpu/verify/engine.py::exact_logit_sign",
+    "fairify_tpu/verify/engine.py::_leaf_sign_lp",
+    "fairify_tpu/verify/engine.py::_eligible_lattice_roots",
+    "fairify_tpu/verify/smt.py::_z3_net",
+    # Per-root host phases (lattice enumeration / pair LP): independent
+    # roots, so genuine pipelining candidates — not yet converted; the
+    # fetched payloads feed immediately-following serial host solvers.
+    "fairify_tpu/verify/engine.py::_lattice_phase",
+    "fairify_tpu/verify/engine.py::_pair_lp_phase",
+}
+_FETCH_HINT = (
+    "synchronous device fetch in a verify/ loop — submit through "
+    "parallel.pipeline.LaunchPipeline and convert at dequeue "
+    "(or extend ALLOW_LOOP_FETCH with file::function and a reason)")
+
 
 def _is_time_time(node: ast.Call) -> bool:
     f = node.func
@@ -46,6 +97,48 @@ def _is_time_time(node: ast.Call) -> bool:
 
 def _is_print(node: ast.Call) -> bool:
     return isinstance(node.func, ast.Name) and node.func.id == "print"
+
+
+def _is_loop_fetch(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        if f.attr == "block_until_ready":
+            return True
+        if isinstance(f.value, ast.Name):
+            # np.asarray(...) / jax.device_get(...) on loop-carried arrays.
+            if f.value.id in ("np", "numpy") and f.attr == "asarray":
+                return True
+            if f.value.id == "jax" and f.attr == "device_get":
+                return True
+    return False
+
+
+def _loop_fetch_errors(tree: ast.AST, rel: str) -> list:
+    """Flag sync fetches whose nearest enclosing loop is a for/while body.
+
+    A nested ``def``/``lambda`` resets the context: a decode closure defined
+    inside a function and *called* from a loop is the pipeline's drain path,
+    not a loop-body fetch.
+    """
+    errors = []
+
+    def walk(node, fn_name, in_loop):
+        for child in ast.iter_child_nodes(node):
+            c_fn, c_loop = fn_name, in_loop
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                c_fn, c_loop = child.name, False
+            elif isinstance(child, ast.Lambda):
+                c_loop = False
+            elif isinstance(child, (ast.For, ast.While)):
+                c_loop = True
+            elif isinstance(child, ast.Call) and c_loop \
+                    and _is_loop_fetch(child) \
+                    and f"{rel}::{c_fn}" not in ALLOW_LOOP_FETCH:
+                errors.append(f"{rel}:{child.lineno}: {_FETCH_HINT}")
+            walk(child, c_fn, c_loop)
+
+    walk(tree, "<module>", False)
+    return errors
 
 
 def check_file(path: str, rel: str) -> list:
@@ -69,6 +162,8 @@ def check_file(path: str, rel: str) -> list:
                 f"{rel}:{node.lineno}: bare print() — progress goes through "
                 f"fairify_tpu.obs.heartbeat, structured output through the "
                 f"event log (or extend ALLOW_PRINT for user-facing output)")
+    if rel.startswith(LOOP_FETCH_SCOPE):
+        errors.extend(_loop_fetch_errors(tree, rel))
     return errors
 
 

@@ -67,8 +67,8 @@ def main() -> None:
         "went through branch-and-bound).",
         "",
         "| Run | Model | Decided | UNK | parts/s/chip | s/part | st0% | "
-        "slowest phase |",
-        "|---|---|---|---|---|---|---|---|",
+        "pipe (max/mean) | slowest phase |",
+        "|---|---|---|---|---|---|---|---|---|",
     ]
     worst = []
     for r in rows:
@@ -79,13 +79,22 @@ def main() -> None:
         slow = max(phases.items(), key=lambda kv: kv[1])[0] if phases else "—"
         if phases:
             slow = f"{slow} ({phases[slow]:.1f}s)"
+        # Async-pipeline overlap: configured depth plus the max and
+        # time-weighted-mean launches actually in flight (absent on
+        # records written before the pipeline existed).
+        if "pipeline_depth" in r:
+            pipe = (f"d{r['pipeline_depth']} "
+                    f"{r.get('launches_in_flight_max', 0)}/"
+                    f"{r.get('launches_in_flight_mean', 0.0):.2f}")
+        else:
+            pipe = "—"
         lines.append(
             f"| {r['_dir']}/{r['_preset']} | {r['_model']} | {r['decided']} | "
             f"{r['unknown']} | {r['partitions_per_sec_per_chip']:.3f} | "
-            f"{spp:.3f} | {st0:.0f} | {slow} |")
+            f"{spp:.3f} | {st0:.0f} | {pipe} | {slow} |")
         worst.append((spp, f"{r['_preset']}/{r['_model']}"))
     if not rows:
-        lines.append("| *(no records yet)* | | | | | | | |")
+        lines.append("| *(no records yet)* | | | | | | | | |")
     else:
         worst.sort(reverse=True)
         lines += [

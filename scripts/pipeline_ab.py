@@ -1,0 +1,308 @@
+#!/usr/bin/env python
+"""A/B the async launch pipeline: pipeline_depth 1 vs 2 on the same tree.
+
+Three arms, each with one untimed warm pass then ≥3 timed repeats (bench
+discipline — compiles never land inside a timed region).  Depth-1 and
+depth-2 repeats are INTERLEAVED so slow process drift (cache state, cgroup
+throttling) hits both arms equally:
+
+* **GC-1 headline** — the bench headline sweep (flagship German net,
+  201 partitions) end-to-end at ``grid_chunk 64`` so the grid is 4 stage-0
+  chunks the pipeline can overlap (the stock whole-grid chunk gives it one
+  launch and nothing to hide).
+* **AC family suite** — the adult model family (reference zoo when
+  present, else the shipped ``models_scaled`` twins), stacked per
+  architecture, swept over a 2048-partition slice at ``grid_chunk 512``
+  through ONE shared pipeline (``sweep.stage0_families``).
+* **Simulated relay** — the same stage-0 sweep through a
+  :class:`RelayPipeline` that delays each launch's host visibility by the
+  audited ~110 ms tunnel round-trip (``audits/device_util_r4.json``).
+  This container's CPU backend has no tunnel, so the first two arms can
+  only show *harmlessness* (overlap achieved, verdicts identical, walls
+  within noise); this arm demonstrates the effect the pipeline exists
+  for — at depth 1 every chunk pays the round-trip serially, at depth ≥2
+  the round-trips hide behind in-flight compute.  Clearly labelled
+  synthetic in the record.
+
+Every arm checks verdict-map equality between depths — the pipeline must
+change WHEN results are fetched, never WHAT is decided.
+
+Usage: python scripts/pipeline_ab.py [--out audits/pipeline_ab_r6.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import threading
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from fairify_tpu.parallel.pipeline import LaunchPipeline  # noqa: E402
+
+REPEATS = 3
+DEPTHS = (1, 2)
+RELAY_S = 0.110  # audited flat launch round-trip, audits/device_util_r4.json
+
+
+class RelayPipeline(LaunchPipeline):
+    """LaunchPipeline whose results become host-visible only ``relay_s``
+    after the kernel finishes — a synthetic stand-in for the tunnelled
+    chip's relay latency.  A watcher thread stamps each launch's true
+    finish time (``block_until_ready``), so with depth ≥2 one launch's
+    relay window overlaps the next launch's compute, exactly like a real
+    pipelined tunnel."""
+
+    def __init__(self, depth: int, relay_s: float = RELAY_S):
+        super().__init__(depth)
+        self.relay_s = relay_s
+        self._ready = {}
+
+    def submit(self, fn, meta=None):
+        def wrapped():
+            import jax
+
+            payload, ctx = fn()
+            key = object()
+
+            def watch():
+                jax.block_until_ready(payload)
+                self._ready[key] = time.perf_counter() + self.relay_s
+
+            threading.Thread(target=watch, daemon=True).start()
+            return payload, {"_key": key, "_ctx": ctx}
+
+        return super().submit(wrapped, meta)
+
+    def _drain_one(self):
+        # The relay wait lives INSIDE the drain, i.e. before the pipeline
+        # admits the next dispatch — at depth 1 every chunk therefore pays
+        # the full round-trip serially (the pre-pipeline order), while at
+        # depth ≥2 the already-in-flight launch computes through it.
+        meta, wrapped_ctx, host = super()._drain_one()
+        key = wrapped_ctx["_key"]
+        while key not in self._ready:  # watcher stamp races device_get
+            time.sleep(0.001)
+        delay = self._ready.pop(key) - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        return meta, wrapped_ctx["_ctx"], host
+
+
+def _median(vals):
+    vals = sorted(vals)
+    return vals[len(vals) // 2]
+
+
+def _summarize(runs, key):
+    vals = [r[key] for r in runs]
+    return {
+        f"median_{key}": _median(vals), "min": min(vals), "max": max(vals),
+        "in_flight_max": max(r["in_flight_max"] for r in runs),
+        "in_flight_mean": _median([r["in_flight_mean"] for r in runs]),
+        "runs": runs,
+    }
+
+
+def _gc_cfg():
+    from fairify_tpu.verify import engine, presets
+
+    return presets.get("GC").with_(
+        soft_timeout_s=10.0, hard_timeout_s=10 * 60.0,
+        exact_certify_masks=False, grid_chunk=64,
+        engine=engine.EngineConfig(frontier_size=512, attack_samples=128,
+                                   bab_attack_samples=16, soft_timeout_s=10.0),
+    )
+
+
+def gc_headline_arm(tmp_root: str) -> dict:
+    from __graft_entry__ import _flagship_net
+    from fairify_tpu import obs
+    from fairify_tpu.verify import sweep
+
+    net = _flagship_net()
+    cfgs = {d: _gc_cfg().with_(pipeline_depth=d,
+                               result_dir=os.path.join(tmp_root, f"gc-d{d}"))
+            for d in DEPTHS}
+    for cfg in cfgs.values():  # warm: identical sweep, untimed
+        shutil.rmtree(cfg.result_dir, ignore_errors=True)
+        sweep.verify_model(net, cfg, model_name="warm", resume=False)
+    runs = {d: [] for d in DEPTHS}
+    verdict_maps = {}
+    for _ in range(REPEATS):
+        for d in DEPTHS:  # interleaved
+            cfg = cfgs[d]
+            shutil.rmtree(cfg.result_dir, ignore_errors=True)
+            obs.registry().reset()
+            t0 = time.perf_counter()
+            rep = sweep.verify_model(net, cfg, model_name="GC-1", resume=False)
+            dt = time.perf_counter() - t0
+            decided = rep.counts["sat"] + rep.counts["unsat"]
+            with open(os.path.join(cfg.result_dir,
+                                   "GC-GC-1.throughput.json")) as fp:
+                thr = json.load(fp)
+            runs[d].append({
+                "parts_per_sec": round(decided / dt, 3),
+                "elapsed_s": round(dt, 3),
+                "device_launches": thr["device_launches"],
+                "in_flight_max": thr["launches_in_flight_max"],
+                "in_flight_mean": thr["launches_in_flight_mean"],
+            })
+            verdict_maps[d] = {
+                o.partition_id: (o.verdict,
+                                 None if o.counterexample is None else
+                                 tuple(tuple(c.tolist())
+                                       for c in o.counterexample))
+                for o in rep.outcomes}
+    arm = {"label": "GC-1 headline, end-to-end (201 partitions, "
+                    "grid_chunk 64; interleaved repeats)",
+           "counts": rep.counts,
+           "depths": {d: _summarize(runs[d], "parts_per_sec")
+                      for d in DEPTHS}}
+    arm["verdict_maps_identical"] = all(
+        verdict_maps[d] == verdict_maps[DEPTHS[0]] for d in DEPTHS)
+    return arm
+
+
+def _adult_stacks(cfg):
+    from collections import defaultdict
+
+    from fairify_tpu.models import zoo
+    from fairify_tpu.parallel.mesh import stack_models
+
+    n_attrs = len(cfg.query().columns)
+    nets, _ = zoo.load_matching("adult", n_attrs)
+    source = "reference zoo"
+    if not nets:  # this container ships only the scaled twins
+        nets, _ = zoo.load_matching("adult", n_attrs,
+                                    root=os.path.join(ROOT, "models_scaled"))
+        source = "models_scaled"
+    groups = defaultdict(list)
+    for n in sorted(nets):
+        groups[(nets[n].in_dim,) + nets[n].layer_sizes].append(n)
+    return ([stack_models([nets[n] for n in g]) for g in groups.values()],
+            len(nets), source)
+
+
+def ac_family_arm() -> dict:
+    from fairify_tpu import obs
+    from fairify_tpu.verify import presets, sweep
+    from fairify_tpu.verify.property import encode
+
+    cfg = presets.get("AC").with_(grid_chunk=512)
+    stacks, n_models, source = _adult_stacks(cfg)
+    enc = encode(cfg.query())
+    _, lo, hi = sweep.build_partitions(cfg)
+    lo, hi = lo[:2048], hi[:2048]
+    for st in stacks:  # warm/compile per architecture, untimed
+        sweep._stage0_family(st, enc, lo[:512], hi[:512], cfg)
+    runs = {d: [] for d in DEPTHS}
+    sig = {}
+    decided = 0
+    for _ in range(REPEATS):
+        for d in DEPTHS:  # interleaved
+            obs.registry().reset()
+            pipe = LaunchPipeline(d)
+            t0 = time.perf_counter()
+            fams = sweep.stage0_families(stacks, enc, lo, hi,
+                                         cfg.with_(pipeline_depth=d),
+                                         pipe=pipe)
+            dt = time.perf_counter() - t0
+            decided = int(sum((u | s).sum()
+                              for fam in fams for u, s, _ in fam))
+            runs[d].append({
+                "model_parts_per_sec": round(decided / dt, 1),
+                "elapsed_s": round(dt, 3),
+                "in_flight_max": pipe.stats.max,
+                "in_flight_mean": round(pipe.stats.mean(), 3),
+            })
+            sig[d] = [(u.tobytes(), s.tobytes(), tuple(sorted(w)))
+                      for fam in fams for u, s, w in fam]
+    arm = {"label": f"AC family suite ({n_models} adult models from "
+                    f"{source}, 2048-partition slice, grid_chunk 512, "
+                    f"shared pipeline; interleaved repeats)",
+           "decided_model_partitions": decided,
+           "depths": {d: _summarize(runs[d], "model_parts_per_sec")
+                      for d in DEPTHS}}
+    arm["verdict_maps_identical"] = all(
+        sig[d] == sig[DEPTHS[0]] for d in DEPTHS)
+    return arm
+
+
+def relay_sim_arm() -> dict:
+    from __graft_entry__ import _flagship_net
+    from fairify_tpu import obs
+    from fairify_tpu.verify import sweep
+    from fairify_tpu.verify.property import encode
+
+    cfg = _gc_cfg().with_(grid_chunk=16)  # 13 chunks: room to hide 12 RTs
+    net = _flagship_net()
+    enc = encode(cfg.query())
+    _, lo, hi = sweep.build_partitions(cfg)
+    sweep._stage0_certify_and_attack(net, enc, lo, hi, cfg)  # warm, no relay
+    runs = {d: [] for d in DEPTHS}
+    maps = {}
+    for _ in range(REPEATS):
+        for d in DEPTHS:  # interleaved
+            obs.registry().reset()
+            pipe = RelayPipeline(d, RELAY_S)
+            t0 = time.perf_counter()
+            unsat, sat, wit = sweep._stage0_certify_and_attack(
+                net, enc, lo, hi, cfg.with_(pipeline_depth=d), pipe=pipe)
+            dt = time.perf_counter() - t0
+            runs[d].append({
+                "chunks_per_sec": round(13 / dt, 3),
+                "elapsed_s": round(dt, 3),
+                "in_flight_max": pipe.stats.max,
+                "in_flight_mean": round(pipe.stats.mean(), 3),
+            })
+            maps[d] = (unsat.tobytes(), sat.tobytes(),
+                       {k: tuple(tuple(c.tolist()) for c in v)
+                        for k, v in wit.items()})
+    arm = {"label": f"SYNTHETIC relay: GC-1 stage-0, 13 chunks of 16, "
+                    f"each launch + {RELAY_S * 1000:.0f} ms simulated tunnel "
+                    f"round-trip (audits/device_util_r4.json); interleaved "
+                    f"repeats",
+           "relay_s": RELAY_S,
+           "depths": {d: _summarize(runs[d], "chunks_per_sec")
+                      for d in DEPTHS}}
+    arm["verdict_maps_identical"] = all(
+        maps[d] == maps[DEPTHS[0]] for d in DEPTHS)
+    return arm
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=os.path.join(ROOT, "audits",
+                                                  "pipeline_ab_r6.json"))
+    ap.add_argument("--tmp", default="/tmp/fairify_tpu_pipeline_ab")
+    args = ap.parse_args()
+    import jax
+
+    rec = {
+        "platform": jax.devices()[0].platform,
+        "repeats": REPEATS,
+        "arms": {
+            "gc_headline": gc_headline_arm(args.tmp),
+            "ac_family": ac_family_arm(),
+            "relay_sim": relay_sim_arm(),
+        },
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as fp:
+        json.dump(rec, fp, indent=2)
+    print(json.dumps(
+        {k: {"identical": v["verdict_maps_identical"],
+             **{str(d): {kk: vv for kk, vv in v["depths"][d].items()
+                         if kk != "runs"} for d in v["depths"]}}
+         for k, v in rec["arms"].items()}, indent=1))
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -260,6 +260,27 @@ def test_heartbeat_launch_delta():
     assert "+7 launches" in out.getvalue()
 
 
+def test_heartbeat_eta_uses_recent_rate_not_run_mean():
+    """Budgeted sweeps: a stage-0 burst (hundreds of partitions per second)
+    followed by the BaB tail (seconds per partition).  The whole-run mean
+    would promise ~1 minute; the recent-rate ETA must reflect the tail."""
+    import io
+    import re
+
+    clock = _FakeClock()
+    out = io.StringIO()
+    hb = hb_mod.Heartbeat(10.0, total=1000, stream=out, clock=clock)
+    clock.t += 1.0
+    hb.beat(decided=500, attempted=500)  # stage-0 burst: 500 parts in 1s
+    clock.t += 60.0
+    hb.beat(decided=510, attempted=510)  # BaB tail: 10 parts in 60s
+    lines = out.getvalue().strip().splitlines()
+    eta = int(re.search(r"eta (\d+)s", lines[1]).group(1))
+    # Whole-run mean (510/61 ≈ 8.4 pps) would claim eta ≈ 59 s; the recent
+    # window runs at 1/6 pps, so an honest ETA is in the thousands.
+    assert eta > 1000, eta
+
+
 # ---------------------------------------------------------------------------
 # Report CLI
 # ---------------------------------------------------------------------------

@@ -1,0 +1,207 @@
+"""Async launch pipeline: depth semantics, verdict-map invariance, crash
+safety.
+
+The pipeline (``parallel/pipeline.py``) changes only WHEN chunk results are
+fetched — never which kernels run or with which seeds — so the decided/
+UNSAT/SAT sets and every witness triple must be bit-identical across
+``pipeline_depth``.  And because the ledger is written only after stage-0
+results are drained, a run killed with chunks still in flight must never
+have ledgered an undrained chunk as decided.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from fairify_tpu.models.train import init_mlp
+from fairify_tpu.parallel.pipeline import FlightStats, LaunchPipeline
+from fairify_tpu.verify import presets, sweep
+
+
+# ---------------------------------------------------------------------------
+# LaunchPipeline unit semantics (no jax needed beyond device_get on numpy)
+# ---------------------------------------------------------------------------
+
+
+def test_pipeline_fifo_and_depth_bound():
+    pipe = LaunchPipeline(depth=2)
+    out = []
+    at_dispatch = []
+
+    def launch(i):
+        # Invariant at dispatch time: room was made BEFORE fn() ran, so at
+        # most depth-1 older launches are still in flight.
+        at_dispatch.append(len(pipe))
+        return {"v": np.array([i])}, {"i": i}
+
+    for i in range(5):
+        for meta, ctx, host in pipe.submit(lambda i=i: launch(i), meta=i):
+            out.append((meta, ctx["i"], int(host["v"][0])))
+        assert len(pipe) <= 2
+    for meta, ctx, host in pipe.drain():
+        out.append((meta, ctx["i"], int(host["v"][0])))
+    # FIFO: drained in submission order, payload/ctx/meta stay aligned.
+    assert [m for m, _, _ in out] == list(range(5))
+    assert all(m == c == v for m, c, v in out)
+    assert at_dispatch == [0, 1, 1, 1, 1]  # 2 in flight after each dispatch
+    assert pipe.stats.max == 2
+
+
+def test_pipeline_depth1_is_synchronous():
+    pipe = LaunchPipeline(depth=1)
+    at_dispatch = []
+
+    def launch(i):
+        at_dispatch.append(len(pipe))
+        return np.array([i]), None
+
+    drained = []
+    for i in range(3):
+        drained += [meta for meta, _, _ in
+                    pipe.submit(lambda i=i: launch(i), meta=i)]
+    drained += [meta for meta, _, _ in pipe.drain()]
+    # Strict alternation: the queue is empty at every dispatch — each
+    # launch was fetched before the next one went out (the pre-pipeline
+    # execution order), and at most one launch ever existed at a time.
+    assert at_dispatch == [0, 0, 0]
+    assert drained == [0, 1, 2]
+    assert pipe.stats.max == 1
+
+
+def test_flight_stats_time_weighted_mean():
+    t = {"now": 0.0}
+    st = FlightStats(clock=lambda: t["now"])
+    st.update(1)          # depth 1 for 2s
+    t["now"] = 2.0
+    st.update(2)          # depth 2 for 2s
+    t["now"] = 4.0
+    st.update(0)
+    assert st.max == 2
+    assert st.summary()["mean"] == pytest.approx((1 * 2 + 2 * 2) / 4.0)
+
+
+# ---------------------------------------------------------------------------
+# Verdict-map invariance across pipeline_depth
+# ---------------------------------------------------------------------------
+
+
+def _outcome_map(report):
+    out = {}
+    for o in report.outcomes:
+        ce = None
+        if o.counterexample is not None:
+            ce = (tuple(int(v) for v in o.counterexample[0]),
+                  tuple(int(v) for v in o.counterexample[1]))
+        out[o.partition_id] = (o.verdict, ce)
+    return out
+
+
+def test_sweep_verdicts_pipeline_depth_invariant(tmp_path):
+    cfg = presets.get("GC").with_(
+        soft_timeout_s=30.0, hard_timeout_s=300.0, sim_size=64,
+        exact_certify_masks=False, grid_chunk=16)
+    net = init_mlp((20, 8, 1), seed=3)
+    span = (0, 48)  # 3 chunks of 16 — enough to overlap, cheap enough for CI
+    maps = {}
+    for depth in (1, 2, 4):
+        rep = sweep.verify_model(
+            net, cfg.with_(result_dir=str(tmp_path / f"d{depth}"),
+                           pipeline_depth=depth),
+            model_name="m", resume=False, partition_span=span)
+        maps[depth] = _outcome_map(rep)
+    assert maps[1], "span produced no outcomes"
+    # Bit-identical decided/UNSAT/SAT sets AND witness triples at any depth.
+    assert maps[1] == maps[2] == maps[4]
+
+
+def test_stage0_families_matches_per_family(tmp_path):
+    from fairify_tpu.parallel.mesh import stack_models
+    from fairify_tpu.verify.property import encode
+
+    cfg = presets.get("GC").with_(grid_chunk=16)
+    enc = encode(cfg.query())
+    _, lo, hi = sweep.build_partitions(cfg)
+    lo, hi = lo[:32], hi[:32]
+    stacks = [stack_models([init_mlp((20, 8, 1), seed=s)
+                            for s in (0, 1)]),
+              stack_models([init_mlp((20, 6, 1), seed=s)
+                            for s in (2, 3, 4)])]
+    # One shared pipeline across both architecture groups...
+    shared = sweep.stage0_families(stacks, enc, lo, hi, cfg)
+    # ...must equal each family swept alone.
+    for st, got in zip(stacks, shared):
+        want = sweep._stage0_family(st, enc, lo, hi, cfg)
+        assert len(got) == len(want)
+        for (u_g, s_g, w_g), (u_w, s_w, w_w) in zip(got, want):
+            np.testing.assert_array_equal(u_g, u_w)
+            np.testing.assert_array_equal(s_g, s_w)
+            assert set(w_g) == set(w_w)
+            for k in w_g:
+                np.testing.assert_array_equal(w_g[k][0], w_w[k][0])
+                np.testing.assert_array_equal(w_g[k][1], w_w[k][1])
+
+
+# ---------------------------------------------------------------------------
+# Crash safety: in-flight chunks never reach the ledger
+# ---------------------------------------------------------------------------
+
+
+def test_crash_with_inflight_chunks_never_ledgers_undrained(tmp_path, monkeypatch):
+    cfg = presets.get("GC").with_(
+        result_dir=str(tmp_path / "crash"), soft_timeout_s=30.0,
+        hard_timeout_s=300.0, sim_size=64, exact_certify_masks=False,
+        grid_chunk=16, pipeline_depth=2)
+    net = init_mlp((20, 8, 1), seed=3)
+    span = (0, 48)
+
+    real_decode = sweep._stage0_block_decode
+    calls = {"n": 0}
+
+    def dying_decode(host, ctx):
+        calls["n"] += 1
+        if calls["n"] >= 2:  # die at the second drain — one chunk in flight
+            raise RuntimeError("simulated crash mid-drain")
+        return real_decode(host, ctx)
+
+    monkeypatch.setattr(sweep, "_stage0_block_decode", dying_decode)
+    with pytest.raises(RuntimeError, match="mid-drain"):
+        sweep.verify_model(net, cfg, model_name="m", resume=False,
+                           partition_span=span)
+    monkeypatch.setattr(sweep, "_stage0_block_decode", real_decode)
+
+    # The crash hit while stage-0 chunks were still in flight: nothing may
+    # have been ledgered as decided (the reporting loop runs only after the
+    # full drain), so resume re-decides everything from scratch...
+    ledger = tmp_path / "crash" / "GC-m@0-48.ledger.jsonl"
+    assert not ledger.exists() or os.path.getsize(ledger) == 0
+
+    # ...and the resumed run's verdict map equals an uninterrupted one.
+    crashed = sweep.verify_model(net, cfg, model_name="m", resume=True,
+                                 partition_span=span)
+    clean = sweep.verify_model(
+        net, cfg.with_(result_dir=str(tmp_path / "clean")),
+        model_name="m", resume=False, partition_span=span)
+    assert _outcome_map(crashed) == _outcome_map(clean)
+
+
+# ---------------------------------------------------------------------------
+# Throughput record carries the overlap gauge
+# ---------------------------------------------------------------------------
+
+
+def test_throughput_json_records_pipeline_gauge(tmp_path):
+    import json
+
+    cfg = presets.get("GC").with_(
+        result_dir=str(tmp_path), soft_timeout_s=30.0, hard_timeout_s=300.0,
+        sim_size=64, exact_certify_masks=False, grid_chunk=16,
+        pipeline_depth=2)
+    net = init_mlp((20, 8, 1), seed=3)
+    sweep.verify_model(net, cfg, model_name="m", resume=False,
+                       partition_span=(0, 48))
+    with open(tmp_path / "GC-m@0-48.throughput.json") as fp:
+        thr = json.load(fp)
+    assert thr["pipeline_depth"] == 2
+    # 3 chunks at depth 2 → the queue genuinely held 2 launches at once.
+    assert thr["launches_in_flight_max"] >= 2
+    assert 0.0 < thr["launches_in_flight_mean"] <= thr["launches_in_flight_max"]
