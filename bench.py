@@ -96,11 +96,19 @@ def main(trace_out=None, heartbeat_s: float = 0.0) -> None:
     # the tracer (VERDICT r5 #1).
     warm = cfg.with_(result_dir="/tmp/fairify_tpu_bench_warm")
     shutil.rmtree("/tmp/fairify_tpu_bench_warm", ignore_errors=True)
+    from fairify_tpu.obs import compile as compile_obs
+
+    compile_pre_warm = compile_obs.snapshot_totals()
     try:
         sweep.verify_model(net, warm, model_name="warmup", resume=False)
     except Exception as exc:
         print(json.dumps({"metric": "warmup_error", "error": str(exc)[:200]}),
               file=sys.stderr)
+    # The compile split (obs.compile): the warm-up pass eats the cold
+    # XLA compiles; the timed repeats report their residual compile_s so a
+    # nonzero value there is itself a regression signal (shape churn the
+    # warm-up should have covered).
+    warm_compile = compile_obs.totals_delta(compile_pre_warm)
 
     # --- Promotion-ladder configs (BASELINE.json "configs"): one JSON line
     # each, printed BEFORE the headline (the driver parses the last line).
@@ -152,6 +160,8 @@ def main(trace_out=None, heartbeat_s: float = 0.0) -> None:
             run_rec["pipeline_depth"] = thr.get("pipeline_depth")
             run_rec["launches_in_flight_max"] = thr.get("launches_in_flight_max")
             run_rec["launches_in_flight_mean"] = thr.get("launches_in_flight_mean")
+            run_rec["compile_s"] = thr.get("compile_s")
+            run_rec["n_compiles"] = thr.get("n_compiles")
         except (OSError, ValueError):
             pass
         runs.append(run_rec)
@@ -173,6 +183,13 @@ def main(trace_out=None, heartbeat_s: float = 0.0) -> None:
         "phases_s": median_run.get("phases_s"),
         "pipeline_depth": median_run.get("pipeline_depth"),
         "launches_in_flight_max": median_run.get("launches_in_flight_max"),
+        # Compile split: the warm-up run absorbed the cold XLA compiles
+        # (reported here, outside the timed medians); the median timed
+        # repeat's residual compile_s should be ~0 on a healthy run.
+        "warmup_compile_s": round(warm_compile["compile_s"], 3),
+        "warmup_n_compiles": warm_compile["n_compiles"],
+        "compile_s": median_run.get("compile_s"),
+        "n_compiles": median_run.get("n_compiles"),
     }))
 
 

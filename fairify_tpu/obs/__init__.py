@@ -13,7 +13,11 @@ first-class instead of ad-hoc:
   with labels, resettable per run (absorbs the old module-global
   ``_LAUNCHES`` and the ``ThroughputCounter`` fields).
 * :mod:`fairify_tpu.obs.heartbeat` — a throttled stderr progress line for
-  long sweeps.
+  long sweeps (flags in-progress XLA compiles).
+* :mod:`fairify_tpu.obs.compile` — :func:`obs_jit`, the ``jax.jit`` drop-in
+  behind every verify/ and ops/ device kernel: a stable-name kernel
+  registry with compile spans, recompile accounting, and first-compile
+  cost/memory analysis.
 * :mod:`fairify_tpu.obs.report` — aggregates event logs into phase /
   verdict / launch breakdown tables (the ``fairify_tpu report``
   subcommand).
@@ -40,6 +44,19 @@ from fairify_tpu.obs.trace import (  # noqa: F401
     tracing,
     write_chrome_trace,
 )
+
+
+def __getattr__(name):
+    # Lazy: obs.compile imports jax at module load, but the report/trace
+    # consumers of this package (``fairify_tpu report`` aggregates logs
+    # host-side) must stay importable without paying — or depending on —
+    # a jax import.  Kernel modules reach obs_jit through this hook (or
+    # import fairify_tpu.obs.compile directly); they import jax anyway.
+    if name == "obs_jit":
+        from fairify_tpu.obs.compile import obs_jit
+
+        return obs_jit
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 @contextlib.contextmanager

@@ -1,0 +1,371 @@
+"""Compile & device-memory observability: ``obs_jit``, the jit-kernel registry.
+
+Host wall time and device-launch economy are first-class (PRs 1-2), but the
+other half of TPU cost was invisible: a cold XLA compile of the fused
+stage-0 kernel costs tens of seconds over the tunnelled link, a shape- or
+static-arg change silently recompiles mid-sweep, and nothing reported the
+executables' FLOPs or HBM footprint — the quantities that bound batch and
+partition sizing.  :func:`obs_jit` is a drop-in for ``jax.jit`` that makes
+all of it observable:
+
+* every kernel is **registered under a stable name** (module-qualified,
+  e.g. ``engine.certify_attack``) in a process-wide registry
+  (:func:`kernels`);
+* each distinct (abstract-shape signature, static-arg key) triggers one
+  explicit trace/lower/compile, recorded as a ``compile.<kernel>`` span
+  carrying the signature and static key, and counted in per-kernel
+  instruments: ``xla_compiles`` counter, ``xla_compile_seconds`` histogram,
+  ``xla_kernel_signatures`` gauge — so recompiles from shape churn (ragged
+  last chunks, per-architecture family stacks) are detected *and
+  attributed* to the kernel and signature that caused them;
+* on a kernel's **first** compile the executable's ``cost_analysis()``
+  (FLOPs, bytes accessed) and ``memory_analysis()`` (argument / output /
+  temp bytes) land in gauges and on the compile span — graceful no-op when
+  a backend doesn't implement them;
+* long compiles are flagged live through the active heartbeat
+  (``compiling <kernel>…``), so a silent multi-second pause is attributed
+  instead of looking like a hang.
+
+Mechanics: ``obs_jit`` keeps its own executable cache keyed by the dynamic
+arguments' abstract avals (+ shardings) and the static-arg values.  A miss
+runs the explicit AOT path (``jitted.lower(...).compile()``) under the
+compile span; a hit calls the cached executable directly.  Calls made while
+tracing (a kernel composed inside another jit) and any AOT failure fall
+back to the plain ``jax.jit`` path, counted in ``xla_compile_fallbacks`` —
+observability must never change results or availability.
+
+Per-kernel totals (:func:`snapshot_totals` / :func:`totals_delta`) feed the
+sweep's throughput JSON (``compile_s`` / ``n_compiles`` /
+``peak_temp_bytes``) and bench's warm-vs-timed compile split; the
+``compile.<kernel>`` spans and the metrics snapshot feed ``fairify_tpu
+report``'s per-kernel compile table.
+"""
+from __future__ import annotations
+
+import inspect
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Set, Tuple
+
+import jax
+
+from fairify_tpu.obs import heartbeat as heartbeat_mod
+from fairify_tpu.obs import metrics as metrics_mod
+from fairify_tpu.obs import trace as trace_mod
+
+try:  # public since jax 0.4.x; guarded so a rename degrades to fallback keys
+    from jax.api_util import shaped_abstractify as _abstractify
+except Exception:  # pragma: no cover - version drift
+    _abstractify = None
+
+# Sentinel: this signature's AOT path failed — serve it via plain jax.jit.
+_FALLBACK = object()
+
+
+@dataclass
+class KernelStats:
+    """Process-cumulative per-kernel compile accounting (never reset — the
+    metrics registry holds the per-run-resettable view of the same events)."""
+
+    name: str
+    n_compiles: int = 0
+    compile_s: float = 0.0  # total trace+lower+compile seconds
+    fallbacks: int = 0  # calls served by plain jax.jit (AOT path unusable)
+    signatures: Set[Any] = field(default_factory=set)
+    # First-compile executable analyses (None until known / unavailable).
+    flops: Optional[float] = None
+    bytes_accessed: Optional[float] = None
+    arg_bytes: Optional[int] = None
+    output_bytes: Optional[int] = None
+    temp_bytes: Optional[int] = None
+    generated_code_bytes: Optional[int] = None
+
+    def as_dict(self) -> dict:
+        return {
+            "n_compiles": self.n_compiles,
+            "compile_s": self.compile_s,
+            "fallbacks": self.fallbacks,
+            "n_signatures": len(self.signatures),
+            "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+            "arg_bytes": self.arg_bytes,
+            "output_bytes": self.output_bytes,
+            "temp_bytes": self.temp_bytes,
+            "generated_code_bytes": self.generated_code_bytes,
+        }
+
+
+def _default_name(fun) -> str:
+    mod = getattr(fun, "__module__", "") or ""
+    return f"{mod.rsplit('.', 1)[-1]}.{fun.__name__.lstrip('_')}"
+
+
+def _leaf_key(leaf):
+    """Hashable abstract signature of one dynamic leaf: aval + sharding.
+
+    The aval (shape/dtype/weak-type) is what decides a retrace; the sharding
+    is part of the compiled executable's contract on mesh runs, so two
+    identically-shaped but differently-sharded calls must not share an
+    executable.
+    """
+    sharding = getattr(leaf, "sharding", None) if isinstance(leaf, jax.Array) \
+        else None
+    if _abstractify is not None:
+        return (_abstractify(leaf), sharding)
+    return (type(leaf).__name__, getattr(leaf, "shape", None),
+            str(getattr(leaf, "dtype", type(leaf).__name__)), sharding)
+
+
+def _sig_str(avals) -> str:
+    """Compact human signature for span attrs: ``f32[2048,13] x2, ...``."""
+    parts = []
+    for aval, _sharding in avals:
+        try:
+            s = aval.str_short()
+        except AttributeError:
+            s = str(aval)
+        if parts and parts[-1][0] == s:
+            parts[-1][1] += 1
+        else:
+            parts.append([s, 1])
+    return ", ".join(s if n == 1 else f"{s} x{n}" for s, n in parts)
+
+
+class ObsJit:
+    """``jax.jit`` wrapper with per-kernel compile registry + accounting.
+
+    Call-compatible with the jitted function (including positional or
+    keyword static args); additionally exposes ``__wrapped__`` (the raw
+    function, for vmap composition) and ``lower`` (the AOT entry the
+    profiling scripts use).
+    """
+
+    def __init__(self, fun, name: Optional[str] = None,
+                 static_argnames: Tuple[str, ...] = (), **jit_kwargs):
+        if isinstance(static_argnames, str):
+            static_argnames = (static_argnames,)
+        self._fun = fun
+        self.__wrapped__ = fun
+        self.__name__ = getattr(fun, "__name__", "jit_fn")
+        self.__doc__ = getattr(fun, "__doc__", None)
+        self.name = name or _default_name(fun)
+        self._static = tuple(static_argnames)
+        self._jitted = jax.jit(fun, static_argnames=static_argnames or None,
+                               **jit_kwargs)
+        try:
+            self._pos_names = tuple(
+                p.name for p in inspect.signature(fun).parameters.values()
+                if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD))
+        except (TypeError, ValueError):  # pragma: no cover - builtins etc.
+            self._pos_names = ()
+        self._lock = threading.Lock()
+        self._execs: Dict[Any, Any] = {}
+        self.stats = KernelStats(self.name)
+        _KERNELS[self.name] = self
+
+    # -- plumbing ----------------------------------------------------------
+    def lower(self, *args, **kwargs):
+        return self._jitted.lower(*args, **kwargs)
+
+    def _split(self, args, kwargs):
+        """(dyn_args, dyn_kwargs, static_items) preserving call structure."""
+        if not self._static:
+            return args, kwargs, ()
+        statics = []
+        dyn_args = []
+        for i, a in enumerate(args):
+            pname = self._pos_names[i] if i < len(self._pos_names) else None
+            if pname in self._static:
+                statics.append((pname, a))
+            else:
+                dyn_args.append(a)
+        dyn_kwargs = {}
+        for k, v in kwargs.items():
+            if k in self._static:
+                statics.append((k, v))
+            else:
+                dyn_kwargs[k] = v
+        return tuple(dyn_args), dyn_kwargs, tuple(sorted(statics,
+                                                         key=lambda kv: kv[0]))
+
+    def _note_fallback(self) -> None:
+        self.stats.fallbacks += 1
+        metrics_mod.registry().counter("xla_compile_fallbacks").inc(
+            kernel=self.name)
+
+    # -- call path ---------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        dyn_args, dyn_kwargs, statics = self._split(args, kwargs)
+        leaves, treedef = jax.tree_util.tree_flatten((dyn_args, dyn_kwargs))
+        if any(isinstance(l, jax.core.Tracer) for l in leaves):
+            # Composed inside an outer trace: the outer kernel owns the
+            # compile; inline through the plain jit path untracked.
+            return self._jitted(*args, **kwargs)
+        try:
+            avals = tuple(_leaf_key(l) for l in leaves)
+            key = (avals, treedef, statics)
+            hash(key)
+        except Exception:
+            self._note_fallback()
+            return self._jitted(*args, **kwargs)
+        entry = self._execs.get(key)
+        if entry is None:
+            entry = self._compile(key, args, kwargs, statics, avals)
+        if entry is _FALLBACK:
+            return self._jitted(*args, **kwargs)
+        try:
+            return entry(*dyn_args, **dyn_kwargs)
+        except Exception:
+            # Executable/argument mismatch (e.g. layout or sharding drift
+            # invisible to the key): never fail the kernel over accounting.
+            self._note_fallback()
+            self._execs[key] = _FALLBACK
+            return self._jitted(*args, **kwargs)
+
+    def _compile(self, key, args, kwargs, statics, avals):
+        heartbeat_mod.notify_compile(self.name)
+        static_str = ", ".join(f"{k}={v!r}" for k, v in statics)
+        with trace_mod.span(f"compile.{self.name}", kernel=self.name,
+                            signature=_sig_str(avals),
+                            static=static_str) as sp:
+            t0 = time.perf_counter()
+            try:
+                lowered = self._jitted.lower(*args, **kwargs)
+                t1 = time.perf_counter()
+                compiled = lowered.compile()
+                t2 = time.perf_counter()
+            except Exception:
+                self._note_fallback()
+                with self._lock:
+                    self._execs[key] = _FALLBACK
+                return _FALLBACK
+            sp.set(lower_s=round(t1 - t0, 6), compile_s=round(t2 - t1, 6))
+            dur = t2 - t0
+            reg = metrics_mod.registry()
+            with self._lock:
+                first = self.stats.n_compiles == 0
+                self.stats.n_compiles += 1
+                self.stats.compile_s += dur
+                self.stats.signatures.add(key)
+                n_sigs = len(self.stats.signatures)
+                self._execs[key] = compiled
+            reg.counter("xla_compiles").inc(kernel=self.name)
+            reg.histogram("xla_compile_seconds").observe(dur, kernel=self.name)
+            reg.gauge("xla_kernel_signatures").set(n_sigs, kernel=self.name)
+            if first:
+                self._record_analysis(compiled, sp)
+        return compiled
+
+    def _record_analysis(self, compiled, sp) -> None:
+        """First-compile FLOPs / memory footprint → gauges + the compile span.
+
+        Both analyses are backend-optional (the CPU backend grew them late;
+        some platforms return None/raise) — absence degrades to missing
+        attrs, never an error.
+        """
+        st = self.stats
+        try:
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else None
+            if isinstance(ca, dict):
+                flops = ca.get("flops")
+                st.flops = float(flops) if flops is not None else None
+                acc = ca.get("bytes accessed")
+                st.bytes_accessed = float(acc) if acc is not None else None
+        except Exception:
+            pass
+        try:
+            ma = compiled.memory_analysis()
+            st.arg_bytes = int(ma.argument_size_in_bytes)
+            st.output_bytes = int(ma.output_size_in_bytes)
+            st.temp_bytes = int(ma.temp_size_in_bytes)
+            st.generated_code_bytes = int(ma.generated_code_size_in_bytes)
+        except Exception:
+            pass
+        reg = metrics_mod.registry()
+        gauges = (("xla_kernel_flops", st.flops),
+                  ("xla_kernel_bytes_accessed", st.bytes_accessed),
+                  ("xla_kernel_arg_bytes", st.arg_bytes),
+                  ("xla_kernel_output_bytes", st.output_bytes),
+                  ("xla_kernel_temp_bytes", st.temp_bytes))
+        for gname, v in gauges:
+            if v is not None:
+                reg.gauge(gname).set(v, kernel=self.name)
+        attrs = {"flops": st.flops, "bytes_accessed": st.bytes_accessed,
+                 "arg_bytes": st.arg_bytes, "output_bytes": st.output_bytes,
+                 "temp_bytes": st.temp_bytes}
+        sp.set(**{k: v for k, v in attrs.items() if v is not None})
+
+
+# ---------------------------------------------------------------------------
+# Registry + totals (throughput JSON / bench warm-split consumers)
+# ---------------------------------------------------------------------------
+
+_KERNELS: Dict[str, ObsJit] = {}
+
+
+def obs_jit(fun=None, *, name: Optional[str] = None,
+            static_argnames: Tuple[str, ...] = (), **jit_kwargs):
+    """Drop-in for ``jax.jit`` / ``partial(jax.jit, static_argnames=...)``.
+
+    Usable bare (``@obs_jit``), with options
+    (``@obs_jit(static_argnames=("k",))``), or call-style
+    (``obs_jit(fn, name="engine.certify", static_argnames=("k",))``).
+    """
+    if fun is None:
+        return lambda f: obs_jit(f, name=name, static_argnames=static_argnames,
+                                 **jit_kwargs)
+    return ObsJit(fun, name=name, static_argnames=static_argnames,
+                  **jit_kwargs)
+
+
+def kernels() -> Dict[str, ObsJit]:
+    """Name → registered kernel (import order; stable within a process)."""
+    return dict(_KERNELS)
+
+
+def kernel_stats() -> Dict[str, dict]:
+    """Name → cumulative stats dict (JSON-ready) for every registered kernel."""
+    return {name: k.stats.as_dict() for name, k in sorted(_KERNELS.items())}
+
+
+def snapshot_totals() -> Dict[str, object]:
+    """Process-cumulative compile totals (pair with :func:`totals_delta`)."""
+    n = c = f = 0.0
+    per_kernel: Dict[str, int] = {}
+    for k in _KERNELS.values():
+        st = k.stats
+        n += st.n_compiles
+        c += st.compile_s
+        f += st.fallbacks
+        per_kernel[k.name] = st.n_compiles
+    return {"n_compiles": int(n), "compile_s": c, "fallbacks": int(f),
+            "per_kernel": per_kernel}
+
+
+def totals_delta(before: Dict[str, object],
+                 after: Optional[Dict[str, object]] = None) -> Dict[str, float]:
+    """Per-run compile record: ``after - before`` for the cumulative counts.
+
+    ``peak_temp_bytes`` is the largest per-executable temp footprint among
+    the kernels that actually compiled WITHIN the window — a run that
+    compiles nothing (warm) reports 0, and an earlier run's big family
+    kernels are never attributed to a later model's record.
+    """
+    if after is None:
+        after = snapshot_totals()
+    before_pk = before.get("per_kernel", {})
+    peak = 0
+    for name, n_after in after.get("per_kernel", {}).items():
+        if n_after > before_pk.get(name, 0):
+            temp = _KERNELS[name].stats.temp_bytes if name in _KERNELS else None
+            if temp:
+                peak = max(peak, temp)
+    return {
+        "n_compiles": int(after["n_compiles"] - before.get("n_compiles", 0)),
+        "compile_s": after["compile_s"] - before.get("compile_s", 0.0),
+        "fallbacks": int(after["fallbacks"] - before.get("fallbacks", 0)),
+        "peak_temp_bytes": int(peak),
+    }

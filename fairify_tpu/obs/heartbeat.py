@@ -25,6 +25,28 @@ from typing import Callable, Optional
 
 from fairify_tpu.obs import metrics as metrics_mod
 
+# The run's live heartbeat (last enabled one wins; sequential sweeps each
+# register their own).  obs.compile uses it to flag in-progress XLA
+# compiles — the long silent pauses that otherwise look like hangs.
+_ACTIVE: Optional["Heartbeat"] = None
+
+
+def active() -> Optional["Heartbeat"]:
+    return _ACTIVE
+
+
+def notify_compile(kernel: str) -> None:
+    """Flag an XLA compile start on the active heartbeat (no-op when none).
+
+    Called by ``obs.compile`` immediately before trace/lower/compile: a cold
+    stage-0 kernel compiles for tens of seconds on the tunnelled link, during
+    which the partition loop — and therefore ``beat`` — cannot run, so the
+    flag must be prospective.
+    """
+    hb = _ACTIVE
+    if hb is not None:
+        hb.compile_started(kernel)
+
 
 class Heartbeat:
     """Throttled progress reporter; ``interval_s <= 0`` disables it."""
@@ -47,10 +69,36 @@ class Heartbeat:
         self._last_launches = self._launches()
         self._last_attempted: Optional[int] = None
         self._rate_ema: Optional[float] = None
+        if self.interval_s > 0:
+            global _ACTIVE
+            _ACTIVE = self
+
+    def close(self) -> None:
+        """Deregister as the live heartbeat (end of the owning sweep)."""
+        global _ACTIVE
+        if _ACTIVE is self:
+            _ACTIVE = None
 
     @staticmethod
     def _launches() -> float:
         return metrics_mod.registry().counter("device_launches").total()
+
+    def compile_started(self, kernel: str) -> None:
+        """One line flagging an XLA compile in progress.
+
+        Unthrottled: a kernel compiles once per signature, so a cold run
+        emits a handful of these, and each one explains a pause the
+        interval-throttled beats cannot cover (the loop is blocked inside
+        the compile).  Does not count as a beat for throttling.
+        """
+        label = f" {self.label}" if self.label else ""
+        try:
+            print(f"[hb{label}] compiling {kernel}…",
+                  file=self.stream or sys.stderr, flush=True)
+        except (OSError, ValueError):
+            # A leaked/stale registration over a closed stream must never
+            # fail the kernel call that triggered the flag.
+            self.close()
 
     def beat(self, decided: int, attempted: int, unknown: int = 0,
              force: bool = False) -> bool:

@@ -11,7 +11,15 @@ results directory's worth), produce
 * a **verdict table** — per model: sat / unsat / unknown, decided-vs-
   attempted, split by the deciding stage (the per-partition ``verdict``
   events the sweep emits carry a ``via`` attr);
+* a **per-kernel compile table** — per ``obs_jit`` kernel: compiles,
+  distinct signatures, total compile seconds, first-compile FLOPs and
+  temp-buffer bytes (from the ``compile.<kernel>`` spans, backfilled from
+  the closing metrics snapshot for compiles that predate tracer
+  activation);
 * the run's **device-launch total** (from the closing metrics snapshot).
+
+Torn/partially-written lines (crash mid-sweep) are skipped with a counted
+warning, never raised on.
 
 The same aggregate is emitted as JSON (``--json-out`` / ``--json``) so
 BENCH/PERF tooling can consume it without re-parsing tables.
@@ -44,23 +52,42 @@ def aggregate(paths: Iterable[str]) -> dict:
     """
     phases: Dict[str, dict] = {}
     span_count = 0
+    skipped_lines = 0
     launches = 0.0
     inflight_max = 0.0
     inflight_means: List[float] = []
     files = 0
     keyed: Dict[tuple, dict] = {}  # (model, partition_id) -> attrs, last wins
     anon: List[dict] = []  # verdict events without a partition id
+    compiles: Dict[str, dict] = {}  # kernel -> compile-table row
     for path in paths:
         files += 1
-        for rec in trace_mod.load_events(path):
+        records, skipped = trace_mod.load_events(path, count_skipped=True)
+        skipped_lines += skipped
+        for rec in records:
             rtype = rec.get("type")
             if rtype == "span":
                 span_count += 1
+                name = rec["name"]
+                attrs = rec.get("attrs", {})
+                if name.startswith("compile."):
+                    row = compiles.setdefault(name[len("compile."):], {
+                        "count": 0, "total_s": 0.0, "signatures": set(),
+                        "flops": None, "temp_bytes": None})
+                    row["count"] += 1
+                    row["total_s"] += rec.get("dur_s", 0.0)
+                    if attrs.get("signature") is not None:
+                        row["signatures"].add(
+                            (attrs.get("signature"), attrs.get("static")))
+                    for k in ("flops", "temp_bytes"):
+                        if row[k] is None and attrs.get(k) is not None:
+                            row[k] = attrs[k]
+                    continue  # compile spans live in their own table
                 ph = phases.setdefault(
-                    rec["name"], {"count": 0, "total_s": 0.0, "launches": 0})
+                    name, {"count": 0, "total_s": 0.0, "launches": 0})
                 ph["count"] += 1
                 ph["total_s"] += rec.get("dur_s", 0.0)
-                ph["launches"] += int(rec.get("attrs", {}).get("launches", 0))
+                ph["launches"] += int(attrs.get("launches", 0))
             elif rtype == "event" and rec.get("name") == "verdict":
                 attrs = rec.get("attrs", {})
                 if attrs.get("verdict") not in ("sat", "unsat", "unknown"):
@@ -73,8 +100,26 @@ def aggregate(paths: Iterable[str]) -> dict:
             elif rtype == "metrics":
                 # Each record is a per-run delta (tracer close), so multiple
                 # runs appended to one file sum correctly.
-                launches += _counter_total(rec.get("metrics", {}),
-                                           "device_launches")
+                metrics = rec.get("metrics", {})
+                launches += _counter_total(metrics, "device_launches")
+                # Compiles that happened while no tracer was active (e.g. a
+                # warm-up pass inside the traced scope's registry window)
+                # have no compile.<kernel> span; the closing snapshot's
+                # per-kernel counter/histogram series still carry them.
+                for s in metrics.get("xla_compiles", {}).get("series", []):
+                    kern = dict(s.get("labels", {})).get("kernel", "?")
+                    row = compiles.setdefault(kern, {
+                        "count": 0, "total_s": 0.0, "signatures": set(),
+                        "flops": None, "temp_bytes": None})
+                    row.setdefault("metric_count", 0)
+                    row["metric_count"] += int(s.get("value", 0))
+                for s in metrics.get("xla_compile_seconds",
+                                     {}).get("series", []):
+                    kern = dict(s.get("labels", {})).get("kernel", "?")
+                    row = compiles.get(kern)
+                    if row is not None:
+                        row.setdefault("metric_s", 0.0)
+                        row["metric_s"] += float(s.get("sum", 0.0))
                 # Async-pipeline overlap gauge (labels stat=max / stat=mean,
                 # last-write-wins per run): across runs, aggregate the peak
                 # of the maxes and the unweighted average of per-run means
@@ -99,9 +144,27 @@ def aggregate(paths: Iterable[str]) -> dict:
         if v != "unknown":  # the breakdown is of DECIDED partitions
             via[attrs.get("via", "?")] = via.get(attrs.get("via", "?"), 0) + 1
     decided = verdicts["sat"] + verdicts["unsat"]
+    compile_table = {}
+    for kern, row in sorted(compiles.items(),
+                            key=lambda kv: -(kv[1]["total_s"]
+                                             or kv[1].get("metric_s", 0.0))):
+        # Spans are authoritative when present (they carry signatures and
+        # durations); the metrics snapshot backfills span-less compiles.
+        count = max(row["count"], row.get("metric_count", 0))
+        total_s = row["total_s"] if row["count"] else row.get("metric_s", 0.0)
+        compile_table[kern] = {
+            "count": count,
+            "total_s": round(total_s, 3),
+            "signatures": len(row["signatures"]) if row["signatures"]
+            else None,
+            "flops": row["flops"],
+            "temp_bytes": row["temp_bytes"],
+        }
     return {
         "files": files,
         "span_count": span_count,
+        "skipped_lines": skipped_lines,
+        "compiles": compile_table,
         "phases": {k: {"count": v["count"],
                        "total_s": round(v["total_s"], 3),
                        "launches": v["launches"]}
@@ -125,6 +188,9 @@ def render(agg: dict) -> str:
     lines: List[str] = []
     lines.append(f"event logs: {agg['files']}   spans: {agg['span_count']}   "
                  f"device launches: {agg['device_launches']}")
+    if agg.get("skipped_lines"):
+        lines.append(f"warning: {agg['skipped_lines']} torn/truncated "
+                     f"line(s) skipped (crash mid-write)")
     if agg.get("launches_in_flight_max"):
         lines.append(f"launches in flight: max {agg['launches_in_flight_max']}"
                      f"   mean {agg['launches_in_flight_mean']:.2f}"
@@ -151,6 +217,18 @@ def render(agg: dict) -> str:
         lines.append("")
         lines.append("decided via: " + ", ".join(
             f"{k}={n}" for k, n in sorted(agg["via"].items())))
+    if agg.get("compiles"):
+        w = max(max(len(k) for k in agg["compiles"]), len("kernel"))
+        lines.append("")
+        lines.append(f"{'kernel':<{w}}  {'compiles':>8}  {'sigs':>4}  "
+                     f"{'compile_s':>9}  {'mflops':>10}  {'temp_mb':>8}")
+        for kern, row in agg["compiles"].items():
+            sigs = row["signatures"] if row["signatures"] is not None else "-"
+            mflops = f"{row['flops'] / 1e6:.1f}" if row["flops"] else "-"
+            temp = f"{row['temp_bytes'] / 1e6:.2f}" \
+                if row["temp_bytes"] is not None else "-"
+            lines.append(f"{kern:<{w}}  {row['count']:>8}  {sigs:>4}  "
+                         f"{row['total_s']:>9.3f}  {mflops:>10}  {temp:>8}")
     return "\n".join(lines)
 
 
@@ -164,6 +242,9 @@ def main(paths: List[str], json_out: str = None, as_json: bool = False) -> int:
         print(f"no such event log: {missing}", file=sys.stderr)
         return 2
     agg = aggregate(paths)
+    if agg.get("skipped_lines"):
+        print(f"report: skipped {agg['skipped_lines']} torn/truncated "
+              f"line(s) across {agg['files']} log(s)", file=sys.stderr)
     print(json.dumps(agg) if as_json else render(agg))
     if json_out:
         with open(json_out, "w") as fp:

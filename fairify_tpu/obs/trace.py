@@ -276,16 +276,30 @@ maybe_tracing = tracing
 # ---------------------------------------------------------------------------
 
 
-def load_events(path: str) -> list:
-    """Event-log records; tolerates the truncated trailing line a crashed
-    run leaves behind (the same convention as the verdict ledger)."""
+def load_events(path: str, count_skipped: bool = False):
+    """Event-log records; tolerates truncated/partially-written lines.
+
+    A crash mid-sweep leaves a torn final line (the same convention as the
+    verdict ledger), and a crash mid-``write`` on a network filesystem can
+    tear earlier lines too.  Unparseable lines are skipped, never raised on
+    — but they are *counted*, so consumers (``fairify_tpu report``) can
+    surface "N torn line(s) skipped" instead of silently under-reporting.
+
+    Returns the record list, or ``(records, skipped)`` when
+    ``count_skipped`` is true.  Blank lines are not torn records.
+    """
     out = []
+    skipped = 0
     with open(path) as fp:
         for line in fp:
+            if not line.strip():
+                continue
             try:
                 out.append(json.loads(line))
             except json.JSONDecodeError:
-                continue
+                skipped += 1
+    if count_skipped:
+        return out, skipped
     return out
 
 

@@ -41,7 +41,6 @@ by ``EngineConfig.lattice_max``.
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional, Tuple
 
 import jax
@@ -49,6 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from fairify_tpu.models.mlp import MLP
+from fairify_tpu.obs import obs_jit
 from fairify_tpu.utils import profiling
 from fairify_tpu.utils.num import matmul
 from fairify_tpu.verify.property import shared_dims, valid_assignments
@@ -192,7 +192,7 @@ def _device_signs(net, start, strides, widths, lo_shared, bases,
                      jnp.where(f < -e, jnp.int8(-1), jnp.int8(0)))
 
 
-@partial(jax.jit, static_argnames=("chunk", "dims_tuple", "d"))
+@obs_jit(static_argnames=("chunk", "dims_tuple", "d"))
 def _lattice_scan_kernel(net: MLP, start, n_total, strides, widths,
                          lo_shared, bases, valid_mask, valid_pair_f,
                          chunk: int, dims_tuple: tuple, d: int):
@@ -235,7 +235,7 @@ def _lattice_scan_kernel(net: MLP, start, n_total, strides, widths,
     return first_flip, margin_count, margin_idx, sign_cols
 
 
-@partial(jax.jit, static_argnames=("chunk", "dims_tuple", "d"))
+@obs_jit(static_argnames=("chunk", "dims_tuple", "d"))
 def _lattice_signs_kernel(net: MLP, start, strides, widths, lo_shared,
                           bases, chunk: int, dims_tuple: tuple, d: int):
     """Full (V, chunk) sign tensor — the margin-overflow fallback pull."""
@@ -243,8 +243,7 @@ def _lattice_signs_kernel(net: MLP, start, strides, widths, lo_shared,
                          chunk, dims_tuple, d)
 
 
-@partial(jax.jit,
-         static_argnames=("chunk", "dims_tuple", "d", "ra_ws", "eps"))
+@obs_jit(static_argnames=("chunk", "dims_tuple", "d", "ra_ws", "eps"))
 def _lattice_scan_kernel_ra(net: MLP, start, n_total, strides, widths,
                             lo_shared, bases, valid_mask, valid_pair_f,
                             chunk: int, dims_tuple: tuple, d: int,

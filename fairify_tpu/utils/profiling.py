@@ -101,7 +101,8 @@ class ThroughputCounter:
         }
 
     def dump(self, path: str, phases: Optional[Dict[str, float]] = None,
-             pipeline: Optional[Dict[str, float]] = None) -> None:
+             pipeline: Optional[Dict[str, float]] = None,
+             compile: Optional[Dict[str, float]] = None) -> None:
         out = self.summary()
         if phases:
             out["phases_s"] = {k: round(v, 3) for k, v in phases.items()}
@@ -112,5 +113,16 @@ class ThroughputCounter:
             out["pipeline_depth"] = int(pipeline.get("depth", 1))
             out["launches_in_flight_max"] = int(pipeline.get("max", 0))
             out["launches_in_flight_mean"] = float(pipeline.get("mean", 0.0))
+        if compile:
+            # Per-run XLA compile record (obs.compile.totals_delta): how
+            # much of this sweep's wall time was trace/lower/compile, how
+            # many compiles happened, and the largest per-executable
+            # temp-buffer footprint among kernels compiled DURING this run
+            # (the HBM number that bounds chunk sizing; a warm run reports
+            # 0 compiles and 0 peak — its executables are attributed to
+            # the run that compiled them).
+            out["n_compiles"] = int(compile.get("n_compiles", 0))
+            out["compile_s"] = round(float(compile.get("compile_s", 0.0)), 3)
+            out["peak_temp_bytes"] = int(compile.get("peak_temp_bytes", 0))
         with open(path, "w") as fp:
             json.dump(out, fp, indent=2)

@@ -28,7 +28,6 @@ from __future__ import annotations
 import time
 from dataclasses import dataclass, field
 from fractions import Fraction
-from functools import partial
 from typing import Optional, Tuple
 
 import jax
@@ -36,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from fairify_tpu import obs
+from fairify_tpu.obs import obs_jit
 from fairify_tpu.models.mlp import MLP
 from fairify_tpu.ops import crown as crown_ops
 from fairify_tpu.ops import interval as interval_ops
@@ -48,7 +48,7 @@ from fairify_tpu.verify.property import PairEncoding
 # ---------------------------------------------------------------------------
 
 
-@jax.jit
+@obs_jit
 def _role_logit_bounds(net: MLP, x_lo, x_hi, xp_lo, xp_hi, use_crown: bool):
     """Logit bounds of both roles; inputs (..., V, d) → four (..., V) arrays."""
 
@@ -184,7 +184,8 @@ def _certify_impl(net: MLP, x_lo, x_hi, xp_lo, xp_hi, lo, hi, assign_vals,
     return ~possible.any(axis=(-2, -1)), score
 
 
-_role_certify_kernel = jax.jit(_certify_impl, static_argnames=("alpha_iters",))
+_role_certify_kernel = obs_jit(_certify_impl, name="engine.role_certify",
+                               static_argnames=("alpha_iters",))
 
 
 def _find_flips_impl(xp, lx, lp, valid, valid_pair):
@@ -242,7 +243,8 @@ def _certify_attack_impl(net: MLP, x_lo, x_hi, xp_lo, xp_hi, lo, hi,
     return cert, score, found, wit
 
 
-_certify_attack_kernel = jax.jit(_certify_attack_impl,
+_certify_attack_kernel = obs_jit(_certify_attack_impl,
+                                 name="engine.certify_attack",
                                  static_argnames=("alpha_iters",))
 
 
@@ -264,7 +266,7 @@ def no_flip_certified(
     return ~possible.any(axis=(-2, -1))
 
 
-@jax.jit
+@obs_jit
 def _attack_logits(net: MLP, x_roles, xp_roles):
     """Forward logits for attack candidates; shapes (..., V, d) → (..., V)."""
     from fairify_tpu.models.mlp import forward
@@ -317,7 +319,7 @@ def find_flips(
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("steps", "restarts"))
+@obs_jit(static_argnames=("steps", "restarts"))
 def _pgd_attack_kernel(
     net: MLP, lo, hi, assign_vals, pa_mask, ra_mask, valid, eps, key, steps: int, restarts: int
 ):
@@ -606,13 +608,13 @@ def decide_leaf(enc: PairEncoding, weights, biases, point: np.ndarray, lo, hi):
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("alpha_iters",))
+@obs_jit(static_argnames=("alpha_iters",))
 def _sign_bound_kernel(net: MLP, lo, hi, signs, alpha_iters: int):
     return crown_ops.sign_constrained_output_bounds(net, lo, hi, signs,
                                                     alpha_iters=alpha_iters)
 
 
-@jax.jit
+@obs_jit
 def _inter_bounds_kernel(net: MLP, lo, hi):
     """Batched CROWN pre-activation bounds (device) for the host LP phase."""
     b = crown_ops.crown_bounds(net, lo, hi)
@@ -684,7 +686,7 @@ def _leaf_sign_lp(weights, biases, masks, pattern, lo, hi, want_positive: bool):
     return "mixed"
 
 
-@jax.jit
+@obs_jit
 def _sample_role_logits(net: MLP, x_roles, xp_roles):
     from fairify_tpu.models.mlp import forward
 

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from fairify_tpu import obs
+from fairify_tpu.obs import obs_jit
 from fairify_tpu.models.mlp import MLP
 from fairify_tpu.ops import exact as exact_ops
 from fairify_tpu.ops import interval as interval_ops
@@ -49,10 +50,7 @@ class PruneResult:
     sv_time_s: float  # exact-verification phase (analog of SV solver time)
 
 
-from functools import partial
-
-
-@partial(jax.jit, static_argnames=("sim_size", "with_sim"))
+@obs_jit(static_argnames=("sim_size", "with_sim"))
 def _sim_and_bounds(net: MLP, keys, lo, hi, sim_size: int,
                     with_sim: bool = True):
     stats, sim = jax.vmap(
@@ -70,7 +68,7 @@ def _sim_and_bounds(net: MLP, keys, lo, hi, sim_size: int,
 from fairify_tpu.utils.prng import grid_keys  # canonical key derivation
 
 
-@partial(jax.jit, static_argnames=("sim_size",))
+@obs_jit(static_argnames=("sim_size",))
 def _sim_stats(net: MLP, keys, lo, hi, sim_size: int):
     """Simulation statistics only — no IBP bounds (harsh prune needs none)."""
     stats, _ = jax.vmap(

@@ -25,7 +25,6 @@ import json
 import os
 import time
 from dataclasses import dataclass, field, replace
-from functools import partial
 from typing import Dict, List, Optional
 
 import jax
@@ -33,6 +32,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from fairify_tpu import obs
+from fairify_tpu.obs import obs_jit
+from fairify_tpu.obs import compile as compile_obs
 from fairify_tpu.data import loaders
 from fairify_tpu.models import mlp as mlp_mod
 from fairify_tpu.models import zoo
@@ -152,9 +153,8 @@ def _stage0_certify_and_attack(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
     for s, e in spans:
         for item in pipe.submit(
                 lambda s=s, e=e: _stage0_block_submit(
-                    net, enc, _pad_rows(lo[s:e], step),
-                    _pad_rows(hi[s:e], step), cfg, mesh,
-                    cfg.engine.seed + seed_offset + s),
+                    net, enc, lo[s:e], hi[s:e], cfg, mesh,
+                    cfg.engine.seed + seed_offset + s, pad_to=step),
                 meta=(s, e)):
             consume(*item)
     for item in pipe.drain():
@@ -163,13 +163,23 @@ def _stage0_certify_and_attack(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
 
 
 def _stage0_block_submit(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
-                         mesh, rng_seed):
+                         mesh, rng_seed, pad_to: int = 0):
     """Dispatch one grid block's stage-0 kernels; no sync on their results.
 
     Returns ``(payload, ctx)`` for the launch pipeline: ``payload`` holds
     the launch's device arrays (fetched only at dequeue), ``ctx`` the
     host-side state :func:`_stage0_block_decode` needs.
+
+    ``pad_to`` > 0 pads a ragged final chunk up to the chunk bucket (last
+    row repeated) BEFORE the attack RNG draws, so every block of a sweep —
+    including the last — hits the one compiled executable per kernel
+    instead of triggering a second XLA compile per model, and the padded
+    rows' RNG draws are identical to an all-full-chunk grid's.  The pad
+    lives here (not at call sites) so the invariant cannot drift per
+    caller; decode trims via ``ctx["n"]`` + the consumer's span slice.
     """
+    if pad_to:
+        lo, hi = _pad_rows(lo, pad_to), _pad_rows(hi, pad_to)
     flo, fhi = lo.astype(np.float32), hi.astype(np.float32)
     x_lo, x_hi, xp_lo, xp_hi, valid = role_boxes(enc, flo, fhi)
     plo, phi, valid_in = flo, fhi, valid
@@ -263,7 +273,7 @@ def _stage0_block(net, enc: PairEncoding, lo, hi, cfg: SweepConfig, mesh, rng_se
     return _stage0_block_decode(jax.device_get(payload), ctx)
 
 
-@partial(jax.jit, static_argnames=("alpha_iters",))
+@obs_jit(static_argnames=("alpha_iters",))
 def _family_certify_kernel(stacked, a, b, c, d, plo, phi, av, pm, rm, eps,
                            va, vp, alpha_iters):
     """vmapped stage-0 combined certificate over a stacked model family.
@@ -280,7 +290,7 @@ def _family_certify_kernel(stacked, a, b, c, d, plo, phi, av, pm, rm, eps,
     )(MLP(stacked.weights, stacked.biases, stacked.masks))
 
 
-@partial(jax.jit, static_argnames=("alpha_iters",))
+@obs_jit(static_argnames=("alpha_iters",))
 def _family_stage0_kernel(stacked, a, b, c, d, plo, phi, av, pm, rm, eps,
                           va, vp, xr, pr, alpha_iters):
     """Certificate + attack + flip detection for a stacked family, ONE launch.
@@ -298,7 +308,7 @@ def _family_stage0_kernel(stacked, a, b, c, d, plo, phi, av, pm, rm, eps,
     )(MLP(stacked.weights, stacked.biases, stacked.masks))
 
 
-@jax.jit
+@obs_jit
 def _family_bounds_kernel(stacked, a, b, c, d, use_crown):
     from fairify_tpu.models.mlp import MLP
 
@@ -307,7 +317,7 @@ def _family_bounds_kernel(stacked, a, b, c, d, use_crown):
     )(MLP(stacked.weights, stacked.biases, stacked.masks))
 
 
-@jax.jit
+@obs_jit
 def _family_logits_kernel(stacked, xr, pr):
     from fairify_tpu.models.mlp import MLP, forward
 
@@ -366,9 +376,8 @@ def stage0_families(stacks, enc: PairEncoding, lo, hi, cfg: SweepConfig,
             for item in pipe.submit(
                     lambda gi=gi, stacked=stacked, s=s, e=e:
                     _family_block_submit(
-                        stacked, enc, _pad_rows(lo[s:e], step),
-                        _pad_rows(hi[s:e], step), cfg, mesh,
-                        cfg.engine.seed + s),
+                        stacked, enc, lo[s:e], hi[s:e], cfg, mesh,
+                        cfg.engine.seed + s, pad_to=step),
                     meta=(gi, s, e)):
                 consume(*item)
     for item in pipe.drain():
@@ -377,8 +386,15 @@ def stage0_families(stacks, enc: PairEncoding, lo, hi, cfg: SweepConfig,
 
 
 def _family_block_submit(stacked, enc: PairEncoding, lo, hi, cfg: SweepConfig,
-                         mesh, rng_seed):
-    """Dispatch one family block's stage-0 kernels; no sync on results."""
+                         mesh, rng_seed, pad_to: int = 0):
+    """Dispatch one family block's stage-0 kernels; no sync on results.
+
+    ``pad_to`` pads a ragged final chunk to the chunk bucket before the RNG
+    draws (see :func:`_stage0_block_submit`) — one compiled executable per
+    stacked family, no second XLA compile on the last block.
+    """
+    if pad_to:
+        lo, hi = _pad_rows(lo, pad_to), _pad_rows(hi, pad_to)
     M = stacked.weights[0].shape[0]
     flo, fhi = lo.astype(np.float32), hi.astype(np.float32)
     x_lo, x_hi, xp_lo, xp_hi, valid = role_boxes(enc, flo, fhi)
@@ -480,7 +496,7 @@ def _family_block_decode(host, ctx):
     return results
 
 
-@partial(jax.jit, static_argnames=("sim_size",))
+@obs_jit(static_argnames=("sim_size",))
 def _parity_grid_from_keys(net, keys, lo, hi, alive, sim_size: int):
     """Pruned-vs-original prediction parity for the whole grid, one kernel.
 
@@ -503,7 +519,7 @@ def _parity_grid_from_keys(net, keys, lo, hi, alive, sim_size: int):
     return jax.vmap(one)(keys, lo, hi, alive)
 
 
-@partial(jax.jit, static_argnames=("sim_size",))
+@obs_jit(static_argnames=("sim_size",))
 def _sim_rows(key, lo, hi, sim_size: int):
     """One partition's simulation samples, regenerated from its key."""
     from fairify_tpu.ops import simulate as sim_ops
@@ -584,12 +600,23 @@ def verify_model(
     outer scope (CLI ``--trace-out``, ``run_sweep``) already owns one; the
     model-level span carries the final verdict counts as attributes.
     """
+    from fairify_tpu.obs import heartbeat as hb_mod
+
     with obs.maybe_tracing(cfg.trace_out, run_id=f"{cfg.name}-{model_name}"):
         with obs.span("verify_model", model=model_name, dataset=cfg.dataset,
                       preset=cfg.name) as sp:
-            rep = _verify_model_impl(
-                net, cfg, model_name, dataset, mesh, resume, retry_unknown,
-                stage0, partition_span, host_index, host_count)
+            try:
+                rep = _verify_model_impl(
+                    net, cfg, model_name, dataset, mesh, resume, retry_unknown,
+                    stage0, partition_span, host_index, host_count)
+            except BaseException:
+                # The impl registers this run's heartbeat as the live one
+                # (compile flags); a raise would otherwise leak it, and
+                # later runs' compiles would print against the dead label.
+                hb = hb_mod.active()
+                if hb is not None:
+                    hb.close()
+                raise
             sp.set(partitions=rep.partitions_total, **rep.counts)
             return rep
 
@@ -654,6 +681,7 @@ def _verify_model_impl(
 
     counter = ThroughputCounter(n_devices=1 if mesh is None else int(np.prod(list(mesh.shape.values()))))
     launch0 = profiling.launch_count()
+    compile0 = compile_obs.snapshot_totals()
     heartbeat = obs.Heartbeat(cfg.heartbeat_s, total=P, label=sink_name) \
         if cfg.heartbeat_s > 0 else None
     # One launch pipeline for the whole run: the stage-0 certify, parity
@@ -1060,10 +1088,12 @@ def _verify_model_impl(
     counter.launches = profiling.launch_count() - launch0
     counter.dump(os.path.join(cfg.result_dir, f"{cfg.name}-{sink_name}.throughput.json"),
                  phases=timer.phases,
-                 pipeline={"depth": cfg.pipeline_depth, **pipe.stats.summary()})
+                 pipeline={"depth": cfg.pipeline_depth, **pipe.stats.summary()},
+                 compile=compile_obs.totals_delta(compile0))
     if heartbeat is not None:  # final line regardless of throttle state
         heartbeat.beat(decided=sat_count + unsat_count, attempted=len(outcomes),
                        unknown=unk_count, force=True)
+        heartbeat.close()
     return ModelReport(
         model=model_name, dataset=cfg.dataset, outcomes=outcomes,
         original_acc=orig_acc, total_time_s=timer.total(), partitions_total=P,

@@ -14,6 +14,15 @@ into the tier-1 test run via ``tests/test_observability.py``).  Two rules:
   their job), the heartbeat itself, and two legacy shims that predate the
   obs layer (``verify/sweep.py``'s stderr skip warning,
   ``verify/exact_check.py``'s debug prints — shrink, don't grow, this list).
+* **No bare ``jax.jit`` in ``fairify_tpu/verify/`` or ``fairify_tpu/ops/``**
+  — device kernels in the verification core must register through
+  ``fairify_tpu.obs.compile.obs_jit`` so every compile is named, counted,
+  timed, and cost/memory-analyzed.  An unregistered ``jax.jit`` (bare
+  decorator, ``jax.jit(...)`` call, or ``partial(jax.jit, ...)``) is a
+  blind spot: its recompiles from shape/static churn are exactly the
+  ~110 ms-to-tens-of-seconds stalls the compile registry exists to
+  attribute.  The allowlist (``ALLOW_RAW_JIT``, repo-relative file paths)
+  names reviewed exceptions — currently empty; shrink, don't grow, it.
 * **No synchronous device fetch in ``fairify_tpu/verify/`` loops** —
   ``np.asarray(...)`` / ``jax.device_get(...)`` / ``.block_until_ready()``
   inside a ``for``/``while`` body stalls the launch queue exactly where
@@ -49,6 +58,13 @@ ALLOW_PRINT = {
     "fairify_tpu/verify/sweep.py",   # legacy: stderr width-mismatch warning
     "fairify_tpu/verify/exact_check.py",  # legacy: gated debug prints
 }
+
+# Raw-jit rule scope: every device kernel of the verification core must go
+# through obs.compile.obs_jit (named compile spans, recompile accounting).
+RAW_JIT_SCOPE = ("fairify_tpu/verify/", "fairify_tpu/ops/")
+# Repo-relative file paths reviewed as legitimate bare-jit users.  Empty:
+# the whole core is migrated; a new entry needs a reason in review.
+ALLOW_RAW_JIT: set = set()
 
 # Hot-loop fetch rule scope: chunk/frontier loops of the verification core.
 LOOP_FETCH_SCOPE = "fairify_tpu/verify/"
@@ -97,6 +113,13 @@ def _is_time_time(node: ast.Call) -> bool:
 
 def _is_print(node: ast.Call) -> bool:
     return isinstance(node.func, ast.Name) and node.func.id == "print"
+
+
+def _is_raw_jit(node: ast.AST) -> bool:
+    """The ``jax.jit`` attribute itself: catches ``@jax.jit``,
+    ``jax.jit(f, ...)`` and ``partial(jax.jit, ...)`` uniformly."""
+    return (isinstance(node, ast.Attribute) and node.attr == "jit"
+            and isinstance(node.value, ast.Name) and node.value.id == "jax")
 
 
 def _is_loop_fetch(node: ast.Call) -> bool:
@@ -149,6 +172,14 @@ def check_file(path: str, rel: str) -> list:
     except SyntaxError as exc:
         return [f"{rel}:{exc.lineno}: syntax error: {exc.msg}"]
     errors = []
+    if rel.startswith(RAW_JIT_SCOPE) and rel not in ALLOW_RAW_JIT:
+        for node in ast.walk(tree):
+            if _is_raw_jit(node):
+                errors.append(
+                    f"{rel}:{node.lineno}: bare jax.jit — register device "
+                    f"kernels through fairify_tpu.obs.compile.obs_jit so "
+                    f"compiles are named/counted/timed (or extend "
+                    f"ALLOW_RAW_JIT with a reviewed reason)")
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
             continue

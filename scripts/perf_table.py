@@ -67,8 +67,8 @@ def main() -> None:
         "went through branch-and-bound).",
         "",
         "| Run | Model | Decided | UNK | parts/s/chip | s/part | st0% | "
-        "pipe (max/mean) | slowest phase |",
-        "|---|---|---|---|---|---|---|---|---|",
+        "pipe (max/mean) | compile | slowest phase |",
+        "|---|---|---|---|---|---|---|---|---|---|",
     ]
     worst = []
     for r in rows:
@@ -88,13 +88,20 @@ def main() -> None:
                     f"{r.get('launches_in_flight_mean', 0.0):.2f}")
         else:
             pipe = "—"
+        # Per-run XLA compile record (obs.compile; absent on records
+        # written before the compile registry existed).  A warm run shows
+        # 0×/0.0s — nonzero n_compiles on a warm row is shape churn.
+        if "n_compiles" in r:
+            comp = f"{r['n_compiles']}x {r.get('compile_s', 0.0):.1f}s"
+        else:
+            comp = "—"
         lines.append(
             f"| {r['_dir']}/{r['_preset']} | {r['_model']} | {r['decided']} | "
             f"{r['unknown']} | {r['partitions_per_sec_per_chip']:.3f} | "
-            f"{spp:.3f} | {st0:.0f} | {pipe} | {slow} |")
+            f"{spp:.3f} | {st0:.0f} | {pipe} | {comp} | {slow} |")
         worst.append((spp, f"{r['_preset']}/{r['_model']}"))
     if not rows:
-        lines.append("| *(no records yet)* | | | | | | | | |")
+        lines.append("| *(no records yet)* | | | | | | | | | |")
     else:
         worst.sort(reverse=True)
         lines += [
@@ -106,10 +113,11 @@ def main() -> None:
             "slow kernels: UNKNOWN-retry passes re-enter a model to decide "
             "a handful of leftover partitions (full stage-0 amortized over "
             "single-digit newly-decided counts), and the first model of an "
-            "architecture in a cold process pays one-time XLA compile "
-            "(tens of seconds over a tunnelled link).  Whole-grid rows for "
-            "the same architectures run orders of magnitude faster per "
-            "partition (see the main table).",
+            "architecture in a cold process pays one-time XLA compile — "
+            "now a recorded number (the `compile` column / PERF.md's "
+            "cold-compile re-measurement: 61-81% of a cold run's wall is "
+            "compile_s).  Whole-grid rows for the same architectures run "
+            "orders of magnitude faster per partition (see the main table).",
         ]
 
     # Multi-device scaling record (audits/scaling_r4.json, scripts/scaling.py).

@@ -1,0 +1,230 @@
+#!/usr/bin/env python
+"""Perf regression gate: diff two bench/throughput records within noise bands.
+
+    python scripts/perfdiff.py BASELINE CANDIDATE [--rel-guard G] [--rel-tol T]
+
+Each input is either a bench output (one JSON object per line, as printed
+by ``python -m fairify_tpu bench`` and archived in ``BENCH_r*.json``) or a
+sweep throughput record (``<preset>-<model>.throughput.json``).  Exit code
+1 iff at least one shared metric regressed; 0 otherwise — the CI gate the
+bench trajectory runs behind.
+
+**Noise-band rule** (docs/DESIGN.md §8): bench records carry a per-metric
+repeat band [min, max] around the quoted median.  A higher-is-better metric
+is a regression iff the candidate's band falls *entirely below* the
+baseline's (``cand.max < base.min``) AND the gap clears a relative guard
+(default 2% of the baseline value) — so identical runs and band-overlapping
+noise always pass, while a genuine slowdown (disjoint bands) always fails.
+Records without repeats (throughput JSONs) have zero-width bands, where the
+guard alone separates noise from signal; their default guard is the wider
+``--rel-tol`` (20%) since a single sample carries no variance evidence.
+
+Lower-is-better counters (``device_launches``, ``n_compiles``,
+``compile_s``) regress when the candidate exceeds baseline by the
+tolerance: launch/compile counts are deterministic per config, so growth
+means a lost fusion or fresh shape churn.
+
+``--self-test`` runs the built-in contract checks (wired into tier-1 via
+``tests/test_perfdiff.py``): identical records pass, a 2x slowdown fails,
+overlapping noisy bands pass, doubled launches fail.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+# Higher-is-better rate fields of a throughput record; everything a bench
+# line quotes under "value" is also a rate.
+_THROUGHPUT_RATES = ("partitions_per_sec", "partitions_per_sec_per_chip")
+# Lower-is-better counters shared by bench lines and throughput records,
+# with an absolute growth floor so a ZERO baseline still gates: a warm run's
+# healthy state is n_compiles=0/compile_s=0.0, and growth from 0 is exactly
+# the shape-churn regression this tool exists to catch (a relative-only rule
+# would skip it).  The compile_s floor of 0.5s ignores persistent-cache
+# reload jitter while catching any real recompile.
+_LOWER_BETTER = {"device_launches": 0.5, "n_compiles": 0.5, "compile_s": 0.5}
+
+
+def _metric_key(metric: str) -> str:
+    """Stable join key for a bench metric string: the text before the
+    parenthesised run detail (counts/medians vary run to run by design)."""
+    return metric.split(" (", 1)[0].strip()
+
+
+def _bench_record(obj: dict) -> Optional[dict]:
+    if "metric" not in obj or "value" not in obj:
+        return None
+    v = obj["value"]
+    rec = {"value": v, "min": obj.get("min", v), "max": obj.get("max", v),
+           "banded": "min" in obj and "max" in obj}
+    for k in _LOWER_BETTER:
+        if obj.get(k) is not None:
+            rec[k] = obj[k]
+    return rec
+
+
+def load_records(path: str) -> Dict[str, dict]:
+    """Metric key → record.  Accepts bench JSONL (one object per line) or a
+    single throughput/headline JSON object; unparseable lines are skipped
+    (bench output may interleave stderr noise when captured loosely)."""
+    with open(path) as fp:
+        text = fp.read()
+    objs = []
+    try:
+        parsed = json.loads(text)
+        objs = parsed if isinstance(parsed, list) else [parsed]
+    except json.JSONDecodeError:
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                objs.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    out: Dict[str, dict] = {}
+    for obj in objs:
+        if not isinstance(obj, dict):
+            continue
+        rec = _bench_record(obj)
+        if rec is not None:
+            out[_metric_key(obj["metric"])] = rec
+            continue
+        # Throughput JSON: every rate present gets its own zero-width-band
+        # record (total AND per-chip — a device-count change can hold one
+        # steady while the other regresses), counters attached to the first.
+        first = True
+        for rate in _THROUGHPUT_RATES:
+            if obj.get(rate) is not None:
+                v = float(obj[rate])
+                trec = {"value": v, "min": v, "max": v, "banded": False}
+                if first:
+                    for k in _LOWER_BETTER:
+                        if obj.get(k) is not None:
+                            trec[k] = obj[k]
+                    first = False
+                out[rate] = trec
+    return out
+
+
+def compare(base: Dict[str, dict], cand: Dict[str, dict],
+            rel_guard: float = 0.02, rel_tol: float = 0.2) -> List[dict]:
+    """Regression findings over the metrics both sides carry."""
+    findings: List[dict] = []
+    for key in sorted(base):
+        b = base[key]
+        c = cand.get(key)
+        if c is None:
+            findings.append({"metric": key, "kind": "missing",
+                             "detail": "metric absent from candidate"})
+            continue
+        # Higher-is-better rate with the noise-band rule.
+        guard = rel_guard if (b["banded"] and c["banded"]) else rel_tol
+        gap = b["min"] - c["max"]
+        if gap > 0 and gap > guard * max(abs(b["value"]), 1e-12):
+            findings.append({
+                "metric": key, "kind": "regression",
+                "detail": (f"candidate band [{c['min']}, {c['max']}] below "
+                           f"baseline band [{b['min']}, {b['max']}] "
+                           f"(median {b['value']} -> {c['value']})")})
+        # Lower-is-better counters both records carry.
+        for lk, floor in _LOWER_BETTER.items():
+            bv, cv = b.get(lk), c.get(lk)
+            if bv is None:
+                continue
+            if cv is None:
+                findings.append({
+                    "metric": f"{key}.{lk}", "kind": "missing",
+                    "detail": f"{lk} absent from candidate "
+                              f"(baseline has {bv})"})
+                continue
+            if cv > bv * (1.0 + rel_tol) + floor:
+                findings.append({
+                    "metric": f"{key}.{lk}", "kind": "regression",
+                    "detail": f"{lk} grew {bv} -> {cv} "
+                              f"(> {1.0 + rel_tol:.2f}x baseline + {floor})"})
+    return findings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("baseline", nargs="?")
+    ap.add_argument("candidate", nargs="?")
+    ap.add_argument("--rel-guard", type=float, default=0.02,
+                    help="disjoint-band gap guard for banded metrics "
+                         "(fraction of baseline; default 0.02)")
+    ap.add_argument("--rel-tol", type=float, default=0.2,
+                    help="tolerance for band-less metrics and lower-better "
+                         "counters (default 0.2)")
+    ap.add_argument("--json", action="store_true",
+                    help="print findings as one JSON line")
+    ap.add_argument("--self-test", action="store_true",
+                    help="run the built-in contract checks and exit")
+    args = ap.parse_args(argv)
+    if args.self_test:
+        return self_test()
+    if not args.baseline or not args.candidate:
+        ap.error("baseline and candidate are required (or --self-test)")
+    base = load_records(args.baseline)
+    cand = load_records(args.candidate)
+    if not base:
+        print(f"perfdiff: no recognizable records in {args.baseline}",
+              file=sys.stderr)
+        return 2
+    findings = compare(base, cand, rel_guard=args.rel_guard,
+                       rel_tol=args.rel_tol)
+    regressions = [f for f in findings if f["kind"] == "regression"]
+    if args.json:
+        print(json.dumps({"metrics": len(base), "findings": findings,
+                          "regressed": len(regressions)}))
+    else:
+        for f in findings:
+            tag = "REGRESSION" if f["kind"] == "regression" else "warning"
+            print(f"perfdiff {tag}: {f['metric']}: {f['detail']}")
+        verdict = "FAIL" if regressions else "ok"
+        print(f"perfdiff {verdict}: {len(base)} metric(s) compared, "
+              f"{len(regressions)} regressed")
+    return 1 if regressions else 0
+
+
+def self_test() -> int:
+    """Contract checks for the noise-band rule (tier-1, test_perfdiff.py)."""
+    base = {"pps": {"value": 50.0, "min": 46.0, "max": 53.0, "banded": True,
+                    "device_launches": 120, "n_compiles": 0}}
+    same = {"pps": dict(base["pps"])}
+    slow = {"pps": {"value": 25.0, "min": 23.0, "max": 26.5, "banded": True,
+                    "device_launches": 120, "n_compiles": 0}}
+    noisy = {"pps": {"value": 47.0, "min": 44.0, "max": 49.0, "banded": True,
+                     "device_launches": 120, "n_compiles": 0}}
+    launchy = {"pps": {"value": 50.0, "min": 46.0, "max": 53.0, "banded": True,
+                       "device_launches": 240, "n_compiles": 0}}
+    warm = {"pps": {"value": 50.0, "min": 46.0, "max": 53.0, "banded": True,
+                    "n_compiles": 0, "compile_s": 0.0}}
+    churned = {"pps": {"value": 50.0, "min": 46.0, "max": 53.0, "banded": True,
+                       "n_compiles": 6, "compile_s": 14.0}}
+    jitter = {"pps": {"value": 50.0, "min": 46.0, "max": 53.0, "banded": True,
+                      "n_compiles": 0, "compile_s": 0.3}}
+    checks = [
+        ("identical records pass", compare(base, same), 0),
+        ("2x slowdown flagged", compare(base, slow), 1),
+        ("overlapping noise bands pass", compare(base, noisy), 0),
+        ("doubled launches flagged", compare(base, launchy), 1),
+        ("compiles growing from a warm 0 baseline flagged",
+         compare(warm, churned), 2),
+        ("cache-reload jitter over a 0 baseline passes",
+         compare(warm, jitter), 0),
+    ]
+    failed = 0
+    for name, findings, want in checks:
+        got = len([f for f in findings if f["kind"] == "regression"])
+        ok = got == want
+        failed += not ok
+        print(f"perfdiff self-test: {name}: "
+              f"{'ok' if ok else f'FAIL (got {got}, want {want})'}")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,371 @@
+"""Compile observability: obs_jit registry semantics (cache hit vs recompile
+under shape/static churn, analysis fallback, nested-trace fallback,
+span/metrics agreement), the ragged-chunk pad (pinned compile counts +
+verdict invariance), and the heartbeat compile flag."""
+import io
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fairify_tpu import obs
+from fairify_tpu.obs import compile as compile_mod
+from fairify_tpu.obs import heartbeat as hb_mod
+from fairify_tpu.obs import metrics as metrics_mod
+from fairify_tpu.obs import report as report_mod
+from fairify_tpu.obs import trace as trace_mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state():
+    trace_mod.deactivate()
+    metrics_mod.registry().reset()
+    hb_mod._ACTIVE = None
+    yield
+    trace_mod.deactivate()
+    metrics_mod.registry().reset()
+    hb_mod._ACTIVE = None
+
+
+def _fresh_kernel(name, static=()):
+    """A uniquely-named obs_jit kernel (executable caches are process-wide,
+    so shared shapes across tests would hide compiles)."""
+
+    def fn(x, k=2):
+        for _ in range(int(k) if not isinstance(k, jnp.ndarray) else 2):
+            x = jnp.tanh(x @ jnp.eye(x.shape[-1], dtype=x.dtype))
+        return x
+
+    return compile_mod.obs_jit(fn, name=name, static_argnames=static)
+
+
+# ---------------------------------------------------------------------------
+# Registry semantics
+# ---------------------------------------------------------------------------
+
+
+def test_registration_and_default_name():
+    k = _fresh_kernel("t.reg_default")
+    assert compile_mod.kernels()["t.reg_default"] is k
+    # Default naming strips the underscore and qualifies by module basename.
+
+    @compile_mod.obs_jit
+    def _my_probe_kernel(x):
+        return x + 1
+
+    assert "test_compile_obs.my_probe_kernel" in compile_mod.kernels()
+    assert np.asarray(_my_probe_kernel(np.float32(1.0))) == 2.0
+
+
+def test_cache_hit_vs_shape_and_static_recompile():
+    k = _fresh_kernel("t.churn", static=("k",))
+    c = obs.registry().counter("xla_compiles")
+    x = np.ones((7, 5), np.float32)
+    y1 = k(x, k=2)
+    assert c.value(kernel="t.churn") == 1
+    y2 = k(x, k=2)  # identical signature: cache hit, no recompile
+    assert c.value(kernel="t.churn") == 1
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+    k(x, k=3)  # static-arg churn
+    assert c.value(kernel="t.churn") == 2
+    k(np.ones((9, 5), np.float32), k=2)  # shape churn
+    assert c.value(kernel="t.churn") == 3
+    assert k.stats.n_compiles == 3
+    assert len(k.stats.signatures) == 3
+    assert obs.registry().gauge(
+        "xla_kernel_signatures").value(kernel="t.churn") == 3
+    # Dtype-churn is a distinct signature too (a retrace in jax terms) —
+    # while f64 input canonicalizes to the f32 signature under x64-off,
+    # exactly as jax's own dispatch cache would treat it.
+    k(np.ones((7, 5), np.int32), k=2)
+    assert c.value(kernel="t.churn") == 4
+    k(np.ones((7, 5), np.float64), k=2)  # canonicalizes to f32: cache hit
+    assert c.value(kernel="t.churn") == 4
+
+
+def test_positional_static_args_and_results_match_plain_jit():
+    def fn(x, n):
+        return x * n
+
+    k = compile_mod.obs_jit(fn, name="t.pos_static", static_argnames=("n",))
+    x = np.arange(6, dtype=np.float32)
+    np.testing.assert_array_equal(np.asarray(k(x, 3)), x * 3)  # positional
+    np.testing.assert_array_equal(np.asarray(k(x, n=3)), x * 3)  # keyword
+    # Positional and keyword static spellings share one static key: the
+    # second call's (pos vs kw) must not have recompiled a third time.
+    assert k.stats.n_compiles == 1
+
+
+def test_first_compile_records_cost_and_memory_analysis():
+    k = _fresh_kernel("t.analysis")
+    k(np.ones((16, 8), np.float32))
+    st = k.stats
+    # CPU backend supports both analyses in this jax version; the contract
+    # is "recorded when available".
+    assert st.n_compiles == 1
+    if st.flops is not None:
+        assert st.flops > 0
+        assert obs.registry().gauge(
+            "xla_kernel_flops").value(kernel="t.analysis") == st.flops
+    if st.temp_bytes is not None:
+        assert obs.registry().gauge(
+            "xla_kernel_temp_bytes").value(kernel="t.analysis") == st.temp_bytes
+
+
+def test_graceful_fallback_when_analyses_unavailable(monkeypatch):
+    """Backends without cost/memory analysis must not break compilation."""
+    import jax._src.stages as stages
+
+    def boom(self):
+        raise NotImplementedError("no analysis on this backend")
+
+    monkeypatch.setattr(stages.Compiled, "cost_analysis", boom)
+    monkeypatch.setattr(stages.Compiled, "memory_analysis", boom)
+    k = _fresh_kernel("t.no_analysis")
+    out = k(np.ones((4, 3), np.float32))
+    assert np.asarray(out).shape == (4, 3)
+    assert k.stats.n_compiles == 1
+    assert k.stats.flops is None and k.stats.temp_bytes is None
+    assert k.stats.fallbacks == 0  # analysis absence is not a call fallback
+
+
+def test_aot_failure_falls_back_to_plain_jit(monkeypatch):
+    k = _fresh_kernel("t.aot_fail")
+
+    class _NoLower:
+        def __init__(self, jitted):
+            self._jitted = jitted
+
+        def __call__(self, *a, **kw):
+            return self._jitted(*a, **kw)
+
+        def lower(self, *a, **kw):
+            raise RuntimeError("AOT path unavailable")
+
+    monkeypatch.setattr(k, "_jitted", _NoLower(k._jitted))
+    out = k(np.ones((3, 3), np.float32))
+    assert np.asarray(out).shape == (3, 3)
+    assert k.stats.n_compiles == 0
+    assert k.stats.fallbacks >= 1
+    assert obs.registry().counter(
+        "xla_compile_fallbacks").value(kernel="t.aot_fail") >= 1
+    # Subsequent calls keep working through the fallback sentinel.
+    assert np.asarray(k(np.ones((3, 3), np.float32))).shape == (3, 3)
+
+
+def test_nested_trace_calls_do_not_count_as_compiles():
+    inner = _fresh_kernel("t.nested_inner")
+
+    @jax.jit
+    def outer(x):
+        return inner(x) + 1.0
+
+    out = outer(jnp.ones((4, 4)))
+    assert np.asarray(out).shape == (4, 4)
+    # The outer jit owns the (untracked) compile; the inner kernel saw only
+    # tracers and must not have taken the AOT path.
+    assert inner.stats.n_compiles == 0
+
+
+def test_compile_span_and_metrics_agree(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    with trace_mod.tracing(path):
+        k = _fresh_kernel("t.span_agree", static=("k",))
+        k(np.ones((5, 5), np.float32), k=2)
+        k(np.ones((5, 5), np.float32), k=2)  # hit — no span
+        k(np.ones((5, 5), np.float32), k=3)  # recompile — second span
+    events = trace_mod.load_events(path)
+    spans = [e for e in events if e["type"] == "span"
+             and e["name"] == "compile.t.span_agree"]
+    assert len(spans) == 2
+    for sp in spans:
+        assert sp["attrs"]["kernel"] == "t.span_agree"
+        assert "float32[5,5]" in sp["attrs"]["signature"]
+        assert sp["attrs"]["static"] in ("k=2", "k=3")
+        assert sp["attrs"]["compile_s"] >= 0
+        assert sp["dur_s"] >= sp["attrs"]["compile_s"]
+    # The closing metrics snapshot carries the same count.
+    metrics = next(e for e in reversed(events) if e["type"] == "metrics")
+    series = metrics["metrics"]["xla_compiles"]["series"]
+    mine = [s for s in series
+            if dict(s["labels"]).get("kernel") == "t.span_agree"]
+    assert mine and mine[0]["value"] == 2
+    # report builds the per-kernel table from the same log.
+    agg = report_mod.aggregate([path])
+    row = agg["compiles"]["t.span_agree"]
+    assert row["count"] == 2 and row["signatures"] == 2
+    assert "t.span_agree" in report_mod.render(agg)
+
+
+def test_totals_delta_per_run_view():
+    before = compile_mod.snapshot_totals()
+    k = _fresh_kernel("t.totals")
+    k(np.ones((6, 2), np.float32))
+    delta = compile_mod.totals_delta(before)
+    assert delta["n_compiles"] == 1
+    assert delta["compile_s"] > 0
+    # peak_temp_bytes is attributed to kernels compiled WITHIN the window.
+    if k.stats.temp_bytes:
+        assert delta["peak_temp_bytes"] == k.stats.temp_bytes
+    # A warm window (no compiles) attributes nothing — an earlier run's
+    # big executables never leak into a later run's record.
+    warm0 = compile_mod.snapshot_totals()
+    k(np.ones((6, 2), np.float32))  # cache hit
+    warm = compile_mod.totals_delta(warm0)
+    assert warm["n_compiles"] == 0
+    assert warm["peak_temp_bytes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Ragged-chunk pad: pinned compile counts + verdict invariance
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def tiny_domain(monkeypatch):
+    from fairify_tpu.data import domains as dom_mod
+    from fairify_tpu.data.domains import DomainSpec
+
+    dom = DomainSpec(name="tinycmp", label="y",
+                     ranges={"a": (0, 34), "pa": (0, 1), "b": (0, 3)})
+    monkeypatch.setitem(dom_mod.DOMAINS, "tinycmp", dom)
+    return dom
+
+
+def _tiny_cfg(tmp_path, **kw):
+    from fairify_tpu.verify import engine
+    from fairify_tpu.verify.config import SweepConfig
+
+    return SweepConfig(
+        name="tinycmp", dataset="tinycmp", protected=("pa",),
+        partition_threshold=5, sim_size=48, soft_timeout_s=30.0,
+        hard_timeout_s=600.0, result_dir=str(tmp_path),
+        engine=engine.EngineConfig(frontier_size=64, attack_samples=23,
+                                   bab_attack_samples=8, soft_timeout_s=30.0),
+        **kw)
+
+
+def test_ragged_chunk_single_compile_and_verdict_invariance(
+        tmp_path, tiny_domain):
+    """A grid whose last chunk is ragged (7 partitions, chunk 4) must pad
+    up to the chunk bucket inside the submit helpers: ONE stage-0 compile
+    per kernel for the whole sweep, and verdicts equal to the unchunked
+    run's."""
+    from fairify_tpu.verify import sweep
+    from fairify_tpu.verify.oracle import random_net
+
+    net = random_net(np.random.default_rng(11), (3, 5, 1))
+    cfg = _tiny_cfg(tmp_path / "ragged", grid_chunk=4)
+    c = obs.registry().counter("xla_compiles")
+    ragged = sweep.verify_model(net, cfg, model_name="m", resume=False)
+    # 7 partitions / chunk 4 → spans of 4,3: the ragged last block must
+    # reuse the 4-row executables, pinning ONE compile per stage-0 kernel
+    # (certify+attack fused, sim+bounds, parity).
+    assert ragged.partitions_total == 7
+    for kern in ("engine.certify_attack", "pruning.sim_and_bounds",
+                 "sweep.parity_grid_from_keys"):
+        assert c.value(kernel=kern) == 1, kern
+    thr = json.load(open(tmp_path / "ragged" / "tinycmp-m.throughput.json"))
+    assert thr["n_compiles"] == int(sum(
+        s["value"] for s in c.snapshot()))
+    assert thr["compile_s"] > 0
+
+    whole = sweep.verify_model(
+        net, _tiny_cfg(tmp_path / "whole", grid_chunk=0),
+        model_name="m", resume=False)
+    assert whole.counts["unknown"] == 0  # strict comparison is meaningful
+    assert ragged.counts == whole.counts
+    assert [o.verdict for o in ragged.outcomes] == \
+        [o.verdict for o in whole.outcomes]
+
+
+def test_family_ragged_chunk_single_compile(tmp_path, tiny_domain):
+    """Stacked-family stage 0 with a ragged final chunk: one compile for
+    the family kernel, per-model results identical to the unchunked pass."""
+    from fairify_tpu.parallel.mesh import stack_models
+    from fairify_tpu.verify import sweep
+    from fairify_tpu.verify.oracle import random_net
+    from fairify_tpu.verify.property import encode
+
+    nets = [random_net(np.random.default_rng(s), (3, 5, 1)) for s in (1, 2)]
+    stacked = stack_models(nets)
+    cfg = _tiny_cfg(tmp_path, grid_chunk=4)
+    enc = encode(cfg.query())
+    _, lo, hi = sweep.build_partitions(cfg)
+    assert lo.shape[0] % 4 != 0  # the point: a ragged last chunk
+    c = obs.registry().counter("xla_compiles")
+    chunked = sweep._stage0_family(stacked, enc, lo, hi, cfg)
+    assert c.value(kernel="sweep.family_stage0_kernel") == 1
+    whole = sweep._stage0_family(stacked, enc, lo, hi,
+                                 cfg.with_(grid_chunk=0))
+    for (cu, cs, cw), (wu, ws, ww) in zip(chunked, whole):
+        np.testing.assert_array_equal(cu, wu)
+        np.testing.assert_array_equal(cs, ws)
+        assert set(cw) == set(ww)
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat compile flag
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_flags_compiles():
+    out = io.StringIO()
+    hb = obs.Heartbeat(10.0, total=10, label="m", stream=out)
+    assert hb_mod.active() is hb
+    hb_mod.notify_compile("engine.certify_attack")
+    assert "[hb m] compiling engine.certify_attack…" in out.getvalue()
+    hb.close()
+    assert hb_mod.active() is None
+    hb_mod.notify_compile("engine.certify_attack")  # no active hb: no-op
+    assert out.getvalue().count("compiling") == 1
+
+
+def test_heartbeat_compile_flag_fires_during_real_compile():
+    out = io.StringIO()
+    hb = obs.Heartbeat(10.0, stream=out)
+    k = _fresh_kernel("t.hb_compile")
+    k(np.ones((2, 2), np.float32))
+    hb.close()
+    assert "compiling t.hb_compile…" in out.getvalue()
+
+
+def test_disabled_heartbeat_does_not_register():
+    hb = obs.Heartbeat(0.0)
+    assert hb_mod.active() is None
+    hb.close()
+
+
+def test_heartbeat_closed_when_sweep_raises(monkeypatch, tmp_path,
+                                            tiny_domain):
+    """A sweep that crashes mid-run must not leak its heartbeat as the live
+    one — later runs' compile flags would print against the dead label."""
+    from fairify_tpu.verify import sweep
+
+    def boom(*a, **kw):
+        obs.Heartbeat(1.0, label="doomed", stream=io.StringIO())
+        raise RuntimeError("mid-sweep crash")
+
+    monkeypatch.setattr(sweep, "_verify_model_impl", boom)
+    cfg = _tiny_cfg(tmp_path, heartbeat_s=1.0)
+    with pytest.raises(RuntimeError, match="mid-sweep crash"):
+        sweep.verify_model(object(), cfg, model_name="m")
+    assert hb_mod.active() is None
+
+
+def test_compile_flag_survives_closed_stream():
+    """A stale registration over a closed stream must never fail the kernel
+    call that triggered the flag; it deregisters itself instead."""
+    class _Closed:
+        def write(self, *a):
+            raise ValueError("I/O operation on closed file")
+
+        def flush(self):
+            raise ValueError("I/O operation on closed file")
+
+    hb = obs.Heartbeat(1.0, stream=_Closed())
+    assert hb_mod.active() is hb
+    hb_mod.notify_compile("engine.certify_attack")  # must not raise
+    assert hb_mod.active() is None

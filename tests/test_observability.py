@@ -330,6 +330,29 @@ def test_report_tolerates_truncated_line(tmp_path):
     assert agg["attempted"] == 4
 
 
+def test_torn_lines_counted_not_raised(tmp_path, capsys):
+    """Crash-mid-sweep leaves torn lines (the final line, or mid-file on a
+    network fs): load_events and report must skip them WITH a counted
+    warning, never raise or silently under-report."""
+    log = tmp_path / "run.jsonl"
+    _synthetic_log(log)
+    # Tear a mid-file line and append a torn final line.
+    lines = log.read_text().splitlines(keepends=True)
+    lines[1] = lines[1][: len(lines[1]) // 2].rstrip() + "\n"
+    log.write_text("".join(lines) + '{"type": "event", "na')
+    records, skipped = trace_mod.load_events(str(log), count_skipped=True)
+    assert skipped == 2
+    assert all(isinstance(r, dict) for r in records)
+    agg = report_mod.aggregate([str(log)])
+    assert agg["skipped_lines"] == 2
+    assert "torn/truncated" in report_mod.render(agg)
+    rc = report_mod.main([str(log)])
+    assert rc == 0
+    assert "skipped 2 torn/truncated" in capsys.readouterr().err
+    # Default signature unchanged for existing callers.
+    assert isinstance(trace_mod.load_events(str(log)), list)
+
+
 def test_report_dedupes_resumed_and_retried_partitions(tmp_path):
     """A resumed run appends ledger replays (and a retry re-decides an
     unknown) to the same log; each partition must count exactly once, with
@@ -398,15 +421,47 @@ def test_snapshot_delta_histograms_and_gauges():
 # ---------------------------------------------------------------------------
 
 
-def test_lint_obs_clean():
-    """The obs lint (tier-1-wired) passes on the current tree."""
+def _lint_obs():
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     sys.path.insert(0, os.path.join(root, "scripts"))
     try:
         import lint_obs
     finally:
         sys.path.pop(0)
-    assert lint_obs.main([]) == 0
+    return lint_obs
+
+
+def test_lint_obs_clean():
+    """The obs lint (tier-1-wired) passes on the current tree."""
+    assert _lint_obs().main([]) == 0
+
+
+def test_lint_bans_raw_jit_in_verify_and_ops(tmp_path):
+    """Every spelling of a bare jax.jit in verify/ or ops/ is flagged;
+    obs_jit passes; files outside the scope are untouched."""
+    lint_obs = _lint_obs()
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax\n"
+        "from functools import partial\n"
+        "@jax.jit\n"
+        "def a(x):\n    return x\n"
+        "b = jax.jit(lambda x: x)\n"
+        "@partial(jax.jit, static_argnames=('k',))\n"
+        "def c(x, k):\n    return x\n")
+    for scope_rel in ("fairify_tpu/verify/bad.py", "fairify_tpu/ops/bad.py"):
+        errors = lint_obs.check_file(str(bad), scope_rel)
+        assert len([e for e in errors if "bare jax.jit" in e]) == 3, scope_rel
+    # Out of scope (models/ trains ad-hoc nets; the rule protects the
+    # verification core): no raw-jit errors.
+    errors = lint_obs.check_file(str(bad), "fairify_tpu/models/bad.py")
+    assert not any("bare jax.jit" in e for e in errors)
+    good = tmp_path / "good.py"
+    good.write_text(
+        "from fairify_tpu.obs import obs_jit\n"
+        "@obs_jit(static_argnames=('k',))\n"
+        "def a(x, k):\n    return x\n")
+    assert lint_obs.check_file(str(good), "fairify_tpu/verify/good.py") == []
 
 
 def test_traced_sweep_matches_report(tmp_path, monkeypatch):

@@ -1,0 +1,150 @@
+"""scripts/perfdiff.py — the perf regression gate: noise-band rule, record
+loading (bench JSONL + throughput JSON), CLI exit codes, self-test wiring."""
+import json
+import os
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_ROOT, "scripts"))
+import perfdiff  # noqa: E402
+
+sys.path.pop(0)
+
+
+def _bench_line(value, lo, hi, launches=100, n_compiles=0):
+    return {"metric": f"verified_partitions_per_sec_per_chip (GC-1, sat=1 "
+                      f"unsat=2; median of 3 repeats)",
+            "value": value, "unit": "partitions/sec", "min": lo, "max": hi,
+            "device_launches": launches, "n_compiles": n_compiles}
+
+
+def test_self_test_passes():
+    """The built-in contract checks (CI wiring for the gate itself)."""
+    assert perfdiff.self_test() == 0
+
+
+def test_identical_bench_records_pass(tmp_path):
+    rec = json.dumps(_bench_line(50.0, 46.0, 53.0))
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(rec + "\n")
+    b.write_text(rec + "\n")
+    assert perfdiff.main([str(a), str(b)]) == 0
+
+
+def test_injected_2x_slowdown_flagged(tmp_path):
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(_bench_line(50.0, 46.0, 53.0)) + "\n")
+    b.write_text(json.dumps(_bench_line(25.0, 23.0, 26.5)) + "\n")
+    assert perfdiff.main([str(a), str(b)]) == 1
+
+
+def test_overlapping_noise_bands_pass(tmp_path):
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(_bench_line(50.0, 46.0, 53.0)) + "\n")
+    b.write_text(json.dumps(_bench_line(47.0, 44.0, 49.0)) + "\n")
+    assert perfdiff.main([str(a), str(b)]) == 0
+
+
+def test_metric_key_ignores_run_detail():
+    """Bench metric strings embed per-run counts; the join key must not."""
+    k1 = perfdiff._metric_key(
+        "verified_partitions_per_sec_per_chip (GC-1, sat=186; median of 3)")
+    k2 = perfdiff._metric_key(
+        "verified_partitions_per_sec_per_chip (GC-1, sat=99; median of 5)")
+    assert k1 == k2 == "verified_partitions_per_sec_per_chip"
+
+
+def test_throughput_json_comparison(tmp_path):
+    base = {"partitions_per_sec": 10.0, "device_launches": 40,
+            "n_compiles": 3, "compile_s": 4.0}
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(base))
+    # Within tolerance: passes.
+    b.write_text(json.dumps({**base, "partitions_per_sec": 9.0}))
+    assert perfdiff.main([str(a), str(b)]) == 0
+    # Halved rate: band-less record, rel-tol guard flags it.
+    b.write_text(json.dumps({**base, "partitions_per_sec": 5.0}))
+    assert perfdiff.main([str(a), str(b)]) == 1
+    # Recompile churn (the ragged-chunk gate): n_compiles doubled.
+    b.write_text(json.dumps({**base, "n_compiles": 6}))
+    assert perfdiff.main([str(a), str(b)]) == 1
+
+
+def test_both_throughput_rates_gated(tmp_path):
+    """A device-count change can hold total partitions_per_sec steady while
+    per-chip throughput halves — both rates must load and gate."""
+    base = {"partitions_per_sec": 10.0, "partitions_per_sec_per_chip": 10.0}
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(base))
+    assert set(perfdiff.load_records(str(a))) == {
+        "partitions_per_sec", "partitions_per_sec_per_chip"}
+    b.write_text(json.dumps({"partitions_per_sec": 10.0,
+                             "partitions_per_sec_per_chip": 5.0}))
+    assert perfdiff.main([str(a), str(b)]) == 1
+
+
+def test_zero_baseline_compile_growth_flagged(tmp_path):
+    """The headline warm-run case: baseline n_compiles=0/compile_s=0 is the
+    healthy state, and ANY real growth from it must gate (a relative-only
+    rule would skip a zero baseline entirely)."""
+    warm = {"partitions_per_sec": 10.0, "device_launches": 40,
+            "n_compiles": 0, "compile_s": 0.0}
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(warm))
+    b.write_text(json.dumps({**warm, "n_compiles": 6, "compile_s": 14.0}))
+    assert perfdiff.main([str(a), str(b)]) == 1
+    # Persistent-cache reload jitter under the absolute floor still passes.
+    b.write_text(json.dumps({**warm, "compile_s": 0.3}))
+    assert perfdiff.main([str(a), str(b)]) == 0
+    # A candidate that silently DROPS the counter fields warns (not a
+    # silent pass pretending the gate ran).
+    b.write_text(json.dumps({"partitions_per_sec": 10.0}))
+    assert perfdiff.main([str(a), str(b)]) == 0  # warning, not regression
+    recs = perfdiff.compare(perfdiff.load_records(str(a)),
+                            perfdiff.load_records(str(b)))
+    assert any(f["kind"] == "missing" and "n_compiles" in f["metric"]
+               for f in recs)
+
+
+def test_bench_jsonl_multiple_lines_and_noise_lines(tmp_path):
+    lines = [
+        json.dumps(_bench_line(50.0, 46.0, 53.0)),
+        json.dumps({"metric": "ac_suite_vmap (12 models)", "value": 900.0,
+                    "min": 850.0, "max": 930.0}),
+        "some stray stderr noise",
+    ]
+    a = tmp_path / "a.json"
+    a.write_text("\n".join(lines))
+    recs = perfdiff.load_records(str(a))
+    assert set(recs) == {"verified_partitions_per_sec_per_chip",
+                        "ac_suite_vmap"}
+    # One metric regresses, the other holds: still a failure overall.
+    b = tmp_path / "b.json"
+    b.write_text("\n".join([
+        json.dumps(_bench_line(50.0, 46.0, 53.0)),
+        json.dumps({"metric": "ac_suite_vmap (12 models)", "value": 300.0,
+                    "min": 280.0, "max": 320.0}),
+    ]))
+    assert perfdiff.main([str(a), str(b)]) == 1
+
+
+def test_missing_metric_warns_but_passes(tmp_path, capsys):
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(_bench_line(50.0, 46.0, 53.0)) + "\n")
+    b.write_text("{}")
+    assert perfdiff.main([str(a), str(b)]) == 0
+    assert "absent from candidate" in capsys.readouterr().out
+
+
+def test_unreadable_baseline_is_an_error(tmp_path):
+    a = tmp_path / "a.json"
+    a.write_text("not json at all")
+    b = tmp_path / "b.json"
+    b.write_text(json.dumps(_bench_line(50.0, 46.0, 53.0)))
+    assert perfdiff.main([str(a), str(b)]) == 2
+
+
+def test_self_test_cli_flag():
+    assert perfdiff.main(["--self-test"]) == 0
