@@ -76,6 +76,19 @@ def _overridden_cfg(args):
         overrides["heartbeat_s"] = float(args.heartbeat_interval)
     if getattr(args, "pipeline_depth", None) is not None:
         overrides["pipeline_depth"] = int(args.pipeline_depth)
+    if getattr(args, "max_launch_retries", None) is not None:
+        overrides["max_launch_retries"] = int(args.max_launch_retries)
+    if getattr(args, "launch_backoff", None) is not None:
+        overrides["launch_backoff_s"] = float(args.launch_backoff)
+    if getattr(args, "chunk_deadline", None) is not None:
+        overrides["chunk_deadline_s"] = float(args.chunk_deadline)
+    if getattr(args, "inject_fault", None):
+        # Validate specs at the CLI boundary so a typo fails fast, not
+        # mid-sweep when the schedule never fires.
+        from fairify_tpu.resilience import faults
+
+        faults.parse_specs(args.inject_fault)
+        overrides["inject_faults"] = tuple(args.inject_fault)
     return cfg.with_(**overrides) if overrides else cfg
 
 
@@ -291,6 +304,22 @@ def main(argv=None) -> int:
                           "in flight; 1 = synchronous, default 2)")
     run.add_argument("--heartbeat-interval", type=float, default=None,
                      help="stderr progress line every N seconds (0 = off)")
+    run.add_argument("--max-launch-retries", type=int, default=None,
+                     help="transient-fault retries per chunk before its "
+                          "partitions degrade to UNKNOWN-with-reason "
+                          "(default 2)")
+    run.add_argument("--launch-backoff", type=float, default=None,
+                     help="first-retry backoff seconds (exponential, "
+                          "jittered; default 0.05)")
+    run.add_argument("--chunk-deadline", type=float, default=None,
+                     help="per-chunk retry deadline in seconds (0 = off): "
+                          "no retry starts after a chunk has spent this long")
+    run.add_argument("--inject-fault", action="append", default=None,
+                     metavar="SITE:KIND:NTH",
+                     help="chaos testing: schedule a fault, e.g. "
+                          "launch.submit:transient:3 or compile:crash:1 "
+                          "(repeatable; sites: launch.submit launch.decode "
+                          "compile smt.query ledger.append)")
 
     ben = sub.add_parser("bench", help="run the headline benchmark")
     ben.add_argument("--trace-out", default=None,

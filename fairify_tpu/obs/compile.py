@@ -231,11 +231,22 @@ class ObsJit:
                             static=static_str) as sp:
             t0 = time.perf_counter()
             try:
+                # Named fault site for the chaos suite: an injected compile
+                # fault exercises exactly the fallback below (the kernel is
+                # served by plain jax.jit — results unchanged, the miss
+                # counted), so a flaky AOT path degrades observability only.
+                from fairify_tpu.resilience import faults as faults_mod
+
+                faults_mod.check("compile")
                 lowered = self._jitted.lower(*args, **kwargs)
                 t1 = time.perf_counter()
                 compiled = lowered.compile()
                 t2 = time.perf_counter()
-            except Exception:
+            except Exception as exc:
+                from fairify_tpu.resilience.supervisor import classify
+
+                if classify(exc) == "propagate":  # injected crash-kind etc.
+                    raise
                 self._note_fallback()
                 with self._lock:
                     self._execs[key] = _FALLBACK

@@ -7,6 +7,9 @@ a sweep was alive.  The heartbeat prints a single line at most once per
 
     [hb GC-1] 1536/3360 attempted (45.7%) | 1510 decided, 12 unknown | 24.1 pps | +38 launches | eta 79s
 
+with ``| retries=N degraded=M`` appended whenever the run has spent launch
+retries or degraded chunks (``resilience/``) — zero-noise when healthy.
+
 Throttling is clock-based (no output when the interval has not elapsed),
 so per-partition call sites can beat unconditionally.  The launch delta
 comes from the ``device_launches`` counter; ETA extrapolates a RECENT
@@ -67,6 +70,12 @@ class Heartbeat:
         self._start = clock()
         self._last: Optional[float] = None
         self._last_launches = self._launches()
+        # Baselines for the retries/degraded suffix: the registry is
+        # process-cumulative, and an earlier model's faults must not
+        # flag a later (healthy) model's heartbeat as flaky.
+        reg = metrics_mod.registry()
+        self._retries0 = reg.counter("launch_retries").total()
+        self._degraded0 = reg.counter("chunks_degraded").total()
         self._last_attempted: Optional[int] = None
         self._rate_ema: Optional[float] = None
         if self.interval_s > 0:
@@ -125,6 +134,13 @@ class Heartbeat:
         parts.append(f"| {decided} decided, {unknown} unknown")
         parts.append(f"| {pps:.2f} pps")
         parts.append(f"| +{d_launch} launches")
+        reg = metrics_mod.registry()
+        retries = int(reg.counter("launch_retries").total() - self._retries0)
+        degr = int(reg.counter("chunks_degraded").total() - self._degraded0)
+        if retries or degr:
+            # Fault visibility (resilience/): a flaky device shows up here
+            # beats before anything degrades; omitted entirely when healthy.
+            parts.append(f"| retries={retries} degraded={degr}")
         if self._last is not None and now > self._last:
             # Fold this beat's window into the recent-rate EMA (the first
             # beat has no window → whole-run-mean fallback below).

@@ -16,7 +16,11 @@ results directory's worth), produce
   temp-buffer bytes (from the ``compile.<kernel>`` spans, backfilled from
   the closing metrics snapshot for compiles that predate tracer
   activation);
-* the run's **device-launch total** (from the closing metrics snapshot).
+* the run's **device-launch total** (from the closing metrics snapshot);
+* a **degradation table** — fault-degraded UNKNOWN partitions bucketed by
+  machine-readable reason code (``site:kind``), read from degraded verdict
+  events or directly from verdict-ledger files (``*.ledger.jsonl`` may be
+  passed as inputs; their ``failure`` records are the source of truth).
 
 Torn/partially-written lines (crash mid-sweep) are skipped with a counted
 warning, never raised on.
@@ -30,6 +34,17 @@ import json
 from typing import Dict, Iterable, List
 
 from fairify_tpu.obs import trace as trace_mod
+
+
+def _ledger_stem(path: str) -> str:
+    """Model label for a verdict-ledger file passed directly to the report."""
+    import os
+
+    base = os.path.basename(path)
+    for suffix in (".ledger.jsonl", ".jsonl"):
+        if base.endswith(suffix):
+            return base[:-len(suffix)]
+    return base
 
 
 def _counter_total(metrics: dict, name: str) -> float:
@@ -64,8 +79,24 @@ def aggregate(paths: Iterable[str]) -> dict:
         files += 1
         records, skipped = trace_mod.load_events(path, count_skipped=True)
         skipped_lines += skipped
+        ledger_model = _ledger_stem(path)
         for rec in records:
             rtype = rec.get("type")
+            if rtype is None and "partition_id" in rec and "verdict" in rec:
+                # A verdict-ledger file (``*.ledger.jsonl``) was passed
+                # directly: fold its records into the verdict/degradation
+                # tables under the file's model stem, same last-wins dedup
+                # as verdict events.  (Pass event logs OR ledgers, not a
+                # run's both — the rows would double count across stems.)
+                attrs = {"model": ledger_model,
+                         "partition_id": rec["partition_id"],
+                         "verdict": rec["verdict"], "via": "ledger-file"}
+                fail = rec.get("failure")
+                if fail:
+                    attrs["failure"] = fail.get("reason", "?") \
+                        if isinstance(fail, dict) else str(fail)
+                keyed[(ledger_model, rec["partition_id"])] = attrs
+                continue
             if rtype == "span":
                 span_count += 1
                 name = rec["name"]
@@ -136,6 +167,7 @@ def aggregate(paths: Iterable[str]) -> dict:
     models: Dict[str, dict] = {}
     verdicts = {"sat": 0, "unsat": 0, "unknown": 0}
     via: Dict[str, int] = {}
+    degraded: Dict[str, int] = {}  # failure reason -> partition count
     for attrs in list(keyed.values()) + anon:
         v = attrs["verdict"]
         verdicts[v] += 1
@@ -143,6 +175,11 @@ def aggregate(paths: Iterable[str]) -> dict:
                           {"sat": 0, "unsat": 0, "unknown": 0})[v] += 1
         if v != "unknown":  # the breakdown is of DECIDED partitions
             via[attrs.get("via", "?")] = via.get(attrs.get("via", "?"), 0) + 1
+        elif attrs.get("failure"):
+            # Fault-degraded UNKNOWNs (ledger `failure` records / degraded
+            # verdict events), bucketed by machine-readable reason code.
+            r = attrs["failure"]
+            degraded[r] = degraded.get(r, 0) + 1
     decided = verdicts["sat"] + verdicts["unsat"]
     compile_table = {}
     for kern, row in sorted(compiles.items(),
@@ -174,6 +211,7 @@ def aggregate(paths: Iterable[str]) -> dict:
         "decided": decided,
         "attempted": decided + verdicts["unknown"],
         "via": via,
+        "degraded": dict(sorted(degraded.items(), key=lambda kv: -kv[1])),
         "models": models,
         "device_launches": int(launches),
         "launches_in_flight_max": int(inflight_max),
@@ -217,6 +255,12 @@ def render(agg: dict) -> str:
         lines.append("")
         lines.append("decided via: " + ", ".join(
             f"{k}={n}" for k, n in sorted(agg["via"].items())))
+    if agg.get("degraded"):
+        w = max(max(len(k) for k in agg["degraded"]), len("degradation reason"))
+        lines.append("")
+        lines.append(f"{'degradation reason':<{w}}  {'partitions':>10}")
+        for reason, n in agg["degraded"].items():
+            lines.append(f"{reason:<{w}}  {n:>10}")
     if agg.get("compiles"):
         w = max(max(len(k) for k in agg["compiles"]), len("kernel"))
         lines.append("")

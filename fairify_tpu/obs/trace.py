@@ -164,12 +164,17 @@ class Tracer:
         return metrics_mod.registry().counter("device_launches").total()
 
     def _write(self, rec: dict) -> None:
+        from fairify_tpu.resilience.journal import write_line
+
         line = json.dumps(rec) + "\n"
         with self._write_lock:
             if self._closed:
                 return
-            self._fp.write(line)
-            self._fp.flush()  # crash-safe, like the verdict ledger
+            # Shared single-write append helper (resilience.journal): one
+            # OS write per record, so a crash can tear at most the final
+            # line.  No fsync here — spans are dense and advisory; the
+            # verdict ledger (which fsyncs) is the record of truth.
+            write_line(self._fp, line, fsync=False)
 
     # -- public API --------------------------------------------------------
     def span(self, name: str, **attrs) -> Span:

@@ -34,6 +34,17 @@ Verdict-map invariance: the pipeline changes only *when* results are
 fetched, never which kernels run or with which seeds (chunk RNG streams
 are keyed to global chunk starts) — decided/UNSAT/SAT sets are bit-equal
 at every depth (``tests/test_pipeline.py``).
+
+Fault tolerance (``resilience/``): dispatch and dequeue are the named
+fault sites ``launch.submit`` / ``launch.decode``.  With a
+:class:`resilience.supervisor.Supervisor` attached, a transient error at
+either site is retried (a failed decode re-dispatches its ``fn`` — submit
+fns are idempotent: their RNG streams are keyed, not shared); exhaustion
+or a fatal error yields the chunk as ``(meta, ctx, ChunkFailure)`` instead
+of a host payload, the queue stays primed, and later chunks are
+unaffected.  Consumers check ``isinstance(host, ChunkFailure)`` and
+degrade exactly that chunk's partitions.  Without a supervisor (the
+default) errors propagate unchanged.
 """
 from __future__ import annotations
 
@@ -96,7 +107,7 @@ class LaunchPipeline:
     """
 
     def __init__(self, depth: int = 2, stats: Optional[FlightStats] = None,
-                 gauge: bool = True):
+                 gauge: bool = True, supervisor=None):
         self.depth = max(1, int(depth))
         self.stats = stats if stats is not None else FlightStats()
         # ``gauge=False`` for engine-internal micro-pipelines (e.g. a
@@ -104,6 +115,7 @@ class LaunchPipeline:
         # last-write-wins per run, and a one-launch pipeline would
         # overwrite the run pipeline's overlap record with ~0.
         self._gauge = gauge
+        self.supervisor = supervisor
         self._q: deque = deque()
         self.stats.update(0)
 
@@ -115,10 +127,32 @@ class LaunchPipeline:
         ready = []
         while len(self._q) >= self.depth:
             ready.append(self._drain_one())
-        payload, ctx = fn()
-        self._q.append((meta, ctx, payload))
+        self._q.append(self._dispatch(fn, meta))
         self.stats.update(len(self._q))
         return ready
+
+    def _dispatch(self, fn, meta) -> Tuple[Any, Any, Any, Any]:
+        """One supervised dispatch → queue entry ``(meta, ctx, payload, fn)``.
+
+        A degraded dispatch enqueues the :class:`ChunkFailure` as the
+        payload so FIFO order (and the consumer's span bookkeeping) is
+        preserved — the failure surfaces at this chunk's drain slot.
+        """
+        from fairify_tpu.resilience import faults
+        from fairify_tpu.resilience.supervisor import ChunkDegraded
+
+        def attempt():
+            faults.check("launch.submit")
+            return fn()
+
+        if self.supervisor is None:
+            payload, ctx = attempt()
+            return meta, ctx, payload, fn
+        try:
+            payload, ctx = self.supervisor.run(attempt, site="launch.submit")
+        except ChunkDegraded as exc:
+            return meta, None, exc.failure, None
+        return meta, ctx, payload, fn
 
     def drain(self) -> Iterator[Tuple[Any, Any, Any]]:
         while self._q:
@@ -128,14 +162,42 @@ class LaunchPipeline:
         import jax
 
         from fairify_tpu import obs
+        from fairify_tpu.resilience import faults
+        from fairify_tpu.resilience.supervisor import ChunkDegraded, ChunkFailure
 
-        meta, ctx, payload = self._q.popleft()
+        meta, ctx, payload, fn = self._q.popleft()
+        if isinstance(payload, ChunkFailure):  # degraded at dispatch
+            self.stats.update(len(self._q))
+            self._record_gauge()
+            return meta, ctx, payload
+
+        state = {"payload": payload}
+
+        def fetch():
+            faults.check("launch.decode")
+            return jax.device_get(state["payload"])
+
+        def redispatch():
+            # A failed decode may have poisoned the device arrays (e.g. a
+            # donated-buffer error): re-run the launch for a fresh payload.
+            # Submit fns are idempotent (per-chunk keyed RNG), so the
+            # replayed kernel is bit-identical.
+            if fn is not None:
+                state["payload"], _ = fn()
+
         # The pipeline's single sanctioned sync point: visible as its own
         # span so Perfetto traces show the drain-wait lane against the
         # in-flight device lanes (short waits = real overlap).
         with obs.span("pipeline.drain", in_flight=len(self._q) + 1,
                       depth=self.depth):
-            host = jax.device_get(payload)
+            if self.supervisor is None:
+                host = fetch()
+            else:
+                try:
+                    host = self.supervisor.run(fetch, site="launch.decode",
+                                               on_retry=redispatch)
+                except ChunkDegraded as exc:
+                    host = exc.failure
         self.stats.update(len(self._q))
         self._record_gauge()
         return meta, ctx, host

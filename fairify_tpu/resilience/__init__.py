@@ -1,0 +1,47 @@
+"""Resilient sweep runtime: fault injection, launch supervision, degradation.
+
+Fairify's soundness contract is asymmetric: a partition may always be
+answered UNKNOWN but never answered wrongly (the reference leans on
+per-partition Z3 timeouts, ``src/GC/Verify-GC.py:225-254``).  This package
+extends that contract from *solver* faults to *runtime* faults — a device
+launch that raises ``XlaRuntimeError``, a decode that dies mid-drain, a
+ledger append over a flaky filesystem — so a single transient error
+degrades exactly the affected partitions to UNKNOWN-with-reason instead of
+killing the whole budgeted run:
+
+* :mod:`fairify_tpu.resilience.faults` — a deterministic fault-injection
+  registry.  Named sites (``launch.submit``, ``launch.decode``,
+  ``compile``, ``smt.query``, ``ledger.append``) are armed from config/CLI
+  specs (``--inject-fault site:kind:nth``), so chaos tests and
+  ``scripts/chaos_matrix.py`` replay exact failure schedules.
+* :mod:`fairify_tpu.resilience.supervisor` — transient/fatal error
+  classification, bounded retries with jittered backoff and a per-chunk
+  deadline; exhaustion raises :class:`ChunkDegraded` carrying a
+  machine-readable :class:`ChunkFailure` reason that lands in the ledger.
+* :mod:`fairify_tpu.resilience.journal` — the atomic (single-write) +
+  fsync'd JSONL append helper behind the verdict ledger, shared with the
+  obs event log's writer.
+
+The degradation contract is pinned by ``tests/test_resilience.py``: for
+every injected-fault schedule, partitions decided around the fault match
+the fault-free run's verdicts exactly, faulted partitions are UNKNOWN with
+a structured ``failure`` record, and a subsequent ``resume=True`` pass
+converges to the fault-free verdict map (DESIGN.md §10).
+"""
+from __future__ import annotations
+
+from fairify_tpu.resilience.faults import (  # noqa: F401
+    FAULT_SITES,
+    InjectedFault,
+    armed,
+    check,
+    disarm,
+    parse_specs,
+)
+from fairify_tpu.resilience.journal import JournalWriter, write_line  # noqa: F401
+from fairify_tpu.resilience.supervisor import (  # noqa: F401
+    ChunkDegraded,
+    ChunkFailure,
+    Supervisor,
+    classify,
+)

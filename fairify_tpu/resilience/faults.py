@@ -1,0 +1,211 @@
+"""Deterministic, seedable fault-injection registry for chaos testing.
+
+Real runtime faults (a tunnelled chip dropping a launch, a network
+filesystem tearing an append) are rare and unreproducible; the retry /
+degradation machinery they exercise must not be.  This registry lets a
+test — or an operator via ``--inject-fault`` — schedule exact failures at
+named **sites**, the five places the sweep talks to something that can
+die:
+
+====================  =====================================================
+site                  where :func:`check` is called
+====================  =====================================================
+``launch.submit``     :class:`parallel.pipeline.LaunchPipeline` dispatch
+``launch.decode``     the pipeline's dequeue-time ``jax.device_get``
+``compile``           ``obs.compile.ObsJit`` explicit AOT compile
+``smt.query``         :func:`verify.smt.decide_box_smt` solver call
+``ledger.append``     :class:`resilience.journal.JournalWriter` appends
+====================  =====================================================
+
+A **spec** is ``site:kind:nth``:
+
+* ``kind`` — ``transient`` (retryable; the supervisor backs off and
+  re-attempts), ``fatal`` (non-retryable; the chunk degrades immediately),
+  or ``crash`` (never handled; propagates like a SIGKILL would, for
+  crash-resume chaos tests).
+* ``nth`` — which arrivals at the site fire: ``3`` (the 3rd arrival only),
+  ``3+`` (every arrival from the 3rd), ``3-5`` (an inclusive range), or
+  ``p0.25`` (each arrival independently with probability 0.25, drawn from
+  the registry's seeded RNG — deterministic for a given seed and arrival
+  order).
+
+Scheduling is arrival-count based, so a schedule is reproducible whenever
+the instrumented call order is (the async pipeline keeps submission order
+depth-invariant precisely so this holds).  Arrivals are counted per site
+from :func:`arm` time; every fired fault bumps the ``fault_injected``
+counter (labelled by site and kind) and emits a ``fault_injected`` obs
+event.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Tuple
+
+FAULT_SITES = frozenset(
+    {"launch.submit", "launch.decode", "compile", "smt.query", "ledger.append"})
+FAULT_KINDS = frozenset({"transient", "fatal", "crash"})
+
+_SPEC_RE = re.compile(
+    r"^(?P<site>[a-z.]+):(?P<kind>[a-z]+):"
+    r"(?P<nth>\d+|\d+\+|\d+-\d+|p(0?\.\d+|1(\.0+)?))$")
+
+
+class InjectedFault(RuntimeError):
+    """The error a scheduled fault raises at its site.
+
+    ``kind`` drives the supervisor's classification: ``transient`` is
+    retried, ``fatal`` degrades without retry, ``crash`` always propagates
+    (it models a failure no in-process handler may paper over).
+    """
+
+    def __init__(self, site: str, kind: str, n: int):
+        super().__init__(f"injected {kind} fault at {site} (arrival #{n})")
+        self.site = site
+        self.kind = kind
+        self.n = n
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    site: str
+    kind: str
+    start: int = 0          # first firing arrival (1-based); 0 = probabilistic
+    stop: Optional[int] = None  # inclusive; None with start>0 = single arrival
+    every: bool = False     # start+ : every arrival from start
+    rate: float = 0.0       # p<rate> : per-arrival probability
+
+    def fires(self, n: int, rng) -> bool:
+        if self.rate:
+            return bool(rng.random() < self.rate)
+        if self.every:
+            return n >= self.start
+        if self.stop is not None:
+            return self.start <= n <= self.stop
+        return n == self.start
+
+
+def parse_spec(spec: str) -> FaultSpec:
+    m = _SPEC_RE.match(spec.strip())
+    if not m:
+        raise ValueError(
+            f"bad fault spec {spec!r}: want site:kind:nth with nth one of "
+            f"'3', '3+', '3-5', 'p0.25'")
+    site, kind, nth = m.group("site"), m.group("kind"), m.group("nth")
+    if site not in FAULT_SITES:
+        raise ValueError(f"unknown fault site {site!r} "
+                         f"(known: {sorted(FAULT_SITES)})")
+    if kind not in FAULT_KINDS:
+        raise ValueError(f"unknown fault kind {kind!r} "
+                         f"(known: {sorted(FAULT_KINDS)})")
+    if nth.startswith("p"):
+        return FaultSpec(site, kind, rate=float(nth[1:]))
+    if nth.endswith("+"):
+        out = FaultSpec(site, kind, start=int(nth[:-1]), every=True)
+    elif "-" in nth:
+        a, b = nth.split("-")
+        out = FaultSpec(site, kind, start=int(a), stop=int(b))
+    else:
+        out = FaultSpec(site, kind, start=int(nth))
+    if out.start < 1:  # arrivals are 1-based; 0 could never fire
+        raise ValueError(f"bad fault spec {spec!r}: nth arrivals are 1-based")
+    return out
+
+
+def parse_specs(specs: Iterable[str]) -> List[FaultSpec]:
+    return [parse_spec(s) for s in specs]
+
+
+class FaultPlan:
+    """Armed schedule: per-site arrival counters + the specs they drive."""
+
+    def __init__(self, specs: Iterable[str], seed: int = 0):
+        import numpy as np
+
+        self.specs = parse_specs(specs)
+        self._rng = np.random.default_rng(seed)
+        self._arrivals: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def arrivals(self, site: str) -> int:
+        return self._arrivals.get(site, 0)
+
+    def check(self, site: str) -> None:
+        """Count one arrival at ``site``; raise if a spec schedules it."""
+        with self._lock:
+            n = self._arrivals.get(site, 0) + 1
+            self._arrivals[site] = n
+            hit = next((s for s in self.specs
+                        if s.site == site and s.fires(n, self._rng)), None)
+        if hit is None:
+            return
+        from fairify_tpu import obs
+
+        obs.registry().counter("fault_injected").inc(site=site, kind=hit.kind)
+        obs.event("fault_injected", site=site, kind=hit.kind, arrival=n)
+        raise InjectedFault(site, hit.kind, n)
+
+
+_active: Optional[FaultPlan] = None
+_lock = threading.Lock()
+
+
+def arm(specs: Iterable[str], seed: int = 0) -> Optional[FaultPlan]:
+    """Activate a fault schedule (replacing any previous one); None if empty."""
+    global _active
+    plan = FaultPlan(specs, seed=seed) if specs else None
+    with _lock:
+        _active = plan
+    return plan
+
+
+def disarm() -> None:
+    global _active
+    with _lock:
+        _active = None
+
+
+def active() -> Optional[FaultPlan]:
+    return _active
+
+
+def check(site: str) -> None:
+    """One arrival at ``site`` — no-op unless a plan is armed.
+
+    The disarmed path is one global read, so instrumented hot paths (every
+    pipeline dispatch/drain) pay nothing in production.
+    """
+    plan = _active
+    if plan is not None:
+        plan.check(site)
+
+
+class armed:
+    """Scope a fault schedule: ``with faults.armed(specs, seed): ...``.
+
+    Nested scopes stack (the inner schedule wins for its duration); an
+    empty ``specs`` is a true no-op, so call sites can pass config fields
+    unconditionally.
+    """
+
+    def __init__(self, specs: Iterable[str], seed: int = 0):
+        self._specs = tuple(specs or ())
+        self._seed = seed
+        self._prev: Optional[FaultPlan] = None
+        self.plan: Optional[FaultPlan] = None
+
+    def __enter__(self) -> Optional[FaultPlan]:
+        if not self._specs:
+            self.plan = _active
+            return self.plan
+        self._prev = _active
+        self.plan = arm(self._specs, seed=self._seed)
+        return self.plan
+
+    def __exit__(self, *exc) -> bool:
+        if self._specs:
+            global _active
+            with _lock:
+                _active = self._prev
+        return False

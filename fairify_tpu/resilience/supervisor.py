@@ -1,0 +1,165 @@
+"""Launch supervision: classify, retry with backoff, degrade to UNKNOWN.
+
+"Fast and Complete" (PAPERS.md) gets verification throughput from cheap
+incomplete passes that are *allowed to fail upward* to a complete
+fallback; this module applies the same principle to runtime faults.  Any
+error at a supervised site is classified:
+
+* **propagate** — control-flow and resource exhaustion
+  (``KeyboardInterrupt``, ``SystemExit``, ``MemoryError``,
+  ``GeneratorExit``) plus injected ``crash`` faults: never handled, the
+  process is supposed to die (crash-resume is the ledger's job).
+* **transient** — plausibly succeeds on re-attempt: XLA/JAX runtime
+  errors (a dropped tunnelled launch), ``OSError``/``TimeoutError``
+  (filesystem/network hiccups), injected ``transient`` faults.  Retried
+  up to ``max_retries`` times with jittered exponential backoff, bounded
+  by the per-chunk ``deadline_s``.
+* **fatal** — everything else (a shape error, an injected ``fatal``
+  fault): re-attempting cannot help, degrade immediately.
+
+Exhaustion or a fatal error raises :class:`ChunkDegraded`, carrying a
+:class:`ChunkFailure` — the machine-readable reason record the sweep
+ledgers with the chunk's partitions (``verdict=unknown`` + ``failure``),
+surfaces in ``fairify_tpu report``'s degradation table and the heartbeat's
+``degraded=`` counter, and that a later ``resume=True`` pass re-attempts.
+Every retry bumps the ``launch_retries`` counter (labelled by site) under
+a ``resilience.retry`` span, so a flaky device is visible in the event log
+long before it exhausts anything.
+
+The deadline is cooperative: a supervised attempt cannot be interrupted
+mid-call (there is no safe way to cancel a blocking ``device_get``), so
+``deadline_s`` bounds when *another* attempt may start, not the wall time
+of a hung one.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from fairify_tpu.resilience.faults import InjectedFault
+
+#: Exceptions no supervisor may convert into a degradation.
+PROPAGATE = (KeyboardInterrupt, SystemExit, MemoryError, GeneratorExit)
+
+#: Exception type names classified transient without importing their
+#: modules (jaxlib's XlaRuntimeError moves between modules across
+#: versions; matching by name keeps the classifier import-light).
+_TRANSIENT_NAMES = frozenset({
+    "XlaRuntimeError", "JaxRuntimeError", "RpcError", "UnavailableError",
+    "InternalError", "DeadlineExceededError",
+})
+
+
+def classify(exc: BaseException) -> str:
+    """``'propagate'`` | ``'transient'`` | ``'fatal'`` for one exception."""
+    if isinstance(exc, InjectedFault):
+        return {"transient": "transient", "fatal": "fatal"}.get(
+            exc.kind, "propagate")
+    if isinstance(exc, PROPAGATE):
+        return "propagate"
+    if isinstance(exc, OSError):
+        # Covers ConnectionError/TimeoutError too (both OSError subclasses).
+        # Permanent errno values (EROFS, ENOSPC) are knowingly retried —
+        # retries are bounded and the exhaustion is counted, while treating
+        # them fatal would skip retries real NFS flakes deserve.
+        return "transient"
+    if type(exc).__name__ in _TRANSIENT_NAMES:
+        return "transient"
+    return "fatal"
+
+
+@dataclass
+class ChunkFailure:
+    """Machine-readable degradation reason for one chunk of partitions."""
+
+    site: str            # which supervised site exhausted/refused
+    kind: str            # 'transient-exhausted' | 'fatal' | 'deadline'
+    error: str           # exception type name
+    detail: str          # str(exception), truncated
+    retries: int = 0     # re-attempts actually spent
+
+    @property
+    def reason(self) -> str:
+        """Compact reason code for tables/counters: ``site:kind``."""
+        return f"{self.site}:{self.kind}"
+
+    def to_record(self) -> dict:
+        return {"reason": self.reason, "site": self.site, "kind": self.kind,
+                "error": self.error, "detail": self.detail[:200],
+                "retries": self.retries}
+
+
+class ChunkDegraded(RuntimeError):
+    """Raised by :meth:`Supervisor.run` when a chunk cannot be completed."""
+
+    def __init__(self, failure: ChunkFailure):
+        super().__init__(f"chunk degraded: {failure.reason} "
+                         f"({failure.error}: {failure.detail[:120]})")
+        self.failure = failure
+
+
+class Supervisor:
+    """Bounded-retry wrapper for device launches and pipeline drains.
+
+    One instance per run (seeded, so backoff jitter is reproducible);
+    cheap enough to construct per call site.  ``deadline_s <= 0`` disables
+    the per-chunk deadline.
+    """
+
+    def __init__(self, max_retries: int = 2, backoff_s: float = 0.05,
+                 backoff_mult: float = 2.0, deadline_s: float = 0.0,
+                 seed: int = 0, sleep: Callable[[float], None] = time.sleep):
+        import numpy as np
+
+        self.max_retries = max(0, int(max_retries))
+        self.backoff_s = float(backoff_s)
+        self.backoff_mult = float(backoff_mult)
+        self.deadline_s = float(deadline_s)
+        self._sleep = sleep
+        self._rng = np.random.default_rng(seed)
+
+    def _backoff(self, attempt: int) -> float:
+        base = self.backoff_s * (self.backoff_mult ** attempt)
+        return base * (1.0 + float(self._rng.random()))  # full jitter, 1-2x
+
+    def run(self, fn: Callable, site: str,
+            on_retry: Optional[Callable[[], None]] = None):
+        """``fn()`` with supervision; returns its value or raises.
+
+        ``on_retry`` runs before each re-attempt (e.g. re-dispatching a
+        launch whose device arrays a failed decode poisoned); an error
+        inside it counts as the attempt's failure.
+        """
+        from fairify_tpu import obs
+
+        t0 = time.perf_counter()
+        retries = 0
+        while True:
+            try:
+                if retries and on_retry is not None:
+                    on_retry()
+                return fn()
+            except BaseException as exc:
+                cls = classify(exc)
+                if cls == "propagate":
+                    raise
+                if cls == "fatal":
+                    raise ChunkDegraded(ChunkFailure(
+                        site=site, kind="fatal", error=type(exc).__name__,
+                        detail=str(exc), retries=retries)) from exc
+                elapsed = time.perf_counter() - t0
+                if 0 < self.deadline_s <= elapsed:
+                    raise ChunkDegraded(ChunkFailure(
+                        site=site, kind="deadline", error=type(exc).__name__,
+                        detail=str(exc), retries=retries)) from exc
+                if retries >= self.max_retries:
+                    raise ChunkDegraded(ChunkFailure(
+                        site=site, kind="transient-exhausted",
+                        error=type(exc).__name__, detail=str(exc),
+                        retries=retries)) from exc
+                retries += 1
+                obs.registry().counter("launch_retries").inc(site=site)
+                with obs.span("resilience.retry", site=site, attempt=retries,
+                              error=type(exc).__name__):
+                    self._sleep(self._backoff(retries - 1))

@@ -102,8 +102,14 @@ class ThroughputCounter:
 
     def dump(self, path: str, phases: Optional[Dict[str, float]] = None,
              pipeline: Optional[Dict[str, float]] = None,
-             compile: Optional[Dict[str, float]] = None) -> None:
+             compile: Optional[Dict[str, float]] = None,
+             resilience: Optional[Dict[str, float]] = None) -> None:
         out = self.summary()
+        if resilience and any(resilience.values()):
+            # Fault record (resilience/): partitions degraded to UNKNOWN by
+            # runtime faults, retries spent, torn resume-ledger lines — all
+            # zero on a healthy run, so the key is omitted entirely then.
+            out["resilience"] = {k: int(v) for k, v in resilience.items()}
         if phases:
             out["phases_s"] = {k: round(v, 3) for k, v in phases.items()}
         if pipeline:

@@ -61,6 +61,25 @@ class SweepConfig:
     # TI).  Flag-gated: the consistency column is an O(|test|²) kNN per
     # partition, which only makes sense on modest grids.
     partition_metrics: bool = False
+    # --- Resilience (fairify_tpu/resilience, DESIGN.md §10) -------------
+    # Bounded retries for a transient fault at a supervised site (device
+    # launch dispatch, pipeline decode, ledger append) before the chunk's
+    # partitions degrade to UNKNOWN-with-reason.  A transient fault costs
+    # at most this many extra launches per chunk.
+    max_launch_retries: int = 2
+    # First-retry backoff (seconds); grows exponentially with full jitter.
+    launch_backoff_s: float = 0.05
+    # Per-chunk retry deadline (seconds; 0 = off): once a chunk has spent
+    # this long across attempts, no further retry starts — it degrades.
+    # Cooperative (a hung device_get cannot be interrupted mid-call).
+    chunk_deadline_s: float = 0.0
+    # Fault-injection schedule for chaos testing: "site:kind:nth" specs
+    # (resilience.faults.parse_spec), armed for the duration of each
+    # verify_model call.  Empty = no injection (production).
+    inject_faults: Tuple[str, ...] = ()
+    # Escalating per-attempt Z3 timeouts for the SMT UNKNOWN-retry path
+    # (verify.smt.decide_box_smt retry_timeouts_s).
+    smt_retry_timeouts_s: Tuple[float, ...] = ()
 
     def query(self) -> FairnessQuery:
         domain = get_domain(self.dataset)

@@ -1055,6 +1055,13 @@ class EngineConfig:
     # host validates the previous chunk's witnesses.  The sweep syncs this
     # to SweepConfig.pipeline_depth; 1 restores synchronous order.
     pipeline_depth: int = 2
+    # Launch supervision for the engine's pipelined loops (the sweep syncs
+    # these to SweepConfig.max_launch_retries / launch_backoff_s): a
+    # transient Phase-A chunk fault is retried this many times, then the
+    # chunk's roots simply stay unattacked — they keep their full
+    # certificate/BaB path, so only SAT-discovery speed is traded.
+    max_launch_retries: int = 2
+    launch_backoff_s: float = 0.05
 
 
 @dataclass
@@ -1161,15 +1168,48 @@ def decide_many(
             # Submission order is the synchronous order, so the shared
             # ``rng_a`` stream (consumed at submit time) is depth-invariant.
             from fairify_tpu.parallel.pipeline import LaunchPipeline
+            from fairify_tpu.resilience.supervisor import ChunkFailure, Supervisor
 
-            pipe = LaunchPipeline(cfg.pipeline_depth, gauge=False)
+            pipe = LaunchPipeline(
+                cfg.pipeline_depth, gauge=False,
+                supervisor=Supervisor(max_retries=cfg.max_launch_retries,
+                                      backoff_s=cfg.launch_backoff_s,
+                                      seed=cfg.seed))
 
             def _consume(meta, ctx, host):
+                if isinstance(host, ChunkFailure):
+                    # Degraded attack chunk: its roots stay unattacked and
+                    # keep the full certificate/BaB path — graceful, sound.
+                    obs.event("degraded", **host.to_record(),
+                              phase="engine.attack")
+                    return
                 s_blk, n_blk = meta
                 for i, ce in pgd_attack_decode(host, ctx).items():
                     if i < n_blk and verdicts[s_blk + i] is None:
                         verdicts[s_blk + i] = "sat"
                         ces[s_blk + i] = ce
+
+            def _replayable_submit(blk):
+                # Chunks share ``rng_a`` (submission-order invariant), but a
+                # supervised retry must NOT advance it again — the first
+                # dispatch snapshots the stream state and replays draw the
+                # identical samples from a clone, keeping faulted runs'
+                # verdicts bit-equal to fault-free ones.
+                state = {}
+
+                def fn():
+                    if "s" not in state:
+                        state["s"] = rng_a.bit_generator.state
+                        r = rng_a
+                    else:
+                        r = np.random.default_rng()
+                        r.bit_generator.state = state["s"]
+                    return pgd_attack_submit(
+                        net, enc,
+                        np.asarray(roots_lo[blk], dtype=np.int64),
+                        np.asarray(roots_hi[blk], dtype=np.int64), r,
+                        steps=cfg.pgd_steps, restarts=cfg.pgd_restarts)
+                return fn
 
             attack_deadline = 0.25 * deadline_s
             submitted = 0
@@ -1185,13 +1225,8 @@ def decide_many(
                     break
                 submitted += 1
                 blk = np.arange(s, min(s + CH, R))
-                for item in pipe.submit(
-                        lambda blk=blk: pgd_attack_submit(
-                            net, enc,
-                            np.asarray(roots_lo[blk], dtype=np.int64),
-                            np.asarray(roots_hi[blk], dtype=np.int64), rng_a,
-                            steps=cfg.pgd_steps, restarts=cfg.pgd_restarts),
-                        meta=(s, len(blk))):
+                for item in pipe.submit(_replayable_submit(blk),
+                                        meta=(s, len(blk))):
                     _consume(*item)
             for item in pipe.drain():
                 _consume(*item)

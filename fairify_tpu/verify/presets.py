@@ -59,18 +59,22 @@ PRESETS = {
     # stage-0 results are tiny (bool masks + witness indices), so a deeper
     # in-flight queue hides host decode jitter at negligible HBM cost.
     # Verdict maps are depth-invariant (chunk RNG keyed to global starts).
+    # max_launch_retries 3 (default 2): hour-budget runs over thousands of
+    # launches see more transient tunnel hiccups, and one extra ~110 ms
+    # retry is far cheaper than degrading (and later re-sweeping) a
+    # 2048-partition chunk.
     "stress-GC": SweepConfig(name="stress-GC", dataset="german", protected=("age",),
                              partition_threshold=10, heuristic_threshold=20,
                              soft_timeout_s=200.0, sim_size=1000,
-                             pipeline_depth=4, **_HOUR),
+                             pipeline_depth=4, max_launch_retries=3, **_HOUR),
     "stress-AC": SweepConfig(name="stress-AC", dataset="adult", protected=("sex",),
                              partition_threshold=6, heuristic_threshold=20,
                              soft_timeout_s=200.0, sim_size=1000,
-                             pipeline_depth=4, **_HOUR),
+                             pipeline_depth=4, max_launch_retries=3, **_HOUR),
     "stress-BM": SweepConfig(name="stress-BM", dataset="bank", protected=("age",),
                              partition_threshold=10, heuristic_threshold=20,
                              soft_timeout_s=200.0, sim_size=1000,
-                             pipeline_depth=4, **_HOUR),
+                             pipeline_depth=4, max_launch_retries=3, **_HOUR),
     # ----- relaxed/ -----
     "relaxed-GC": SweepConfig(name="relaxed-GC", dataset="german",
                               protected=("sex", "marital-status"),

@@ -65,14 +65,44 @@ def _z3_net(x, weights, biases):
     return h[0]
 
 
+def _unknown_reason(reason_str: str) -> str:
+    """Map z3's ``reason_unknown`` to the degradation taxonomy's two codes.
+
+    ``timeout`` (budget ran out — escalating the timeout may decide it)
+    vs ``solver-error`` (the query itself defeated the solver — more time
+    rarely helps).  Both are sound: UNKNOWN is always a legal answer.
+    """
+    r = (reason_str or "").lower()
+    if "timeout" in r or "canceled" in r or "resource" in r:
+        return "timeout"
+    return "solver-error"
+
+
 def decide_box_smt(
     net: MLP,
     enc: PairEncoding,
     lo: np.ndarray,
     hi: np.ndarray,
     soft_timeout_s: float = 100.0,
-) -> Tuple[str, Optional[Tuple[np.ndarray, np.ndarray]]]:
-    """Z3 verdict for one partition box (masked net is excised first)."""
+    retry_timeouts_s: Tuple[float, ...] = (),
+) -> Tuple[str, Optional[Tuple[np.ndarray, np.ndarray]], Optional[str]]:
+    """Z3 verdict for one partition box (masked net is excised first).
+
+    Returns ``(verdict, counterexample, reason)``: ``reason`` is ``None``
+    for decided verdicts and a machine-readable code for UNKNOWN —
+    ``"timeout"`` / ``"solver-error"`` (a deterministic solver failure) /
+    ``"transient"`` (a retryable runtime fault exhausted the ladder) /
+    ``"injected"``.  Z3 exceptions are
+    mapped to UNKNOWN instead of propagating (the reference's soundness
+    contract: a partition may be answered UNKNOWN but never wrongly, and
+    never crash the sweep, ``src/GC/Verify-GC.py:225-254``).
+
+    ``retry_timeouts_s`` is the escalating-timeout ladder for the
+    UNKNOWN-retry path (``SweepConfig.smt_retry_timeouts_s``): each entry
+    re-checks the same solver state with a larger per-attempt budget, so
+    a timeout at 100 s can fall upward to 300 s / 900 s before the box is
+    finally conceded as UNKNOWN.
+    """
     _require_z3()
     small = excise(net)
     weights = [np.asarray(w) for w in small.weights]
@@ -81,7 +111,6 @@ def decide_box_smt(
     x = [z3.Int(f"x{i}") for i in range(d)]
     xp = [z3.Int(f"x_{i}") for i in range(d)]
     s = z3.Solver()
-    s.set("timeout", int(soft_timeout_s * 1000))
 
     pa = set(int(i) for i in enc.pa_idx)
     ra = set(int(i) for i in enc.ra_idx)
@@ -99,24 +128,58 @@ def decide_box_smt(
     yp = _z3_net(xp, weights, biases)
     s.add(z3.Or(z3.And(y < 0, yp > 0), z3.And(y > 0, yp < 0)))
 
-    with obs.span("smt.z3_query", timeout_s=soft_timeout_s, dims=d) as sp:
-        res = s.check()
-        if res == z3.sat:
-            verdict = "sat"
-            m = s.model()
+    reason: Optional[str] = None
+    for attempt, t in enumerate((soft_timeout_s,) + tuple(retry_timeouts_s)):
+        s.set("timeout", int(t * 1000))
+        with obs.span("smt.z3_query", timeout_s=t, dims=d,
+                      attempt=attempt) as sp:
+            try:
+                from fairify_tpu.resilience import faults
 
-            def val(v):
-                return int(m.eval(v, model_completion=True).as_long())
+                faults.check("smt.query")
+                res = s.check()
+            except BaseException as exc:
+                from fairify_tpu.resilience.faults import InjectedFault
+                from fairify_tpu.resilience.supervisor import classify
 
-            ce = (np.array([val(v) for v in x], dtype=np.int64),
-                  np.array([val(v) for v in xp], dtype=np.int64))
-        elif res == z3.unsat:
-            verdict, ce = "unsat", None
-        else:
-            verdict, ce = "unknown", None
-        sp.set(verdict=verdict)
-    obs.registry().counter("smt_queries").inc(verdict=verdict)
-    return verdict, ce
+                cls = classify(exc)
+                if cls == "propagate":
+                    raise
+                reason = "injected" if isinstance(exc, InjectedFault) \
+                    else ("transient" if cls == "transient"
+                          else "solver-error")
+                sp.set(verdict="unknown", reason=reason,
+                       error=type(exc).__name__)
+                obs.registry().counter("smt_queries").inc(verdict="unknown",
+                                                          reason=reason)
+                if cls == "transient":
+                    continue  # plausibly succeeds at the next tier
+                break  # a deterministic solver error repeats at any budget
+            if res == z3.sat:
+                verdict = "sat"
+                m = s.model()
+
+                def val(v):
+                    return int(m.eval(v, model_completion=True).as_long())
+
+                ce = (np.array([val(v) for v in x], dtype=np.int64),
+                      np.array([val(v) for v in xp], dtype=np.int64))
+            elif res == z3.unsat:
+                verdict, ce = "unsat", None
+            else:
+                verdict, ce = "unknown", None
+                reason = _unknown_reason(s.reason_unknown())
+            sp.set(verdict=verdict, **({"reason": reason}
+                                       if verdict == "unknown" else {}))
+        if verdict == "unknown":
+            obs.registry().counter("smt_queries").inc(verdict="unknown",
+                                                      reason=reason)
+            if reason == "timeout":
+                continue  # escalate to the next timeout tier
+            break  # solver-error: more time rarely helps
+        obs.registry().counter("smt_queries").inc(verdict=verdict)
+        return verdict, ce, None
+    return "unknown", None, reason
 
 
 # ---------------------------------------------------------------------------

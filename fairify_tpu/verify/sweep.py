@@ -21,7 +21,6 @@ on a virtual 8-device CPU mesh).
 """
 from __future__ import annotations
 
-import json
 import os
 import time
 from dataclasses import dataclass, field, replace
@@ -41,6 +40,9 @@ from fairify_tpu.ops import heuristic as heur_ops
 from fairify_tpu.ops import masks as mask_ops
 from fairify_tpu.parallel.pipeline import LaunchPipeline
 from fairify_tpu.partition import grid as grid_mod
+from fairify_tpu.resilience import faults as faults_mod
+from fairify_tpu.resilience.journal import JournalWriter
+from fairify_tpu.resilience.supervisor import ChunkDegraded, ChunkFailure, Supervisor, classify
 from fairify_tpu.utils import profiling
 from fairify_tpu.utils.prng import shuffled_order
 from fairify_tpu.utils.timing import PhaseTimer
@@ -76,6 +78,13 @@ class ModelReport:
     # for multi-host runs); derived files (e.g. decoded CE CSVs) must use
     # this so sibling sinks never collide across hosts.
     sink_name: str = ""
+    # Torn/undecodable JSONL lines skipped while loading this run's resume
+    # ledger (mirrors obs.load_events' skipped_lines; >0 after a crash).
+    ledger_skipped_lines: int = 0
+    # Partitions answered UNKNOWN because a runtime fault degraded their
+    # chunk (subset of counts["unknown"]; each carries a ledger `failure`
+    # record and is re-attempted by a later resume=True pass).
+    degraded: int = 0
 
     @property
     def counts(self) -> Dict[str, int]:
@@ -117,8 +126,25 @@ _chunk_spans = grid_mod.chunk_spans
 _pad_rows = grid_mod.pad_rows
 
 
+def _supervisor(cfg: SweepConfig) -> Supervisor:
+    """The run's launch supervisor, configured from the sweep knobs."""
+    return Supervisor(max_retries=cfg.max_launch_retries,
+                      backoff_s=cfg.launch_backoff_s,
+                      deadline_s=cfg.chunk_deadline_s, seed=cfg.seed)
+
+
+def _unretried_failure(site: str, exc: BaseException) -> ChunkFailure:
+    """Failure record for a fault caught OUTSIDE the supervisor's retry loop
+    (sequential engine phases), kept inside the documented kind taxonomy:
+    a transient-class error here is 'transient-exhausted' at retries=0."""
+    kind = "transient-exhausted" if classify(exc) == "transient" else "fatal"
+    return ChunkFailure(site=site, kind=kind, error=type(exc).__name__,
+                        detail=str(exc), retries=0)
+
+
 def _stage0_certify_and_attack(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
-                               mesh=None, seed_offset: int = 0, pipe=None):
+                               mesh=None, seed_offset: int = 0, pipe=None,
+                               on_failure=None):
     """Root certificates + attack for the whole grid, in grid-chunk blocks.
 
     ``seed_offset`` ties the attack RNG to the grid's global start index
@@ -135,17 +161,21 @@ def _stage0_certify_and_attack(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
     """
     P = lo.shape[0]
     step, spans = _chunk_spans(P, cfg.grid_chunk)
-    if len(spans) == 1:
-        return _stage0_block(net, enc, lo, hi, cfg, mesh,
-                             cfg.engine.seed + seed_offset)
     if pipe is None:
-        pipe = LaunchPipeline(cfg.pipeline_depth)
+        pipe = LaunchPipeline(cfg.pipeline_depth, supervisor=_supervisor(cfg))
     unsat = np.zeros(P, dtype=bool)
     sat = np.zeros(P, dtype=bool)
     witnesses: Dict[int, tuple] = {}
 
     def consume(meta, ctx, host):
         s, e = meta
+        if isinstance(host, ChunkFailure):
+            # Supervised retries exhausted: this chunk's partitions degrade
+            # (the caller ledgers them UNKNOWN-with-reason); the pipeline
+            # stays primed and later chunks are unaffected.
+            if on_failure is not None:
+                on_failure(s, e, host)
+            return
         u, sa, w = _stage0_block_decode(host, ctx)
         unsat[s:e], sat[s:e] = u[: e - s], sa[: e - s]
         witnesses.update({s + k: v for k, v in w.items() if k < e - s})
@@ -268,7 +298,10 @@ def _stage0_block_decode(host, ctx):
 
 
 def _stage0_block(net, enc: PairEncoding, lo, hi, cfg: SweepConfig, mesh, rng_seed):
-    """Synchronous submit+decode of one block (single-span grids, tests)."""
+    """Synchronous submit+decode of one block (tests, ad-hoc tooling).
+
+    The sweep itself routes every block — single-span grids included —
+    through the supervised launch pipeline, so faults degrade per chunk."""
     payload, ctx = _stage0_block_submit(net, enc, lo, hi, cfg, mesh, rng_seed)
     return _stage0_block_decode(jax.device_get(payload), ctx)
 
@@ -356,7 +389,7 @@ def stage0_families(stacks, enc: PairEncoding, lo, hi, cfg: SweepConfig,
     P = lo.shape[0]
     step, spans = _chunk_spans(P, cfg.grid_chunk)
     if pipe is None:
-        pipe = LaunchPipeline(cfg.pipeline_depth)
+        pipe = LaunchPipeline(cfg.pipeline_depth, supervisor=_supervisor(cfg))
     accs = []
     for stacked in stacks:
         M = stacked.weights[0].shape[0]
@@ -366,6 +399,15 @@ def stage0_families(stacks, enc: PairEncoding, lo, hi, cfg: SweepConfig,
 
     def consume(meta, ctx, host):
         gi, s, e = meta
+        if isinstance(host, ChunkFailure):
+            # A degraded family chunk leaves its span UNDECIDED (not
+            # UNKNOWN): these are precomputed stage-0 results, and every
+            # undecided partition gets the per-model PGD/BaB path anyway —
+            # degradation upward to the slower-but-complete tier.
+            obs.registry().counter("chunks_degraded").inc(site=host.site)
+            obs.event("degraded", **host.to_record(), phase="stage0_family",
+                      partitions=e - s)
+            return
         unsat, sat, wits = accs[gi]
         for m, (u, sa, w) in enumerate(_family_block_decode(host, ctx)):
             unsat[m][s:e], sat[m][s:e] = u[: e - s], sa[: e - s]
@@ -566,18 +608,70 @@ def _ledger_path(cfg: SweepConfig, model_name: str) -> str:
     return os.path.join(cfg.result_dir, f"{cfg.name}-{model_name}.ledger.jsonl")
 
 
+def _read_ledger(path: str):
+    """One ledger file's records in file order, plus the torn-line count.
+
+    Same tolerant JSONL loader as the obs event log (ONE implementation,
+    ``obs.load_events``): truncated/undecodable lines — a crash
+    mid-append, a network FS tearing a write — are skipped but COUNTED; a
+    resume that silently dropped records would under-report exactly when
+    it matters most.
+    """
+    if not os.path.isfile(path):
+        return [], 0
+    return obs.load_events(path, count_skipped=True)
+
+
+def merge_ledgers(paths) -> tuple:
+    """Decided-wins merge of one or more ledger files.
+
+    Promoted from the script layer (``scripts/deep_retry_variants.py`` /
+    ``_sweeplib.merge_span_ledgers``) so resume-after-fault is a library
+    guarantee with ONE merge semantics:
+
+    * a partition any file records as **decided** stays decided — a later
+      file's (or a later line's) budget-cut ``unknown`` never demotes it;
+    * an ``unknown`` carrying a ``failure`` record (a fault-degraded
+      chunk) is **not settled** — resume re-attempts it;
+    * among plain unknowns and degradations, the last record wins (a
+      resumed run that re-attempts a degraded partition and hits a genuine
+      budget UNKNOWN settles it).
+
+    Returns ``(done, degraded, skipped_lines)``: settled pid → record,
+    degraded pid → record, torn-line count.
+    """
+    done: Dict[int, dict] = {}
+    degraded: Dict[int, dict] = {}
+    skipped = 0
+    for path in paths:
+        recs, sk = _read_ledger(path)
+        skipped += sk
+        for rec in recs:
+            pid = rec["partition_id"]
+            prev = done.get(pid)
+            if rec["verdict"] != "unknown":
+                done[pid] = rec
+                degraded.pop(pid, None)
+            elif prev is not None and prev["verdict"] != "unknown":
+                continue  # decided-wins
+            elif rec.get("failure"):
+                degraded[pid] = rec
+                done.pop(pid, None)
+            else:
+                done[pid] = rec
+                degraded.pop(pid, None)
+    return done, degraded, skipped
+
+
 def _load_ledger(path: str) -> Dict[int, dict]:
-    """Partition-id → record map; tolerates the truncated trailing line a
-    crashed run leaves behind (that is precisely the resume scenario)."""
-    done = {}
-    if os.path.isfile(path):
-        with open(path) as fp:
-            for line in fp:
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                done[rec["partition_id"]] = rec
+    """Partition-id → record map for one ledger (decided-wins merge).
+
+    Fault-degraded records are included (their verdict is ``unknown``), so
+    script-layer consumers that bucket on ``verdict`` treat them as
+    retryable — only :func:`verify_model`'s resume distinguishes them.
+    """
+    done, degraded, _skipped = merge_ledgers([path])
+    done.update(degraded)
     return done
 
 
@@ -604,7 +698,8 @@ def verify_model(
 
     with obs.maybe_tracing(cfg.trace_out, run_id=f"{cfg.name}-{model_name}"):
         with obs.span("verify_model", model=model_name, dataset=cfg.dataset,
-                      preset=cfg.name) as sp:
+                      preset=cfg.name) as sp, \
+                faults_mod.armed(cfg.inject_faults, seed=cfg.seed):
             try:
                 rep = _verify_model_impl(
                     net, cfg, model_name, dataset, mesh, resume, retry_unknown,
@@ -618,6 +713,8 @@ def verify_model(
                     hb.close()
                 raise
             sp.set(partitions=rep.partitions_total, **rep.counts)
+            if rep.degraded:
+                sp.set(degraded=rep.degraded)
             return rep
 
 
@@ -667,12 +764,25 @@ def _verify_model_impl(
 
     os.makedirs(cfg.result_dir, exist_ok=True)
     ledger_path = _ledger_path(cfg, sink_name)
-    done = _load_ledger(ledger_path) if resume else {}
+    led_skipped = 0
+    if resume:
+        # Decided-wins merge (library guarantee, not script lore): decided
+        # verdicts stay settled; fault-degraded UNKNOWNs (records with a
+        # `failure` reason) are NOT settled — this resume re-attempts them.
+        done, _degraded_prev, led_skipped = merge_ledgers([ledger_path])
+        if led_skipped:
+            import sys
+
+            print(f"warning: skipped {led_skipped} torn/undecodable ledger "
+                  f"line(s) in {ledger_path} (crash mid-append)",
+                  file=sys.stderr)
+    else:
+        done = {}
     if retry_unknown:
         # Re-attempt budget-exhausted partitions (e.g. with a larger soft
         # timeout); decided verdicts stay settled.  The re-decided rows are
-        # re-appended to the ledger, and _load_ledger's last-wins merge makes
-        # the retry the record of truth on the next resume.
+        # re-appended to the ledger, and the decided-wins merge makes the
+        # retry the record of truth on the next resume.
         done = {pid: rec for pid, rec in done.items()
                 if rec["verdict"] != "unknown"}
     csv_path = os.path.join(cfg.result_dir, f"{sink_name}.csv")
@@ -687,15 +797,46 @@ def _verify_model_impl(
     # One launch pipeline for the whole run: the stage-0 certify, parity
     # and deep-PGD chunk loops all share it, so its lifetime stats (max +
     # time-weighted mean launches in flight) are the run's overlap record
-    # (dumped in the throughput JSON next to device_launches).
-    pipe = LaunchPipeline(cfg.pipeline_depth)
+    # (dumped in the throughput JSON next to device_launches).  The
+    # attached supervisor retries transient launch faults; exhaustion
+    # degrades exactly the affected chunk's partitions to UNKNOWN-with-
+    # reason (recorded in `failed`, ledgered below) and the sweep goes on.
+    sup = _supervisor(cfg)
+    pipe = LaunchPipeline(cfg.pipeline_depth, supervisor=sup)
+    failed: Dict[int, dict] = {}  # local partition index -> failure record
+
+    def _degrade(idxs, failure: ChunkFailure, phase: str) -> None:
+        rec = failure.to_record()
+        n_new = 0
+        for i in idxs:
+            if 0 <= i < P and i not in failed:
+                failed[i] = rec
+                n_new += 1
+        if n_new:  # a chunk already degraded in an earlier phase counts once
+            obs.registry().counter("chunks_degraded").inc(site=failure.site)
+            obs.event("degraded", **rec, phase=phase, partitions=n_new)
+
     with xla_trace(cfg.profile_dir):
         with obs.timed_span(timer, "stage0_prune", partitions=P):
-            prune = pruning.sound_prune_grid(
-                net, lo, hi, cfg.sim_size, cfg.seed,
-                exact_certify=cfg.exact_certify_masks, chunk=cfg.grid_chunk,
-                index_offset=span_start, keep_sim=False,
-            )
+            try:
+                prune = sup.run(lambda: pruning.sound_prune_grid(
+                    net, lo, hi, cfg.sim_size, cfg.seed,
+                    exact_certify=cfg.exact_certify_masks, chunk=cfg.grid_chunk,
+                    index_offset=span_start, keep_sim=False,
+                ), site="prune")
+            except ChunkDegraded as exc:
+                # Pruning feeds only mask-derived REPORTING (compression
+                # columns, pruned_acc parity, the heuristic retry) — no
+                # verdict depends on it.  Losing it degrades nothing:
+                # stage 0 / PGD / BaB all proceed, the mask columns read
+                # zero, and only the UNKNOWN-improving heuristic retry is
+                # skipped.  A genuinely sick device will fault again in
+                # stage 0 and degrade there, chunk by chunk.
+                prune = None
+                obs.registry().counter("chunks_degraded").inc(
+                    site=exc.failure.site)
+                obs.event("degraded", **exc.failure.to_record(),
+                          phase="stage0_prune", partitions=0)
         with obs.timed_span(timer, "stage0_decide", partitions=P) as sp0:
             if stage0 is not None:  # precomputed by the stacked family kernel
                 unsat0, sat0, witnesses = stage0
@@ -703,11 +844,13 @@ def _verify_model_impl(
             else:
                 unsat0, sat0, witnesses = _stage0_certify_and_attack(
                     net, enc, lo, hi, cfg, mesh=mesh, seed_offset=span_start,
-                    pipe=pipe)
+                    pipe=pipe,
+                    on_failure=lambda s, e, f: _degrade(range(s, e), f,
+                                                        "stage0_decide"))
             sp0.set(unsat=int(unsat0.sum()), sat=int(sat0.sum()))
         with obs.timed_span(timer, "stage0_parity"):
             step, spans = _chunk_spans(P, cfg.grid_chunk)
-            parity = np.empty(P, dtype=np.float32)
+            parity = np.zeros(P, dtype=np.float32)
 
             def _parity_submit(s, e):
                 alive = tuple(
@@ -724,9 +867,20 @@ def _verify_model_impl(
 
             def _parity_consume(meta, _ctx, host):
                 s, e = meta
+                if isinstance(host, ChunkFailure):
+                    # The parity kernel feeds only the pruned_acc CSV
+                    # column, never a verdict — partitions stage 0 already
+                    # decided keep their sound SAT/UNSAT (pruned_acc reads
+                    # 0.0 for the lost chunk); only still-undecided ones
+                    # degrade, since their remaining path shares the sick
+                    # device anyway.
+                    _degrade([i for i in range(s, e)
+                              if not sat0[i] and not unsat0[i]],
+                             host, "stage0_parity")
+                    return
                 parity[s:e] = np.asarray(host)[: e - s]
 
-            for s, e in spans:
+            for s, e in (spans if prune is not None else ()):
                 for item in pipe.submit(
                         lambda s=s, e=e: _parity_submit(s, e), meta=(s, e)):
                     _parity_consume(*item)
@@ -735,7 +889,7 @@ def _verify_model_impl(
         stage0_per_part = 0.0  # finalized (incl. the PGD phase) below
 
         outcomes: List[PartitionOutcome] = []
-        sat_count = unsat_count = unk_count = 0
+        sat_count = unsat_count = unk_count = degraded_count = 0
         weights = [np.asarray(w) for w in net.weights]
         biases = [np.asarray(b) for b in net.biases]
 
@@ -747,7 +901,7 @@ def _verify_model_impl(
         # itself is cheap and never discards work).
         pending = [p for p in range(P)
                    if (span_start + p + 1) not in done
-                   and not sat0[p] and not unsat0[p]]
+                   and not sat0[p] and not unsat0[p] and p not in failed]
         # Gradient attack on the stage-0 leftovers: counterexamples the
         # random sampler misses (logit zero-crossings on thin slabs) are
         # found by batched PGD in one jit, sparing those roots the BaB tree.
@@ -774,6 +928,9 @@ def _verify_model_impl(
                 def _pgd_consume(meta, ctx, host):
                     nonlocal slab_spent
                     s, blk = meta
+                    if isinstance(host, ChunkFailure):
+                        _degrade(blk, host, "stage0_pgd")
+                        return
                     w, near_zero, near_abs = engine.pgd_attack_decode(
                         host, ctx, return_points=True)
                     pgd_wit.update({s + k: v for k, v in w.items()})
@@ -836,7 +993,7 @@ def _verify_model_impl(
                 p = pending[i]
                 sat0[p] = True
                 witnesses[p] = ce
-            pending = [p for p in pending if not sat0[p]]
+            pending = [p for p in pending if not sat0[p] and p not in failed]
         stage0_per_part = sum(
             timer.get(ph) for ph in
             ("stage0_prune", "stage0_decide", "stage0_parity", "stage0_pgd")
@@ -847,11 +1004,28 @@ def _verify_model_impl(
             deadline = min(cfg.soft_timeout_s * len(pending), hard_left)
             with obs.timed_span(timer, "bab", roots=len(pending),
                                 deadline_s=round(deadline, 3)):
-                decisions = engine.decide_many(
-                    net, enc, lo[pending], hi[pending],
-                    replace(cfg.engine, pipeline_depth=cfg.pipeline_depth),
-                    deadline_s=deadline, mesh=mesh, attacked=pgd_covered_all,
-                )
+                try:
+                    decisions = engine.decide_many(
+                        net, enc, lo[pending], hi[pending],
+                        replace(cfg.engine, pipeline_depth=cfg.pipeline_depth,
+                                max_launch_retries=cfg.max_launch_retries,
+                                launch_backoff_s=cfg.launch_backoff_s),
+                        deadline_s=deadline, mesh=mesh,
+                        attacked=pgd_covered_all,
+                    )
+                except BaseException as exc:
+                    # The engine's pipelined Phase A degrades per chunk on
+                    # its own; a fault escaping the sequential BaB phases
+                    # has no finer-grained blast radius than the batch —
+                    # degrade every pending root and keep the run alive
+                    # (re-running the whole batch would multiply its
+                    # deadline, so faults here get no whole-batch retry:
+                    # a transient is 'exhausted' at zero retries).
+                    if classify(exc) == "propagate":
+                        raise
+                    _degrade(pending, _unretried_failure("bab", exc), "bab")
+                    decisions = []
+                    pending = []
             bab = dict(zip(pending, decisions))
             # Per-phase attribution (VERDICT r3): where inside the engine
             # ladder the BaB seconds went, summed over roots — S (sign
@@ -886,6 +1060,14 @@ def _verify_model_impl(
                 "gm": gm,
             }
 
+    # Atomic + fsync'd appends (resilience.journal): one OS write per
+    # record, synced before the next partition is attempted — the strongest
+    # crash-resume story a JSONL ledger can give.  Appends are supervised:
+    # a transient filesystem error is retried; exhaustion is counted
+    # (`ledger_append_failures`) and the sweep continues — the verdict
+    # stays in this report, and a later resume re-decides it (sound).
+    ledger = JournalWriter(ledger_path, fault_site="ledger.append",
+                           supervisor=sup)
     for p in range(P):
         pid = span_start + p + 1
         if pid in done:
@@ -904,13 +1086,20 @@ def _verify_model_impl(
                                attempted=len(outcomes), unknown=unk_count)
             continue
         t_part = time.perf_counter()
-        dead = pruning.partition_masks(prune, p)
+        fail_rec = failed.get(p)
+        dead = pruning.partition_masks(prune, p) if prune is not None else None
 
         h_attempt = h_success = 0
+        smt_decided = False
         sv_time = hv_time = h_time = 0.0
         ce = None
         nodes = 0
-        if sat0[p]:
+        if fail_rec is not None:
+            # A runtime fault degraded this partition's chunk: UNKNOWN with
+            # a machine-readable reason, never a wrong answer — the row is
+            # ledgered with the failure record and re-attempted on resume.
+            verdict = "unknown"
+        elif sat0[p]:
             verdict, ce = "sat", witnesses[p]
         elif unsat0[p]:
             verdict = "unsat"
@@ -919,39 +1108,75 @@ def _verify_model_impl(
             sv_time = dec.elapsed_s  # per-root attributed cost (engine.decide_many)
             nodes = dec.nodes
             verdict, ce = dec.verdict, dec.counterexample
-            if verdict == "unknown" and cumulative <= cfg.hard_timeout_s:
+            if verdict == "unknown" and prune is not None \
+                    and cumulative <= cfg.hard_timeout_s:
                 # Heuristic retry: kill borderline-quiet neurons, re-decide on
                 # the masked net (``src/GC/Verify-GC.py:172-211``).
                 h_attempt = 1
                 obs.registry().counter("unknown_retries").inc()
                 t_h = time.perf_counter()
-                h_dead, merged = heur_ops.heuristic_prune(
-                    [l[p] for l in prune.ws_lb], [l[p] for l in prune.ws_ub],
-                    [l[p] for l in prune.candidates], [l[p] for l in prune.surviving],
-                    dead, cfg.heuristic_threshold,
-                )
-                h_net = mask_ops.apply_dead_masks(net, [jnp.asarray(d) for d in merged])
-                dec2 = engine.decide_box(
-                    h_net, enc, lo[p], hi[p],
-                    replace(cfg.engine, soft_timeout_s=cfg.soft_timeout_s),
-                )
-                hv_time = dec2.elapsed_s
-                h_time = time.perf_counter() - t_h
-                nodes += dec2.nodes
-                if dec2.verdict != "unknown":
-                    h_success = 1
-                    verdict, ce = dec2.verdict, dec2.counterexample
-                    # A SAT from the unsoundly-pruned net must replay on the
-                    # original to count (the reference's V-accurate check).
-                    if verdict == "sat" and not engine.validate_pair(weights, biases, *ce):
-                        verdict, ce = "unknown", None
-                        h_success = 0
-                dead = merged
+                try:
+                    h_dead, merged = heur_ops.heuristic_prune(
+                        [l[p] for l in prune.ws_lb], [l[p] for l in prune.ws_ub],
+                        [l[p] for l in prune.candidates], [l[p] for l in prune.surviving],
+                        dead, cfg.heuristic_threshold,
+                    )
+                    h_net = mask_ops.apply_dead_masks(net, [jnp.asarray(d) for d in merged])
+                    dec2 = engine.decide_box(
+                        h_net, enc, lo[p], hi[p],
+                        replace(cfg.engine, soft_timeout_s=cfg.soft_timeout_s),
+                    )
+                except BaseException as exc:
+                    # A fault in the retry only loses the retry: the root's
+                    # verdict stays the (sound) UNKNOWN it already has.
+                    if classify(exc) == "propagate":
+                        raise
+                    _degrade([p], _unretried_failure("bab", exc),
+                             "heuristic_retry")
+                    fail_rec = failed.get(p)
+                    h_time = time.perf_counter() - t_h
+                else:
+                    hv_time = dec2.elapsed_s
+                    h_time = time.perf_counter() - t_h
+                    nodes += dec2.nodes
+                    if dec2.verdict != "unknown":
+                        h_success = 1
+                        verdict, ce = dec2.verdict, dec2.counterexample
+                        # A SAT from the unsoundly-pruned net must replay on the
+                        # original to count (the reference's V-accurate check).
+                        if verdict == "sat" and not engine.validate_pair(weights, biases, *ce):
+                            verdict, ce = "unknown", None
+                            h_success = 0
+                    dead = merged
+            if verdict == "unknown" and fail_rec is None \
+                    and cfg.smt_retry_timeouts_s \
+                    and cumulative <= cfg.hard_timeout_s:
+                # Last tier of the UNKNOWN-retry ladder (opt-in via
+                # cfg.smt_retry_timeouts_s): a Z3 second opinion on the
+                # ORIGINAL net with escalating per-attempt timeouts — the
+                # reference's re-run-with-a-larger-argv-soft-timeout
+                # escalation (src/GC/Verify-GC.py:146-149) as a config
+                # knob.  No-op where z3-solver is not installed; faults/
+                # solver errors come back as UNKNOWN-with-reason, never
+                # propagate (decide_box_smt's own contract).
+                from fairify_tpu.verify import smt as smt_mod
+
+                if smt_mod.HAVE_Z3:
+                    smt_verdict, smt_ce, _reason = smt_mod.decide_box_smt(
+                        net, enc, lo[p], hi[p],
+                        soft_timeout_s=cfg.soft_timeout_s,
+                        retry_timeouts_s=cfg.smt_retry_timeouts_s)
+                    if smt_verdict != "unknown":
+                        verdict, ce = smt_verdict, smt_ce
+                        smt_decided = True
 
         c_check = v_accurate = 0
-        if verdict == "sat" and ce is not None:
+        if verdict == "sat" and ce is not None and dead is not None:
+            # dead is None only when pruning itself degraded — a C-check
+            # against a nonexistent pruned net would trivially "pass";
+            # report 0, consistent with the zeroed compression columns.
             c_check, v_accurate = _c_check_np(weights, biases, dead, ce)
-        if h_attempt:  # masks changed after the batched parity pass
+        if h_attempt and fail_rec is None:  # masks changed after parity pass
             pruned_acc = _parity_resim(
                 weights, biases, dead,
                 pruning.grid_keys(cfg.seed, span_start + p, 1)[0],
@@ -965,26 +1190,34 @@ def _verify_model_impl(
             unsat_count += 1
         else:
             unk_count += 1
+        if fail_rec is not None:
+            degraded_count += 1
         counter.record(verdict, via_stage0=bool(sat0[p] or unsat0[p]))
         if h_success:
             obs.registry().counter("unknown_retry_success").inc()
+        extra = {"failure": fail_rec["reason"]} if fail_rec is not None else {}
         obs.event("verdict", model=model_name, partition_id=pid,
                   verdict=verdict,
-                  via="stage0" if (sat0[p] or unsat0[p])
-                  else ("heuristic" if h_success else "bab"))
+                  via="degraded" if fail_rec is not None
+                  else "stage0" if (sat0[p] or unsat0[p])
+                  else "smt" if smt_decided
+                  else ("heuristic" if h_success else "bab"), **extra)
 
         # Per-row accounting: amortized stage-0 share + this row's attributed
         # BaB cost (sv_time) + its own loop work (heuristic retry, replay).
         total_time = stage0_per_part + sv_time + (time.perf_counter() - t_part)
         cumulative += time.perf_counter() - t_part
         obs.registry().histogram("partition_latency_s").observe(total_time)
-        comp = {
-            "b": mask_ops.compression_ratio([l[p] for l in prune.b_deads]),
-            "s": mask_ops.compression_ratio([l[p] for l in prune.s_deads]),
-            "st": mask_ops.compression_ratio([l[p] for l in prune.st_deads]),
-            "h": mask_ops.compression_ratio(dead) if h_attempt else 0.0,
-            "t": mask_ops.compression_ratio(dead),
-        }
+        if prune is not None:
+            comp = {
+                "b": mask_ops.compression_ratio([l[p] for l in prune.b_deads]),
+                "s": mask_ops.compression_ratio([l[p] for l in prune.s_deads]),
+                "st": mask_ops.compression_ratio([l[p] for l in prune.st_deads]),
+                "h": mask_ops.compression_ratio(dead) if h_attempt else 0.0,
+                "t": mask_ops.compression_ratio(dead),
+            }
+        else:  # pruning itself degraded — no masks exist for this span
+            comp = {"b": 0.0, "s": 0.0, "st": 0.0, "h": 0.0, "t": 0.0}
         out = PartitionOutcome(
             pid, verdict, ce, h_attempt, h_success, nodes,
             times={"sv": sv_time, "s": stage0_per_part + sv_time, "hv": hv_time,
@@ -997,7 +1230,7 @@ def _verify_model_impl(
             heartbeat.beat(decided=sat_count + unsat_count,
                            attempted=len(outcomes), unknown=unk_count)
 
-        if pm is not None:
+        if pm is not None and fail_rec is None and dead is not None:
             # Reference artifact shape (``src/CP/Verify-CP.py:448-458``):
             # Partition ID, orig/pruned test acc + F1, then the group
             # metrics.  One deliberate delta, documented: the reference
@@ -1041,12 +1274,14 @@ def _verify_model_impl(
             original_acc=orig_acc, pruned_acc=pruned_acc,
             c1=ce[0] if ce else None, c2=ce[1] if ce else None,
         ))
-        with open(ledger_path, "a") as fp:
-            fp.write(json.dumps({
-                "partition_id": pid, "verdict": verdict,
-                "ce": [ce[0].tolist(), ce[1].tolist()] if ce else None,
-                "time_s": round(total_time, 4),
-            }) + "\n")
+        led_rec = {
+            "partition_id": pid, "verdict": verdict,
+            "ce": [ce[0].tolist(), ce[1].tolist()] if ce else None,
+            "time_s": round(total_time, 4),
+        }
+        if fail_rec is not None:
+            led_rec["failure"] = fail_rec
+        ledger.append(led_rec)
         if ce is not None:
             # Counterexample CSV, encoded form (``src/CP/Verify-CP.py:310-326``),
             # appended per partition like the ledger: crash-safe, and resumed
@@ -1067,6 +1302,7 @@ def _verify_model_impl(
         # and the heuristic-retry guard.  Verdicts already computed are always
         # reported — no work is discarded by a reporting-loop break.
 
+    ledger.close()
     if retry_unknown:
         # Re-decided rows were appended after their original 'unknown' rows;
         # restore one-row-per-partition ascending order for row-for-row
@@ -1089,7 +1325,9 @@ def _verify_model_impl(
     counter.dump(os.path.join(cfg.result_dir, f"{cfg.name}-{sink_name}.throughput.json"),
                  phases=timer.phases,
                  pipeline={"depth": cfg.pipeline_depth, **pipe.stats.summary()},
-                 compile=compile_obs.totals_delta(compile0))
+                 compile=compile_obs.totals_delta(compile0),
+                 resilience={"degraded": degraded_count,
+                             "ledger_skipped_lines": led_skipped})
     if heartbeat is not None:  # final line regardless of throttle state
         heartbeat.beat(decided=sat_count + unsat_count, attempted=len(outcomes),
                        unknown=unk_count, force=True)
@@ -1097,7 +1335,8 @@ def _verify_model_impl(
     return ModelReport(
         model=model_name, dataset=cfg.dataset, outcomes=outcomes,
         original_acc=orig_acc, total_time_s=timer.total(), partitions_total=P,
-        sink_name=sink_name,
+        sink_name=sink_name, ledger_skipped_lines=led_skipped,
+        degraded=degraded_count,
     )
 
 
@@ -1162,7 +1401,8 @@ def _run_sweep_impl(cfg, model_root, data_root, mesh, stack,
             # first chunk is dispatched while group A's last chunks are
             # still decoding per-model witnesses on host.
             stacks = [stack_models([nets[n] for n in names]) for names in multi]
-            fam_pipe = LaunchPipeline(cfg.pipeline_depth)
+            fam_pipe = LaunchPipeline(cfg.pipeline_depth,
+                                      supervisor=_supervisor(cfg))
             with obs.span("stage0_family",
                           models=sum(len(n) for n in multi),
                           groups=len(multi), partitions=int(lo.shape[0])) as sp:

@@ -261,15 +261,15 @@ def merge_span_ledgers(cfg, model_name: str):
         cfg.result_dir, f"{cfg.name}-{model_name}@*.ledger.jsonl")))
     from fairify_tpu.verify import sweep as sweep_mod
 
-    decided: dict = {}
-    unknown: set = set()
-    for path in paths:
-        for pid, rec in sweep_mod._load_ledger(path).items():
-            if rec["verdict"] != "unknown":
-                decided[pid] = rec
-                unknown.discard(pid)
-            elif pid not in decided:
-                unknown.add(pid)
+    # The decided-wins merge now lives in the library (sweep.merge_ledgers,
+    # this PR's promotion) — fault-degraded UNKNOWNs land in the retryable
+    # bucket alongside budget UNKNOWNs, which is exactly what the retry
+    # pass wants.
+    done, degraded, _skipped = sweep_mod.merge_ledgers(paths)
+    decided = {pid: rec for pid, rec in done.items()
+               if rec["verdict"] != "unknown"}
+    unknown = {pid for pid, rec in done.items()
+               if rec["verdict"] == "unknown"} | set(degraded)
     return paths, decided, unknown
 
 

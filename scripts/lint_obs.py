@@ -23,6 +23,13 @@ into the tier-1 test run via ``tests/test_observability.py``).  Two rules:
   ~110 ms-to-tens-of-seconds stalls the compile registry exists to
   attribute.  The allowlist (``ALLOW_RAW_JIT``, repo-relative file paths)
   names reviewed exceptions — currently empty; shrink, don't grow, it.
+* **No silently-swallowed broad excepts in ``fairify_tpu/``** — a bare
+  ``except:`` / ``except Exception`` / ``except BaseException`` whose body
+  never re-raises swallows exactly the faults the resilience layer
+  (``fairify_tpu/resilience``) exists to classify, retry, and degrade
+  with a recorded reason.  Handlers that conditionally re-raise (after
+  ``resilience.supervisor.classify``) pass; the reviewed swallow sites
+  (compile fallback, import gates) live in ``ALLOW_BROAD_EXCEPT``.
 * **No synchronous device fetch in ``fairify_tpu/verify/`` loops** —
   ``np.asarray(...)`` / ``jax.device_get(...)`` / ``.block_until_ready()``
   inside a ``for``/``while`` body stalls the launch queue exactly where
@@ -103,6 +110,64 @@ _FETCH_HINT = (
     "synchronous device fetch in a verify/ loop — submit through "
     "parallel.pipeline.LaunchPipeline and convert at dequeue "
     "(or extend ALLOW_LOOP_FETCH with file::function and a reason)")
+
+# Broad-except rule: a bare ``except:`` / ``except Exception`` /
+# ``except BaseException`` that never re-raises swallows exactly the
+# faults the resilience layer exists to classify and surface (an injected
+# ``crash`` fault, a KeyboardInterrupt under BaseException) — silent
+# degradation with no counter, no event, no ledger reason.  Handlers that
+# contain a ``raise`` (conditional re-raise after classification) pass.
+# The allowlist (``file::function``) names reviewed swallow sites — each
+# with its reason.  Shrink, don't grow, it.
+ALLOW_BROAD_EXCEPT = {
+    # Import gate: jax.api_util.shaped_abstractify rename degrades to
+    # conservative fallback cache keys, never an import error.
+    "fairify_tpu/obs/compile.py::<module>",
+    # Compile fallbacks: an unusable AOT path serves the kernel via plain
+    # jax.jit (counted in xla_compile_fallbacks) — observability must
+    # never change results or availability.  (_compile's handler re-raises
+    # propagate-class faults, so only __call__'s swallow sites need this.)
+    "fairify_tpu/obs/compile.py::__call__",
+    # Backend-optional executable analyses (cost/memory): absence degrades
+    # to missing attrs.
+    "fairify_tpu/obs/compile.py::_record_analysis",
+}
+_BROAD_HINT = (
+    "broad except (bare/Exception/BaseException) that never re-raises — "
+    "classify via fairify_tpu.resilience.supervisor.classify and degrade "
+    "with a recorded reason, or extend ALLOW_BROAD_EXCEPT with a reviewed "
+    "reason")
+
+
+def _is_broad_type(node) -> bool:
+    """Does the handler's type expression name Exception/BaseException?"""
+    if node is None:
+        return True  # bare except:
+    if isinstance(node, ast.Tuple):
+        return any(_is_broad_type(el) for el in node.elts)
+    return isinstance(node, ast.Name) and node.id in ("Exception",
+                                                      "BaseException")
+
+
+def _broad_except_errors(tree: ast.AST, rel: str) -> list:
+    """Flag broad exception handlers with no ``raise`` anywhere in the body."""
+    errors = []
+
+    def walk(node, fn_name):
+        for child in ast.iter_child_nodes(node):
+            c_fn = fn_name
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                c_fn = child.name
+            elif isinstance(child, ast.ExceptHandler) \
+                    and _is_broad_type(child.type) \
+                    and not any(isinstance(n, ast.Raise)
+                                for n in ast.walk(child)) \
+                    and f"{rel}::{c_fn}" not in ALLOW_BROAD_EXCEPT:
+                errors.append(f"{rel}:{child.lineno}: {_BROAD_HINT}")
+            walk(child, c_fn)
+
+    walk(tree, "<module>")
+    return errors
 
 
 def _is_time_time(node: ast.Call) -> bool:
@@ -195,6 +260,7 @@ def check_file(path: str, rel: str) -> list:
                 f"event log (or extend ALLOW_PRINT for user-facing output)")
     if rel.startswith(LOOP_FETCH_SCOPE):
         errors.extend(_loop_fetch_errors(tree, rel))
+    errors.extend(_broad_except_errors(tree, rel))
     return errors
 
 

@@ -232,7 +232,7 @@ def test_z3_agrees_with_native_engine(seed):
     net = mlp.from_numpy(ws, bs)
     native = engine.decide_box(net, enc, lo.astype(np.int64), hi.astype(np.int64),
                                engine.EngineConfig(soft_timeout_s=30.0))
-    smt_verdict, _ = smt.decide_box_smt(net, enc, lo.astype(np.int64),
-                                        hi.astype(np.int64))
+    smt_verdict, _, _reason = smt.decide_box_smt(net, enc, lo.astype(np.int64),
+                                                 hi.astype(np.int64))
     if "unknown" not in (native.verdict, smt_verdict):
         assert native.verdict == smt_verdict
