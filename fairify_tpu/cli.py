@@ -223,6 +223,13 @@ def _experiment_traced(args, cfg) -> int:
     return 0
 
 
+def _cmd_lint(args) -> int:
+    """Run the static-analysis rule engine (DESIGN.md §11) over fairify_tpu/."""
+    from fairify_tpu.lint import core as lint_core
+
+    return lint_core.run_cli(args)
+
+
 def _cmd_metrics(args) -> int:
     """Group-fairness report for zoo models on their dataset's test split
     (the reference's AIF360 metric blocks, ``src/CP/Verify-CP.py:398-458``)."""
@@ -369,10 +376,17 @@ def main(argv=None) -> int:
     met.add_argument("--model-root", default=None)
     met.add_argument("--data-root", default=None)
 
+    lint = sub.add_parser(
+        "lint", help="run the nine-rule static-analysis engine over "
+                     "fairify_tpu/ (DESIGN.md §11)")
+    from fairify_tpu.lint.core import add_cli_args as _lint_cli_args
+
+    _lint_cli_args(lint)
+
     args = ap.parse_args(argv)
     return {"list": _cmd_list, "run": _cmd_run, "bench": _cmd_bench,
             "experiment": _cmd_experiment, "metrics": _cmd_metrics,
-            "report": _cmd_report}[args.cmd](args)
+            "report": _cmd_report, "lint": _cmd_lint}[args.cmd](args)
 
 
 if __name__ == "__main__":

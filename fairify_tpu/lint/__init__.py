@@ -1,0 +1,29 @@
+"""``fairify_tpu.lint`` — the repo's rule-engine static-analysis framework.
+
+A fast AST-only analysis (no jax import, no execution of the code under
+analysis) exposed as ``fairify_tpu lint`` and ``scripts/lint.py`` and run
+by tier-1 via ``tests/test_lint.py``.  See DESIGN.md §11 for the contract
+and ``fairify_tpu/lint/rules.py`` for the nine-rule catalog.
+
+Public surface::
+
+    from fairify_tpu import lint
+    result = lint.run_lint()          # LintResult over the whole repo
+    rc = lint.main(["--format", "json"])   # the CLI entry
+
+``scripts/lint_obs.py`` remains as a deprecated compatibility shim over
+the five legacy rules.
+"""
+from fairify_tpu.lint.core import (  # noqa: F401
+    BASELINE_REL,
+    FileContext,
+    Finding,
+    LintResult,
+    Rule,
+    load_baseline,
+    main,
+    render_text,
+    repo_root,
+    run_lint,
+)
+from fairify_tpu.lint.rules import all_rules, legacy_rules  # noqa: F401

@@ -1,0 +1,485 @@
+"""Rule-engine core for ``fairify_tpu.lint``: contexts, baseline, runner, CLI.
+
+The lint framework is a cheap whole-repo AST analysis (no imports of the
+code under analysis, no jax) that guards the invariants the runtime
+subsystems cannot enforce from the inside: every device kernel registered
+through ``obs_jit``, no sync fetch stalling the launch queue, no fault
+swallowed without a recorded reason, trace-pure jitted bodies, stable jit
+signatures, locked shared state, and live chaos coverage.  This module is
+the engine; the rules live in ``rules_obs`` / ``rules_jit`` /
+``rules_locks`` / ``rules_faults``.
+
+Vocabulary (see DESIGN.md §11 for the full contract):
+
+* **Rule** — a plugin with a stable ``id``, a ``severity``, a path-prefix
+  ``scope``, and a reviewed ``allowlist`` of ``file`` or ``file::function``
+  keys.  Per-file findings come from :meth:`Rule.check`; cross-file
+  analyses (fault-site coverage) report from :meth:`Rule.finalize` after
+  every file has been scanned.
+* **Suppression** — ``# lint: disable=<rule-id>[,<rule-id>...]`` on the
+  flagged line silences exactly that line; ``disable=all`` silences every
+  rule there.  Suppressions are counted, never silent.
+* **Baseline** — ``audits/lint_baseline.json`` grandfathers reviewed
+  findings by ``rule::path::function`` key with a per-key count and a
+  mandatory reason.  Baselined findings are reported but do not fail the
+  run; ratchet mode (``--ratchet``) additionally fails when any rule's
+  total finding count exceeds its committed baseline total, so the
+  grandfathered set can only shrink.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+_SUPPRESS_RE = re.compile(r"#\s*lint:\s*disable=([a-zA-Z0-9_,\- ]+)")
+
+#: Default committed-baseline location, repo-relative.
+BASELINE_REL = "audits/lint_baseline.json"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One violation: where, which rule, and the actionable message."""
+
+    rule: str
+    path: str  # repo-relative, '/'-separated
+    line: int
+    function: str  # enclosing def/class attribution ('<module>' at top level)
+    message: str
+    severity: str = "error"
+
+    @property
+    def key(self) -> str:
+        """Line-number-free identity used for baseline matching (line churn
+        from unrelated edits must not invalidate a grandfathered entry)."""
+        return f"{self.rule}::{self.path}::{self.function}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "function": self.function, "severity": self.severity,
+                "message": self.message}
+
+
+_EMPTY_TARGETS: frozenset = frozenset()
+
+
+def attributed_nodes(tree: ast.AST) -> List[tuple]:
+    """One shared walk: ``(node, function, in_loop_body, loop_targets)``.
+
+    Attribution and loop context are scope-accurate:
+
+    * nested ``def``/``lambda`` resets the loop context (a decode closure
+      defined inside a function and *called* from a loop is the pipeline's
+      drain path, not a loop-body fetch);
+    * a ``ClassDef`` re-attributes its body to the class name — a handler
+      in a class body must not inherit the enclosing function's allowlist
+      key (methods still attribute to the method name);
+    * only per-iteration code is in-loop: a ``for``/``while`` ``else:``
+      clause and a ``for``'s iterable run once and keep the outer context
+      (a ``while``'s test re-evaluates per iteration, so it counts);
+    * ``loop_targets`` is the set of iteration-variable names of every
+      enclosing ``for`` in the same function scope.
+
+    Every rule iterates this one cached list (via
+    :meth:`FileContext.attributed`) instead of re-walking the tree.
+
+    Iterative (explicit stack) with direct ``__dict__`` child iteration —
+    the walk runs once per file over the whole repo and is the engine's
+    hot loop; node order within the list is unspecified (the engine sorts
+    findings by location at the end).
+    """
+    AST = ast.AST
+    out: List[tuple] = []
+    app = out.append
+    stack: List[tuple] = [(tree, "<module>", False, _EMPTY_TARGETS)]
+    pop = stack.pop
+    push = stack.append
+    while stack:
+        item = pop()
+        app(item)
+        node, fn, in_loop, targets = item
+        cls = node.__class__
+        if cls is ast.FunctionDef or cls is ast.AsyncFunctionDef:
+            fn, in_loop, targets = node.name, False, _EMPTY_TARGETS
+        elif cls is ast.Lambda:
+            in_loop, targets = False, _EMPTY_TARGETS
+        elif cls is ast.ClassDef:
+            fn = node.name
+        elif cls is ast.For or cls is ast.AsyncFor:
+            inner = targets | frozenset(
+                n.id for n in ast.walk(node.target)
+                if n.__class__ is ast.Name)
+            push((node.target, fn, in_loop, targets))
+            push((node.iter, fn, in_loop, targets))
+            for child in node.body:
+                push((child, fn, True, inner))
+            for child in node.orelse:
+                push((child, fn, in_loop, targets))
+            continue
+        elif cls is ast.While:
+            push((node.test, fn, True, targets))
+            for child in node.body:
+                push((child, fn, True, targets))
+            for child in node.orelse:
+                push((child, fn, in_loop, targets))
+            continue
+        for v in node.__dict__.values():
+            if v.__class__ is list:
+                for it in v:
+                    if isinstance(it, AST):
+                        push((it, fn, in_loop, targets))
+            elif isinstance(v, AST):
+                push((v, fn, in_loop, targets))
+    return out
+
+
+class FileContext:
+    """Parsed view of one file: AST, source lines, per-line suppressions.
+
+    ``cache`` is a per-file scratch dict rules share derived analyses
+    through (e.g. the jitted-def discovery both jit rules need).
+    """
+
+    def __init__(self, path: str, rel: str, src: Optional[str] = None):
+        if src is None:
+            with open(path) as fp:
+                src = fp.read()
+        self.path = path
+        self.rel = rel
+        self.src = src
+        self.tree = ast.parse(src, filename=path)
+        self.cache: Dict[str, object] = {}
+        self._attributed: Optional[List[tuple]] = None
+        self._suppress: Dict[int, set] = {}
+        for i, line in enumerate(src.splitlines(), start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                ids = {s.strip() for s in m.group(1).split(",") if s.strip()}
+                self._suppress[i] = ids
+
+    def attributed(self) -> List[tuple]:
+        """Cached :func:`attributed_nodes` of this file's tree."""
+        if self._attributed is None:
+            self._attributed = attributed_nodes(self.tree)
+        return self._attributed
+
+    def suppressed(self, line: int, rule_id: str) -> bool:
+        ids = self._suppress.get(line)
+        return bool(ids) and (rule_id in ids or "all" in ids)
+
+    def suppressions(self) -> Dict[int, set]:
+        return dict(self._suppress)
+
+
+class Rule:
+    """Plugin protocol (subclass, set the class attrs, implement check).
+
+    ``scope`` is a tuple of repo-relative path prefixes; the engine calls
+    :meth:`check` only for files inside it.  ``allowlist`` entries are
+    either a repo-relative file path (whole file exempt) or
+    ``path::function`` (one attribution key exempt) — reviewed exceptions,
+    each of which should carry a reason comment where it is defined.
+    """
+
+    id: str = ""
+    severity: str = "error"
+    description: str = ""
+    scope: Tuple[str, ...] = ("fairify_tpu/",)
+    allowlist: frozenset = frozenset()
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith(tuple(self.scope))
+
+    def allowed(self, rel: str, function: str = "<module>") -> bool:
+        return rel in self.allowlist or f"{rel}::{function}" in self.allowlist
+
+    def finding(self, ctx: FileContext, line: int, message: str,
+                function: str = "<module>") -> Finding:
+        return Finding(rule=self.id, path=ctx.rel, line=line,
+                       function=function, message=message,
+                       severity=self.severity)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:  # per-file
+        return ()
+
+    def finalize(self, files: Dict[str, FileContext]) -> Iterable[Finding]:
+        """Cross-file findings, called once after every file's check()."""
+        return ()
+
+
+@dataclass
+class LintResult:
+    """Everything a renderer or CI gate needs from one engine run."""
+
+    findings: List[Finding] = field(default_factory=list)  # actionable
+    baselined: List[Finding] = field(default_factory=list)  # grandfathered
+    suppressed: int = 0
+    parse_errors: List[Finding] = field(default_factory=list)
+    rules: List[str] = field(default_factory=list)
+    n_files: int = 0
+    duration_s: float = 0.0
+    ratchet_breaches: List[str] = field(default_factory=list)
+
+    def counts(self, include_baselined: bool = False) -> Dict[str, int]:
+        out = {r: 0 for r in self.rules}
+        pools = [self.findings] + ([self.baselined] if include_baselined else [])
+        for pool in pools:
+            for f in pool:
+                out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.parse_errors \
+            and not self.ratchet_breaches
+
+    def as_dict(self) -> dict:
+        return {
+            "version": 1,
+            "ok": self.ok,
+            "rules": list(self.rules),
+            "n_files": self.n_files,
+            "duration_s": round(self.duration_s, 4),
+            "counts": self.counts(),
+            "findings": [f.as_dict() for f in self.findings],
+            "baselined": [f.as_dict() for f in self.baselined],
+            "parse_errors": [f.as_dict() for f in self.parse_errors],
+            "suppressed": self.suppressed,
+            "ratchet_breaches": list(self.ratchet_breaches),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+
+def load_baseline(path: str) -> Dict[str, dict]:
+    """``rule::path::function`` → ``{"count": n, "reason": str}``.
+
+    A missing file is an empty baseline (the committed tree should be
+    clean); a malformed one raises — a broken gate must be loud.
+    """
+    if not os.path.exists(path):
+        return {}
+    with open(path) as fp:
+        doc = json.load(fp)
+    findings = doc.get("findings", {})
+    out = {}
+    for key, ent in findings.items():
+        if not isinstance(ent, dict) or int(ent.get("count", 0)) < 1:
+            raise ValueError(f"baseline entry {key!r} needs a count >= 1")
+        if not str(ent.get("reason", "")).strip():
+            raise ValueError(
+                f"baseline entry {key!r} needs a non-empty reason")
+        out[key] = {"count": int(ent["count"]),
+                    "reason": str(ent["reason"])}
+    return out
+
+
+def apply_baseline(findings: List[Finding], baseline: Dict[str, dict]
+                   ) -> Tuple[List[Finding], List[Finding]]:
+    """Split findings into (actionable, baselined) under per-key budgets."""
+    budget = {k: v["count"] for k, v in baseline.items()}
+    active, grandfathered = [], []
+    for f in findings:
+        if budget.get(f.key, 0) > 0:
+            budget[f.key] -= 1
+            grandfathered.append(f)
+        else:
+            active.append(f)
+    return active, grandfathered
+
+
+def ratchet_breaches(result: LintResult, baseline: Dict[str, dict]
+                     ) -> List[str]:
+    """Per-rule totals (active + baselined) vs the committed baseline totals.
+
+    Any rule whose finding count exceeds its baseline total is a breach —
+    the grandfathered set may only shrink.
+    """
+    base_totals: Dict[str, int] = {}
+    for key, ent in baseline.items():
+        rule = key.split("::", 1)[0]
+        base_totals[rule] = base_totals.get(rule, 0) + ent["count"]
+    breaches = []
+    for rule, n in sorted(result.counts(include_baselined=True).items()):
+        allowed = base_totals.get(rule, 0)
+        if n > allowed:
+            breaches.append(f"{rule}: {n} finding(s) > baseline {allowed}")
+    return breaches
+
+
+# ---------------------------------------------------------------------------
+# Runner
+# ---------------------------------------------------------------------------
+
+
+def repo_root() -> str:
+    """The repo checkout this package lives in (…/fairify_tpu/lint/core.py)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def iter_py_files(root: str, subdir: str = "fairify_tpu"
+                  ) -> Iterable[Tuple[str, str]]:
+    """Sorted (abs path, repo-relative path) for every .py under subdir."""
+    base = os.path.join(root, subdir)
+    for dirpath, dirnames, filenames in os.walk(base):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                path = os.path.join(dirpath, fn)
+                yield path, os.path.relpath(path, root).replace(os.sep, "/")
+
+
+def run_lint(root: Optional[str] = None,
+             rules: Optional[Sequence[Rule]] = None,
+             files: Optional[Sequence[Tuple[str, str]]] = None,
+             baseline: Optional[Dict[str, dict]] = None,
+             ratchet: bool = False) -> LintResult:
+    """Run ``rules`` over the repo (or an explicit ``files`` list).
+
+    ``files`` entries are ``(abs_path, repo_relative)`` — the fixture
+    corpus uses this to lint virtual trees.  ``baseline`` is the loaded
+    grandfather map (``None`` = empty).
+    """
+    t0 = time.perf_counter()
+    if rules is None:
+        from fairify_tpu.lint.rules import all_rules
+
+        rules = all_rules()
+    if root is None:
+        root = repo_root()
+    if files is None:
+        files = list(iter_py_files(root))
+
+    result = LintResult(rules=[r.id for r in rules])
+    contexts: Dict[str, FileContext] = {}
+    raw: List[Finding] = []
+    for path, rel in files:
+        try:
+            ctx = FileContext(path, rel)
+        except SyntaxError as exc:
+            result.parse_errors.append(Finding(
+                rule="parse", path=rel, line=exc.lineno or 0,
+                function="<module>", message=f"syntax error: {exc.msg}"))
+            continue
+        contexts[rel] = ctx
+        for rule in rules:
+            if rule.applies(rel):
+                raw.extend(rule.check(ctx))
+    for rule in rules:
+        raw.extend(rule.finalize(contexts))
+
+    kept: List[Finding] = []
+    for f in raw:
+        ctx = contexts.get(f.path)
+        if ctx is not None and ctx.suppressed(f.line, f.rule):
+            result.suppressed += 1
+        else:
+            kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+
+    result.findings, result.baselined = apply_baseline(kept, baseline or {})
+    result.n_files = len(contexts)
+    if ratchet:
+        result.ratchet_breaches = ratchet_breaches(result, baseline or {})
+    result.duration_s = time.perf_counter() - t0
+    return result
+
+
+# ---------------------------------------------------------------------------
+# Rendering + CLI
+# ---------------------------------------------------------------------------
+
+
+def render_text(result: LintResult, verbose_baselined: bool = False) -> str:
+    lines = []
+    for f in result.parse_errors:
+        lines.append(f.render())
+    for f in result.findings:
+        lines.append(f.render())
+    if verbose_baselined:
+        for f in result.baselined:
+            lines.append(f"{f.render()}  (baselined)")
+    for b in result.ratchet_breaches:
+        lines.append(f"ratchet: {b}")
+    n = len(result.findings) + len(result.parse_errors)
+    lines.append(
+        f"lint: {n} finding(s), {len(result.baselined)} baselined, "
+        f"{result.suppressed} suppressed — {len(result.rules)} rules over "
+        f"{result.n_files} files in {result.duration_s:.2f}s")
+    return "\n".join(lines)
+
+
+def add_cli_args(ap) -> None:
+    """Lint CLI options, defined once — used by this module's ``main`` and
+    by the ``fairify_tpu lint`` subparser (``cli._cmd_lint`` forwards its
+    parsed namespace straight to :func:`run_cli`)."""
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--ratchet", action="store_true",
+                    help="also fail if any rule's finding count exceeds the "
+                         "committed baseline total (growth gate)")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline JSON path (default <root>/{BASELINE_REL}; "
+                         f"'none' disables)")
+    ap.add_argument("--root", default=None, help="repo root override")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule-id subset (default: all nine)")
+    ap.add_argument("--show-baselined", action="store_true",
+                    help="also print grandfathered findings (text format)")
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """CLI shared by ``fairify_tpu lint`` and ``scripts/lint.py``."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="fairify_tpu lint",
+        description="AST rule engine over fairify_tpu/ (see DESIGN.md §11)")
+    add_cli_args(ap)
+    return run_cli(ap.parse_args(argv))
+
+
+def run_cli(args) -> int:
+    """Run the engine from a parsed :func:`add_cli_args` namespace."""
+    import sys
+
+    from fairify_tpu.lint.rules import all_rules
+
+    root = args.root or repo_root()
+    rules = all_rules()
+    if args.rules:
+        want = {s.strip() for s in args.rules.split(",") if s.strip()}
+        unknown = want - {r.id for r in rules}
+        if unknown:
+            print(f"unknown rule id(s): {sorted(unknown)} "
+                  f"(known: {sorted(r.id for r in rules)})", file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.id in want]
+
+    baseline: Dict[str, dict] = {}
+    if args.baseline != "none":
+        bpath = args.baseline or os.path.join(root, BASELINE_REL)
+        try:
+            baseline = load_baseline(bpath)
+        except (ValueError, json.JSONDecodeError) as exc:
+            print(f"bad baseline {bpath}: {exc}", file=sys.stderr)
+            return 2
+
+    result = run_lint(root=root, rules=rules, baseline=baseline,
+                      ratchet=args.ratchet)
+    if args.format == "json":
+        print(json.dumps(result.as_dict(), indent=2))
+    else:
+        print(render_text(result, verbose_baselined=args.show_baselined))
+    return 0 if result.ok else 1

@@ -1,0 +1,56 @@
+"""The shipped rule set: nine rules, five migrated + four new.
+
+Rule ids are stable API — inline suppressions, allowlists, and the
+committed baseline all key on them:
+
+==========================  ================================================
+id                          guards
+==========================  ================================================
+``obs-time-time``           wall-clock timing outside PhaseTimer/obs spans
+``obs-print``               progress/diagnostics bypassing the heartbeat
+``obs-raw-jit``             device kernels not registered through obs_jit
+``obs-broad-except``        swallowed faults the resilience layer never saw
+``obs-loop-fetch``          sync device fetches stalling the launch queue
+``jit-purity``              trace-time side effects inside jitted bodies
+``recompile-hazard``        static-arg/signature churn → silent recompiles
+``lock-discipline``         lock-protected attrs accessed without the lock
+``fault-site-coverage``     chaos sites drifting from their call sites
+==========================  ================================================
+
+To add a rule: subclass :class:`fairify_tpu.lint.core.Rule` in a
+``rules_*`` module, give it a stable id/scope/description, add it to
+:func:`all_rules`, and ship ≥1 positive and ≥1 negative fixture under
+``tests/lint_fixtures/<rule-id>/`` — ``tests/test_lint.py``'s meta-test
+fails otherwise.  See DESIGN.md §11.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from fairify_tpu.lint.core import Rule
+from fairify_tpu.lint.rules_faults import FaultSiteRule
+from fairify_tpu.lint.rules_jit import JitPurityRule, RecompileHazardRule
+from fairify_tpu.lint.rules_locks import LockDisciplineRule
+from fairify_tpu.lint.rules_obs import (
+    BroadExceptRule,
+    LoopFetchRule,
+    PrintRule,
+    RawJitRule,
+    TimeTimeRule,
+)
+
+LEGACY_RULE_IDS = ("obs-time-time", "obs-print", "obs-raw-jit",
+                   "obs-broad-except", "obs-loop-fetch")
+
+
+def legacy_rules() -> List[Rule]:
+    """The five rules ``scripts/lint_obs.py`` shipped (shim surface)."""
+    return [TimeTimeRule(), PrintRule(), RawJitRule(), BroadExceptRule(),
+            LoopFetchRule()]
+
+
+def all_rules() -> List[Rule]:
+    """Fresh instances of every shipped rule (engine runs are stateful —
+    cross-file rules accumulate during check and report in finalize)."""
+    return legacy_rules() + [JitPurityRule(), RecompileHazardRule(),
+                             LockDisciplineRule(), FaultSiteRule()]

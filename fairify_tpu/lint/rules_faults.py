@@ -1,0 +1,126 @@
+"""Fault-site coverage: the chaos registry and its call sites never drift.
+
+``resilience/faults.py`` names the places the sweep talks to something
+that can die (``FAULT_SITES``); the chaos suite's guarantees are only as
+strong as those names staying wired.  Two failure modes, both silent at
+runtime:
+
+* a call site passes a site string the registry doesn't know — every
+  ``--inject-fault`` spec for it is rejected at the CLI while the code
+  path runs unprotected (no schedule can ever fire there);
+* a registered site loses its last call site in a refactor — the chaos
+  matrix keeps "covering" a place the sweep no longer visits.
+
+The rule collects every **literal** site string across ``fairify_tpu/``
+from (a) ``faults.check("<site>")`` calls (module aliases ``faults`` /
+``faults_mod``) and (b) ``fault_site="<site>"`` keyword arguments (the
+:class:`resilience.journal.JournalWriter` contract).  Dynamic site
+expressions (``faults.check(self._site)``) are invisible to the AST and
+intentionally uncounted — each site must keep at least one literal
+anchor.  ``supervisor.run(..., site=...)`` labels are classification
+metadata, not injection sites, and are ignored.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from fairify_tpu.lint.core import FileContext, Finding, Rule
+
+#: The registry module, repo-relative (where FAULT_SITES is declared).
+FAULTS_REL = "fairify_tpu/resilience/faults.py"
+
+_CHECK_ALIASES = frozenset({"faults", "faults_mod"})
+
+
+def _fault_sites_decl(tree: ast.AST) -> Optional[Tuple[frozenset, int]]:
+    """(sites, lineno) of the ``FAULT_SITES = frozenset({...})`` literal."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "FAULT_SITES"
+                   for t in node.targets):
+            continue
+        strings = [n.value for n in ast.walk(node.value)
+                   if isinstance(n, ast.Constant)
+                   and isinstance(n.value, str)]
+        return frozenset(strings), node.lineno
+    return None
+
+
+def _literal_strings(expr: ast.AST) -> Iterable[ast.Constant]:
+    """String constants in ``expr`` that are whole site names: a bare
+    literal or a literal arm of a default pattern (``site or "x"``,
+    ternary).  f-string fragments (``f"ledger.{op}"``) are *pieces* of a
+    dynamic site, not sites — walking into JoinedStr would turn the
+    documented literal-anchor contract into false positives."""
+    stack = [expr]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.JoinedStr):
+            continue
+        if isinstance(n, ast.Constant):
+            if isinstance(n.value, str):
+                yield n
+        else:
+            stack.extend(ast.iter_child_nodes(n))
+
+
+class FaultSiteRule(Rule):
+    id = "fault-site-coverage"
+    description = ("every literal site passed to resilience.faults.check "
+                   "must be registered in FAULT_SITES, and every "
+                   "registered site must keep >=1 literal call site")
+
+    def __init__(self):
+        # (site, rel, line) of every literal use seen this run.
+        self._uses: List[Tuple[str, str, int]] = []
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.rel == FAULTS_REL:
+            return ()  # the registry declares sites; it doesn't consume them
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "check" \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id in _CHECK_ALIASES:
+                if node.args and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    self._uses.append((node.args[0].value, ctx.rel,
+                                       node.lineno))
+            for kw in node.keywords:
+                if kw.arg == "fault_site":
+                    for sub in _literal_strings(kw.value):
+                        self._uses.append((sub.value, ctx.rel, sub.lineno))
+        return ()
+
+    def finalize(self, files: Dict[str, FileContext]) -> Iterable[Finding]:
+        reg = files.get(FAULTS_REL)
+        decl = _fault_sites_decl(reg.tree) if reg is not None else None
+        if decl is None:
+            # No registry in this file set (partial runs/fixtures without
+            # one): nothing to validate against.
+            return
+        sites, decl_line = decl
+        covered = set()
+        for site, rel, line in self._uses:
+            covered.add(site)
+            if site not in sites:
+                yield Finding(
+                    rule=self.id, path=rel, line=line, function="<module>",
+                    message=(f"unknown fault site {site!r} — not in "
+                             f"resilience.faults.FAULT_SITES "
+                             f"({sorted(sites)}); injection specs for it "
+                             f"are rejected and the path runs unprotected"),
+                    severity=self.severity)
+        for site in sorted(sites - covered):
+            yield Finding(
+                rule=self.id, path=FAULTS_REL, line=decl_line,
+                function="<module>",
+                message=(f"registered fault site {site!r} has no literal "
+                         f"call site in fairify_tpu/ — chaos coverage for "
+                         f"it is silently disabled; call faults.check"
+                         f"({site!r}) at the site or retire the entry"),
+                severity=self.severity)

@@ -1,0 +1,464 @@
+"""Trace-time analyses of jitted kernels: purity and signature stability.
+
+Both rules start from the same discovery pass: every function the file
+jits, whether decorator-style (``@obs_jit``, ``@obs_jit(...)``,
+``@jax.jit``, ``@partial(jax.jit, ...)``) or call-style
+(``kernel = obs_jit(_impl, static_argnames=(...))``), together with its
+declared ``static_argnames``.
+
+**jit-purity** — a jitted body executes exactly once per (signature,
+static key), at trace time; anything it does besides building the traced
+computation silently stops happening on cached calls.  Flagged: ``print``,
+``global``/``nonlocal`` declarations, calls into the host observability
+layer (obs spans/events, metrics, heartbeat, ``profiling.bump_launch`` —
+these belong at the call site, outside the kernel), and mutation of
+captured host state (``xs.append(...)`` / ``xs[i] = ...`` where ``xs`` is
+not bound inside the kernel).
+
+**recompile-hazard** — the signature churn behind the ~110 ms stalls that
+``obs/compile.py`` can only count after the fact, caught before merge:
+
+* a ``static_argnames`` entry that names no parameter (a typo leaves the
+  argument traced — or, on strict jax versions, errors at call time);
+* a float-typed static parameter (every distinct value is a new
+  executable; floats rarely repeat exactly) or a mutable default for a
+  static parameter (unhashable → TypeError at call time);
+* a Python conditional (``if``/``while``/ternary/``assert``) on a traced
+  (non-static) parameter — ConcretizationError at trace time, or, where
+  it survives, one retrace per branch outcome;
+* a call site passing an enclosing loop's iteration variable as a static
+  argument — one compile per distinct value, inside a chunk loop;
+* constructing ``jax.jit``/``obs_jit`` inside a loop body — every
+  iteration starts a fresh executable cache and re-pays trace+compile.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from fairify_tpu.lint.core import FileContext, Finding, Rule
+
+#: Mutating container methods whose receiver must be kernel-local.
+#: ``update`` is deliberately absent: optax's pure
+#: ``GradientTransformation.update(grads, state)`` is ubiquitous inside
+#: jitted train steps and indistinguishable from ``dict.update`` by AST.
+MUTATORS = frozenset({
+    "append", "extend", "insert", "remove", "pop", "clear", "add",
+    "discard", "setdefault", "popitem", "appendleft",
+    "extendleft", "sort", "reverse", "write",
+})
+
+#: Host-observability roots whose calls are side effects at trace time.
+OBS_ROOTS = frozenset({
+    "obs", "profiling", "heartbeat", "heartbeat_mod", "metrics_mod",
+    "trace_mod", "hb_mod",
+})
+OBS_BARE = frozenset({"bump_launch", "notify_compile"})
+
+
+@dataclass
+class JittedDef:
+    """One jitted function: its def node, statics, and callable aliases."""
+
+    node: ast.AST  # FunctionDef / AsyncFunctionDef
+    statics: Tuple[str, ...]
+    aliases: Tuple[str, ...]  # names a call site may use for this kernel
+    jit_line: int  # decorator / wrapping-call line for def-level findings
+
+
+def _static_names_from_call(call: ast.Call) -> Tuple[str, ...]:
+    for kw in call.keywords:
+        if kw.arg in ("static_argnames", "static_argnums"):
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return tuple(e.value for e in v.elts
+                             if isinstance(e, ast.Constant)
+                             and isinstance(e.value, str))
+    return ()
+
+
+def _is_jit_name(node: ast.AST) -> bool:
+    """``obs_jit`` / ``jax.jit`` as a bare expression."""
+    if isinstance(node, ast.Name) and node.id == "obs_jit":
+        return True
+    return (isinstance(node, ast.Attribute) and node.attr == "jit"
+            and isinstance(node.value, ast.Name) and node.value.id == "jax")
+
+
+def _jit_call(node: ast.AST) -> Optional[ast.Call]:
+    """The jit-constructing Call if ``node`` is one (incl. partial form)."""
+    if not isinstance(node, ast.Call):
+        return None
+    if _is_jit_name(node.func):
+        return node
+    if isinstance(node.func, ast.Name) and node.func.id == "partial" \
+            and node.args and _is_jit_name(node.args[0]):
+        return node
+    return None
+
+
+def _decorator_statics(dec: ast.AST) -> Optional[Tuple[str, ...]]:
+    """statics tuple if ``dec`` is a jit decorator, else None."""
+    if _is_jit_name(dec):
+        return ()
+    call = _jit_call(dec)
+    if call is not None:
+        return _static_names_from_call(call)
+    return None
+
+
+def jitted_defs(ctx: FileContext) -> List[JittedDef]:
+    """Per-file jitted-def discovery, cached (both jit rules share it)."""
+    cached = ctx.cache.get("jitted_defs")
+    if cached is None:
+        cached = ctx.cache["jitted_defs"] = collect_jitted(ctx.tree)
+    return cached
+
+
+def collect_jitted(tree: ast.AST) -> List[JittedDef]:
+    defs: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+
+    out: List[JittedDef] = []
+    seen: set = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            statics = _decorator_statics(dec)
+            if statics is not None:
+                out.append(JittedDef(node, statics, (node.name,),
+                                     dec.lineno))
+                seen.add(id(node))
+                break
+    # Call style: ``alias = obs_jit(_impl, name=..., static_argnames=...)``.
+    for stmt in ast.walk(tree):
+        if not (isinstance(stmt, ast.Assign)
+                and isinstance(stmt.value, ast.Call)):
+            continue
+        call = _jit_call(stmt.value)
+        if call is None or not call.args:
+            continue
+        target_fn = call.args[-1] if isinstance(call.func, ast.Name) \
+            and call.func.id == "partial" else call.args[0]
+        if not isinstance(target_fn, ast.Name):
+            continue
+        fn = defs.get(target_fn.id)
+        if fn is None or id(fn) in seen:
+            continue
+        aliases = tuple(t.id for t in stmt.targets
+                        if isinstance(t, ast.Name)) or (target_fn.id,)
+        out.append(JittedDef(fn, _static_names_from_call(call), aliases,
+                             stmt.lineno))
+        seen.add(id(fn))
+    return out
+
+
+def _param_args(fn) -> list:
+    a = fn.args
+    return list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+
+
+def _param_names(fn) -> List[str]:
+    names = [p.arg for p in _param_args(fn)]
+    if fn.args.vararg:
+        names.append(fn.args.vararg.arg)
+    if fn.args.kwarg:
+        names.append(fn.args.kwarg.arg)
+    return names
+
+
+def _target_names(t: ast.AST) -> Iterable[str]:
+    """Names *bound* by an assignment target.  A subscript/attribute store
+    (``xs[i] = v`` / ``o.a = v``) binds nothing — its base must already be
+    bound, and treating it as a binding would hide exactly the captured
+    mutation the purity rule exists to flag."""
+    if isinstance(t, ast.Name):
+        yield t.id
+    elif isinstance(t, ast.Starred):
+        yield from _target_names(t.value)
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for el in t.elts:
+            yield from _target_names(el)
+
+
+def _bound_names(fn) -> set:
+    """Every name bound anywhere inside ``fn`` (params, assignments, loop
+    and comprehension targets, with/except aliases, imports, nested defs).
+
+    Nested scopes are merged — coarse, but it only makes the captured-state
+    check *miss* shadowed captures, never flag kernel-local state.
+    """
+    bound = set(_param_names(fn))
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                bound.update(_target_names(t))
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            bound.update(_target_names(node.target))
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            bound.update(_target_names(node.target))
+        elif isinstance(node, ast.comprehension):
+            bound.update(_target_names(node.target))
+        elif isinstance(node, ast.withitem) and node.optional_vars:
+            bound.update(_target_names(node.optional_vars))
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            bound.add(node.name)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            bound.add(node.name)
+            if node is not fn and not isinstance(node, ast.ClassDef):
+                bound.update(p.arg for p in _param_args(node))
+        elif isinstance(node, ast.Lambda):
+            bound.update(p.arg for p in _param_args(node))
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                bound.add((alias.asname or alias.name).split(".")[0])
+        elif isinstance(node, (ast.NamedExpr,)):
+            bound.update(_target_names(node.target))
+    return bound
+
+
+def _call_root(expr: ast.AST) -> Optional[str]:
+    """Leftmost Name of a dotted/chained call target
+    (``obs.registry().counter("x").inc`` → ``obs``)."""
+    while True:
+        if isinstance(expr, ast.Attribute):
+            expr = expr.value
+        elif isinstance(expr, ast.Call):
+            expr = expr.func
+        elif isinstance(expr, ast.Name):
+            return expr.id
+        else:
+            return None
+
+
+class JitPurityRule(Rule):
+    id = "jit-purity"
+    description = ("side effects inside jit-traced bodies (run at trace "
+                   "time only): print, global/nonlocal, obs/metrics/"
+                   "heartbeat calls, mutation of captured state")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for jd in jitted_defs(ctx):
+            fn_name = jd.node.name
+            if self.allowed(ctx.rel, fn_name):
+                continue
+            bound = _bound_names(jd.node)
+            for node in ast.walk(jd.node):
+                yield from self._check_node(ctx, fn_name, node, bound)
+
+    def _check_node(self, ctx, fn_name, node, bound):
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            kind = "global" if isinstance(node, ast.Global) else "nonlocal"
+            yield self.finding(
+                ctx, node.lineno,
+                f"{kind} mutation inside a jit-traced body — runs once at "
+                f"trace time, never per execution; return the value "
+                f"instead", function=fn_name)
+            return
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id == "print":
+                yield self.finding(
+                    ctx, node.lineno,
+                    "print() inside a jit-traced body — fires at trace "
+                    "time only; use jax.debug.print for per-execution "
+                    "output or move it to the call site",
+                    function=fn_name)
+            root = _call_root(f)
+            if root in OBS_ROOTS or (isinstance(f, ast.Name)
+                                     and f.id in OBS_BARE):
+                yield self.finding(
+                    ctx, node.lineno,
+                    "host observability call inside a jit-traced body — "
+                    "spans/metrics/heartbeat record trace time, not "
+                    "execution; instrument the call site instead",
+                    function=fn_name)
+            if isinstance(f, ast.Attribute) and f.attr in MUTATORS \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id not in bound:
+                yield self.finding(
+                    ctx, node.lineno,
+                    f"mutation of captured {f.value.id!r} "
+                    f"(.{f.attr}) inside a jit-traced body — happens once "
+                    f"at trace time; thread state through the kernel's "
+                    f"returns", function=fn_name)
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id not in bound:
+                    yield self.finding(
+                        ctx, t.lineno,
+                        f"subscript store into captured {t.value.id!r} "
+                        f"inside a jit-traced body — happens once at trace "
+                        f"time; return the value instead",
+                        function=fn_name)
+
+
+#: Call/test constructs whose result is concrete even on traced values.
+_CONCRETE_FNS = frozenset({"len", "isinstance", "type", "getattr",
+                           "hasattr", "callable"})
+
+
+def _traced_cond_name(test: ast.AST, dyn: set) -> Optional[str]:
+    """A dynamic-parameter Name the test's truthiness depends on, if any.
+
+    Shape-level introspection stays legal: attribute access (``x.ndim``),
+    ``len(x)``, ``isinstance``, and identity tests (``x is None``) are all
+    concrete under tracing.  Calls are skipped entirely (their purity is
+    the callee's business) — the rule prefers missing a hazard to flagging
+    idiomatic shape code.
+    """
+    if isinstance(test, ast.Name):
+        return test.id if test.id in dyn else None
+    if isinstance(test, ast.Attribute):
+        return None  # x.ndim / x.shape — concrete
+    if isinstance(test, ast.Call):
+        return None
+    if isinstance(test, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+            return None  # `x is None` — identity on the Python object
+        for sub in [test.left] + list(test.comparators):
+            hit = _traced_cond_name(sub, dyn)
+            if hit:
+                return hit
+        return None
+    if isinstance(test, ast.BoolOp):
+        for sub in test.values:
+            hit = _traced_cond_name(sub, dyn)
+            if hit:
+                return hit
+        return None
+    if isinstance(test, ast.UnaryOp):
+        return _traced_cond_name(test.operand, dyn)
+    if isinstance(test, ast.BinOp):
+        return (_traced_cond_name(test.left, dyn)
+                or _traced_cond_name(test.right, dyn))
+    if isinstance(test, ast.Subscript):
+        # x[0] of a traced array is traced; the slice itself is not.
+        return _traced_cond_name(test.value, dyn)
+    return None
+
+
+class RecompileHazardRule(Rule):
+    id = "recompile-hazard"
+    description = ("jit signature churn caught statically: bad/float/"
+                   "mutable static args, Python conditionals on traced "
+                   "values, per-iteration static kwargs, jit-in-loop")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        jds = jitted_defs(ctx)
+        for jd in jds:
+            if not self.allowed(ctx.rel, jd.node.name):
+                yield from self._check_def(ctx, jd)
+        yield from self._check_sites(ctx, jds)
+
+    # -- definition-level hazards -----------------------------------------
+    def _check_def(self, ctx, jd):
+        fn = jd.node
+        params = _param_names(fn)
+        for s in jd.statics:
+            if s not in params:
+                yield self.finding(
+                    ctx, jd.jit_line,
+                    f"static_argnames entry {s!r} names no parameter of "
+                    f"{fn.name} — the argument stays traced (typo?)",
+                    function=fn.name)
+        args = _param_args(fn)
+        defaults = fn.args.defaults
+        # Map trailing defaults onto positional params.
+        pos = list(fn.args.posonlyargs) + list(fn.args.args)
+        default_of = dict(zip([p.arg for p in pos[len(pos) - len(defaults):]],
+                              defaults))
+        default_of.update({p.arg: d for p, d in
+                           zip(fn.args.kwonlyargs, fn.args.kw_defaults) if d})
+        for p in args:
+            if p.arg not in jd.statics:
+                continue
+            ann_float = (isinstance(p.annotation, ast.Name)
+                         and p.annotation.id == "float")
+            d = default_of.get(p.arg)
+            d_float = (isinstance(d, ast.Constant)
+                       and isinstance(d.value, float))
+            if ann_float or d_float:
+                yield self.finding(
+                    ctx, p.lineno,
+                    f"float-valued static arg {p.arg!r} — every distinct "
+                    f"value compiles a new executable; pass it as a traced "
+                    f"array or quantize it", function=fn.name)
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                yield self.finding(
+                    ctx, p.lineno,
+                    f"mutable default for static arg {p.arg!r} — "
+                    f"unhashable static values fail the jit cache key",
+                    function=fn.name)
+        dyn = set(params) - set(jd.statics)
+        for node in ast.walk(fn):
+            tests = []
+            if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                tests.append(node.test)
+            elif isinstance(node, ast.Assert):
+                tests.append(node.test)
+            for t in tests:
+                name = _traced_cond_name(t, dyn)
+                if name:
+                    yield self.finding(
+                        ctx, t.lineno,
+                        f"Python conditional on traced value {name!r} "
+                        f"inside a jitted body — ConcretizationError at "
+                        f"trace time or one retrace per outcome; use "
+                        f"lax.cond/jnp.where or declare it static",
+                        function=fn.name)
+
+    # -- call-site hazards -------------------------------------------------
+    def _check_sites(self, ctx, jds):
+        """One pass over the shared walk: per-iteration static args at call
+        sites of this file's kernels, and jit construction inside loops."""
+        kernels: Dict[str, Tuple[Tuple[str, ...], List[str]]] = {}
+        for jd in jds:
+            info = (jd.statics, _param_names(jd.node))
+            for alias in jd.aliases:
+                kernels[alias] = info
+        for node, fn, in_loop, loop_targets in ctx.attributed():
+            if not isinstance(node, ast.Call):
+                continue
+            if in_loop and _jit_call(node) is not None \
+                    and not self.allowed(ctx.rel, fn):
+                yield self.finding(
+                    ctx, node.lineno,
+                    "jax.jit/obs_jit constructed inside a loop body — each "
+                    "iteration starts an empty executable cache and "
+                    "re-pays trace+compile; hoist the jitted callable out "
+                    "of the loop", function=fn)
+            if not (kernels and loop_targets):
+                continue
+            f = node.func
+            name = f.id if isinstance(f, ast.Name) else None
+            if name not in kernels:
+                continue
+            statics, params = kernels[name]
+            varying = []
+            for kw in node.keywords:
+                if kw.arg in statics and isinstance(kw.value, ast.Name) \
+                        and kw.value.id in loop_targets:
+                    varying.append((kw.arg, kw.value.id))
+            for i, a in enumerate(node.args):
+                if i < len(params) and params[i] in statics \
+                        and isinstance(a, ast.Name) and a.id in loop_targets:
+                    varying.append((params[i], a.id))
+            for static_name, var in varying:
+                yield self.finding(
+                    ctx, node.lineno,
+                    f"static arg {static_name!r} of {name} is the loop "
+                    f"variable {var!r} — one XLA compile per iteration "
+                    f"value; pad/bucket to a fixed static instead",
+                    function=fn)

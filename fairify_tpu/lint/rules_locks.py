@@ -1,0 +1,152 @@
+"""Lock discipline for the classes threads actually share.
+
+The obs metrics registry, the launch pipeline, and the resilience journal
+are the three modules whose instances are touched concurrently (span and
+heartbeat consumers, supervised retries, multi-threaded tests).  Their
+concurrency contract is simple: any instance attribute that is *assigned*
+inside a ``with self.<lock>`` block is lock-protected, and every other
+read or write of it in the same class must also hold that lock.
+
+The rule is lexical and per-class:
+
+* **lock attributes** — ``self.X = threading.Lock()`` / ``RLock()``;
+* **protected attributes** — targets of ``self.Y = ...`` /
+  ``self.Y[...] = ...`` / ``self.Y += ...`` inside any
+  ``with self.<lock>:`` block, in any method;
+* **violations** — any other appearance of ``self.Y`` outside a
+  ``with self.<lock>:`` block, in any method except ``__init__``
+  (construction precedes sharing, so unguarded ``__init__`` assignments
+  are the normal way protected state is born).
+
+A private helper that is only ever *called* under the lock is invisible to
+a lexical analysis — restructure it, or suppress the line with
+``# lint: disable=lock-discipline`` and a comment naming the caller that
+holds the lock.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from fairify_tpu.lint.core import FileContext, Finding, Rule
+
+
+def _self_attr(node: ast.AST, self_name: str) -> str:
+    """``Y`` if node is ``<self>.Y`` (else '')."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == self_name:
+        return node.attr
+    return ""
+
+
+def _store_target_attr(t: ast.AST, self_name: str) -> str:
+    """``Y`` for targets ``self.Y`` or ``self.Y[...]``."""
+    if isinstance(t, ast.Subscript):
+        t = t.value
+    return _self_attr(t, self_name)
+
+
+def _locked_walk(method: ast.AST, self_name: str, locks: Set[str]):
+    """Yield ``(node, under_lock)`` for every node in the method body.
+
+    ``under_lock`` is lexical containment in a ``with self.<lock>:`` block
+    (any of the class's lock attributes).  Nested defs keep the lexical
+    context — the closures in these modules are invoked synchronously by
+    their enclosing method.
+    """
+    out: List[Tuple[ast.AST, bool]] = []
+
+    def rec(node: ast.AST, locked: bool) -> None:
+        out.append((node, locked))
+        child_locked = locked
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if _self_attr(item.context_expr, self_name) in locks:
+                    child_locked = True
+        for child in ast.iter_child_nodes(node):
+            rec(child, child_locked)
+
+    rec(method, False)
+    return out
+
+
+class LockDisciplineRule(Rule):
+    id = "lock-discipline"
+    description = ("attributes assigned under self._lock must never be "
+                   "read or written outside a `with <lock>` block in the "
+                   "same class (init exempt)")
+    scope = (
+        "fairify_tpu/obs/metrics.py",
+        "fairify_tpu/parallel/pipeline.py",
+        "fairify_tpu/resilience/journal.py",
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(ctx, node)
+
+    def _check_class(self, ctx: FileContext, cls: ast.ClassDef
+                     ) -> Iterable[Finding]:
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+        def self_name(m) -> str:
+            pos = list(m.args.posonlyargs) + list(m.args.args)
+            return pos[0].arg if pos else "self"
+
+        # Lock attributes: self.X = threading.Lock() / threading.RLock().
+        locks: Set[str] = set()
+        for m in methods:
+            for node in ast.walk(m):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call):
+                    f = node.value.func
+                    if isinstance(f, ast.Attribute) \
+                            and f.attr in ("Lock", "RLock") \
+                            and isinstance(f.value, ast.Name) \
+                            and f.value.id == "threading":
+                        for t in node.targets:
+                            attr = _self_attr(t, self_name(m))
+                            if attr:
+                                locks.add(attr)
+        if not locks:
+            return
+
+        # Pass A: attributes assigned under a lock anywhere in the class.
+        protected: Set[str] = set()
+        for m in methods:
+            sn = self_name(m)
+            for node, locked in _locked_walk(m, sn, locks):
+                if not locked:
+                    continue
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        attr = _store_target_attr(t, sn)
+                        if attr and attr not in locks:
+                            protected.add(attr)
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    attr = _store_target_attr(node.target, sn)
+                    if attr and attr not in locks:
+                        protected.add(attr)
+        if not protected:
+            return
+
+        # Pass B: any other access outside the lock (init exempt).
+        for m in methods:
+            if m.name == "__init__" or self.allowed(ctx.rel, m.name):
+                continue
+            sn = self_name(m)
+            seen_lines: Set[Tuple[int, str]] = set()
+            for node, locked in _locked_walk(m, sn, locks):
+                if locked:
+                    continue
+                attr = _self_attr(node, sn)
+                if attr in protected and (node.lineno, attr) not in seen_lines:
+                    seen_lines.add((node.lineno, attr))
+                    yield self.finding(
+                        ctx, node.lineno,
+                        f"{cls.name}.{attr} is lock-protected (assigned "
+                        f"under {'/'.join(sorted(locks))}) but accessed "
+                        f"outside a `with` block in {m.name}() — take the "
+                        f"lock or move the access", function=m.name)

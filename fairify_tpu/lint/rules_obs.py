@@ -1,0 +1,249 @@
+"""The five observability rules migrated from ``scripts/lint_obs.py``.
+
+Semantics are unchanged from the script (same scopes, same allowlists,
+same hints) with two attribution bugs fixed during migration:
+
+* the hot-loop fetch rule no longer flags fetches in a ``for``/``while``
+  **``else:``** clause or in a ``for``'s iterable expression — both run
+  once, not per iteration (the old walker used ``iter_child_nodes`` and
+  could not tell ``body`` from ``orelse``);
+* the broad-except and loop-fetch walkers reset function attribution at
+  ``ClassDef`` boundaries, so a handler in a class body is attributed to
+  the class name instead of silently inheriting the enclosing
+  ``<module>``/function allowlist key.
+
+``scripts/lint_obs.py`` remains as a thin compatibility shim over these
+rule objects (deprecated — new call sites should run ``fairify_tpu lint``).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from fairify_tpu.lint.core import FileContext, Finding, Rule
+
+# ---------------------------------------------------------------------------
+# Allowlists (reviewed exceptions; repo-relative '/'-separated paths).
+# Shrink, don't grow, each of them.
+# ---------------------------------------------------------------------------
+
+ALLOW_TIME_TIME = frozenset({
+    "fairify_tpu/obs/trace.py",  # the obs layer's wall-clock shim
+})
+
+ALLOW_PRINT = frozenset({
+    "fairify_tpu/cli.py",            # user-facing command output
+    "fairify_tpu/obs/heartbeat.py",  # the sanctioned progress line
+    "fairify_tpu/obs/report.py",     # report renderer (CLI body)
+    "fairify_tpu/verify/sweep.py",   # legacy: stderr width-mismatch warning
+    "fairify_tpu/verify/exact_check.py",  # legacy: gated debug prints
+    "fairify_tpu/lint/core.py",      # the lint CLI's own report output
+})
+
+# Raw-jit rule scope: every device kernel of the verification core must go
+# through obs.compile.obs_jit (named compile spans, recompile accounting).
+RAW_JIT_SCOPE = ("fairify_tpu/verify/", "fairify_tpu/ops/")
+# Repo-relative file paths reviewed as legitimate bare-jit users.  Empty:
+# the whole core is migrated; a new entry needs a reason in review.
+ALLOW_RAW_JIT: frozenset = frozenset()
+
+# Hot-loop fetch rule scope: chunk/frontier loops of the verification core.
+LOOP_FETCH_SCOPE = ("fairify_tpu/verify/",)
+# ``file::function`` sync points reviewed as legitimate.  Everything else in
+# a verify/ loop must route through parallel.pipeline.LaunchPipeline.
+ALLOW_LOOP_FETCH = frozenset({
+    # Drain-API decode bodies: the pipeline hands them HOST payloads; the
+    # remaining np.asarray calls pull already-materialized model weights.
+    "fairify_tpu/verify/sweep.py::_family_block_decode",
+    # Per-partition heuristic-retry re-sim: one tiny launch whose result
+    # this row's CSV needs immediately — scoped to its own helper so the
+    # sweep's main loop body stays under the lint.
+    "fairify_tpu/verify/sweep.py::_parity_resim",
+    # BaB frontier iterations are sequentially dependent (each batch's
+    # branching decides the next batch) — no independent work to overlap.
+    "fairify_tpu/verify/engine.py::decide_many",
+    "fairify_tpu/verify/engine.py::uniform_sign_bab",
+    "fairify_tpu/verify/engine.py::_run_lp_phase",
+    # Exact-certify chunk results feed the immediately-following host mask
+    # assembly per chunk; candidate for pipelining, not yet converted.
+    # (sound_prune_grid itself now submits through LaunchPipeline.)
+    "fairify_tpu/verify/exact_check.py::exact_certify_grid",
+    # Pure-host numpy coercions of weights/points inside exact/LP/SMT
+    # loops — ``np.asarray`` on data that never lived on device.
+    "fairify_tpu/verify/engine.py::exact_logit_sign",
+    "fairify_tpu/verify/engine.py::_leaf_sign_lp",
+    "fairify_tpu/verify/engine.py::_eligible_lattice_roots",
+    "fairify_tpu/verify/smt.py::_z3_net",
+    # Per-root host phases (lattice enumeration / pair LP): independent
+    # roots, so genuine pipelining candidates — not yet converted; the
+    # fetched payloads feed immediately-following serial host solvers.
+    "fairify_tpu/verify/engine.py::_lattice_phase",
+    "fairify_tpu/verify/engine.py::_pair_lp_phase",
+})
+
+ALLOW_BROAD_EXCEPT = frozenset({
+    # Import gate: jax.api_util.shaped_abstractify rename degrades to
+    # conservative fallback cache keys, never an import error.
+    "fairify_tpu/obs/compile.py::<module>",
+    # Compile fallbacks: an unusable AOT path serves the kernel via plain
+    # jax.jit (counted in xla_compile_fallbacks) — observability must
+    # never change results or availability.  (_compile's handler re-raises
+    # propagate-class faults, so only __call__'s swallow sites need this.)
+    "fairify_tpu/obs/compile.py::__call__",
+    # Backend-optional executable analyses (cost/memory): absence degrades
+    # to missing attrs.
+    "fairify_tpu/obs/compile.py::_record_analysis",
+})
+
+_FETCH_HINT = (
+    "synchronous device fetch in a verify/ loop — submit through "
+    "parallel.pipeline.LaunchPipeline and convert at dequeue "
+    "(or extend ALLOW_LOOP_FETCH with file::function and a reason)")
+
+_BROAD_HINT = (
+    "broad except (bare/Exception/BaseException) that never re-raises — "
+    "classify via fairify_tpu.resilience.supervisor.classify and degrade "
+    "with a recorded reason, or extend ALLOW_BROAD_EXCEPT with a reviewed "
+    "reason")
+
+
+# ---------------------------------------------------------------------------
+# Node predicates (verbatim from the script)
+# ---------------------------------------------------------------------------
+
+
+def _is_time_time(node: ast.Call) -> bool:
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr == "time"
+            and isinstance(f.value, ast.Name) and f.value.id == "time")
+
+
+def _is_print(node: ast.Call) -> bool:
+    return isinstance(node.func, ast.Name) and node.func.id == "print"
+
+
+def _is_raw_jit(node: ast.AST) -> bool:
+    """The ``jax.jit`` attribute itself: catches ``@jax.jit``,
+    ``jax.jit(f, ...)`` and ``partial(jax.jit, ...)`` uniformly."""
+    return (isinstance(node, ast.Attribute) and node.attr == "jit"
+            and isinstance(node.value, ast.Name) and node.value.id == "jax")
+
+
+def _is_loop_fetch(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        if f.attr == "block_until_ready":
+            return True
+        if isinstance(f.value, ast.Name):
+            # np.asarray(...) / jax.device_get(...) on loop-carried arrays.
+            if f.value.id in ("np", "numpy") and f.attr == "asarray":
+                return True
+            if f.value.id == "jax" and f.attr == "device_get":
+                return True
+    return False
+
+
+def _is_broad_type(node) -> bool:
+    """Does the handler's type expression name Exception/BaseException?"""
+    if node is None:
+        return True  # bare except:
+    if isinstance(node, ast.Tuple):
+        return any(_is_broad_type(el) for el in node.elts)
+    return isinstance(node, ast.Name) and node.id in ("Exception",
+                                                      "BaseException")
+
+
+# ---------------------------------------------------------------------------
+# Rules
+# ---------------------------------------------------------------------------
+
+
+class TimeTimeRule(Rule):
+    id = "obs-time-time"
+    description = ("raw time.time() banned in fairify_tpu/ — timing goes "
+                   "through PhaseTimer / obs spans (monotonic clocks)")
+    allowlist = ALLOW_TIME_TIME
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if self.allowed(ctx.rel):
+            return
+        for node, fn, _loop, _t in ctx.attributed():
+            if isinstance(node, ast.Call) and _is_time_time(node):
+                yield self.finding(
+                    ctx, node.lineno,
+                    "raw time.time() — use time.perf_counter() via "
+                    "PhaseTimer/obs spans (or extend ALLOW_TIME_TIME for a "
+                    "sanctioned shim)", function=fn)
+
+
+class PrintRule(Rule):
+    id = "obs-print"
+    description = ("bare print() banned in fairify_tpu/ — progress goes "
+                   "through obs.heartbeat, structured output through the "
+                   "event log")
+    allowlist = ALLOW_PRINT
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if self.allowed(ctx.rel):
+            return
+        for node, fn, _loop, _t in ctx.attributed():
+            if isinstance(node, ast.Call) and _is_print(node):
+                yield self.finding(
+                    ctx, node.lineno,
+                    "bare print() — progress goes through "
+                    "fairify_tpu.obs.heartbeat, structured output through "
+                    "the event log (or extend ALLOW_PRINT for user-facing "
+                    "output)", function=fn)
+
+
+class RawJitRule(Rule):
+    id = "obs-raw-jit"
+    description = ("bare jax.jit banned in verify/ and ops/ — kernels "
+                   "register through obs.compile.obs_jit")
+    scope = RAW_JIT_SCOPE
+    allowlist = ALLOW_RAW_JIT
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if self.allowed(ctx.rel):
+            return
+        for node, fn, _loop, _t in ctx.attributed():
+            if _is_raw_jit(node):
+                yield self.finding(
+                    ctx, node.lineno,
+                    "bare jax.jit — register device kernels through "
+                    "fairify_tpu.obs.compile.obs_jit so compiles are "
+                    "named/counted/timed (or extend ALLOW_RAW_JIT with a "
+                    "reviewed reason)", function=fn)
+
+
+class BroadExceptRule(Rule):
+    id = "obs-broad-except"
+    description = ("broad except that never re-raises banned in "
+                   "fairify_tpu/ — faults must be classified and degraded "
+                   "with a recorded reason")
+    allowlist = ALLOW_BROAD_EXCEPT
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node, fn, _loop, _t in ctx.attributed():
+            if isinstance(node, ast.ExceptHandler) \
+                    and _is_broad_type(node.type) \
+                    and not any(isinstance(n, ast.Raise)
+                                for n in ast.walk(node)) \
+                    and not self.allowed(ctx.rel, fn):
+                yield self.finding(ctx, node.lineno, _BROAD_HINT, function=fn)
+
+
+class LoopFetchRule(Rule):
+    id = "obs-loop-fetch"
+    description = ("synchronous device fetch inside a verify/ loop body "
+                   "banned — submit through LaunchPipeline, convert at "
+                   "dequeue")
+    scope = LOOP_FETCH_SCOPE
+    allowlist = ALLOW_LOOP_FETCH
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node, fn, in_loop, _t in ctx.attributed():
+            if in_loop and isinstance(node, ast.Call) \
+                    and _is_loop_fetch(node) \
+                    and not self.allowed(ctx.rel, fn):
+                yield self.finding(ctx, node.lineno, _FETCH_HINT, function=fn)

@@ -14,9 +14,10 @@ This registry replaces both with named instruments that
   report`` aggregates.
 
 Everything is host-side Python on the sweep's bookkeeping path (never
-inside a jit), and every mutation takes one small lock — thread-safe for
-the multi-threaded span/heartbeat consumers, negligible against the
-~110 ms device-launch floor the counters exist to account for.
+inside a jit), and every access — reads included — takes one small lock
+(the ``lock-discipline`` lint enforces this): thread-safe for the
+multi-threaded span/heartbeat consumers, negligible against the ~110 ms
+device-launch floor the counters exist to account for.
 """
 from __future__ import annotations
 
@@ -49,7 +50,8 @@ class Counter:
             self._series[k] = self._series.get(k, 0) + n
 
     def value(self, **labels) -> float:
-        return self._series.get(_key(labels), 0)
+        with self._lock:
+            return self._series.get(_key(labels), 0)
 
     def total(self) -> float:
         with self._lock:
@@ -80,7 +82,8 @@ class Gauge:
             self._series[_key(labels)] = value
 
     def value(self, **labels) -> Optional[float]:
-        return self._series.get(_key(labels))
+        with self._lock:
+            return self._series.get(_key(labels))
 
     def reset(self) -> None:
         with self._lock:
@@ -108,16 +111,12 @@ class Histogram:
         # label key -> [per-bucket counts..., overflow], running sum, count
         self._series: Dict[tuple, list] = {}
 
-    def _slot(self, k: tuple) -> list:
-        s = self._series.get(k)
-        if s is None:
-            s = self._series[k] = [[0] * (len(self.buckets) + 1), 0.0, 0]
-        return s
-
     def observe(self, value: float, **labels) -> None:
         k = _key(labels)
         with self._lock:
-            s = self._slot(k)
+            s = self._series.get(k)
+            if s is None:
+                s = self._series[k] = [[0] * (len(self.buckets) + 1), 0.0, 0]
             for i, b in enumerate(self.buckets):
                 if value <= b:
                     s[0][i] += 1
@@ -128,16 +127,19 @@ class Histogram:
             s[2] += 1
 
     def counts(self, **labels) -> list:
-        s = self._series.get(_key(labels))
-        return list(s[0]) if s else [0] * (len(self.buckets) + 1)
+        with self._lock:
+            s = self._series.get(_key(labels))
+            return list(s[0]) if s else [0] * (len(self.buckets) + 1)
 
     def sum(self, **labels) -> float:
-        s = self._series.get(_key(labels))
-        return s[1] if s else 0.0
+        with self._lock:
+            s = self._series.get(_key(labels))
+            return s[1] if s else 0.0
 
     def count(self, **labels) -> int:
-        s = self._series.get(_key(labels))
-        return s[2] if s else 0
+        with self._lock:
+            s = self._series.get(_key(labels))
+            return s[2] if s else 0
 
     def reset(self) -> None:
         with self._lock:

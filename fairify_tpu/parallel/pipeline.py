@@ -107,7 +107,8 @@ class LaunchPipeline:
     """
 
     def __init__(self, depth: int = 2, stats: Optional[FlightStats] = None,
-                 gauge: bool = True, supervisor=None):
+                 gauge: bool = True, supervisor=None,
+                 fault_sites: bool = True):
         self.depth = max(1, int(depth))
         self.stats = stats if stats is not None else FlightStats()
         # ``gauge=False`` for engine-internal micro-pipelines (e.g. a
@@ -115,6 +116,13 @@ class LaunchPipeline:
         # last-write-wins per run, and a one-launch pipeline would
         # overwrite the run pipeline's overlap record with ~0.
         self._gauge = gauge
+        # ``fault_sites=False`` for pipelines whose whole phase is already
+        # supervised as ONE unit at the call site (the prune pass runs
+        # under ``sup.run(site="prune")``): their launches must not consume
+        # ``launch.submit``/``launch.decode`` arrivals, or every existing
+        # chaos schedule (arrival-count based, see resilience/faults.py)
+        # would shift when an internal phase changes its launch structure.
+        self._fault_sites = fault_sites
         self.supervisor = supervisor
         self._q: deque = deque()
         self.stats.update(0)
@@ -142,7 +150,8 @@ class LaunchPipeline:
         from fairify_tpu.resilience.supervisor import ChunkDegraded
 
         def attempt():
-            faults.check("launch.submit")
+            if self._fault_sites:
+                faults.check("launch.submit")
             return fn()
 
         if self.supervisor is None:
@@ -174,7 +183,8 @@ class LaunchPipeline:
         state = {"payload": payload}
 
         def fetch():
-            faults.check("launch.decode")
+            if self._fault_sites:
+                faults.check("launch.decode")
             return jax.device_get(state["payload"])
 
         def redispatch():

@@ -87,6 +87,7 @@ def sound_prune_grid(
     chunk: int = 0,
     index_offset: int = 0,
     keep_sim: bool = True,
+    pipeline_depth: int = 2,
 ) -> PruneResult:
     """Sound pruning for a (P, d) box grid in batched device passes.
 
@@ -99,7 +100,15 @@ def sound_prune_grid(
     padded, so the kernel compiles once) and results concatenated.  Each
     partition's PRNG key is derived from its *global* index
     (``index_offset``), so verdicts are chunk-size invariant.
+
+    Chunk launches submit through a :class:`LaunchPipeline`
+    (``pipeline_depth`` in flight; 1 = the old synchronous fetch order), so
+    the host-side slicing of chunk k overlaps the device work of chunk k+1.
+    The pipeline changes only *when* results are fetched — launch order,
+    kernel arguments, and per-partition keys are depth-invariant, so masks
+    and samples are bit-equal at every depth (``tests/test_chunking.py``).
     """
+    from fairify_tpu.parallel.pipeline import LaunchPipeline
     from fairify_tpu.partition.grid import chunk_spans, pad_rows
 
     P = lo.shape[0]
@@ -108,23 +117,44 @@ def sound_prune_grid(
                         chunks=len(spans))
     lo_np, hi_np = np.asarray(lo), np.asarray(hi)
     cand_c, pos_c, lb_c, ub_c, sim_c = [], [], [], [], []
+
+    def _chunk_submit(s: int, e: int):
+        """Dispatch one padded chunk; returns (device payload, n valid rows)."""
+        clo = pad_rows(lo_np[s:e], step)
+        chi = pad_rows(hi_np[s:e], step)
+        keys = grid_keys(seed, index_offset + s, step)
+        profiling.bump_launch()
+        payload = _sim_and_bounds(
+            net, keys, jnp.asarray(clo, jnp.float32),
+            jnp.asarray(chi, jnp.float32), sim_size, with_sim=keep_sim,
+        )
+        return payload, e - s
+
+    def _chunk_decode(n: int, host) -> None:
+        """Append one drained chunk's HOST arrays (padding rows dropped)."""
+        stats, sim, bounds = host
+        cand_c.append([c[:n] for c in stats.candidates])
+        pos_c.append([p[:n] for p in stats.positive_prob])
+        lb_c.append([b[:n] for b in bounds.ws_lb])
+        ub_c.append([b[:n] for b in bounds.ws_ub])
+        if keep_sim:
+            sim_c.append(sim[:n])
+
     with span_obs:
+        # gauge=False: a prune-phase micro-pipeline must not overwrite the
+        # run pipeline's launches_in_flight overlap record.  fault_sites=
+        # False: the whole prune pass is supervised as ONE unit by the
+        # sweep (`sup.run(site="prune")`, blast radius: masks only), so its
+        # launches must not consume launch.submit/launch.decode arrivals
+        # the stage-0 chaos schedules count on.
+        pipe = LaunchPipeline(depth=pipeline_depth, gauge=False,
+                              fault_sites=False)
         for s, e in spans:
-            clo = pad_rows(lo_np[s:e], step)
-            chi = pad_rows(hi_np[s:e], step)
-            keys = grid_keys(seed, index_offset + s, step)
-            profiling.bump_launch()
-            stats, sim, bounds = _sim_and_bounds(
-                net, keys, jnp.asarray(clo, jnp.float32), jnp.asarray(chi, jnp.float32),
-                sim_size, with_sim=keep_sim,
-            )
-            n = e - s
-            cand_c.append([np.asarray(c)[:n] for c in stats.candidates])
-            pos_c.append([np.asarray(p) [:n] for p in stats.positive_prob])
-            lb_c.append([np.asarray(b)[:n] for b in bounds.ws_lb])
-            ub_c.append([np.asarray(b)[:n] for b in bounds.ws_ub])
-            if keep_sim:
-                sim_c.append(np.asarray(sim)[:n])
+            for _meta, n, host in pipe.submit(
+                    lambda s=s, e=e: _chunk_submit(s, e)):
+                _chunk_decode(n, host)
+        for _meta, n, host in pipe.drain():
+            _chunk_decode(n, host)
 
     L = len(cand_c[0])
     _cat = lambda parts: [np.concatenate([p[l] for p in parts]) for l in range(L)]

@@ -1,0 +1,24 @@
+#!/usr/bin/env python
+"""CI entry for the static-analysis rule engine (``fairify_tpu.lint``).
+
+Equivalent to ``python -m fairify_tpu lint`` but importable without the
+package installed (inserts the repo root on sys.path).  Typical CI lines:
+
+    python scripts/lint.py                      # text findings, exit 1 on any
+    python scripts/lint.py --format json        # machine-readable result
+    python scripts/lint.py --ratchet            # also gate per-rule growth
+                                                # vs audits/lint_baseline.json
+
+See DESIGN.md §11 for the rule catalog and the allowlist / suppression /
+baseline workflow.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fairify_tpu.lint import core  # noqa: E402
+
+
+if __name__ == "__main__":
+    sys.exit(core.main())

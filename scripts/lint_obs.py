@@ -1,279 +1,70 @@
 #!/usr/bin/env python
-"""Observability lint: timing/progress in ``fairify_tpu/`` must use the obs layer.
+"""DEPRECATED shim over ``fairify_tpu.lint`` — use ``fairify_tpu lint``.
 
-Fast AST-based check (no imports of the package, runs in milliseconds; wired
-into the tier-1 test run via ``tests/test_observability.py``).  Two rules:
+The five observability rules this script used to implement inline
+(raw ``time.time()``, bare ``print``, bare ``jax.jit`` in verify/+ops/,
+silently-swallowed broad excepts, synchronous device fetches in verify/
+loops) migrated unchanged into the rule engine at ``fairify_tpu/lint/``
+(``rules_obs.py``), which added four more analyses (jit-purity,
+recompile-hazard, lock-discipline, fault-site-coverage), per-rule
+allowlists, ``# lint: disable=<rule-id>`` inline suppressions, a committed
+baseline, and JSON output.  New call sites should run::
 
-* **No raw ``time.time()``** — wall-clock subtraction for timing belongs in
-  ``PhaseTimer`` / obs spans (monotonic clocks, rounding only at
-  serialization).  The one sanctioned caller is the obs layer's own clock
-  shim (``obs/trace.py``, wall-clock span timestamps).
-* **No bare ``print()``** for timing/progress — progress lines go through
-  ``obs.heartbeat`` (throttled) and structured results through the event
-  log.  Allowlisted: the CLI and report renderer (user-facing output is
-  their job), the heartbeat itself, and two legacy shims that predate the
-  obs layer (``verify/sweep.py``'s stderr skip warning,
-  ``verify/exact_check.py``'s debug prints — shrink, don't grow, this list).
-* **No bare ``jax.jit`` in ``fairify_tpu/verify/`` or ``fairify_tpu/ops/``**
-  — device kernels in the verification core must register through
-  ``fairify_tpu.obs.compile.obs_jit`` so every compile is named, counted,
-  timed, and cost/memory-analyzed.  An unregistered ``jax.jit`` (bare
-  decorator, ``jax.jit(...)`` call, or ``partial(jax.jit, ...)``) is a
-  blind spot: its recompiles from shape/static churn are exactly the
-  ~110 ms-to-tens-of-seconds stalls the compile registry exists to
-  attribute.  The allowlist (``ALLOW_RAW_JIT``, repo-relative file paths)
-  names reviewed exceptions — currently empty; shrink, don't grow, it.
-* **No silently-swallowed broad excepts in ``fairify_tpu/``** — a bare
-  ``except:`` / ``except Exception`` / ``except BaseException`` whose body
-  never re-raises swallows exactly the faults the resilience layer
-  (``fairify_tpu/resilience``) exists to classify, retry, and degrade
-  with a recorded reason.  Handlers that conditionally re-raise (after
-  ``resilience.supervisor.classify``) pass; the reviewed swallow sites
-  (compile fallback, import gates) live in ``ALLOW_BROAD_EXCEPT``.
-* **No synchronous device fetch in ``fairify_tpu/verify/`` loops** —
-  ``np.asarray(...)`` / ``jax.device_get(...)`` / ``.block_until_ready()``
-  inside a ``for``/``while`` body stalls the launch queue exactly where
-  the async pipeline (``parallel/pipeline.py``) exists to keep it full;
-  chunk loops must submit through a :class:`LaunchPipeline` and convert
-  only at dequeue.  The allowlist (``ALLOW_LOOP_FETCH``, keyed
-  ``file::function``) names the remaining legitimate sync points — drain-
-  API decode bodies, sequentially-dependent BaB iterations, single-
-  partition retries — each with its reason.  Shrink, don't grow, it.
-  Deliberately NOT matched: ``np.array`` (22 in-tree uses are host list
-  construction; flagging them would bury the signal) — a reviewer must
-  still catch ``np.array(device_array)``, as with any other blocking
-  read (``float(x)``, ``int(x)``) the AST can't distinguish.
+    python -m fairify_tpu lint          # all nine rules
+    python scripts/lint.py --ratchet    # CI growth gate
 
-AST-based, so docstrings/comments mentioning the patterns don't trip it.
-``scripts/`` and ``tests/`` are out of scope: the rule protects the
-library's hot paths, not one-off harnesses.
+This file keeps the old module surface — ``check_file(path, rel)``,
+``main(argv)``, and the ``ALLOW_*`` constants — for existing callers
+(``tests/test_observability.py`` / ``tests/test_resilience.py`` exercise
+it as the legacy-rule regression surface).  It will be removed once
+nothing imports it; do not add rules here.
 """
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-# Paths are repo-relative, '/'-separated.
-ALLOW_TIME_TIME = {
-    "fairify_tpu/obs/trace.py",  # the obs layer's wall-clock shim
-}
-ALLOW_PRINT = {
-    "fairify_tpu/cli.py",            # user-facing command output
-    "fairify_tpu/obs/heartbeat.py",  # the sanctioned progress line
-    "fairify_tpu/obs/report.py",     # report renderer (CLI body)
-    "fairify_tpu/verify/sweep.py",   # legacy: stderr width-mismatch warning
-    "fairify_tpu/verify/exact_check.py",  # legacy: gated debug prints
-}
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
-# Raw-jit rule scope: every device kernel of the verification core must go
-# through obs.compile.obs_jit (named compile spans, recompile accounting).
-RAW_JIT_SCOPE = ("fairify_tpu/verify/", "fairify_tpu/ops/")
-# Repo-relative file paths reviewed as legitimate bare-jit users.  Empty:
-# the whole core is migrated; a new entry needs a reason in review.
-ALLOW_RAW_JIT: set = set()
-
-# Hot-loop fetch rule scope: chunk/frontier loops of the verification core.
-LOOP_FETCH_SCOPE = "fairify_tpu/verify/"
-# ``file::function`` sync points reviewed as legitimate.  Everything else in
-# a verify/ loop must route through parallel.pipeline.LaunchPipeline.
-ALLOW_LOOP_FETCH = {
-    # Drain-API decode bodies: the pipeline hands them HOST payloads; the
-    # remaining np.asarray calls pull already-materialized model weights.
-    "fairify_tpu/verify/sweep.py::_family_block_decode",
-    # Per-partition heuristic-retry re-sim: one tiny launch whose result
-    # this row's CSV needs immediately — scoped to its own helper so the
-    # sweep's main loop body stays under the lint.
-    "fairify_tpu/verify/sweep.py::_parity_resim",
-    # BaB frontier iterations are sequentially dependent (each batch's
-    # branching decides the next batch) — no independent work to overlap.
-    "fairify_tpu/verify/engine.py::decide_many",
-    "fairify_tpu/verify/engine.py::uniform_sign_bab",
-    "fairify_tpu/verify/engine.py::_run_lp_phase",
-    # Sound-prune chunk results feed the immediately-following host mask
-    # assembly per chunk; candidate for pipelining, not yet converted.
-    "fairify_tpu/verify/pruning.py::sound_prune_grid",
-    "fairify_tpu/verify/exact_check.py::exact_certify_grid",
-    # Pure-host numpy coercions of weights/points inside exact/LP/SMT
-    # loops — ``np.asarray`` on data that never lived on device.
-    "fairify_tpu/verify/engine.py::exact_logit_sign",
-    "fairify_tpu/verify/engine.py::_leaf_sign_lp",
-    "fairify_tpu/verify/engine.py::_eligible_lattice_roots",
-    "fairify_tpu/verify/smt.py::_z3_net",
-    # Per-root host phases (lattice enumeration / pair LP): independent
-    # roots, so genuine pipelining candidates — not yet converted; the
-    # fetched payloads feed immediately-following serial host solvers.
-    "fairify_tpu/verify/engine.py::_lattice_phase",
-    "fairify_tpu/verify/engine.py::_pair_lp_phase",
-}
-_FETCH_HINT = (
-    "synchronous device fetch in a verify/ loop — submit through "
-    "parallel.pipeline.LaunchPipeline and convert at dequeue "
-    "(or extend ALLOW_LOOP_FETCH with file::function and a reason)")
-
-# Broad-except rule: a bare ``except:`` / ``except Exception`` /
-# ``except BaseException`` that never re-raises swallows exactly the
-# faults the resilience layer exists to classify and surface (an injected
-# ``crash`` fault, a KeyboardInterrupt under BaseException) — silent
-# degradation with no counter, no event, no ledger reason.  Handlers that
-# contain a ``raise`` (conditional re-raise after classification) pass.
-# The allowlist (``file::function``) names reviewed swallow sites — each
-# with its reason.  Shrink, don't grow, it.
-ALLOW_BROAD_EXCEPT = {
-    # Import gate: jax.api_util.shaped_abstractify rename degrades to
-    # conservative fallback cache keys, never an import error.
-    "fairify_tpu/obs/compile.py::<module>",
-    # Compile fallbacks: an unusable AOT path serves the kernel via plain
-    # jax.jit (counted in xla_compile_fallbacks) — observability must
-    # never change results or availability.  (_compile's handler re-raises
-    # propagate-class faults, so only __call__'s swallow sites need this.)
-    "fairify_tpu/obs/compile.py::__call__",
-    # Backend-optional executable analyses (cost/memory): absence degrades
-    # to missing attrs.
-    "fairify_tpu/obs/compile.py::_record_analysis",
-}
-_BROAD_HINT = (
-    "broad except (bare/Exception/BaseException) that never re-raises — "
-    "classify via fairify_tpu.resilience.supervisor.classify and degrade "
-    "with a recorded reason, or extend ALLOW_BROAD_EXCEPT with a reviewed "
-    "reason")
-
-
-def _is_broad_type(node) -> bool:
-    """Does the handler's type expression name Exception/BaseException?"""
-    if node is None:
-        return True  # bare except:
-    if isinstance(node, ast.Tuple):
-        return any(_is_broad_type(el) for el in node.elts)
-    return isinstance(node, ast.Name) and node.id in ("Exception",
-                                                      "BaseException")
-
-
-def _broad_except_errors(tree: ast.AST, rel: str) -> list:
-    """Flag broad exception handlers with no ``raise`` anywhere in the body."""
-    errors = []
-
-    def walk(node, fn_name):
-        for child in ast.iter_child_nodes(node):
-            c_fn = fn_name
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                c_fn = child.name
-            elif isinstance(child, ast.ExceptHandler) \
-                    and _is_broad_type(child.type) \
-                    and not any(isinstance(n, ast.Raise)
-                                for n in ast.walk(child)) \
-                    and f"{rel}::{c_fn}" not in ALLOW_BROAD_EXCEPT:
-                errors.append(f"{rel}:{child.lineno}: {_BROAD_HINT}")
-            walk(child, c_fn)
-
-    walk(tree, "<module>")
-    return errors
-
-
-def _is_time_time(node: ast.Call) -> bool:
-    f = node.func
-    return (isinstance(f, ast.Attribute) and f.attr == "time"
-            and isinstance(f.value, ast.Name) and f.value.id == "time")
-
-
-def _is_print(node: ast.Call) -> bool:
-    return isinstance(node.func, ast.Name) and node.func.id == "print"
-
-
-def _is_raw_jit(node: ast.AST) -> bool:
-    """The ``jax.jit`` attribute itself: catches ``@jax.jit``,
-    ``jax.jit(f, ...)`` and ``partial(jax.jit, ...)`` uniformly."""
-    return (isinstance(node, ast.Attribute) and node.attr == "jit"
-            and isinstance(node.value, ast.Name) and node.value.id == "jax")
-
-
-def _is_loop_fetch(node: ast.Call) -> bool:
-    f = node.func
-    if isinstance(f, ast.Attribute):
-        if f.attr == "block_until_ready":
-            return True
-        if isinstance(f.value, ast.Name):
-            # np.asarray(...) / jax.device_get(...) on loop-carried arrays.
-            if f.value.id in ("np", "numpy") and f.attr == "asarray":
-                return True
-            if f.value.id == "jax" and f.attr == "device_get":
-                return True
-    return False
-
-
-def _loop_fetch_errors(tree: ast.AST, rel: str) -> list:
-    """Flag sync fetches whose nearest enclosing loop is a for/while body.
-
-    A nested ``def``/``lambda`` resets the context: a decode closure defined
-    inside a function and *called* from a loop is the pipeline's drain path,
-    not a loop-body fetch.
-    """
-    errors = []
-
-    def walk(node, fn_name, in_loop):
-        for child in ast.iter_child_nodes(node):
-            c_fn, c_loop = fn_name, in_loop
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                c_fn, c_loop = child.name, False
-            elif isinstance(child, ast.Lambda):
-                c_loop = False
-            elif isinstance(child, (ast.For, ast.While)):
-                c_loop = True
-            elif isinstance(child, ast.Call) and c_loop \
-                    and _is_loop_fetch(child) \
-                    and f"{rel}::{c_fn}" not in ALLOW_LOOP_FETCH:
-                errors.append(f"{rel}:{child.lineno}: {_FETCH_HINT}")
-            walk(child, c_fn, c_loop)
-
-    walk(tree, "<module>", False)
-    return errors
+from fairify_tpu.lint.core import FileContext  # noqa: E402
+from fairify_tpu.lint.rules import legacy_rules  # noqa: E402
+from fairify_tpu.lint.rules_obs import (  # noqa: E402,F401  (legacy surface)
+    ALLOW_BROAD_EXCEPT,
+    ALLOW_LOOP_FETCH,
+    ALLOW_PRINT,
+    ALLOW_RAW_JIT,
+    ALLOW_TIME_TIME,
+    LOOP_FETCH_SCOPE,
+    RAW_JIT_SCOPE,
+)
 
 
 def check_file(path: str, rel: str) -> list:
-    with open(path) as fp:
-        src = fp.read()
+    """Legacy per-file entry: the five obs rules, old message format."""
     try:
-        tree = ast.parse(src, filename=path)
+        ctx = FileContext(path, rel)
     except SyntaxError as exc:
         return [f"{rel}:{exc.lineno}: syntax error: {exc.msg}"]
-    errors = []
-    if rel.startswith(RAW_JIT_SCOPE) and rel not in ALLOW_RAW_JIT:
-        for node in ast.walk(tree):
-            if _is_raw_jit(node):
-                errors.append(
-                    f"{rel}:{node.lineno}: bare jax.jit — register device "
-                    f"kernels through fairify_tpu.obs.compile.obs_jit so "
-                    f"compiles are named/counted/timed (or extend "
-                    f"ALLOW_RAW_JIT with a reviewed reason)")
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        if _is_time_time(node) and rel not in ALLOW_TIME_TIME:
-            errors.append(
-                f"{rel}:{node.lineno}: raw time.time() — use "
-                f"time.perf_counter() via PhaseTimer/obs spans "
-                f"(or extend ALLOW_TIME_TIME for a sanctioned shim)")
-        elif _is_print(node) and rel not in ALLOW_PRINT:
-            errors.append(
-                f"{rel}:{node.lineno}: bare print() — progress goes through "
-                f"fairify_tpu.obs.heartbeat, structured output through the "
-                f"event log (or extend ALLOW_PRINT for user-facing output)")
-    if rel.startswith(LOOP_FETCH_SCOPE):
-        errors.extend(_loop_fetch_errors(tree, rel))
-    errors.extend(_broad_except_errors(tree, rel))
-    return errors
+    findings = []
+    for rule in legacy_rules():
+        if rule.applies(rel):
+            findings.extend(f for f in rule.check(ctx)
+                            if not ctx.suppressed(f.line, f.rule))
+    findings.sort(key=lambda f: (f.line, f.rule))
+    return [f"{rel}:{f.line}: {f.message}" for f in findings]
 
 
 def main(argv=None) -> int:
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    pkg = os.path.join(root, "fairify_tpu")
+    pkg = os.path.join(_ROOT, "fairify_tpu")
     errors = []
     for dirpath, _dirnames, filenames in os.walk(pkg):
         for fn in sorted(filenames):
             if not fn.endswith(".py"):
                 continue
             path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            rel = os.path.relpath(path, _ROOT).replace(os.sep, "/")
             errors.extend(check_file(path, rel))
     for e in errors:
         print(e, file=sys.stderr)

@@ -1,0 +1,11 @@
+# rel: fairify_tpu/resilience/fx_journal.py
+def open_ledger(journal_cls, path, site=None):
+    # fault_site= literals count as coverage (the JournalWriter contract);
+    # supervisor.run(..., site=...) labels do not.
+    return journal_cls(path, fault_site=site or "demo.used")
+
+
+def open_shard(journal_cls, path, op):
+    # A dynamic (f-string) site is intentionally uncounted: its fragments
+    # ("demo.") must not be collected as literal site names.
+    return journal_cls(path, fault_site=f"demo.{op}")

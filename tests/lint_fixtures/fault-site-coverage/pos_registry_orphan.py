@@ -1,0 +1,3 @@
+# rel: fairify_tpu/resilience/faults.py
+FAULT_SITES = frozenset({"demo.used", "demo.orphan"})  # EXPECT
+FAULT_KINDS = frozenset({"transient", "fatal", "crash"})

@@ -1,0 +1,7 @@
+# rel: fairify_tpu/verify/fx_sites.py
+from fairify_tpu.resilience import faults
+
+
+def instrumented():
+    faults.check("demo.used")
+    faults.check("demo.bogus")  # EXPECT

@@ -1,0 +1,17 @@
+# rel: fairify_tpu/verify/fx_pure_ok.py
+from fairify_tpu.obs import obs_jit
+
+
+@obs_jit(static_argnames=("n",))
+def pure_kernel(optimizer, x, state, n):
+    ys = []
+    for i in range(n):
+        ys.append(x * i)  # kernel-local list: trace-local, fine
+    scratch = {}
+    scratch["m"] = x  # kernel-local dict: fine
+    updates, state = optimizer.update(x, state)  # optax-style: pure
+    return sum(ys), updates, state
+
+
+def host_progress(i):
+    print("host", i)  # not a jitted body: obs-print's business, not ours

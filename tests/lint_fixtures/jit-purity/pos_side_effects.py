@@ -1,0 +1,29 @@
+# rel: fairify_tpu/verify/fx_pure.py
+from fairify_tpu import obs
+from fairify_tpu.obs import obs_jit
+from fairify_tpu.utils import profiling
+
+results = []
+totals = {}
+
+
+@obs_jit
+def impure_kernel(x):
+    print("tracing", x)  # EXPECT
+    obs.event("kernel", n=1)  # EXPECT
+    profiling.bump_launch()  # EXPECT
+    results.append(x)  # EXPECT
+    totals["x"] = x  # EXPECT
+    return x
+
+
+def make_counter():
+    acc = 0
+
+    @obs_jit
+    def kernel(x):
+        nonlocal acc  # EXPECT
+        acc = acc + 1
+        return x
+
+    return kernel

@@ -1,0 +1,25 @@
+# rel: fairify_tpu/parallel/pipeline.py
+import threading
+
+
+class SafeBox:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+
+    def put(self, k, v):
+        with self._lock:
+            self._items[k] = v
+
+    def get(self, k):
+        with self._lock:
+            return self._items.get(k)
+
+
+class NoLocks:
+    # No lock attributes: the rule has nothing to protect here.
+    def __init__(self):
+        self.items = {}
+
+    def put(self, k, v):
+        self.items[k] = v

@@ -1,0 +1,15 @@
+# rel: fairify_tpu/obs/metrics.py
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}  # __init__ writes precede sharing: exempt
+
+    def put(self, k, v):
+        with self._lock:
+            self._items[k] = v
+
+    def get(self, k):
+        return self._items.get(k)  # EXPECT

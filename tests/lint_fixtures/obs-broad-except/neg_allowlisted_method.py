@@ -1,0 +1,13 @@
+# rel: fairify_tpu/obs/compile.py
+class ObsJit:
+    def __call__(self):
+        # fairify_tpu/obs/compile.py::__call__ is an ALLOW_BROAD_EXCEPT
+        # entry (reviewed compile fallback).
+        try:
+            return run()
+        except Exception:
+            return None
+
+
+def run():
+    pass

@@ -1,0 +1,30 @@
+# rel: fairify_tpu/verify/fx_broad_ok.py
+def narrow():
+    try:
+        work()
+    except ValueError:
+        pass
+
+
+def reraises():
+    try:
+        work()
+    except Exception:
+        raise
+
+
+def classified(classify):
+    try:
+        work()
+    except Exception as exc:
+        if classify(exc) == "propagate":
+            raise
+        record(exc)
+
+
+def work():
+    pass
+
+
+def record(exc):
+    pass

@@ -1,0 +1,26 @@
+# rel: fairify_tpu/verify/fx_broad.py
+def swallow_bare():
+    try:
+        work()
+    except:  # EXPECT
+        pass
+
+
+def swallow_base():
+    try:
+        work()
+    except BaseException:  # EXPECT
+        cleanup = 1
+
+
+class Widget:
+    # Class-body handler: attributed to 'Widget', never to the enclosing
+    # module/function allowlist key (the old walker got this wrong).
+    try:
+        import optional_dep
+    except Exception:  # EXPECT
+        optional_dep = None
+
+
+def work():
+    pass

@@ -1,0 +1,10 @@
+# rel: fairify_tpu/verify/engine.py
+import numpy as np
+
+
+def decide_many(frontier):
+    # engine.py::decide_many is an ALLOW_LOOP_FETCH entry (sequentially
+    # dependent BaB iterations).
+    while frontier:
+        frontier = np.asarray(frontier)
+    return frontier

@@ -1,0 +1,21 @@
+# rel: fairify_tpu/verify/fx_fetch_ok.py
+import numpy as np
+
+
+def cold(chunks, dev):
+    for c in chunks:
+        pass
+    else:
+        final = np.asarray(dev)  # for-else runs once, not per iteration
+    for row in np.asarray(dev):  # the iterable evaluates once
+        pass
+
+    def decode(x):
+        # Nested def resets the loop context: this is the pipeline's
+        # drain path, handed HOST payloads.
+        return np.asarray(x)
+
+    for c in chunks:
+        decode(c)
+    last = np.asarray(dev)  # not in a loop at all
+    return final, last

@@ -1,0 +1,14 @@
+# rel: fairify_tpu/verify/fx_fetch.py
+import jax
+import numpy as np
+
+
+def hot(chunks, dev):
+    out = []
+    for c in chunks:
+        out.append(np.asarray(c))  # EXPECT
+    while dev:
+        dev = jax.device_get(dev)  # EXPECT
+    for c in chunks:
+        c.block_until_ready()  # EXPECT
+    return out

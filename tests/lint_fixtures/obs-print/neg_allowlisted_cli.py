@@ -1,0 +1,5 @@
+# rel: fairify_tpu/cli.py
+def render(rows):
+    # The CLI renders user-facing output: allowlisted (ALLOW_PRINT).
+    for r in rows:
+        print(r)

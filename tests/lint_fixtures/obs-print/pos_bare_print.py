@@ -1,0 +1,3 @@
+# rel: fairify_tpu/verify/fx_print.py
+def progress(i):
+    print(f"partition {i}")  # EXPECT

@@ -1,0 +1,7 @@
+# rel: fairify_tpu/verify/fx_obsjit.py
+from fairify_tpu.obs import obs_jit
+
+
+@obs_jit(static_argnames=("k",))
+def registered(x, k):
+    return x

@@ -1,0 +1,17 @@
+# rel: fairify_tpu/verify/fx_rawjit.py
+from functools import partial
+
+import jax
+
+
+@jax.jit  # EXPECT
+def a(x):
+    return x
+
+
+b = jax.jit(lambda x: x)  # EXPECT
+
+
+@partial(jax.jit, static_argnames=("k",))  # EXPECT
+def c(x, k):
+    return x

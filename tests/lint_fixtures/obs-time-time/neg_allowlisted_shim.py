@@ -1,0 +1,11 @@
+# rel: fairify_tpu/obs/trace.py
+import time
+
+
+def wall_clock():
+    # This rel is the allowlisted obs clock shim (ALLOW_TIME_TIME).
+    return time.time()
+
+
+def monotonic():
+    return time.perf_counter()

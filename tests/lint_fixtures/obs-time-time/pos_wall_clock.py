@@ -1,0 +1,7 @@
+# rel: fairify_tpu/verify/fx_time.py
+import time
+
+
+def slow_phase():
+    t0 = time.time()  # EXPECT
+    return t0

@@ -1,0 +1,24 @@
+# rel: fairify_tpu/verify/fx_hazard_ok.py
+import jax.numpy as jnp
+
+from fairify_tpu.obs import obs_jit
+
+
+@obs_jit(static_argnames=("n", "with_sim"))
+def stable_kernel(net, x, n, with_sim=True):
+    ys = x if with_sim else -x  # static conditional: fine
+    if x is None:  # identity on the Python object: concrete
+        return ys
+    if x.ndim == 2:  # shape introspection: concrete under tracing
+        ys = ys[None]
+    if len(x) > 3:  # len() is concrete
+        ys = ys * 2
+    return jnp.where(x > 0, ys, -ys)  # traced select belongs in the graph
+
+
+def drive(xs):
+    out = []
+    for x in xs:
+        # Constant static per call — the loop variable feeds a TRACED slot.
+        out.append(stable_kernel(None, x, 4))
+    return out

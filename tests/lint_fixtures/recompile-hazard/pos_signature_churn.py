@@ -1,0 +1,40 @@
+# rel: fairify_tpu/verify/fx_hazard.py
+import jax
+
+from fairify_tpu.obs import obs_jit
+
+
+@obs_jit(static_argnames=("size", "flavor"))  # EXPECT
+def typo_kernel(x, size):
+    return x
+
+
+@obs_jit(static_argnames=("eps",))
+def float_static(x, eps: float = 1e-3):  # EXPECT
+    return x
+
+
+@obs_jit
+def traced_branch(x, y):
+    if x > 0:  # EXPECT
+        return y
+    return -y
+
+
+@obs_jit(static_argnames=("chunk",))
+def chunked(x, chunk):
+    return x
+
+
+def sweep_over(xs):
+    outs = []
+    for n in range(8):
+        outs.append(chunked(xs, chunk=n))  # EXPECT
+    return outs
+
+
+def relaunch(fns, x):
+    for f in fns:
+        g = jax.jit(f)  # EXPECT
+        x = g(x)
+    return x

@@ -38,6 +38,29 @@ def test_sound_prune_grid_chunk_invariant(gc_grid):
     np.testing.assert_array_equal(whole.sim, chunked.sim)
 
 
+def test_sound_prune_grid_pipeline_depth_invariant(gc_grid):
+    """The chunk loop now submits through LaunchPipeline; depth changes
+    only *when* results are fetched, so masks, bounds, and samples must be
+    bit-equal to the synchronous order (depth 1) at every depth."""
+    cfg, lo, hi = gc_grid
+    net = init_mlp((20, 8, 1), seed=3)
+    lo, hi = lo[:40], hi[:40]
+    sync = pruning.sound_prune_grid(
+        net, lo, hi, 64, cfg.seed, exact_certify=False, chunk=17,
+        pipeline_depth=1)
+    for depth in (2, 4):
+        piped = pruning.sound_prune_grid(
+            net, lo, hi, 64, cfg.seed, exact_certify=False, chunk=17,
+            pipeline_depth=depth)
+        for a, b in zip(sync.st_deads, piped.st_deads):
+            np.testing.assert_array_equal(a, b)
+        for a, b in zip(sync.ws_lb, piped.ws_lb):
+            np.testing.assert_array_equal(a, b)
+        for a, b in zip(sync.ws_ub, piped.ws_ub):
+            np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(sync.sim, piped.sim)
+
+
 def test_sweep_verdicts_chunk_invariant(tmp_path, gc_grid):
     cfg, _, _ = gc_grid
     net = init_mlp((20, 8, 1), seed=3)

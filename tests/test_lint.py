@@ -1,0 +1,244 @@
+"""Tier-1 surface of the ``fairify_tpu.lint`` rule engine (DESIGN.md §11).
+
+Three layers:
+
+* **repo gate** — the committed tree is clean under all nine rules with the
+  committed baseline, including ratchet mode.  This is the CI wiring: a PR
+  that introduces a finding (or grows a baselined rule's count) fails here.
+* **fixture corpus** — ``tests/lint_fixtures/<rule-id>/`` holds small
+  positive/negative snippets per rule.  Each fixture's first line declares
+  the virtual repo-relative path it is linted as (``# rel: …``), and every
+  line that must be flagged carries an ``# EXPECT`` marker; the golden test
+  pins the exact ``(path, line)`` set per rule.  A meta-test asserts every
+  shipped rule keeps ≥1 positive and ≥1 negative fixture.
+* **engine behavior** — inline suppressions, baseline grandfathering,
+  ratchet breaches, JSON output, and the deprecated ``scripts/lint_obs.py``
+  shim surface.
+
+No jax import anywhere on these paths: the lint layer is plain-AST only.
+"""
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from fairify_tpu.lint import core
+from fairify_tpu.lint.rules import LEGACY_RULE_IDS, all_rules, legacy_rules
+
+REPO_ROOT = pathlib.Path(core.repo_root())
+FIXTURE_ROOT = pathlib.Path(__file__).parent / "lint_fixtures"
+
+RULE_IDS = [r.id for r in all_rules()]
+
+
+def _rule(rule_id):
+    """A fresh instance (rules are stateful across one engine run)."""
+    return {r.id: r for r in all_rules()}[rule_id]
+
+
+def _fixture_files(rule_id):
+    """[(abs path, declared repo-relative path)] for one rule's corpus."""
+    out = []
+    for p in sorted((FIXTURE_ROOT / rule_id).glob("*.py")):
+        first = p.read_text().splitlines()[0]
+        assert first.startswith("# rel: "), \
+            f"{p} must declare its virtual path in line 1 as '# rel: …'"
+        out.append((str(p), first[len("# rel: "):].strip()))
+    return out
+
+
+def _expected_lines(path, rel):
+    """{(rel, lineno)} of every ``# EXPECT``-marked line in one fixture."""
+    return {(rel, i)
+            for i, line in enumerate(
+                pathlib.Path(path).read_text().splitlines(), start=1)
+            if "# EXPECT" in line}
+
+
+# ---------------------------------------------------------------------------
+# Repo gate (the actual CI check)
+# ---------------------------------------------------------------------------
+
+
+def test_repo_clean_under_all_nine_rules_with_ratchet():
+    baseline = core.load_baseline(str(REPO_ROOT / core.BASELINE_REL))
+    result = core.run_lint(baseline=baseline, ratchet=True)
+    assert result.rules == list(RULE_IDS) and len(result.rules) == 9
+    assert not result.parse_errors, [f.render() for f in result.parse_errors]
+    assert not result.findings, "\n" + "\n".join(
+        f.render() for f in result.findings)
+    assert not result.ratchet_breaches, result.ratchet_breaches
+    assert result.ok
+    assert result.n_files > 50  # whole-repo sweep, not a partial walk
+
+
+def test_legacy_rules_reproduce_lint_obs_clean():
+    """The five migrated rules find nothing on the committed tree — the
+    engine reproduces the old ``scripts/lint_obs.py`` result exactly."""
+    result = core.run_lint(rules=legacy_rules())
+    assert tuple(result.rules) == LEGACY_RULE_IDS
+    assert not result.findings and not result.parse_errors
+
+
+# ---------------------------------------------------------------------------
+# Fixture corpus: golden (path, line) sets per rule
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_fixture_corpus_golden(rule_id):
+    """Linting one rule's full fixture dir (as one virtual tree, so the
+    cross-file fault-site analysis sees registry + call sites together)
+    flags exactly the ``# EXPECT`` lines."""
+    files = _fixture_files(rule_id)
+    expected = set()
+    for path, rel in files:
+        expected |= _expected_lines(path, rel)
+    result = core.run_lint(rules=[_rule(rule_id)], files=files)
+    assert not result.parse_errors, [f.render() for f in result.parse_errors]
+    got = {(f.path, f.line) for f in result.findings}
+    assert got == expected, (
+        f"{rule_id}: findings {sorted(got - expected)} unexpected, "
+        f"{sorted(expected - got)} missing")
+
+
+def test_every_rule_has_positive_and_negative_fixtures():
+    """Meta-test: a shipped rule without a corpus cannot regress safely."""
+    for rule_id in RULE_IDS:
+        d = FIXTURE_ROOT / rule_id
+        assert d.is_dir(), f"missing fixture dir for rule {rule_id!r}"
+        pos = sorted(d.glob("pos_*.py"))
+        neg = sorted(d.glob("neg_*.py"))
+        assert pos, f"{rule_id}: no positive fixture (pos_*.py)"
+        assert neg, f"{rule_id}: no negative fixture (neg_*.py)"
+        for p in pos:
+            assert "# EXPECT" in p.read_text(), \
+                f"{p} is a positive fixture but marks no # EXPECT line"
+        for p in neg:
+            assert "# EXPECT" not in p.read_text(), \
+                f"{p} is a negative fixture but marks an # EXPECT line"
+    extra = {d.name for d in FIXTURE_ROOT.iterdir() if d.is_dir()} \
+        - set(RULE_IDS)
+    assert not extra, f"fixture dirs without a shipped rule: {sorted(extra)}"
+
+
+# ---------------------------------------------------------------------------
+# Suppressions, baseline, ratchet
+# ---------------------------------------------------------------------------
+
+
+def _lint_src(tmp_path, src, rel="fairify_tpu/verify/fx.py", **kw):
+    p = tmp_path / "fx.py"
+    p.write_text(src)
+    return core.run_lint(rules=kw.pop("rules", None) or [_rule("obs-print")],
+                         files=[(str(p), rel)], **kw)
+
+
+def test_inline_suppression_silences_exactly_that_line(tmp_path):
+    result = _lint_src(tmp_path, (
+        "def f(i):\n"
+        "    print(i)  # lint: disable=obs-print\n"
+        "    print(i)  # lint: disable=obs-time-time  (wrong id: no effect)\n"
+        "    print(i)\n"))
+    assert [f.line for f in result.findings] == [3, 4]
+    assert result.suppressed == 1
+
+
+def test_inline_suppression_disable_all(tmp_path):
+    result = _lint_src(tmp_path,
+                       "print(1)  # lint: disable=all\n")
+    assert not result.findings and result.suppressed == 1
+
+
+def test_baseline_grandfathers_by_key_and_count(tmp_path):
+    src = "def f(i):\n    print(i)\n    print(i)\n"
+    key = "obs-print::fairify_tpu/verify/fx.py::f"
+    baseline = {key: {"count": 1, "reason": "test"}}
+    result = _lint_src(tmp_path, src, baseline=baseline)
+    assert len(result.findings) == 1 and len(result.baselined) == 1
+    assert result.findings[0].key == key  # overflow past the budget is live
+    # Full budget: everything grandfathered, run is ok (without ratchet).
+    result = _lint_src(tmp_path, src,
+                       baseline={key: {"count": 2, "reason": "test"}})
+    assert not result.findings and len(result.baselined) == 2 and result.ok
+
+
+def test_ratchet_breaches_when_count_exceeds_baseline(tmp_path):
+    src = "def f(i):\n    print(i)\n    print(i)\n"
+    key = "obs-print::fairify_tpu/verify/fx.py::f"
+    ok = _lint_src(tmp_path, src, ratchet=True,
+                   baseline={key: {"count": 2, "reason": "test"}})
+    assert ok.ok and not ok.ratchet_breaches
+    bad = _lint_src(tmp_path, src, ratchet=True,
+                    baseline={key: {"count": 1, "reason": "test"}})
+    assert bad.ratchet_breaches == ["obs-print: 2 finding(s) > baseline 1"]
+    assert not bad.ok
+
+
+def test_malformed_baseline_is_loud(tmp_path):
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps({"findings": {"obs-print::x.py::f": {"count": 0}}}))
+    with pytest.raises(ValueError):
+        core.load_baseline(str(p))
+    # The reason is mandatory: grandfathering without a recorded why fails.
+    p.write_text(json.dumps({"findings": {"obs-print::x.py::f": {"count": 1}}}))
+    with pytest.raises(ValueError):
+        core.load_baseline(str(p))
+    assert core.load_baseline(str(tmp_path / "missing.json")) == {}
+
+
+def test_parse_error_is_a_finding_not_a_crash(tmp_path):
+    result = _lint_src(tmp_path, "def broken(:\n")
+    assert not result.findings
+    assert [f.rule for f in result.parse_errors] == ["parse"]
+    assert not result.ok
+
+
+# ---------------------------------------------------------------------------
+# CLI: scripts/lint.py (JSON + ratchet) and the lint_obs shim
+# ---------------------------------------------------------------------------
+
+
+def test_scripts_lint_json_and_ratchet_exit_zero():
+    proc = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "scripts" / "lint.py"),
+         "--format", "json", "--ratchet"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] is True
+    assert sorted(doc["counts"]) == sorted(RULE_IDS)
+    assert all(n == 0 for n in doc["counts"].values())
+    assert doc["ratchet_breaches"] == []
+
+
+def test_cli_rejects_unknown_rule_id(capsys):
+    assert core.main(["--rules", "no-such-rule"]) == 2
+
+
+def test_cli_rule_subset(capsys):
+    assert core.main(["--rules", "obs-print,jit-purity",
+                      "--format", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert sorted(doc["rules"]) == ["jit-purity", "obs-print"]
+
+
+def test_lint_obs_shim_surface(tmp_path):
+    """The deprecated shim still exposes check_file/main/ALLOW_* and stays
+    clean on the committed tree (legacy-rule regression surface)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "lint_obs_shim", str(REPO_ROOT / "scripts" / "lint_obs.py"))
+    shim = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(shim)
+    for name in ("ALLOW_TIME_TIME", "ALLOW_PRINT", "ALLOW_RAW_JIT",
+                 "ALLOW_BROAD_EXCEPT", "ALLOW_LOOP_FETCH"):
+        assert isinstance(getattr(shim, name), frozenset)
+    p = tmp_path / "bad.py"
+    p.write_text("import time\nt = time.time()\n")
+    msgs = shim.check_file(str(p), "fairify_tpu/verify/bad.py")
+    assert len(msgs) == 1 and "time.time()" in msgs[0]
+    assert shim.main([]) == 0  # whole-tree legacy sweep is clean
