@@ -106,22 +106,35 @@ def _cmd_run(args) -> int:
 def _run_traced(args, cfg) -> int:
     from fairify_tpu.verify import sweep
 
-    mesh = None
-    if args.mesh:
-        from fairify_tpu.parallel.mesh import make_mesh
-
-        mesh = make_mesh()
-
     # --host-count distributes the partition grid: this process sweeps only
     # its contiguous slice (parallel.multihost.host_slice); span-qualified
     # ledgers merge across hosts with parallel.multihost.merge_ledgers.
     if (args.host_index is None) != (args.host_count is None):
         print("--host-index and --host-count must be given together", file=sys.stderr)
         return 2
+    if args.shards is not None and args.host_count is not None:
+        print("--shards and --host-count are mutually exclusive", file=sys.stderr)
+        return 2
+    if args.shards is not None and args.mesh:
+        print("--shards and --mesh are mutually exclusive (each shard runs "
+              "on its own submesh)", file=sys.stderr)
+        return 2
+    if args.shards is not None and args.shards < 1:
+        print("--shards must be >= 1", file=sys.stderr)
+        return 2
+    if args.shards is not None and args.retry_unknown:
+        print("--shards does not support --retry-unknown yet", file=sys.stderr)
+        return 2
+    mesh = None
+    if args.mesh:
+        from fairify_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh()
     reports = sweep.run_sweep(cfg, model_root=args.model_root, data_root=args.data_root,
                               mesh=mesh, host_index=args.host_index,
                               host_count=args.host_count,
-                              retry_unknown=args.retry_unknown)
+                              retry_unknown=args.retry_unknown,
+                              n_shards=args.shards)
     if not reports:
         print(f"no models found for dataset {cfg.dataset!r} "
               f"(set --model-root or FAIRIFY_TPU_MODEL_ROOT)", file=sys.stderr)
@@ -303,6 +316,11 @@ def main(argv=None) -> int:
                      help="total hosts; each sweeps its slice of the grid")
     run.add_argument("--mesh", action="store_true",
                      help="shard stage 0 over all visible devices")
+    run.add_argument("--shards", type=int, default=None,
+                     help="fault-tolerant sharded sweep: split the grid "
+                          "into N per-shard fault domains over the visible "
+                          "devices; a shard loss elastically re-shards onto "
+                          "the survivors (parallel.shards)")
     run.add_argument("--trace-out", default=None,
                      help="write a JSONL span/event log here plus a Chrome "
                           "trace alongside (<path>.chrome.json)")

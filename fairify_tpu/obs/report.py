@@ -20,7 +20,11 @@ results directory's worth), produce
 * a **degradation table** — fault-degraded UNKNOWN partitions bucketed by
   machine-readable reason code (``site:kind``), read from degraded verdict
   events or directly from verdict-ledger files (``*.ledger.jsonl`` may be
-  passed as inputs; their ``failure`` records are the source of truth).
+  passed as inputs; their ``failure`` records are the source of truth);
+* a **per-shard table** — for sharded sweeps (``parallel.shards``; span-
+  qualified sinks ``model@start-stop`` or ``failure`` records carrying a
+  ``shard`` index): per shard, verdict counts and how many partitions
+  degraded — the shard-loss blast radius at a glance.
 
 Torn/partially-written lines (crash mid-sweep) are skipped with a counted
 warning, never raised on.
@@ -95,6 +99,8 @@ def aggregate(paths: Iterable[str]) -> dict:
                 if fail:
                     attrs["failure"] = fail.get("reason", "?") \
                         if isinstance(fail, dict) else str(fail)
+                    if isinstance(fail, dict) and fail.get("shard") is not None:
+                        attrs["shard"] = fail["shard"]
                 keyed[(ledger_model, rec["partition_id"])] = attrs
                 continue
             if rtype == "span":
@@ -168,6 +174,7 @@ def aggregate(paths: Iterable[str]) -> dict:
     verdicts = {"sat": 0, "unsat": 0, "unknown": 0}
     via: Dict[str, int] = {}
     degraded: Dict[str, int] = {}  # failure reason -> partition count
+    shards: Dict[str, dict] = {}   # per-shard verdict/degradation rows
     for attrs in list(keyed.values()) + anon:
         v = attrs["verdict"]
         verdicts[v] += 1
@@ -180,6 +187,19 @@ def aggregate(paths: Iterable[str]) -> dict:
             # verdict events), bucketed by machine-readable reason code.
             r = attrs["failure"]
             degraded[r] = degraded.get(r, 0) + 1
+        # Per-shard rows: span-qualified sink stems name the shard's span
+        # (parallel.shards keeps one journal per initial shard); a failure
+        # record's `shard` index labels losses attributed after re-shard.
+        model = str(attrs.get("model", "?"))
+        label = model if "@" in model else (
+            f"shard {attrs['shard']}" if attrs.get("shard") is not None
+            else None)
+        if label is not None:
+            row = shards.setdefault(label, {"sat": 0, "unsat": 0,
+                                            "unknown": 0, "degraded": 0})
+            row[v] += 1
+            if v == "unknown" and attrs.get("failure"):
+                row["degraded"] += 1
     decided = verdicts["sat"] + verdicts["unsat"]
     compile_table = {}
     for kern, row in sorted(compiles.items(),
@@ -212,6 +232,7 @@ def aggregate(paths: Iterable[str]) -> dict:
         "attempted": decided + verdicts["unknown"],
         "via": via,
         "degraded": dict(sorted(degraded.items(), key=lambda kv: -kv[1])),
+        "shards": {k: shards[k] for k in sorted(shards)},
         "models": models,
         "device_launches": int(launches),
         "launches_in_flight_max": int(inflight_max),
@@ -261,6 +282,14 @@ def render(agg: dict) -> str:
         lines.append(f"{'degradation reason':<{w}}  {'partitions':>10}")
         for reason, n in agg["degraded"].items():
             lines.append(f"{reason:<{w}}  {n:>10}")
+    if agg.get("shards"):
+        w = max(max(len(k) for k in agg["shards"]), len("shard"))
+        lines.append("")
+        lines.append(f"{'shard':<{w}}  {'sat':>6}  {'unsat':>6}  "
+                     f"{'unknown':>7}  {'degraded':>8}")
+        for label, row in agg["shards"].items():
+            lines.append(f"{label:<{w}}  {row['sat']:>6}  {row['unsat']:>6}  "
+                         f"{row['unknown']:>7}  {row['degraded']:>8}")
     if agg.get("compiles"):
         w = max(max(len(k) for k in agg["compiles"]), len("kernel"))
         lines.append("")

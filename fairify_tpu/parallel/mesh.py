@@ -16,23 +16,77 @@ Two composable axes:
 """
 from __future__ import annotations
 
+import warnings
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# One warning per process for silent device truncation in make_mesh: every
+# sweep builds meshes repeatedly and a per-call warning would drown the
+# heartbeat, but losing chips silently is exactly how a "multi-chip" run
+# ends up single-chip for weeks.
+_TRUNCATION_WARNED = False
+
+
+def _record_mesh_devices(n_used: int) -> None:
+    """Expose the devices actually in the mesh as the ``mesh_devices`` gauge
+    (vs. ``jax.device_count()``): a truncated mesh is visible in every
+    ``*.throughput.json`` / report snapshot, not just at build time."""
+    from fairify_tpu import obs
+
+    obs.registry().gauge("mesh_devices").set(n_used)
+
 
 def make_mesh(n_parts: Optional[int] = None, n_models: int = 1) -> Mesh:
-    """Mesh over available devices: ``(parts, models)`` axes."""
+    """Mesh over available devices: ``(parts, models)`` axes.
+
+    ``n_parts * n_models`` larger than the visible device count is an
+    error; smaller uses a prefix of the devices and warns once (the rest
+    of the fleet would otherwise idle silently).
+    """
+    global _TRUNCATION_WARNED
     devs = np.array(jax.devices())
     n_parts = n_parts or (len(devs) // n_models)
-    devs = devs[: n_parts * n_models].reshape(n_parts, n_models)
+    used = n_parts * n_models
+    if used > len(devs):
+        raise ValueError(
+            f"make_mesh: requested {n_parts}x{n_models} mesh needs {used} "
+            f"devices but only {len(devs)} are visible")
+    if used < len(devs) and not _TRUNCATION_WARNED:
+        _TRUNCATION_WARNED = True
+        warnings.warn(
+            f"make_mesh: {n_parts}x{n_models} mesh uses {used} of "
+            f"{len(devs)} visible devices; {len(devs) - used} idle "
+            f"(pick n_parts/n_models that factor the fleet, or shard the "
+            f"remainder via parallel.shards)", RuntimeWarning, stacklevel=2)
+    _record_mesh_devices(used)
+    devs = devs[:used].reshape(n_parts, n_models)
     return Mesh(devs, axis_names=("parts", "models"))
 
 
+def submesh(devices: Sequence, n_models: int = 1) -> Mesh:
+    """``(parts, models)`` mesh over an EXPLICIT device subset.
+
+    The shard runtime (:mod:`fairify_tpu.parallel.shards`) rebuilds meshes
+    from whatever devices survive a loss, so the device set is an argument,
+    not ``jax.devices()``.  ``len(devices)`` must be a multiple of
+    ``n_models``; the ``parts`` axis takes the rest.
+    """
+    devs = np.array(list(devices))
+    if len(devs) == 0 or len(devs) % n_models:
+        raise ValueError(
+            f"submesh: {len(devs)} device(s) do not factor into "
+            f"models={n_models}")
+    _record_mesh_devices(len(devs))
+    return Mesh(devs.reshape(len(devs) // n_models, n_models),
+                axis_names=("parts", "models"))
+
+
 def pad_to_multiple(arr: np.ndarray, multiple: int, axis: int = 0):
-    """Pad axis 0 by repeating the last row so it divides the mesh axis.
+    """Pad ``axis`` (default 0) by repeating its last slice so its length
+    divides ``multiple`` (the mesh axis size).
 
     Returns (padded, original_length).  Padded rows recompute an existing
     partition — harmless and branch-free (verdicts are deduplicated by

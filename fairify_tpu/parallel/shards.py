@@ -1,0 +1,363 @@
+"""Sharded sweep runtime: per-shard fault domains + elastic re-sharding.
+
+ROADMAP item 2 makes the (family, chunk) grid multi-chip; this module makes
+it multi-chip *and degradation-safe*.  A **shard** is a contiguous,
+chunk-aligned span of the partition grid bound to a device group; each
+shard runs the normal sweep (:func:`verify.sweep.verify_model`) on a
+``(parts, models)`` submesh built from exactly its devices, inside its own
+:class:`resilience.supervisor.Supervisor` fault domain with three shard-
+level fault sites (``shard.dispatch``, ``shard.gather``, ``device.lost``).
+
+Failure semantics (the blast-radius contract, DESIGN.md §12):
+
+* a **transient** shard fault (``device.lost:transient``, a flaky DCN
+  gather) is absorbed by the shard supervisor's bounded retry — the retry
+  re-runs the shard with ``resume=True`` so already-ledgered verdicts
+  replay instead of recomputing;
+* a **fatal** / retry-exhausted shard fault quarantines the shard's whole
+  device group and **elastically re-shards**: the failed span is re-split
+  at grid-chunk boundaries over the surviving device set, meshes are
+  rebuilt smaller, and the work re-dispatches — down to a single-chip
+  mesh when one device survives;
+* with **no survivors** the remaining spans are ledgered UNKNOWN with a
+  machine-readable ``failure`` record (``site:kind`` + shard index), so a
+  later ``resume=True`` pass re-attempts exactly those partitions.
+
+Verdict determinism: shard boundaries land on multiples of
+``cfg.grid_chunk``, and the stage-0 attack RNG streams are keyed to global
+chunk starts (:func:`verify.sweep._stage0_certify_and_attack`), so decided
+verdicts are shard-count and re-shard invariant; each initial shard span
+keeps ONE journal (``<preset>-<model>@<start>-<stop>.ledger.jsonl``) that
+every re-dispatch of its partitions appends to, and cross-shard merge is
+:func:`verify.sweep.merge_ledgers`' decided-wins semantics.
+
+Shards are dispatched sequentially in-process (the multi-process axis is
+:mod:`fairify_tpu.parallel.multihost`); cross-device parallelism comes
+from the WIDTH of each shard's mesh — ``n_shards=1`` puts the whole fleet
+under one launch (max throughput, coarsest fault domain), ``n_shards=N``
+gives single-device shards (finest blast radius, no cross-device launch).
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+from fairify_tpu import obs
+from fairify_tpu.resilience import faults as faults_mod
+from fairify_tpu.resilience.journal import JournalWriter
+from fairify_tpu.resilience.supervisor import (
+    ChunkDegraded,
+    ChunkFailure,
+    Supervisor,
+)
+
+
+class DeviceLostError(RuntimeError):
+    """A shard's device set is gone (injected ``device.lost:fatal`` or a
+    platform 'device lost'): retrying on the same devices cannot help, so
+    the shard runtime quarantines them and re-shards onto survivors."""
+
+
+@dataclass(frozen=True)
+class Shard:
+    """One dispatch unit: a device group owning a span of the grid."""
+
+    index: int                 # monotone dispatch counter (obs label)
+    devices: Tuple             # the group's jax devices
+    span: Tuple[int, int]      # [start, stop) global partition indices
+    sink_span: Tuple[int, int]  # initial-shard span that names the journal
+
+    @property
+    def sink(self) -> str:
+        return f"{self.sink_span[0]}-{self.sink_span[1]}"
+
+
+def shard_spans(start: int, stop: int, n_shards: int,
+                align: int = 1) -> List[Tuple[int, int]]:
+    """Contiguous balanced spans of ``[start, stop)``, boundaries aligned.
+
+    Interior boundaries land on multiples of ``align`` (the sweep's
+    ``grid_chunk``): the stage-0 attack RNG streams are keyed to global
+    chunk starts, so aligned spans draw exactly the samples a single-shard
+    run would — and a re-split of a failed span cannot move any chunk's
+    seed.  ``n_shards`` is capped at the number of whole chunks.
+    """
+    n = stop - start
+    if n <= 0:
+        return []
+    align = max(1, int(align))
+    blocks = -(-n // align)  # ceil: the final block may be ragged
+    n_shards = max(1, min(int(n_shards), blocks))
+    base, rem = divmod(blocks, n_shards)
+    spans = []
+    b0 = 0
+    for i in range(n_shards):
+        nb = base + (1 if i < rem else 0)
+        spans.append((start + b0 * align,
+                      min(start + (b0 + nb) * align, stop)))
+        b0 += nb
+    return spans
+
+
+def device_groups(devices: Sequence, n_groups: int) -> List[Tuple]:
+    """Balanced contiguous split of ``devices`` into ``n_groups`` tuples."""
+    devices = list(devices)
+    n_groups = max(1, min(int(n_groups), len(devices)))
+    base, rem = divmod(len(devices), n_groups)
+    out = []
+    i = 0
+    for g in range(n_groups):
+        n = base + (1 if g < rem else 0)
+        out.append(tuple(devices[i:i + n]))
+        i += n
+    return out
+
+
+def _shard_mesh(devices: Tuple):
+    """The shard's ``(parts, models)`` submesh over exactly its devices."""
+    from fairify_tpu.parallel.mesh import submesh
+
+    return submesh(devices)
+
+
+def _rewrite_device_lost(failure: ChunkFailure) -> ChunkFailure:
+    """Attribute device-loss failures to the ``device.lost`` site.
+
+    The supervisor labels every failure with its ``run(site=...)`` (the
+    dispatch site); a loss that fired at the ``device.lost`` fault site —
+    or surfaced as :class:`DeviceLostError` — should carry the loss site in
+    its ``site:kind`` reason code so report tables bucket it correctly.
+    """
+    if failure.error == "DeviceLostError" or "device.lost" in failure.detail:
+        return ChunkFailure("device.lost", failure.kind, failure.error,
+                            failure.detail, failure.retries, failure.shard)
+    return failure
+
+
+def sweep_sharded(
+    net,
+    cfg,
+    model_name: str = "model",
+    dataset=None,
+    devices: Optional[Sequence] = None,
+    n_shards: Optional[int] = None,
+    resume: bool = True,
+    partition_span: Optional[Tuple[int, int]] = None,
+    max_rounds: Optional[int] = None,
+):
+    """Run one model's sweep sharded over a device fleet; returns the merged
+    :class:`verify.sweep.ModelReport`.
+
+    ``n_shards`` fault domains over ``devices`` (default: every visible
+    device, one shard per device up to the chunk count).  Each initial
+    shard span owns one journal; re-dispatches after a loss append to the
+    same journal with ``resume=True``, so no decided verdict is ever
+    recomputed and ``resume=True`` on a later call re-attempts exactly the
+    partitions no shard ever decided.
+    """
+    import jax
+
+    from fairify_tpu.verify import sweep as sweep_mod
+
+    devices = list(devices if devices is not None else jax.devices())
+    if not devices:
+        raise ValueError("sweep_sharded: no devices")
+    _, lo, _hi = sweep_mod.build_partitions(cfg)
+    span0 = (0, int(lo.shape[0])) if partition_span is None \
+        else (int(partition_span[0]), int(partition_span[1]))
+    P = span0[1] - span0[0]
+    align = cfg.grid_chunk if cfg.grid_chunk > 0 else max(P, 1)
+    n_shards = int(n_shards) if n_shards else len(devices)
+    init_spans = shard_spans(span0[0], span0[1], min(n_shards, len(devices)),
+                             align)
+    if max_rounds is None:
+        # Every round either finishes work or shrinks the fleet, so the
+        # loop terminates on its own; the cap is a defense against a
+        # pathological schedule, generous enough to never bind in practice.
+        max_rounds = 2 * (len(init_spans) + len(devices)) + 2
+
+    if not resume:
+        # resume=False is a clean slate for THIS run's journals: stale
+        # records from an earlier run must not leak into the re-dispatch
+        # path (which always resumes so a failed attempt's partial work is
+        # kept, never recomputed).
+        for s, e in init_spans:
+            path = sweep_mod._ledger_path(cfg, f"{model_name}@{s}-{e}")
+            if os.path.isfile(path):
+                os.remove(path)
+
+    with obs.span("sweep_sharded", model=model_name, preset=cfg.name,
+                  shards=len(init_spans), devices=len(devices)) as sp, \
+            faults_mod.armed(cfg.inject_faults, seed=cfg.seed):
+        out = _sweep_sharded_impl(
+            net, cfg, model_name, dataset, devices, n_shards, resume,
+            init_spans, P, align, max_rounds, sweep_mod)
+        sp.set(partitions=P, **out.counts)
+        if out.degraded:
+            sp.set(degraded=out.degraded)
+        return out
+
+
+def _sweep_sharded_impl(net, cfg, model_name, dataset, devices, n_shards,
+                        resume, init_spans, P, align, max_rounds, sweep_mod):
+    surviving = list(devices)
+    registry = obs.registry()
+    registry.gauge("mesh_size").set(len(surviving))
+
+    # Work items: (span, sink_span, failure) — failure is the ChunkFailure
+    # that last hit this span's lineage (None until its first loss).  A
+    # re-split keeps the ORIGINAL shard's sink_span, so every re-dispatch
+    # appends to the initial shard journal; carrying the failure per
+    # lineage keeps abandoned spans' ledger records attributed to the
+    # shard/site that actually lost them, not whichever shard failed last.
+    pending = [(sp_, sp_, None) for sp_ in init_spans]
+    reports = []          # ModelReports of completed span runs
+    abandoned = []        # (span, sink_span, ChunkFailure)
+    shard_counter = 0
+    rounds = 0
+
+    def run_one(shard: Shard, first_resume: bool):
+        mesh = _shard_mesh(shard.devices)
+        sup = Supervisor(max_retries=cfg.max_launch_retries,
+                         backoff_s=cfg.launch_backoff_s,
+                         deadline_s=cfg.chunk_deadline_s,
+                         seed=cfg.seed + 101 * (shard.index + 1))
+        state = {"resume": first_resume}
+
+        def dispatch():
+            try:
+                faults_mod.check("device.lost")
+            except faults_mod.InjectedFault as exc:
+                if exc.kind == "fatal":
+                    # Retrying on a dead chip cannot help: surface as a
+                    # loss so the runtime re-shards instead of retrying.
+                    raise DeviceLostError(str(exc)) from exc
+                raise  # transient blip (retried) / crash (propagates)
+            faults_mod.check("shard.dispatch")
+            r, state["resume"] = state["resume"], True
+            return sweep_mod.verify_model(
+                net, cfg, model_name=model_name, dataset=dataset, mesh=mesh,
+                resume=r, partition_span=shard.span,
+                sink_name=f"{model_name}@{shard.sink}")
+
+        with obs.span("shard.run", shard=shard.index,
+                      span=f"{shard.span[0]}-{shard.span[1]}",
+                      devices=len(shard.devices)):
+            rep = sup.run(dispatch, site="shard.dispatch")
+            # The gather site models pulling the shard's verdict summary
+            # back for the cross-shard merge (a DCN fetch on real fleets).
+            sup.run(lambda: faults_mod.check("shard.gather"),
+                    site="shard.gather")
+            return rep
+
+    while pending:
+        if not surviving or rounds >= max_rounds:
+            abandoned.extend(pending)
+            pending = []
+            break
+        groups = device_groups(surviving, min(n_shards, len(surviving)))
+        lost_by = {}  # lost device -> the ChunkFailure that killed its group
+        requeue = []
+        for i, (span, sink_span, lineage_failure) in enumerate(pending):
+            grp = groups[i % len(groups)]
+            dead = next((d for d in grp if d in lost_by), None)
+            if dead is not None:
+                # The group already lost a member this round: don't burn a
+                # retry budget on known-dead hardware, requeue directly —
+                # attributed to the failure that killed the group.
+                requeue.append((span, sink_span, lost_by[dead]))
+                continue
+            shard = Shard(shard_counter, grp, span, sink_span)
+            shard_counter += 1
+            try:
+                rep = run_one(shard, first_resume=resume or rounds > 0)
+            except ChunkDegraded as exc:
+                failure = _rewrite_device_lost(exc.failure)
+                failure.shard = shard.index
+                registry.counter("shard_failures").inc(
+                    site=failure.site, kind=failure.kind)
+                obs.event("shard_failed", **failure.to_record(),
+                          span=f"{span[0]}-{span[1]}",
+                          devices=len(grp))
+                lost_by.update((d, failure) for d in grp)
+                requeue.append((span, sink_span, failure))
+                continue
+            reports.append(rep)
+        if lost_by:
+            surviving = [d for d in surviving if d not in lost_by]
+            registry.gauge("mesh_size").set(len(surviving))
+        if requeue and surviving:
+            # Elastic re-shard: split each failed span over the shrunken
+            # fleet at chunk boundaries; journals stay pinned to the
+            # initial shard span.
+            n_next = min(n_shards, len(surviving))
+            next_pending = []
+            for span, sink_span, lineage_failure in requeue:
+                subs = shard_spans(span[0], span[1], n_next, align)
+                next_pending.extend((s, sink_span, lineage_failure)
+                                    for s in subs)
+                obs.event("reshard", span=f"{span[0]}-{span[1]}",
+                          subspans=len(subs), devices=len(surviving))
+            pending = next_pending
+        else:
+            pending = requeue
+        rounds += 1
+
+    degraded_extra = 0
+    synthesized = []
+    for span, sink_span, failure in abandoned:
+        outs, n_deg = _ledger_abandoned(cfg, model_name, span, sink_span,
+                                        failure, sweep_mod)
+        synthesized.extend(outs)
+        degraded_extra += n_deg
+
+    outcomes = [o for rep in reports for o in rep.outcomes] + synthesized
+    outcomes.sort(key=lambda o: o.partition_id)
+    return sweep_mod.ModelReport(
+        model=model_name, dataset=cfg.dataset, outcomes=outcomes,
+        original_acc=next((r.original_acc for r in reports
+                           if r.original_acc), 0.0),
+        total_time_s=sum(r.total_time_s for r in reports),
+        partitions_total=P, sink_name=model_name,
+        ledger_skipped_lines=sum(r.ledger_skipped_lines for r in reports),
+        degraded=sum(r.degraded for r in reports) + degraded_extra,
+    )
+
+
+def _ledger_abandoned(cfg, model_name, span, sink_span, failure, sweep_mod):
+    """Ledger a span no device could run: UNKNOWN + failure per partition.
+
+    Partitions the failed attempts already settled keep their records
+    (decided-wins); everything else gets a shard-failure record so the
+    degradation is machine-readable and ``resume=True`` re-attempts it.
+    """
+    if failure is None:  # max_rounds safety valve with no recorded failure
+        failure = ChunkFailure(site="shard.dispatch", kind="fatal",
+                               error="ReshardExhausted",
+                               detail="re-shard rounds exhausted")
+    sink = f"{model_name}@{sink_span[0]}-{sink_span[1]}"
+    path = sweep_mod._ledger_path(cfg, sink)
+    done, _degraded, _skipped = sweep_mod.merge_ledgers([path])
+    rec_f = failure.to_record()
+    outs = []
+    n_deg = 0
+    with JournalWriter(path, fault_site=None) as writer:
+        for gi in range(span[0], span[1]):
+            pid = gi + 1
+            rec = done.get(pid)
+            if rec is not None:
+                outs.append(sweep_mod.PartitionOutcome(
+                    pid, rec["verdict"],
+                    counterexample=sweep_mod._ledger_ce(rec.get("ce"))))
+                continue
+            writer.append({"partition_id": pid, "verdict": "unknown",
+                           "failure": rec_f})
+            outs.append(sweep_mod.PartitionOutcome(pid, "unknown"))
+            n_deg += 1
+    if n_deg:
+        obs.registry().counter("chunks_degraded").inc(site=failure.site,
+                                                      n=1)
+        obs.event("degraded", **rec_f, phase="sweep_sharded",
+                  partitions=n_deg)
+    return outs, n_deg

@@ -4,8 +4,7 @@ Real runtime faults (a tunnelled chip dropping a launch, a network
 filesystem tearing an append) are rare and unreproducible; the retry /
 degradation machinery they exercise must not be.  This registry lets a
 test — or an operator via ``--inject-fault`` — schedule exact failures at
-named **sites**, the five places the sweep talks to something that can
-die:
+named **sites**, the places the sweep talks to something that can die:
 
 ====================  =====================================================
 site                  where :func:`check` is called
@@ -15,6 +14,14 @@ site                  where :func:`check` is called
 ``compile``           ``obs.compile.ObsJit`` explicit AOT compile
 ``smt.query``         :func:`verify.smt.decide_box_smt` solver call
 ``ledger.append``     :class:`resilience.journal.JournalWriter` appends
+``shard.dispatch``    :func:`parallel.shards.sweep_sharded` handing a
+                      shard's span to its device group
+``shard.gather``      collecting a completed shard's verdict summary back
+                      into the cross-shard merge
+``device.lost``       a shard's device set dying mid-sweep (``fatal``
+                      triggers elastic re-sharding onto the survivors;
+                      ``transient`` models a link blip the shard
+                      supervisor's retry absorbs)
 ====================  =====================================================
 
 A **spec** is ``site:kind:nth``:
@@ -44,7 +51,8 @@ from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Tuple
 
 FAULT_SITES = frozenset(
-    {"launch.submit", "launch.decode", "compile", "smt.query", "ledger.append"})
+    {"launch.submit", "launch.decode", "compile", "smt.query", "ledger.append",
+     "shard.dispatch", "shard.gather", "device.lost"})
 FAULT_KINDS = frozenset({"transient", "fatal", "crash"})
 
 _SPEC_RE = re.compile(

@@ -78,6 +78,10 @@ class ChunkFailure:
     error: str           # exception type name
     detail: str          # str(exception), truncated
     retries: int = 0     # re-attempts actually spent
+    # Shard index when the failure happened in a shard-level fault domain
+    # (parallel.shards); None for single-chip chunk failures.  Lands in the
+    # ledger record so `fairify_tpu report` can bucket degradation per shard.
+    shard: Optional[int] = None
 
     @property
     def reason(self) -> str:
@@ -85,9 +89,12 @@ class ChunkFailure:
         return f"{self.site}:{self.kind}"
 
     def to_record(self) -> dict:
-        return {"reason": self.reason, "site": self.site, "kind": self.kind,
-                "error": self.error, "detail": self.detail[:200],
-                "retries": self.retries}
+        rec = {"reason": self.reason, "site": self.site, "kind": self.kind,
+               "error": self.error, "detail": self.detail[:200],
+               "retries": self.retries}
+        if self.shard is not None:
+            rec["shard"] = self.shard
+        return rec
 
 
 class ChunkDegraded(RuntimeError):

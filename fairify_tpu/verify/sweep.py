@@ -687,12 +687,19 @@ def verify_model(
     partition_span=None,
     host_index=None,
     host_count=None,
+    sink_name=None,
 ) -> ModelReport:
     """Run the full sweep for one model; write CSV + ledger rows as we go.
 
     ``cfg.trace_out`` activates the obs span tracer for this call unless an
     outer scope (CLI ``--trace-out``, ``run_sweep``) already owns one; the
     model-level span carries the final verdict counts as attributes.
+
+    ``sink_name`` overrides the derived result-sink base name (normally
+    ``model`` or span-qualified ``model@start-stop``): the shard runtime
+    (:mod:`fairify_tpu.parallel.shards`) pins every re-dispatch of a failed
+    shard's partitions to the INITIAL shard's journal, so a span keeps one
+    ledger across elastic re-shards.
     """
     from fairify_tpu.obs import heartbeat as hb_mod
 
@@ -703,7 +710,7 @@ def verify_model(
             try:
                 rep = _verify_model_impl(
                     net, cfg, model_name, dataset, mesh, resume, retry_unknown,
-                    stage0, partition_span, host_index, host_count)
+                    stage0, partition_span, host_index, host_count, sink_name)
             except BaseException:
                 # The impl registers this run's heartbeat as the live one
                 # (compile flags); a raise would otherwise leak it, and
@@ -730,6 +737,7 @@ def _verify_model_impl(
     partition_span,
     host_index,
     host_count,
+    sink_override,
 ) -> ModelReport:
     from fairify_tpu.utils.cache import enable_persistent_cache
 
@@ -757,6 +765,8 @@ def _verify_model_impl(
         # Hosts may share result_dir (network fs): qualify sinks by span so
         # concurrent appends never interleave.
         sink_name = f"{model_name}@{span_start}-{span_stop}"
+    if sink_override is not None:
+        sink_name = sink_override
     P = len(p_list)
     if P == 0:  # e.g. more hosts than partitions — an empty but valid span
         return ModelReport(model=model_name, dataset=cfg.dataset, outcomes=[],
@@ -1343,6 +1353,7 @@ def _verify_model_impl(
 def run_sweep(
     cfg: SweepConfig, model_root=None, data_root=None, mesh=None, stack: bool = True,
     host_index=None, host_count=None, retry_unknown: bool = False,
+    n_shards=None,
 ) -> List[ModelReport]:
     """Sweep every model of the configured family (the drivers' outer loop).
 
@@ -1354,17 +1365,34 @@ def run_sweep(
     process sweeps only its :func:`fairify_tpu.parallel.multihost.host_slice`
     span of every model (family stacking is disabled — stage-0 results are
     span-local).
+
+    ``n_shards`` routes every model through the fault-domain sharded runtime
+    (:func:`fairify_tpu.parallel.shards.sweep_sharded`): the grid is split
+    into per-shard spans over the visible devices, a shard loss elastically
+    re-shards onto survivors, and cross-shard verdicts merge decided-wins.
+    Mutually exclusive with ``host_count`` (shard *within* each host slice
+    by calling ``sweep_sharded`` with ``partition_span`` directly).
     """
+    if n_shards and host_count is not None:
+        raise ValueError("run_sweep: n_shards and host_count are mutually "
+                         "exclusive (call shards.sweep_sharded with "
+                         "partition_span to shard inside a host slice)")
+    if n_shards and retry_unknown:
+        raise ValueError("run_sweep: retry_unknown is not supported with "
+                         "n_shards yet — resume=True re-attempts degraded "
+                         "partitions; budget UNKNOWNs stay settled")
     with obs.maybe_tracing(cfg.trace_out, run_id=cfg.name):
         with obs.span("run_sweep", preset=cfg.name, dataset=cfg.dataset) as sp:
             reports = _run_sweep_impl(cfg, model_root, data_root, mesh, stack,
-                                      host_index, host_count, retry_unknown)
+                                      host_index, host_count, retry_unknown,
+                                      n_shards)
             sp.set(models=len(reports))
             return reports
 
 
 def _run_sweep_impl(cfg, model_root, data_root, mesh, stack,
-                    host_index, host_count, retry_unknown) -> List[ModelReport]:
+                    host_index, host_count, retry_unknown,
+                    n_shards=None) -> List[ModelReport]:
     import sys
 
     from fairify_tpu.utils.cache import enable_persistent_cache
@@ -1380,6 +1408,18 @@ def _run_sweep_impl(cfg, model_root, data_root, mesh, stack,
               file=sys.stderr)
     if not nets:
         return []
+
+    if n_shards:
+        # Sharded runtime: per-shard fault domains + elastic re-shard.
+        # Family stacking is disabled for the same reason as multi-host
+        # (stage-0 family results are grid-global, shards are span-local).
+        from fairify_tpu.parallel import shards as shards_mod
+
+        return [
+            shards_mod.sweep_sharded(net, cfg, model_name=name,
+                                     dataset=dataset, n_shards=n_shards)
+            for name, net in nets.items()
+        ]
 
     stage0_by_model = {}
     if host_count is not None:

@@ -56,13 +56,17 @@ def model_natkey(name: str):
 
 
 def run_and_record(cfg, run_id: str, results_path: str, extra=None,
-                   model_filter=None, done=None) -> list:
+                   model_filter=None, done=None, n_shards=None) -> list:
     """Sweep every not-yet-recorded zoo model under ``cfg``; append records.
 
     Returns the newly appended records (verified rows plus ``skipped``
     markers for width-mismatched models).  Observability flows through the
     config: set ``cfg.trace_out`` / ``cfg.heartbeat_s`` and
-    ``sweep.run_sweep`` owns the tracer scope.
+    ``sweep.run_sweep`` owns the tracer scope.  ``n_shards`` routes the
+    sweep through the fault-domain sharded runtime
+    (``parallel.shards.sweep_sharded`` — per-shard journals merge with the
+    same ``model@span`` ledger convention :func:`merge_span_ledgers`
+    already unions, so resumable recording composes with sharding).
     """
     from fairify_tpu.models import zoo
     from fairify_tpu.verify import sweep
@@ -84,7 +88,8 @@ def run_and_record(cfg, run_id: str, results_path: str, extra=None,
         return []
     print(f"== {run_id}: {todo}", flush=True)
     t0 = time.perf_counter()
-    reports = sweep.run_sweep(cfg.with_(models=tuple(todo)))
+    reports = sweep.run_sweep(cfg.with_(models=tuple(todo)),
+                              n_shards=n_shards)
     recs = []
     for rep in reports:
         counts = rep.counts

@@ -17,8 +17,15 @@ Every cell's schedule is printed in its JSON row, so any failure is
 reproducible with ``fairify_tpu run --inject-fault <spec>``.  Exit 1 if
 any cell violates the contract.
 
+Shard-loss cells (``parallel.shards``) extend the matrix to the sharded
+runtime: ``device.lost`` at each shard index × {transient, fatal}.  A
+transient loss must be absorbed by the shard supervisor (verdict map
+IDENTICAL, nothing degraded); a fatal loss must quarantine the shard's
+device group, elastically re-shard its span onto the survivors, and still
+converge to the fault-free verdict map without a resume pass.
+
 Usage: python scripts/chaos_matrix.py [--out chaos] [--span 48]
-           [--grid-chunk 16] [--preset GC]
+           [--grid-chunk 16] [--preset GC] [--shards 3]
 """
 from __future__ import annotations
 
@@ -30,6 +37,14 @@ import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
+
+# The shard-loss cells need a device fleet; pin the virtual CPU mesh
+# BEFORE jax initializes (same contract as tests/conftest.py).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 # Transient cells use nth=2 (one retry absorbs it: verdicts must be
 # IDENTICAL, not just consistent); exhausting cells use 2+ (every arrival
@@ -64,6 +79,9 @@ def main() -> int:
     ap.add_argument("--preset", default="GC")
     ap.add_argument("--span", type=int, default=48)
     ap.add_argument("--grid-chunk", type=int, default=16)
+    ap.add_argument("--shards", type=int, default=3,
+                    help="fault domains for the shard-loss cells "
+                         "(0 disables them)")
     args = ap.parse_args()
 
     from fairify_tpu.models.train import init_mlp
@@ -154,6 +172,60 @@ def main() -> int:
         row["ok"] = row["crashed"] and row["resume_converged"]
         failures += 0 if row["ok"] else 1
         print(json.dumps(row), flush=True)
+
+    # Shard-loss cells: device.lost at each shard index × transient/fatal
+    # over the sharded runtime.  The fault-free SHARDED run is the pin —
+    # it must itself equal the single-chip map (cross-path invariance).
+    if args.shards:
+        import jax
+
+        from fairify_tpu.obs import metrics as metrics_mod
+        from fairify_tpu.parallel import shards as shards_mod
+
+        n_sh = min(args.shards, len(jax.devices()))
+        sh_base = shards_mod.sweep_sharded(
+            net, cfg0.with_(result_dir=os.path.join(args.out, "shard_base")),
+            model_name="m", n_shards=n_sh, partition_span=span, resume=False)
+        row = {"cell": "shard/fault-free", "shards": n_sh,
+               "matches_single_chip": _vmap(sh_base) == want}
+        failures += 0 if row["matches_single_chip"] else 1
+        print(json.dumps(row), flush=True)
+
+        for k in range(n_sh):
+            for kind in ("transient", "fatal"):
+                spec = f"device.lost:{kind}:{k + 1}"
+                rdir = os.path.join(args.out, f"shard{k}_{kind}")
+                cfg = cfg0.with_(result_dir=rdir, inject_faults=(spec,))
+                row = {"cell": f"device.lost/shard{k}/{kind}", "spec": spec}
+                fail_ctr = metrics_mod.registry().counter("shard_failures")
+                f0 = fail_ctr.total()
+                try:
+                    rep = shards_mod.sweep_sharded(
+                        net, cfg, model_name="m", n_shards=n_sh,
+                        partition_span=span, resume=False)
+                except BaseException as exc:  # clause 1: must not crash
+                    row["crashed"] = f"{type(exc).__name__}: {exc}"
+                    row["ok"] = False
+                    failures += 1
+                    print(json.dumps(row), flush=True)
+                    continue
+                got = _vmap(rep)
+                decided_match = all(got[p] == want[p] for p in got
+                                    if got[p] != "unknown")
+                row.update(degraded=rep.degraded, **rep.counts,
+                           decided_match=decided_match,
+                           shard_failures=fail_ctr.total() - f0)
+                if kind == "transient":
+                    # Absorbed by the shard supervisor's retry: identical
+                    # map, nothing degraded, no shard failure recorded.
+                    row["ok"] = bool(got == want and rep.degraded == 0)
+                else:
+                    # Quarantine + elastic re-shard: the lost shard's span
+                    # is re-decided on the survivors, so the FULL map must
+                    # converge without any resume pass.
+                    row["ok"] = bool(got == want and row["shard_failures"] >= 1)
+                failures += 0 if row["ok"] else 1
+                print(json.dumps(row), flush=True)
 
     print(json.dumps({"cells_failed": failures}), flush=True)
     return 1 if failures else 0

@@ -24,6 +24,21 @@ Lower-is-better counters (``device_launches``, ``n_compiles``,
 tolerance: launch/compile counts are deterministic per config, so growth
 means a lost fusion or fresh shape churn.
 
+**MULTICHIP records** (``MULTICHIP_r*.json``, and the richer output of
+``scripts/multichip_scaling.py``) are a third shape: a single JSON object
+with ``n_devices``/``ok`` plus optionally per-mesh-size throughput
+(``model_partitions_per_sec: {"1": x, "8": y}``) and the 1→N ``scaling_x``
+ratio.  They gate as higher-is-better zero-width-band metrics
+(``multichip.ok``, ``multichip.n_devices``, ``multichip.pps@<n>dev``,
+``multichip.scaling_x``): an ``ok`` flip or a fleet shrunken by even one
+device fails outright (deterministic metrics gate strictly), while the
+single-sample throughput/scaling numbers fail past the band-less noise
+tolerance (``--rel-tol``).  ``ok`` means run-health in BOTH record
+shapes (the driver's dry-run success; the scaling harness's cross-mesh
+verdict consistency), so a minimal driver baseline gates a rich scaling
+candidate: the throughput metrics simply join the gate once both sides
+carry them.
+
 ``--self-test`` runs the built-in contract checks (wired into tier-1 via
 ``tests/test_perfdiff.py``): identical records pass, a 2x slowdown fails,
 overlapping noisy bands pass, doubled launches fail.
@@ -65,6 +80,44 @@ def _bench_record(obj: dict) -> Optional[dict]:
     return rec
 
 
+def _flat(v: float, strict: bool = False) -> dict:
+    """Zero-width-band record for a single-sample metric.
+
+    ``strict`` marks a deterministic metric (a flag, a device count): ANY
+    decrease is a regression, no noise tolerance applies.
+    """
+    v = float(v)
+    rec = {"value": v, "min": v, "max": v, "banded": False}
+    if strict:
+        rec["strict"] = True
+    return rec
+
+
+def _multichip_records(obj: dict) -> Dict[str, dict]:
+    """Metrics of one MULTICHIP record (``n_devices`` marks the shape).
+
+    The minimal driver records ({n_devices, rc, ok}) gate on the ok flag
+    and the fleet size; ``scripts/multichip_scaling.py`` adds per-mesh
+    throughput and the 1→N scaling factor, each its own gated metric.
+    The ok flag and fleet size are deterministic, so they gate strictly —
+    losing ONE chip fails; the throughput/scaling numbers are single
+    samples and keep the band-less noise tolerance.
+    """
+    if "n_devices" not in obj or "metric" in obj:
+        return {}
+    out: Dict[str, dict] = {}
+    if "ok" in obj:
+        out["multichip.ok"] = _flat(1.0 if obj["ok"] else 0.0, strict=True)
+    out["multichip.n_devices"] = _flat(obj["n_devices"], strict=True)
+    pps = obj.get("model_partitions_per_sec")
+    if isinstance(pps, dict):
+        for n, v in pps.items():
+            out[f"multichip.pps@{n}dev"] = _flat(v)
+    if obj.get("scaling_x") is not None:
+        out["multichip.scaling_x"] = _flat(obj["scaling_x"])
+    return out
+
+
 def load_records(path: str) -> Dict[str, dict]:
     """Metric key → record.  Accepts bench JSONL (one object per line) or a
     single throughput/headline JSON object; unparseable lines are skipped
@@ -91,6 +144,10 @@ def load_records(path: str) -> Dict[str, dict]:
         rec = _bench_record(obj)
         if rec is not None:
             out[_metric_key(obj["metric"])] = rec
+            continue
+        mc = _multichip_records(obj)
+        if mc:
+            out.update(mc)
             continue
         # Throughput JSON: every rate present gets its own zero-width-band
         # record (total AND per-chip — a device-count change can hold one
@@ -120,8 +177,12 @@ def compare(base: Dict[str, dict], cand: Dict[str, dict],
             findings.append({"metric": key, "kind": "missing",
                              "detail": "metric absent from candidate"})
             continue
-        # Higher-is-better rate with the noise-band rule.
-        guard = rel_guard if (b["banded"] and c["banded"]) else rel_tol
+        # Higher-is-better rate with the noise-band rule; strict metrics
+        # (deterministic flags/counts) regress on ANY decrease.
+        if b.get("strict"):
+            guard = 0.0
+        else:
+            guard = rel_guard if (b["banded"] and c["banded"]) else rel_tol
         gap = b["min"] - c["max"]
         if gap > 0 and gap > guard * max(abs(b["value"]), 1e-12):
             findings.append({
@@ -206,6 +267,28 @@ def self_test() -> int:
                        "n_compiles": 6, "compile_s": 14.0}}
     jitter = {"pps": {"value": 50.0, "min": 46.0, "max": 53.0, "banded": True,
                       "n_compiles": 0, "compile_s": 0.3}}
+    mc_base = _multichip_records(
+        {"n_devices": 8, "ok": True,
+         "model_partitions_per_sec": {"1": 100.0, "8": 450.0},
+         "scaling_x": 4.5})
+    mc_same = dict(mc_base)
+    mc_broken = _multichip_records(
+        {"n_devices": 8, "ok": False,
+         "model_partitions_per_sec": {"1": 100.0, "8": 450.0},
+         "scaling_x": 4.5})
+    mc_flat = _multichip_records(
+        {"n_devices": 8, "ok": True,
+         "model_partitions_per_sec": {"1": 100.0, "8": 110.0},
+         "scaling_x": 1.1})
+    mc_shrunk = _multichip_records({"n_devices": 4, "ok": True})
+    mc_one_lost = _multichip_records(
+        {"n_devices": 7, "ok": True,
+         "model_partitions_per_sec": {"1": 100.0, "8": 450.0},
+         "scaling_x": 4.5})
+    mc_jitter = _multichip_records(
+        {"n_devices": 8, "ok": True,
+         "model_partitions_per_sec": {"1": 98.0, "8": 430.0},
+         "scaling_x": 4.4})
     checks = [
         ("identical records pass", compare(base, same), 0),
         ("2x slowdown flagged", compare(base, slow), 1),
@@ -215,6 +298,17 @@ def self_test() -> int:
          compare(warm, churned), 2),
         ("cache-reload jitter over a 0 baseline passes",
          compare(warm, jitter), 0),
+        ("identical multichip records pass", compare(mc_base, mc_same), 0),
+        ("multichip ok flip flagged", compare(mc_base, mc_broken), 1),
+        ("lost multichip scaling flagged (pps@8dev + scaling_x)",
+         compare(mc_base, mc_flat), 2),
+        ("shrunken fleet flagged",
+         compare(_multichip_records({"n_devices": 8, "ok": True}), mc_shrunk),
+         1),
+        ("single lost device flagged (strict n_devices)",
+         compare(mc_base, mc_one_lost), 1),
+        ("in-tolerance throughput jitter passes",
+         compare(mc_base, mc_jitter), 0),
     ]
     failed = 0
     for name, findings, want in checks:

@@ -1,3 +1,4 @@
 # rel: fairify_tpu/resilience/faults.py
-FAULT_SITES = frozenset({"demo.used", "demo.orphan"})  # EXPECT
+FAULT_SITES = frozenset({"demo.used", "demo.orphan", "shard.dispatch",  # EXPECT
+                         "shard.gather", "device.lost"})
 FAULT_KINDS = frozenset({"transient", "fatal", "crash"})

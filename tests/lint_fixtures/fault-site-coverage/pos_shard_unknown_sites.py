@@ -1,0 +1,12 @@
+# rel: fairify_tpu/parallel/fx_shard_typos.py
+from fairify_tpu.resilience import faults
+
+
+def dispatch_shard_typoed(journal_cls, path, run):
+    # Misspelled shard-runtime sites: every spec targeting them is rejected
+    # at the CLI while these paths run unprotected — each must be flagged.
+    faults.check("shard.dispach")  # EXPECT
+    rep = run()
+    faults.check("device.gone")  # EXPECT
+    journal_cls(path, fault_site="shard.gathr")  # EXPECT
+    return rep

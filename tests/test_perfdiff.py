@@ -146,5 +146,44 @@ def test_unreadable_baseline_is_an_error(tmp_path):
     assert perfdiff.main([str(a), str(b)]) == 2
 
 
+def test_multichip_record_gating(tmp_path):
+    """MULTICHIP records (n_devices/ok + optional per-mesh throughput and
+    scaling factor) load as gated metrics: an ok flip, a shrunken fleet,
+    or a lost scaling factor each fail; identical records pass."""
+    base = {"n_devices": 8, "rc": 0, "ok": True,
+            "model_partitions_per_sec": {"1": 100.0, "8": 450.0},
+            "scaling_x": 4.5}
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(base))
+    assert set(perfdiff.load_records(str(a))) == {
+        "multichip.ok", "multichip.n_devices", "multichip.pps@1dev",
+        "multichip.pps@8dev", "multichip.scaling_x"}
+    b.write_text(json.dumps(base))
+    assert perfdiff.main([str(a), str(b)]) == 0
+    b.write_text(json.dumps({**base, "ok": False}))
+    assert perfdiff.main([str(a), str(b)]) == 1
+    b.write_text(json.dumps({**base, "scaling_x": 1.1,
+                             "model_partitions_per_sec": {"1": 100.0,
+                                                          "8": 110.0}}))
+    assert perfdiff.main([str(a), str(b)]) == 1
+    # ok and n_devices are deterministic, so they gate strictly: losing
+    # even ONE device of the fleet fails (no 20% noise tolerance).
+    b.write_text(json.dumps({**base, "n_devices": 7}))
+    assert perfdiff.main([str(a), str(b)]) == 1
+    # The minimal driver record shape ({n_devices, rc, ok, ...}) still
+    # gates on the ok flag and the fleet size.
+    a.write_text(json.dumps({"n_devices": 8, "rc": 0, "ok": True,
+                             "skipped": False, "tail": ""}))
+    b.write_text(json.dumps({"n_devices": 4, "rc": 0, "ok": True}))
+    assert perfdiff.main([str(a), str(b)]) == 1
+    # Cross-shape: a minimal driver baseline gates a rich scaling-harness
+    # candidate (the README recipe) — ok means run-health in both shapes,
+    # throughput metrics join the gate only once both sides carry them.
+    b.write_text(json.dumps(base))
+    assert perfdiff.main([str(a), str(b)]) == 0
+    b.write_text(json.dumps({**base, "ok": False}))
+    assert perfdiff.main([str(a), str(b)]) == 1
+
+
 def test_self_test_cli_flag():
     assert perfdiff.main(["--self-test"]) == 0

@@ -619,6 +619,159 @@ def test_smt_injected_fault_maps_to_unknown_reason():
 
 
 # ---------------------------------------------------------------------------
+# sharded sweeps: per-shard fault domains + elastic re-sharding
+# ---------------------------------------------------------------------------
+
+
+def _sharded(tmp_path, name, spec=None, resume=False, **kw):
+    from fairify_tpu.parallel import shards as shards_mod
+
+    cfg = _cfg(tmp_path, name,
+               **({"inject_faults": (spec,)} if spec else {}))
+    return cfg, shards_mod.sweep_sharded(
+        _net(), cfg, model_name="m", n_shards=3, partition_span=SPAN,
+        resume=resume, **kw)
+
+
+def test_sharded_fault_free_matches_plain(tmp_path, fault_free):
+    """Cross-path pin: the sharded runtime (submeshed stage 0, per-shard
+    journals) reproduces the single-chip verdict map bit-equal."""
+    _cfg_, rep = _sharded(tmp_path, "sh_ff")
+    assert _vmap(rep) == fault_free
+    assert rep.degraded == 0
+
+
+def test_device_lost_fatal_reshards_and_converges(tmp_path, fault_free):
+    """Killing shard 1's device group mid-sweep: the group is quarantined,
+    its span elastically re-shards onto the 5 survivors, and the FULL
+    verdict map still equals fault-free — no resume pass needed."""
+    import jax
+
+    cfg, rep = _sharded(tmp_path, "sh_dl", spec="device.lost:fatal:2")
+    assert _vmap(rep) == fault_free
+    assert rep.degraded == 0
+    assert metrics_mod.registry().counter("shard_failures").value(
+        site="device.lost", kind="fatal") == 1
+    # The mesh_size gauge tracks the surviving fleet: 8 minus the lost
+    # 3-device group of shard index 1 (groups split 3/3/2).
+    assert metrics_mod.registry().gauge("mesh_size").value() \
+        == len(jax.devices()) - 3
+
+
+def test_device_lost_transient_absorbed(tmp_path, fault_free):
+    """A transient device.lost (link blip) is absorbed by the shard
+    supervisor's retry: identical map, nothing degraded, no quarantine —
+    and sweep_sharded never raises (acceptance clause)."""
+    _cfg_, rep = _sharded(tmp_path, "sh_tr", spec="device.lost:transient:2")
+    assert _vmap(rep) == fault_free
+    assert rep.degraded == 0
+    assert metrics_mod.registry().counter("shard_failures").total() == 0
+
+
+def test_all_devices_lost_degrades_then_resume_converges(tmp_path, fault_free):
+    """Every dispatch loses its device group: all partitions are ledgered
+    UNKNOWN with a machine-readable device.lost failure (carrying the shard
+    index), and resume=True on a healthy fleet re-attempts exactly those."""
+    cfg, rep = _sharded(tmp_path, "sh_all", spec="device.lost:fatal:1+")
+    got = _vmap(rep)
+    assert set(got.values()) == {"unknown"}
+    assert rep.degraded == rep.partitions_total == SPAN[1] - SPAN[0]
+    n_failures = 0
+    for k, (s, e) in enumerate(((0, 16), (16, 32), (32, 48))):
+        path = os.path.join(cfg.result_dir,
+                            f"{cfg.name}-m@{s}-{e}.ledger.jsonl")
+        with open(path) as fp:
+            failures = [json.loads(l)["failure"] for l in fp if l.strip()]
+        n_failures += len(failures)
+        assert all(f["reason"] == "device.lost:fatal" for f in failures)
+        # Failure attribution is per lineage, not whichever shard failed
+        # LAST: all three initial dispatches fail in round 0 (indices
+        # 0/1/2 in span order), so span k's records carry shard=k.
+        assert {f.get("shard") for f in failures} == {k}
+    assert n_failures == rep.partitions_total
+
+    from fairify_tpu.parallel import shards as shards_mod
+
+    resumed = shards_mod.sweep_sharded(
+        _net(), cfg.with_(inject_faults=()), model_name="m", n_shards=3,
+        partition_span=SPAN, resume=True)
+    assert _vmap(resumed) == fault_free
+    assert resumed.degraded == 0
+
+
+@pytest.mark.parametrize("spec", [
+    "shard.dispatch:fatal:1",
+    "shard.gather:transient:1",
+])
+def test_shard_site_faults_never_lose_verdicts(tmp_path, fault_free, spec):
+    """A fatal dispatch fault re-shards (same map); a transient gather
+    fault retries the shard with resume=True, replaying — not recomputing —
+    its already-ledgered verdicts."""
+    _cfg_, rep = _sharded(tmp_path, "sh_site", spec=spec)
+    assert _vmap(rep) == fault_free
+    assert rep.degraded == 0
+
+
+def test_merge_ledgers_across_interleaved_shard_journals(tmp_path):
+    """Cross-shard decided-wins: interleaved per-shard journals (a failed
+    attempt's partial records + the re-shard's re-decisions) merge to one
+    settled map, and torn lines are counted across ALL shard files."""
+    fail = {"reason": "device.lost:fatal", "site": "device.lost",
+            "kind": "fatal", "error": "DeviceLostError", "detail": "",
+            "retries": 0, "shard": 1}
+    paths = []
+    for k, recs in enumerate((
+            [{"partition_id": 1, "verdict": "unsat"},
+             {"partition_id": 2, "verdict": "unknown", "failure": fail}],
+            [{"partition_id": 17, "verdict": "unknown", "failure": fail},
+             {"partition_id": 17, "verdict": "sat", "ce": None}],
+            [{"partition_id": 33, "verdict": "unknown"}])):
+        p = str(tmp_path / f"GC-m@{k * 16}-{(k + 1) * 16}.ledger.jsonl")
+        with open(p, "w") as fp:
+            for rec in recs:
+                fp.write(json.dumps(rec) + "\n")
+        paths.append(p)
+    with open(paths[0], "a") as fp:
+        fp.write('{"partition_id": 3, "verd')  # torn mid-append
+    with open(paths[2], "a") as fp:
+        fp.write('{"partition_id": 34, "ver')  # torn in another shard
+    done, degraded, skipped = sweep.merge_ledgers(paths)
+    assert done[1]["verdict"] == "unsat"
+    assert 2 in degraded and 2 not in done      # loss: not settled
+    assert done[17]["verdict"] == "sat"         # re-shard re-decision wins
+    assert 17 not in degraded
+    assert done[33]["verdict"] == "unknown"     # budget UNKNOWN: settled
+    assert skipped == 2                         # torn lines sum across files
+
+
+def test_report_renders_per_shard_table(tmp_path, capsys):
+    """Shard journals passed to `fairify_tpu report` produce the per-shard
+    degradation table: span-labelled rows with verdict + degraded counts."""
+    from fairify_tpu.obs import report as report_mod
+
+    fail = {"reason": "device.lost:fatal", "site": "device.lost",
+            "kind": "fatal", "error": "DeviceLostError", "detail": "",
+            "retries": 0, "shard": 2}
+    p1 = str(tmp_path / "GC-m@0-16.ledger.jsonl")
+    p2 = str(tmp_path / "GC-m@16-32.ledger.jsonl")
+    with open(p1, "w") as fp:
+        fp.write(json.dumps({"partition_id": 1, "verdict": "unsat"}) + "\n")
+        fp.write(json.dumps({"partition_id": 2, "verdict": "sat"}) + "\n")
+    with open(p2, "w") as fp:
+        for pid in (17, 18):
+            fp.write(json.dumps({"partition_id": pid, "verdict": "unknown",
+                                 "failure": fail}) + "\n")
+    agg = report_mod.aggregate([p1, p2])
+    assert agg["shards"] == {
+        "GC-m@0-16": {"sat": 1, "unsat": 1, "unknown": 0, "degraded": 0},
+        "GC-m@16-32": {"sat": 0, "unsat": 0, "unknown": 2, "degraded": 2}}
+    assert agg["degraded"] == {"device.lost:fatal": 2}
+    assert report_mod.main([p1, p2]) == 0
+    text = capsys.readouterr().out
+    assert "shard" in text and "GC-m@16-32" in text
+
+
+# ---------------------------------------------------------------------------
 # lint: bare-except / swallowed-BaseException rule
 # ---------------------------------------------------------------------------
 
