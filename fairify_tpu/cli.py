@@ -236,6 +236,92 @@ def _experiment_traced(args, cfg) -> int:
     return 0
 
 
+def _cmd_serve(args) -> int:
+    """Run the persistent verification server (DESIGN.md §13).
+
+    Owns the device and its warm ``obs_jit`` kernel cache for its whole
+    lifetime; requests arrive through the spool inbox (``fairify_tpu
+    submit``) and coalesce into shared launches.  SIGTERM/SIGINT drain
+    gracefully: in-flight work finishes, queued requests are journaled
+    back to the inbox for the next server's ``resume=True`` pickup.
+    """
+    import signal
+    import threading
+
+    from fairify_tpu import obs
+    from fairify_tpu.serve import ServeConfig, VerificationServer
+
+    scfg = ServeConfig(
+        spool=args.spool, batch_window_s=args.batch_window,
+        max_batch=args.max_batch, span_chunks=args.span_chunks,
+        poll_s=args.poll_interval, default_deadline_s=args.default_deadline,
+        n_shards=args.shards)
+    stop = threading.Event()
+
+    def _sig(_signum, _frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    with obs.tracing(args.trace_out, run_id="serve"):
+        srv = VerificationServer(scfg).start()
+        print(f"fairify_tpu serve: spool={args.spool} "
+              f"batch_window={scfg.batch_window_s}s max_batch={scfg.max_batch}"
+              f" (SIGTERM drains)", file=sys.stderr)
+        worker_died = False
+        while not stop.wait(timeout=1.0):
+            if not srv.alive():
+                # A propagate-class crash killed the worker; without this
+                # check the process would advertise a live server whose
+                # inbox is never scanned again.
+                worker_died = True
+                print("fairify_tpu serve: worker thread died — draining",
+                      file=sys.stderr)
+                break
+        requeued = srv.drain()
+    print(json.dumps({"drained": True, "worker_died": worker_died,
+                      "requeued": [r.id for r in requeued]}))
+    return 1 if worker_died else 0
+
+
+def _cmd_submit(args) -> int:
+    """Submit one verification job to a running server's spool."""
+    from fairify_tpu.serve import client
+
+    overrides = {}
+    if args.soft_timeout is not None:
+        overrides["soft_timeout_s"] = float(args.soft_timeout)
+    if args.hard_timeout is not None:
+        overrides["hard_timeout_s"] = float(args.hard_timeout)
+    if args.seed is not None:
+        overrides["seed"] = int(args.seed)
+    if args.grid_chunk is not None:
+        overrides["grid_chunk"] = int(args.grid_chunk)
+    init = None
+    if args.init_sizes:
+        init = {"sizes": args.init_sizes, "seed": args.init_seed}
+    try:
+        payload = client.build_payload(
+            args.preset, model=args.model, init=init,
+            overrides=overrides or None, deadline_s=args.deadline,
+            span=tuple(args.span) if args.span else None,
+            model_root=args.model_root)
+    except ValueError as exc:
+        print(f"submit: {exc}", file=sys.stderr)
+        return 2
+    req_id = client.submit(args.spool, payload)
+    if args.wait is None:
+        print(json.dumps({"request": req_id, "status": "submitted"}))
+        return 0
+    rec = client.wait(args.spool, req_id,
+                      timeout=args.wait if args.wait > 0 else None)
+    if rec is None:
+        print(json.dumps({"request": req_id, "status": "pending"}))
+        return 3
+    print(json.dumps(rec))
+    return 0 if rec.get("status") == "done" else 1
+
+
 def _cmd_lint(args) -> int:
     """Run the static-analysis rule engine (DESIGN.md §11) over fairify_tpu/."""
     from fairify_tpu.lint import core as lint_core
@@ -394,6 +480,65 @@ def main(argv=None) -> int:
     met.add_argument("--model-root", default=None)
     met.add_argument("--data-root", default=None)
 
+    srv = sub.add_parser(
+        "serve", help="persistent verification server: warm kernel cache, "
+                      "cross-request batching, SLA-aware admission "
+                      "(DESIGN.md §13)")
+    srv.add_argument("--spool", required=True,
+                     help="service directory: inbox/ for submits, "
+                          "requests/<id>/ for results, serve.journal.jsonl "
+                          "for lifecycle records")
+    srv.add_argument("--batch-window", type=float, default=0.05,
+                     help="coalescing window after the first queued request "
+                          "(seconds; default 0.05)")
+    srv.add_argument("--max-batch", type=int, default=8,
+                     help="most requests coalesced per batch (default 8)")
+    srv.add_argument("--span-chunks", type=int, default=0,
+                     help="refinement granule in grid chunks: 0 = one "
+                          "verify_model call per request, N = yield every "
+                          "N chunks so drain/deadline checks interleave "
+                          "mid-request")
+    srv.add_argument("--poll-interval", type=float, default=0.1,
+                     help="inbox scan interval (seconds; default 0.1)")
+    srv.add_argument("--default-deadline", type=float, default=None,
+                     help="SLA applied to submits that carry none "
+                          "(seconds; default: best effort)")
+    srv.add_argument("--shards", type=int, default=None,
+                     help="route requests through the fault-tolerant shard "
+                          "fleet (parallel.shards) instead of the "
+                          "single-mesh sweep")
+    srv.add_argument("--trace-out", default=None,
+                     help="JSONL span/event log (request lifecycle events "
+                          "feed the `fairify_tpu report` request table)")
+
+    sbm = sub.add_parser(
+        "submit", help="submit one verification job to a running server")
+    sbm.add_argument("preset", help="preset name (see `list`)")
+    sbm.add_argument("--spool", required=True,
+                     help="the server's --spool directory")
+    sbm.add_argument("--model", default=None,
+                     help="zoo model name (e.g. GC-1)")
+    sbm.add_argument("--init-sizes", type=int, nargs="*", default=None,
+                     metavar="N",
+                     help="synthetic net layer sizes instead of --model "
+                          "(e.g. --init-sizes 20 8 1)")
+    sbm.add_argument("--init-seed", type=int, default=0)
+    sbm.add_argument("--deadline", type=float, default=None,
+                     help="wall-clock SLA in seconds from submit")
+    sbm.add_argument("--span", type=int, nargs=2, default=None,
+                     metavar=("START", "STOP"),
+                     help="global partition span [START, STOP)")
+    sbm.add_argument("--soft-timeout", type=float, default=None)
+    sbm.add_argument("--hard-timeout", type=float, default=None)
+    sbm.add_argument("--seed", type=int, default=None)
+    sbm.add_argument("--grid-chunk", type=int, default=None)
+    sbm.add_argument("--model-root", default=None)
+    sbm.add_argument("--wait", type=float, default=None, nargs="?", const=0.0,
+                     metavar="TIMEOUT",
+                     help="block until the verdict lands (optional timeout "
+                          "in seconds; bare --wait waits forever); exit 0 "
+                          "iff the request finished `done`")
+
     lint = sub.add_parser(
         "lint", help="run the nine-rule static-analysis engine over "
                      "fairify_tpu/ (DESIGN.md §11)")
@@ -404,7 +549,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     return {"list": _cmd_list, "run": _cmd_run, "bench": _cmd_bench,
             "experiment": _cmd_experiment, "metrics": _cmd_metrics,
-            "report": _cmd_report, "lint": _cmd_lint}[args.cmd](args)
+            "report": _cmd_report, "lint": _cmd_lint,
+            "serve": _cmd_serve, "submit": _cmd_submit}[args.cmd](args)
 
 
 if __name__ == "__main__":

@@ -1,15 +1,19 @@
 """Lock discipline for the classes threads actually share.
 
-The obs metrics registry, the launch pipeline, and the resilience journal
-are the three modules whose instances are touched concurrently (span and
-heartbeat consumers, supervised retries, multi-threaded tests).  Their
-concurrency contract is simple: any instance attribute that is *assigned*
-inside a ``with self.<lock>`` block is lock-protected, and every other
-read or write of it in the same class must also hold that lock.
+The obs metrics registry, the launch pipeline, the resilience journal and
+the serve subsystem (request queue, admission controller, server worker)
+are the modules whose instances are touched concurrently (span and
+heartbeat consumers, supervised retries, client submit threads racing the
+server worker, multi-threaded tests).  Their concurrency contract is
+simple: any instance attribute that is *assigned* inside a ``with
+self.<lock>`` block is lock-protected, and every other read or write of it
+in the same class must also hold that lock.
 
 The rule is lexical and per-class:
 
-* **lock attributes** — ``self.X = threading.Lock()`` / ``RLock()``;
+* **lock attributes** — ``self.X = threading.Lock()`` / ``RLock()`` /
+  ``Condition(...)`` (a Condition wraps a lock, and ``with self._cv:``
+  acquires it — the serve queue's idiom);
 * **protected attributes** — targets of ``self.Y = ...`` /
   ``self.Y[...] = ...`` / ``self.Y += ...`` inside any
   ``with self.<lock>:`` block, in any method;
@@ -79,6 +83,7 @@ class LockDisciplineRule(Rule):
         "fairify_tpu/obs/metrics.py",
         "fairify_tpu/parallel/pipeline.py",
         "fairify_tpu/resilience/journal.py",
+        "fairify_tpu/serve/",
     )
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
@@ -103,7 +108,7 @@ class LockDisciplineRule(Rule):
                         and isinstance(node.value, ast.Call):
                     f = node.value.func
                     if isinstance(f, ast.Attribute) \
-                            and f.attr in ("Lock", "RLock") \
+                            and f.attr in ("Lock", "RLock", "Condition") \
                             and isinstance(f.value, ast.Name) \
                             and f.value.id == "threading":
                         for t in node.targets:

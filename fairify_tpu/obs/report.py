@@ -24,7 +24,12 @@ results directory's worth), produce
 * a **per-shard table** — for sharded sweeps (``parallel.shards``; span-
   qualified sinks ``model@start-stop`` or ``failure`` records carrying a
   ``shard`` index): per shard, verdict counts and how many partitions
-  degraded — the shard-loss blast radius at a glance.
+  degraded — the shard-loss blast radius at a glance;
+* a **request table** — for service runs (``fairify_tpu serve``; the
+  server journals every lifecycle transition as a ``request`` event):
+  per request, final status, queue wait, run seconds and whether its SLA
+  was missed, last-transition-wins per request id (the event stream
+  replays a request's whole lifecycle; the terminal record is truth).
 
 Torn/partially-written lines (crash mid-sweep) are skipped with a counted
 warning, never raised on.
@@ -78,6 +83,7 @@ def aggregate(paths: Iterable[str]) -> dict:
     files = 0
     keyed: Dict[tuple, dict] = {}  # (model, partition_id) -> attrs, last wins
     anon: List[dict] = []  # verdict events without a partition id
+    requests: Dict[str, dict] = {}  # request id -> lifecycle attrs, last wins
     compiles: Dict[str, dict] = {}  # kernel -> compile-table row
     for path in paths:
         files += 1
@@ -125,6 +131,11 @@ def aggregate(paths: Iterable[str]) -> dict:
                 ph["count"] += 1
                 ph["total_s"] += rec.get("dur_s", 0.0)
                 ph["launches"] += int(attrs.get("launches", 0))
+            elif rtype == "event" and rec.get("name") == "request":
+                attrs = rec.get("attrs", {})
+                rid = attrs.get("request")
+                if rid is not None:
+                    requests[rid] = attrs
             elif rtype == "event" and rec.get("name") == "verdict":
                 attrs = rec.get("attrs", {})
                 if attrs.get("verdict") not in ("sat", "unsat", "unknown"):
@@ -217,6 +228,19 @@ def aggregate(paths: Iterable[str]) -> dict:
             "flops": row["flops"],
             "temp_bytes": row["temp_bytes"],
         }
+    request_table = {}
+    for rid in sorted(requests):
+        attrs = requests[rid]
+        request_table[rid] = {
+            "model": attrs.get("model", "?"),
+            "status": attrs.get("status", "?"),
+            "queue_wait_s": round(float(attrs.get("queue_wait_s", 0.0)), 4),
+            "run_s": round(float(attrs.get("run_s", 0.0)), 4),
+            "deadline_missed": bool(attrs.get("deadline_missed", False)),
+            "decided": (int(attrs.get("sat", 0)) + int(attrs.get("unsat", 0)))
+            if "sat" in attrs else None,
+            "reason": attrs.get("reason"),
+        }
     return {
         "files": files,
         "span_count": span_count,
@@ -233,6 +257,7 @@ def aggregate(paths: Iterable[str]) -> dict:
         "via": via,
         "degraded": dict(sorted(degraded.items(), key=lambda kv: -kv[1])),
         "shards": {k: shards[k] for k in sorted(shards)},
+        "requests": request_table,
         "models": models,
         "device_launches": int(launches),
         "launches_in_flight_max": int(inflight_max),
@@ -290,6 +315,21 @@ def render(agg: dict) -> str:
         for label, row in agg["shards"].items():
             lines.append(f"{label:<{w}}  {row['sat']:>6}  {row['unsat']:>6}  "
                          f"{row['unknown']:>7}  {row['degraded']:>8}")
+    if agg.get("requests"):
+        w = max(max(len(k) for k in agg["requests"]), len("request"))
+        lines.append("")
+        lines.append(f"{'request':<{w}}  {'model':>10}  {'status':>8}  "
+                     f"{'wait_s':>8}  {'run_s':>8}  {'decided':>7}  {'sla':>6}")
+        misses = 0
+        for rid, row in agg["requests"].items():
+            sla = "MISS" if row["deadline_missed"] else "ok"
+            misses += int(row["deadline_missed"])
+            decided = row["decided"] if row["decided"] is not None else "-"
+            lines.append(f"{rid:<{w}}  {row['model']:>10}  "
+                         f"{row['status']:>8}  {row['queue_wait_s']:>8.3f}  "
+                         f"{row['run_s']:>8.3f}  {decided:>7}  {sla:>6}")
+        lines.append(f"requests: {len(agg['requests'])}   "
+                     f"deadline misses: {misses}")
     if agg.get("compiles"):
         w = max(max(len(k) for k in agg["compiles"]), len("kernel"))
         lines.append("")

@@ -22,6 +22,12 @@ site                  where :func:`check` is called
                       triggers elastic re-sharding onto the survivors;
                       ``transient`` models a link blip the shard
                       supervisor's retry absorbs)
+``request.admit``     :meth:`serve.admission.AdmissionController.admit`
+                      deciding whether a service request is accepted
+``request.deadline``  the server's per-request deadline check before a
+                      request (or its next span) starts executing
+``serve.drain``       :meth:`serve.server.VerificationServer.drain`
+                      journaling queued requests for resume pickup
 ====================  =====================================================
 
 A **spec** is ``site:kind:nth``:
@@ -52,7 +58,8 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 FAULT_SITES = frozenset(
     {"launch.submit", "launch.decode", "compile", "smt.query", "ledger.append",
-     "shard.dispatch", "shard.gather", "device.lost"})
+     "shard.dispatch", "shard.gather", "device.lost",
+     "request.admit", "request.deadline", "serve.drain"})
 FAULT_KINDS = frozenset({"transient", "fatal", "crash"})
 
 _SPEC_RE = re.compile(

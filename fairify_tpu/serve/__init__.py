@@ -1,0 +1,25 @@
+"""Verification-as-a-service: the persistent ``fairify_tpu serve`` process.
+
+One long-lived server owns the device and its warm ``obs_jit`` kernel
+cache; concurrent verification requests share both.  The subsystem turns
+the operational substrate of PRs 1–7 (spans/metrics, the async launch
+pipeline, compile accounting, fault supervision, journals, the shard
+fleet) into a service:
+
+* :mod:`fairify_tpu.serve.request` — the job model and its lifecycle;
+* :mod:`fairify_tpu.serve.admission` — SLA-aware admission over the
+  budgeted-sweep predicate (``scripts/_sweeplib.py`` delegates here);
+* :mod:`fairify_tpu.serve.batcher` — arch-bucketed cross-request
+  coalescing into shared vmapped family launches;
+* :mod:`fairify_tpu.serve.server` — the queue → admit → batch → stream
+  worker loop with graceful SIGTERM drain;
+* :mod:`fairify_tpu.serve.client` — the file-spool submit protocol
+  (``fairify_tpu submit``).
+"""
+from fairify_tpu.serve.admission import (  # noqa: F401
+    AdmissionController,
+    AdmissionRejected,
+    span_admissible,
+)
+from fairify_tpu.serve.request import VerifyRequest, new_request_id  # noqa: F401
+from fairify_tpu.serve.server import ServeConfig, VerificationServer  # noqa: F401

@@ -1,0 +1,131 @@
+"""SLA-aware admission: the budgeted-sweep predicate, promoted to a service.
+
+The budget-admission rule the sweep harness has used since PR 2
+(``scripts/_sweeplib.py``): once a throughput rate is measured, never START
+work whose committed in-flight cost exceeds a fraction of the remaining
+budget — with the async launch pipeline, the moment a span starts,
+``depth × chunk`` partitions are committed device work that must drain
+even if the budget trips mid-span.  :func:`span_admissible` is that
+predicate as a library function (``_sweeplib`` delegates to it), and
+:class:`AdmissionController` applies the same logic at the request level:
+
+* **throughput EMA** — completed requests update an exponential moving
+  average of partitions/second (the service analog of the harness's
+  per-span measured rate; an EMA because a long-lived server sees drift —
+  cold compiles early, warm caches later).
+* **backlog accounting** — every admitted request adds its estimated cost
+  (``partitions / rate``) to the committed backlog; completion removes it.
+* **SLA admission** — a request with a deadline is rejected at submit time
+  when ``backlog + its own cost`` cannot fit inside the deadline (scaled
+  by the same safety factor the harness uses: rate estimates are noisy and
+  a hard-root tail can run ~2× its stage-0-dominated prediction).  With no
+  measured rate yet every request admits — the first request is the
+  throughput probe, exactly like the harness's first span.
+
+``request.admit`` is the registered fault-injection site for the decision
+(chaos cells reject a request instead of crashing the server).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from fairify_tpu.resilience import faults as faults_mod
+
+#: Fraction of the remaining budget a newly started span (or admitted
+#: request) may commit.  0.4 absorbs rate misestimates — see the budget-
+#: honesty note in ``scripts/_sweeplib.py`` (a span that hits a hard-root
+#: tail can run ~2x its stage-0-dominated prediction).
+SAFETY_FACTOR = 0.4
+
+
+def span_admissible(rate: Optional[float], depth: int, chunk: int,
+                    left_s: float, factor: float = SAFETY_FACTOR) -> bool:
+    """May a span START given the measured rate and the remaining budget?
+
+    ``rate`` is partitions/second (None = not yet measured: admit — the
+    span doubles as the throughput probe).  The committed cost of starting
+    is the whole in-flight backlog ``depth × chunk``, not one chunk.
+    """
+    if rate is None:
+        return True
+    return (depth * chunk) / max(rate, 1e-9) <= factor * left_s
+
+
+class AdmissionController:
+    """Thread-safe request admission over a throughput EMA + backlog."""
+
+    def __init__(self, ema_alpha: float = 0.3, factor: float = 0.8):
+        # ``factor`` is the admission analog of the harness's span factor:
+        # the fraction of a request's SLA window its predicted completion
+        # (backlog ahead of it + its own cost) may fill.  0.8 leaves the
+        # headroom rate noise deserves without rejecting feasible work —
+        # spans inside a budget use the stricter SAFETY_FACTOR because a
+        # budget overrun has no retry, while a deadline miss is counted
+        # and visible.
+        self._alpha = float(ema_alpha)
+        self._factor = float(factor)
+        self._lock = threading.Lock()
+        self._rate: Optional[float] = None      # partitions/sec EMA
+        self._backlog_s: float = 0.0            # committed cost, seconds
+        self._est: Dict[str, float] = {}        # request id -> admitted cost
+
+    def rate(self) -> Optional[float]:
+        with self._lock:
+            return self._rate
+
+    def backlog_s(self) -> float:
+        with self._lock:
+            return self._backlog_s
+
+    def estimate_s(self, partitions: int) -> Optional[float]:
+        """Predicted cost of a request (None until a rate is measured)."""
+        with self._lock:
+            if self._rate is None:
+                return None
+            return partitions / max(self._rate, 1e-9)
+
+    def admit(self, request) -> None:
+        """Admit ``request`` or raise :class:`AdmissionRejected`.
+
+        The decision is a named fault site (``request.admit``): an
+        injected fault here surfaces as a rejection reason, never a server
+        crash (the server classifies and converts; crash-kind propagates).
+        """
+        faults_mod.check("request.admit")
+        with self._lock:
+            est = None if self._rate is None \
+                else request.partitions / max(self._rate, 1e-9)
+            if request.deadline_s is not None and est is not None:
+                predicted = self._backlog_s + est
+                if predicted > self._factor * request.deadline_s:
+                    raise AdmissionRejected(
+                        f"deadline-infeasible: predicted "
+                        f"{predicted:.2f}s of committed work against a "
+                        f"{request.deadline_s:.2f}s deadline "
+                        f"(rate {self._rate:.1f} parts/s, backlog "
+                        f"{self._backlog_s:.2f}s)")
+            self._est[request.id] = est or 0.0
+            self._backlog_s += est or 0.0
+
+    def release(self, request) -> None:
+        """Drop an admitted request's backlog share (rejected-after-admit
+        or drained before running)."""
+        with self._lock:
+            self._backlog_s -= self._est.pop(request.id, 0.0)
+            self._backlog_s = max(self._backlog_s, 0.0)
+
+    def finished(self, request, partitions: int, elapsed_s: float) -> None:
+        """Fold a completed request into the rate EMA and free its backlog."""
+        with self._lock:
+            self._backlog_s -= self._est.pop(request.id, 0.0)
+            self._backlog_s = max(self._backlog_s, 0.0)
+            if elapsed_s <= 0.0 or partitions <= 0:
+                return
+            sample = partitions / elapsed_s
+            self._rate = sample if self._rate is None \
+                else (1.0 - self._alpha) * self._rate + self._alpha * sample
+
+
+class AdmissionRejected(RuntimeError):
+    """Raised by :meth:`AdmissionController.admit`; the reason is the str."""

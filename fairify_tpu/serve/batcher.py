@@ -1,0 +1,150 @@
+"""Arch-bucketed cross-request batcher: many requests, few mega-launches.
+
+α,β-CROWN's "rapid massively-parallel incomplete verifier" framing
+(PAPERS.md: arxiv 2011.13824) coalesces many small verification problems
+into few large device launches; the sweep already does that *within* one
+run (family stacking, chunk bucketing, the async pipeline).  This module
+does it *across concurrent service requests*:
+
+* requests are bucketed by **stage-0 signature** (every config field that
+  shapes the grid and the attack RNG streams — identical signature means
+  identical ``(lo, hi)`` grid, identical per-chunk seeds) and then by
+  **architecture** (``(in_dim,) + layer_sizes``, the family-stack key);
+* every arch bucket with ≥2 members stacks its requests' nets into ONE
+  vmapped family (:func:`parallel.mesh.stack_models`) and all buckets'
+  (family, chunk) blocks ride ONE shared :class:`LaunchPipeline` through
+  :func:`verify.sweep.stage0_families` — one fused launch per chunk per
+  family, instead of one per chunk per *request*;
+* the **model axis is a compiled-shape bucket** exactly like the chunk
+  axis: ``pad_models`` (the server passes its ``max_batch``) pads every
+  stack to one fixed width by repeating the last member, so a bucket of
+  2 and a bucket of 7 hit the SAME family executable (pad-slot results
+  are discarded).  Under-filled buckets waste vmapped compute, but only
+  at low concurrency — where the device is idle anyway — and in exchange
+  a warm server owns exactly ONE family executable per architecture;
+* the ragged-chunk padding inside ``_family_block_submit`` (PR 3) then
+  means every coalesced block hits that same compiled executable — a warm
+  server recompiles nothing, whatever mix of requests arrives.
+
+Bit-equality contract: the family kernels are the solo kernels under
+``vmap`` with the same globally-keyed RNG streams (``seed_offset`` pins
+span-local slices to global chunk starts), so each request's stage-0
+results — and therefore its verdict ledger — are bit-equal to the run it
+would have done alone (pinned in ``tests/test_serve.py``).  Requests whose
+signature or architecture matches nobody else's simply run the normal
+single-model path; they still share the server's warm ``obs_jit`` cache.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+from fairify_tpu import obs
+
+
+def stage0_signature(cfg, partition_span) -> tuple:
+    """Everything that must match for two requests' stage-0 streams to be
+    interchangeable: the grid construction knobs, the seeds that key the
+    attack RNG, and the chunking that buckets launches.  Budgets and
+    result sinks deliberately excluded — they shape refinement, not the
+    shared stage-0 launches."""
+    eng = cfg.engine
+    return (
+        cfg.dataset, tuple(cfg.protected), tuple(cfg.relaxed), cfg.relax_eps,
+        cfg.partition_threshold, cfg.capped_partitions, cfg.max_partitions,
+        tuple(sorted(cfg.domain_overrides.items())), cfg.seed,
+        cfg.grid_chunk, eng.seed, eng.attack_samples, eng.use_crown,
+        tuple(partition_span) if partition_span is not None else None,
+    )
+
+
+def arch_key(net) -> tuple:
+    return (net.in_dim,) + tuple(net.layer_sizes)
+
+
+def plan_buckets(requests: Sequence) -> List[List]:
+    """Group requests into coalescible buckets (≥2 requests each).
+
+    Returns the list of buckets; requests not in any bucket run solo.
+    Bucket membership is (stage-0 signature, architecture) equality —
+    the two conditions under which one vmapped family launch can serve
+    every member with its solo-run RNG streams.
+    """
+    groups: Dict[tuple, List] = {}
+    for req in requests:
+        key = (stage0_signature(req.cfg, req.partition_span),
+               arch_key(req.net))
+        groups.setdefault(key, []).append(req)
+    return [reqs for reqs in groups.values() if len(reqs) >= 2]
+
+
+def slice_stage0(stage0, s: int, e: int):
+    """Span-local slice of a precomputed ``(unsat, sat, witnesses)`` triple
+    (for span-granular refinement under a drainable server)."""
+    unsat, sat, wits = stage0
+    return (unsat[s:e], sat[s:e],
+            {k - s: v for k, v in wits.items() if s <= k < e})
+
+
+def batched_stage0(requests: Sequence, pipe=None,
+                   pad_models: int = 0, grid_fn=None) -> Dict[str, tuple]:
+    """Cross-request coalesced stage 0: request id → its stage-0 triple.
+
+    Requests that coalesced get their certificates + attacks from shared
+    family launches; ids absent from the returned map found no partner and
+    should run the normal solo path.  All buckets share one launch
+    pipeline, so bucket B's first chunk dispatches while bucket A's last
+    chunks still decode host-side — the device queue never drains between
+    buckets, same as the AC-suite family sweep.
+
+    ``grid_fn(cfg) -> (lo, hi)`` supplies the full partition grid; the
+    server passes its per-signature memo so a steady stream of coalesced
+    batches doesn't rebuild a multi-second stress grid on the worker
+    thread every batch window.
+    """
+    from fairify_tpu.parallel.mesh import stack_models
+    from fairify_tpu.verify import sweep as sweep_mod
+    from fairify_tpu.verify.property import encode
+
+    buckets = plan_buckets(requests)
+    out: Dict[str, tuple] = {}
+    if not buckets:
+        return out
+    occupancy = sum(len(b) for b in buckets)
+    with obs.span("serve.batch_stage0", buckets=len(buckets),
+                  requests=occupancy):
+        # Buckets may differ in signature (different grids), so each
+        # signature group gets its own stage0_families call — but they all
+        # submit into the SAME pipe, which is what keeps the device fed.
+        by_sig: Dict[tuple, List[List]] = {}
+        for bucket in buckets:
+            sig = stage0_signature(bucket[0].cfg, bucket[0].partition_span)
+            by_sig.setdefault(sig, []).append(bucket)
+        for sig_buckets in by_sig.values():
+            ref = sig_buckets[0][0]
+            cfg = ref.cfg
+            enc = encode(cfg.query())
+            if grid_fn is not None:
+                lo, hi = grid_fn(cfg)
+            else:
+                _, lo, hi = sweep_mod.build_partitions(cfg)
+            span_start = 0
+            if ref.partition_span is not None:
+                span_start, span_stop = ref.partition_span
+                lo, hi = lo[span_start:span_stop], hi[span_start:span_stop]
+            stacks = []
+            for bucket in sig_buckets:
+                members = [req.net for req in bucket]
+                if pad_models > len(members):
+                    # Fixed model-axis width: pad slots recompute the last
+                    # member and are sliced away below — shape stability
+                    # (zero recompiles on a warm server) over idle FLOPs.
+                    members += [members[-1]] * (pad_models - len(members))
+                stacks.append(stack_models(members))
+            fams = sweep_mod.stage0_families(
+                stacks, enc, lo, hi, cfg, pipe=pipe, seed_offset=span_start)
+            for bucket, fam in zip(sig_buckets, fams):
+                for req, s0 in zip(bucket, fam):
+                    out[req.id] = s0
+    if out:
+        obs.registry().histogram("serve_batch_occupancy").observe(occupancy)
+    return out

@@ -1,0 +1,168 @@
+"""File-spool client protocol: how ``fairify_tpu submit`` talks to ``serve``.
+
+The transport is deliberately a directory, not a socket: the server's
+spool is the one durable thing a drain already preserves, atomic rename is
+the only concurrency primitive both sides need, and a file-based inbox
+makes ``resume=True`` pickup of requeued requests free (a drain just
+writes the payload back).  Layout under ``--spool``::
+
+    inbox/<id>.json                 submitted payloads (rename-atomic)
+    requests/<id>/request.json      the accepted payload (server copy)
+    requests/<id>/status.json       terminal lifecycle record
+    requests/<id>/*.ledger.jsonl    the streaming verdict ledger (tail it)
+    serve.journal.jsonl             every lifecycle transition, JSONL
+
+A **payload** is JSON with:
+
+``preset``       required preset name (``fairify_tpu list``)
+``model``        zoo model name (e.g. ``GC-1``), or
+``init``         ``{"sizes": [...], "seed": N}`` synthetic net
+                 (bench/chaos harnesses; exactly one of model/init)
+``overrides``    ``SweepConfig.with_`` keyword overrides (timeouts,
+                 grid_chunk, pipeline_depth, inject_faults, ...)
+``deadline_s``   wall-clock SLA from submit; absent = server default
+``span``         ``[start, stop)`` global partition indices; absent = all
+``model_root``   zoo root override (defaults to the server's environment)
+``id``           optional caller-chosen request id
+``submitted_ts`` epoch submit time, stamped by :func:`submit`; the SLA
+                 clock is measured from here so it survives drain/requeue
+                 handoffs between servers
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional, Tuple
+
+
+def build_payload(preset: str, model: Optional[str] = None,
+                  init: Optional[dict] = None,
+                  overrides: Optional[dict] = None,
+                  deadline_s: Optional[float] = None,
+                  span: Optional[Tuple[int, int]] = None,
+                  model_root: Optional[str] = None,
+                  request_id: Optional[str] = None) -> dict:
+    """Validated payload dict (the submit-side half of the protocol)."""
+    if (model is None) == (init is None):
+        raise ValueError("exactly one of model= / init= is required")
+    payload = {"preset": preset}
+    if model is not None:
+        payload["model"] = model
+    if init is not None:
+        sizes = [int(s) for s in init["sizes"]]
+        if len(sizes) < 2:
+            raise ValueError("init.sizes needs at least [in_dim, out]")
+        payload["init"] = {"sizes": sizes, "seed": int(init.get("seed", 0))}
+    if overrides:
+        payload["overrides"] = dict(overrides)
+    if deadline_s is not None:
+        payload["deadline_s"] = float(deadline_s)
+    if span is not None:
+        payload["span"] = [int(span[0]), int(span[1])]
+    if model_root is not None:
+        payload["model_root"] = model_root
+    if request_id is not None:
+        payload["id"] = request_id
+    return payload
+
+
+def resolve_payload(payload: dict, result_dir: str):
+    """Server-side payload → ``(cfg, net, model_name, dataset)``.
+
+    ``result_dir`` becomes the request's private sink directory (the
+    per-request isolation boundary); the payload's own ``result_dir``
+    override is ignored — a client must not write outside its request
+    directory.
+    """
+    from fairify_tpu.verify import presets
+
+    cfg = presets.get(payload["preset"])
+    overrides = dict(payload.get("overrides") or {})
+    overrides["result_dir"] = result_dir
+    cfg = cfg.with_(**overrides)
+    if "init" in payload:
+        from fairify_tpu.models.train import init_mlp
+
+        init = payload["init"]
+        net = init_mlp(tuple(init["sizes"]), seed=int(init.get("seed", 0)))
+        model_name = payload.get(
+            "model", f"init{'x'.join(str(s) for s in init['sizes'])}"
+            f"-s{init.get('seed', 0)}")
+    else:
+        from fairify_tpu.models import zoo
+
+        model_name = payload["model"]
+        net = zoo.load(cfg.dataset, model_name,
+                       root=payload.get("model_root"))
+    # Same gate run_sweep applies to zoo models: a net whose input width
+    # doesn't match the verification domain would fatally degrade every
+    # launch — reject it here, before it costs device time.
+    n_attrs = len(cfg.query().columns)
+    if net.in_dim != n_attrs:
+        raise ValueError(
+            f"{model_name}: input dim {net.in_dim} != domain dim {n_attrs} "
+            f"of preset {payload['preset']!r}")
+    return cfg, net, model_name, None
+
+
+def write_atomic_json(path: str, obj: dict) -> None:
+    """Write-then-rename so readers never observe a torn file.
+
+    The one atomic primitive both halves of the spool protocol share —
+    inbox payloads, status.json, drain requeues all go through it."""
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as fp:
+        json.dump(obj, fp)
+    os.replace(tmp, path)
+
+
+def submit(spool: str, payload: dict) -> str:
+    """Drop a payload into the server's inbox; returns the request id.
+
+    Stamps the epoch submit time (``submitted_ts``) so the request's SLA
+    clock survives a drain/requeue handoff — the next server restores it
+    instead of restarting the deadline from pickup."""
+    from fairify_tpu.serve.request import new_request_id
+
+    req_id = payload.get("id") or new_request_id()
+    payload = dict(payload, id=req_id)
+    payload.setdefault("submitted_ts", time.time())
+    inbox = os.path.join(spool, "inbox")
+    os.makedirs(inbox, exist_ok=True)
+    write_atomic_json(os.path.join(inbox, f"{req_id}.json"), payload)
+    return req_id
+
+
+def status(spool: str, request_id: str) -> Optional[dict]:
+    """Terminal lifecycle record, or None while the request is in flight."""
+    path = os.path.join(spool, "requests", request_id, "status.json")
+    try:
+        with open(path) as fp:
+            return json.load(fp)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def wait(spool: str, request_id: str, timeout: Optional[float] = None,
+         poll_s: float = 0.2) -> Optional[dict]:
+    """Poll until the request's status.json lands (or timeout)."""
+    t0 = time.monotonic()
+    while True:
+        rec = status(spool, request_id)
+        if rec is not None:
+            return rec
+        if timeout is not None and time.monotonic() - t0 > timeout:
+            return None
+        time.sleep(poll_s)
+
+
+def ledger_paths(spool: str, request_id: str) -> list:
+    """The request's streaming verdict ledgers (tail these for results)."""
+    rdir = os.path.join(spool, "requests", request_id)
+    try:
+        names = sorted(os.listdir(rdir))
+    except OSError:
+        return []
+    return [os.path.join(rdir, n) for n in names
+            if n.endswith(".ledger.jsonl")]

@@ -1,0 +1,130 @@
+"""Service request model: what a client asks for, and what it gets back.
+
+A :class:`VerifyRequest` is one verification job handed to the persistent
+server (:mod:`fairify_tpu.serve.server`): a resolved network + sweep
+config, an optional partition span, and an optional wall-clock deadline
+(the request's SLA).  The server owns the request's lifecycle:
+
+``queued`` → ``running`` → ``done`` | ``failed`` | ``requeued``
+                                   (``rejected`` never leaves admission)
+
+* **rejected** — admission refused it (queue draining, or the SLA is
+  infeasible against the measured backlog); nothing executed.
+* **failed** — a runtime fault escaped the request's own fault domain
+  (classified non-propagate): the *request* degrades with a
+  machine-readable reason, the server loop stays alive.
+* **requeued** — a graceful drain stopped the server before (or mid-way
+  through) this request; its spool record is journaled so the next server
+  picks it up with ``resume=True`` and its partial ledger replays.
+
+Each request's sweep writes into its own ``result_dir`` (one directory per
+request under the spool), so the verdict ledger the sweep streams through
+:class:`resilience.journal.JournalWriter` doubles as the client-visible
+incremental result feed — clients tail
+``requests/<id>/<preset>-<model>@<span>.ledger.jsonl`` while the request
+runs and read ``status.json`` for the terminal summary.
+"""
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+#: Lifecycle states (see module docstring for the transitions).
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+FAILED = "failed"
+REJECTED = "rejected"
+REQUEUED = "requeued"
+
+
+def new_request_id() -> str:
+    """Sortable-ish unique id: epoch millis + random suffix."""
+    return f"r{int(time.time() * 1000):013d}-{uuid.uuid4().hex[:8]}"
+
+
+def monotonic_from_epoch(ts: float) -> float:
+    """Map an epoch stamp onto this process's monotonic clock.
+
+    How a requeued request's original submit time (``submitted_ts`` in the
+    spool payload) becomes the new server's ``submitted_at`` — the SLA
+    clock keeps running across the handoff.  Clamped so a skewed future
+    stamp can't grant extra budget."""
+    return time.monotonic() - max(0.0, time.time() - ts)
+
+
+@dataclass
+class VerifyRequest:
+    """One verification job: model + config + SLA.
+
+    ``cfg.result_dir`` must already point at the request's own directory —
+    the server never shares sinks between requests (per-request ledgers
+    are the isolation boundary the bit-equality tests pin).
+    """
+
+    id: str
+    cfg: object                 # verify.config.SweepConfig, fully resolved
+    net: object                 # models.mlp.MLP
+    model_name: str
+    dataset: Optional[object] = None
+    # Wall-clock SLA in seconds, measured from submit time; None = best
+    # effort (no deadline, admission never rejects on feasibility).
+    deadline_s: Optional[float] = None
+    # [start, stop) global partition indices; None = the whole grid.
+    partition_span: Optional[Tuple[int, int]] = None
+    # Spool-protocol payload (client.py): carried so a drain can journal
+    # the request back for the next server; None for in-process submits.
+    spool_payload: Optional[dict] = None
+
+    # --- server-owned lifecycle state -------------------------------------
+    status: str = QUEUED
+    reason: str = ""            # rejection/failure/requeue detail
+    submitted_at: float = field(default_factory=time.monotonic)
+    started_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    deadline_missed: bool = False
+    report: Optional[object] = None   # verify.sweep.ModelReport when done
+    # Partitions this request's span covers (estimated at admission from
+    # the grid size; exact once the report lands).
+    partitions: int = 0
+
+    @property
+    def queue_wait_s(self) -> float:
+        t = self.started_at if self.started_at is not None else time.monotonic()
+        return max(t - self.submitted_at, 0.0)
+
+    @property
+    def run_s(self) -> float:
+        if self.started_at is None:
+            return 0.0
+        t = self.finished_at if self.finished_at is not None else time.monotonic()
+        return max(t - self.started_at, 0.0)
+
+    def deadline_left(self, now: Optional[float] = None) -> Optional[float]:
+        """Seconds of SLA remaining (negative = already missed); None = no SLA."""
+        if self.deadline_s is None:
+            return None
+        now = time.monotonic() if now is None else now
+        return self.deadline_s - (now - self.submitted_at)
+
+    def to_record(self) -> dict:
+        """Lifecycle journal record (serve.journal.jsonl / obs events)."""
+        rec = {
+            "request": self.id, "status": self.status,
+            "model": self.model_name, "preset": self.cfg.name,
+            "queue_wait_s": round(self.queue_wait_s, 4),
+            "run_s": round(self.run_s, 4),
+            "deadline_s": self.deadline_s,
+            "deadline_missed": self.deadline_missed,
+            "partitions": self.partitions,
+        }
+        if self.partition_span is not None:
+            rec["span"] = f"{self.partition_span[0]}-{self.partition_span[1]}"
+        if self.reason:
+            rec["reason"] = self.reason
+        if self.report is not None:
+            rec.update(self.report.counts)
+            rec["degraded"] = self.report.degraded
+        return rec

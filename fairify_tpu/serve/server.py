@@ -1,0 +1,688 @@
+"""The persistent verification server: queue → admission → batch → stream.
+
+One long-lived process owns the device and its warm ``obs_jit`` kernel
+cache; requests share both.  The event loop is a single worker thread
+(the device is a serial resource — cross-request parallelism comes from
+*coalescing* work into wider launches, not from racing threads at the
+dispatch ring):
+
+1. **submit** (any thread, or the spool inbox): the request is admitted
+   against the SLA feasibility predicate (:mod:`serve.admission`) and
+   queued; rejected requests never execute.
+2. **batch**: the worker collects up to ``max_batch`` queued requests
+   inside a ``batch_window_s`` coalescing window, hands them to the
+   arch-bucketed batcher (:mod:`serve.batcher`) — same-architecture
+   requests get their stage-0 certificates/attacks from shared vmapped
+   family launches through one :class:`LaunchPipeline` — then runs each
+   request's refinement in FIFO order with its precomputed stage 0.
+3. **stream**: every request's sweep writes its own JSONL verdict ledger
+   incrementally (the normal ``verify_model`` ledger, atomic + fsync'd via
+   :class:`resilience.journal.JournalWriter`), so clients tail results
+   while the request runs; lifecycle transitions land in
+   ``serve.journal.jsonl`` and as obs ``request`` events.
+
+Fault semantics (the per-request blast radius, DESIGN.md §13): a runtime
+fault inside one request's execution is classified by the resilience
+taxonomy — transient faults are already absorbed per chunk by the sweep's
+own supervisor; anything that still escapes marks *that request* failed
+with a machine-readable reason and the server loop continues.  Only
+propagate-class errors (crash faults, KeyboardInterrupt) kill the server.
+
+Graceful drain (SIGTERM): in-flight work finishes — the running batch's
+launches drain through the normal pipeline; with ``span_chunks > 0`` the
+running request itself yields at its next chunk-aligned span boundary —
+and every request still queued (or preempted mid-request) is journaled
+``requeued`` with its spool payload written back to the inbox, so the next
+server picks it up and its ledger replays ``resume=True``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from fairify_tpu import obs
+from fairify_tpu.resilience import faults as faults_mod
+from fairify_tpu.resilience.journal import JournalWriter
+from fairify_tpu.resilience.supervisor import Supervisor, classify
+from fairify_tpu.serve import batcher
+from fairify_tpu.serve.admission import AdmissionController, AdmissionRejected
+from fairify_tpu.serve.client import write_atomic_json as _atomic_json
+from fairify_tpu.serve.request import (
+    DONE,
+    FAILED,
+    QUEUED,
+    REJECTED,
+    REQUEUED,
+    RUNNING,
+    VerifyRequest,
+    monotonic_from_epoch,
+    new_request_id,
+)
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    """Server knobs (the CLI flags of ``fairify_tpu serve``)."""
+
+    # Spool directory (inbox/ + requests/ + serve.journal.jsonl); None =
+    # in-process submits only (tests, embedding).
+    spool: Optional[str] = None
+    # How long the worker waits after the first queued request for more to
+    # coalesce into the same batch (the cross-request batching window).
+    batch_window_s: float = 0.05
+    # Most requests coalesced into one batch — AND the fixed model-axis
+    # width every coalesced family stack is padded to (batcher
+    # ``pad_models``): one compiled family executable per architecture,
+    # whatever the batch occupancy.  The vmapped kernels scale linearly
+    # in it, so under-filled batches trade idle FLOPs for zero recompiles.
+    max_batch: int = 8
+    # Refinement granule in grid chunks: 0 = each request runs as ONE
+    # verify_model call (no mid-request preemption; bit-identical to its
+    # solo run), N > 0 = the request yields every N chunks so drain and
+    # deadline checks interleave mid-request (chunk-aligned spans keep the
+    # RNG streams global, so decided verdicts are granule-invariant).
+    span_chunks: int = 0
+    # Inbox poll interval (seconds) when a spool is configured.
+    poll_s: float = 0.1
+    # Deadline applied to spool requests that do not carry one; None =
+    # best effort.
+    default_deadline_s: Optional[float] = None
+    # Route each request through the PR 7 shard fleet instead of the
+    # single-mesh sweep (per-request fault domains over the visible
+    # devices; disables cross-request stage-0 stacking, which is
+    # grid-global while shards are span-local).
+    n_shards: Optional[int] = None
+
+
+class VerificationServer:
+    """Single-process verification service (see module docstring).
+
+    Use as a context manager, or ``start()`` / ``drain()`` explicitly::
+
+        with VerificationServer(ServeConfig(spool="spool")) as srv:
+            req = srv.submit(cfg, net, "GC-1", deadline_s=60.0)
+            srv.wait(req.id, timeout=120.0)
+    """
+
+    def __init__(self, cfg: ServeConfig = ServeConfig()):
+        self.cfg = cfg
+        self.admission = AdmissionController()
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: deque = deque()
+        self._requests: Dict[str, VerifyRequest] = {}
+        self._grids: Dict[tuple, Tuple] = {}
+        self._draining = False
+        self._thread: Optional[threading.Thread] = None
+        self._sup = Supervisor(max_retries=2, backoff_s=0.05)
+        self._journal_writer: Optional[JournalWriter] = None
+        if cfg.spool:
+            os.makedirs(os.path.join(cfg.spool, "inbox"), exist_ok=True)
+            os.makedirs(os.path.join(cfg.spool, "requests"), exist_ok=True)
+            self._journal_writer = JournalWriter(
+                os.path.join(cfg.spool, "serve.journal.jsonl"),
+                supervisor=self._sup)
+
+    # --- lifecycle --------------------------------------------------------
+
+    def start(self) -> "VerificationServer":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._worker,
+                                            name="fairify-serve", daemon=True)
+            self._thread.start()
+        return self
+
+    def __enter__(self) -> "VerificationServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.drain()
+        return False
+
+    def drain(self) -> List[VerifyRequest]:
+        """Graceful shutdown: finish in-flight work, requeue the rest.
+
+        Returns the requests that were journaled ``requeued``.  The
+        ``serve.drain`` fault site fires here; a non-crash injected fault
+        is recorded and drain proceeds — shutdown must not be deniable.
+        """
+        try:
+            faults_mod.check("serve.drain")
+        except BaseException as exc:
+            if classify(exc) == "propagate":
+                raise
+            obs.event("degraded", site="serve.drain",
+                      error=type(exc).__name__, detail=str(exc)[:200])
+        with self._cv:
+            self._draining = True
+            queued = list(self._queue)
+            self._queue.clear()
+            self._cv.notify_all()
+        requeued = [self._requeue(req) for req in queued]
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        # The worker may have preempted its running request at a span
+        # boundary; it requeues that one itself before exiting — fold it
+        # into the return value so the drain report is complete.
+        with self._cv:
+            seen = {r.id for r in requeued}
+            requeued += [r for r in self._requests.values()
+                         if r.status == REQUEUED and r.id not in seen]
+        if self._journal_writer is not None:
+            self._journal_writer.close()
+        return requeued
+
+    def _requeue(self, req: VerifyRequest) -> VerifyRequest:
+        req.status = REQUEUED
+        req.reason = req.reason or "server draining"
+        self.admission.release(req)
+        self._journal(req)
+        if self.cfg.spool and req.spool_payload is not None:
+            # Back into the inbox for the next server; its result_dir is
+            # stable (requests/<id>/), so the replayed run resumes from
+            # the ledger instead of recomputing.
+            _atomic_json(os.path.join(self.cfg.spool, "inbox",
+                                      f"{req.id}.json"), req.spool_payload)
+        with self._cv:
+            self._cv.notify_all()   # wake wait()ers: requeued is terminal
+        return req
+
+    # --- submission -------------------------------------------------------
+
+    def submit(self, cfg, net, model_name: str, dataset=None,
+               deadline_s: Optional[float] = None,
+               partition_span: Optional[Tuple[int, int]] = None,
+               request_id: Optional[str] = None,
+               spool_payload: Optional[dict] = None,
+               submitted_at: Optional[float] = None) -> VerifyRequest:
+        """Queue one verification job; returns the request (possibly
+        already ``rejected`` — check ``status``).  Thread-safe.
+
+        ``submitted_at`` (monotonic) backdates the SLA clock — spool
+        pickups pass the payload's original submit stamp so a
+        drain/requeue handoff doesn't silently extend the deadline."""
+        req = VerifyRequest(
+            id=request_id or new_request_id(), cfg=cfg, net=net,
+            model_name=model_name, dataset=dataset, deadline_s=deadline_s,
+            partition_span=partition_span, spool_payload=spool_payload)
+        if submitted_at is not None:
+            req.submitted_at = submitted_at
+        req.partitions = self._span_size(cfg, partition_span)
+        registry = obs.registry()
+        with self._cv:
+            draining = self._draining
+        if draining and self.cfg.spool and spool_payload is not None:
+            # A spool-backed request arriving during drain (the worker's
+            # last inbox scan racing the shutdown) must NOT be consumed as
+            # a rejection — requeue it so the payload lands back in the
+            # inbox and the next server picks it up.
+            with self._cv:
+                self._requests[req.id] = req
+            return self._requeue(req)
+        try:
+            if draining:
+                raise AdmissionRejected("server draining")
+            self.admission.admit(req)
+        except BaseException as exc:
+            if classify(exc) == "propagate":
+                raise
+            req.status = REJECTED
+            req.reason = str(exc)
+            registry.counter("serve_requests").inc(status=REJECTED)
+            with self._cv:
+                self._requests[req.id] = req
+            # Rejection is terminal: a spool client polling status.json
+            # must unblock, not wait out its timeout.
+            self._finish(req)
+            return req
+        with self._cv:
+            self._requests[req.id] = req
+            if self._draining:
+                # drain() snapped the queue between our draining check
+                # and this append — enqueueing now would strand the
+                # request (the worker is gone).  Hand it to the drain
+                # path instead.
+                drained_in_race = True
+            else:
+                drained_in_race = False
+                self._queue.append(req)
+                registry.gauge("serve_queue_depth").set(len(self._queue))
+                self._cv.notify_all()
+        if drained_in_race:
+            if self.cfg.spool and spool_payload is not None:
+                return self._requeue(req)       # releases its admission
+            self.admission.release(req)
+            req.status = REJECTED
+            req.reason = "server draining"
+            registry.counter("serve_requests").inc(status=REJECTED)
+            self._finish(req)
+            return req
+        registry.counter("serve_requests").inc(status=QUEUED)
+        self._journal(req)
+        return req
+
+    def _grid(self, cfg) -> Tuple:
+        """Full-grid ``(lo, hi)`` memoized per stage-0 signature — stress
+        grids reach millions of boxes and must not be rebuilt per request
+        (admission sizing) or per coalesced batch (the worker thread)."""
+        sig = batcher.stage0_signature(cfg, None)
+        with self._cv:
+            got = self._grids.get(sig)
+        if got is None:
+            from fairify_tpu.verify import sweep as sweep_mod
+
+            _, lo, hi = sweep_mod.build_partitions(cfg)
+            got = (lo, hi)
+            with self._cv:
+                self._grids[sig] = got
+        return got
+
+    def _span_size(self, cfg, partition_span) -> int:
+        """Partition count of the request's span (admission cost input)."""
+        if partition_span is not None:
+            return int(partition_span[1]) - int(partition_span[0])
+        lo, _hi = self._grid(cfg)
+        return int(lo.shape[0])
+
+    def alive(self) -> bool:
+        """True while the worker thread is running.
+
+        False after a drain — or after a propagate-class crash killed the
+        worker (by design, see ``_worker``): the process may look healthy
+        while the inbox is never scanned again, so operators (``fairify_tpu
+        serve``) must poll this and drain when it flips."""
+        return self._thread is not None and self._thread.is_alive()
+
+    def get(self, request_id: str) -> Optional[VerifyRequest]:
+        with self._cv:
+            return self._requests.get(request_id)
+
+    def wait(self, request_id: str, timeout: Optional[float] = None
+             ) -> Optional[VerifyRequest]:
+        """Block until the request reaches a terminal state.
+
+        Event-driven: terminal transitions notify ``_cv`` (the 0.5 s cap
+        on each wait is a backstop, not the latency)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        terminal = (DONE, FAILED, REJECTED, REQUEUED)
+        with self._cv:
+            while True:
+                req = self._requests.get(request_id)
+                if req is not None and req.status in terminal:
+                    return req
+                left = None if deadline is None \
+                    else deadline - time.monotonic()
+                if left is not None and left <= 0.0:
+                    return req
+                self._cv.wait(timeout=0.5 if left is None
+                              else min(0.5, left))
+
+    # --- worker loop ------------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            batch = self._next_batch()
+            if not batch:
+                return
+            try:
+                self._run_batch(batch)
+            except BaseException as exc:
+                # A propagate-class error (crash fault, interrupt) escaped
+                # a request: leave every batch member in a client-visible
+                # terminal state and let the thread die — the
+                # process-level contract is the ledger's, not ours.  The
+                # batch was already popped from the queue, so members the
+                # crash beat to the device would otherwise be stranded
+                # ``queued`` forever: spool-backed ones go back to the
+                # inbox for the next server, in-process ones fail.
+                for req in batch:
+                    if req.status not in (QUEUED, RUNNING):
+                        continue
+                    req.reason = f"server crash: {type(exc).__name__}"
+                    if req.status == QUEUED and self.cfg.spool \
+                            and req.spool_payload is not None:
+                        self._requeue(req)
+                        continue
+                    req.status = FAILED
+                    self.admission.release(req)
+                    self._finish(req)
+                raise
+
+    def _next_batch(self) -> List[VerifyRequest]:
+        window_until: Optional[float] = None
+        while True:
+            if self.cfg.spool:
+                try:
+                    self._scan_inbox()
+                except BaseException as exc:
+                    # A scan flake (fs blip, racing server) must not kill
+                    # the worker — queued requests would strand forever.
+                    if classify(exc) == "propagate":
+                        raise
+                    obs.event("degraded", site="serve.inbox",
+                              error=type(exc).__name__,
+                              detail=str(exc)[:200])
+            with self._cv:
+                now = time.monotonic()
+                if self._draining:
+                    return []
+                if self._queue:
+                    if window_until is None:
+                        window_until = now + self.cfg.batch_window_s
+                    if len(self._queue) >= self.cfg.max_batch \
+                            or now >= window_until:
+                        n = min(len(self._queue), self.cfg.max_batch)
+                        batch = [self._queue.popleft() for _ in range(n)]
+                        obs.registry().gauge("serve_queue_depth").set(
+                            len(self._queue))
+                        return batch
+                    self._cv.wait(timeout=window_until - now)
+                    continue
+                window_until = None
+                self._cv.wait(timeout=self.cfg.poll_s)
+
+    def _run_batch(self, batch: List[VerifyRequest]) -> None:
+        registry = obs.registry()
+        with obs.span("serve.batch", requests=len(batch)):
+            registry.histogram("serve_batch_size").observe(len(batch))
+            stage0_by_id = {}
+            if self.cfg.n_shards is None and len(batch) >= 2:
+                try:
+                    pipe = self._batch_pipe(batch[0].cfg)
+                    stage0_by_id = batcher.batched_stage0(
+                        batch, pipe=pipe, pad_models=self.cfg.max_batch,
+                        grid_fn=self._grid)
+                except BaseException as exc:
+                    # Losing the coalesced pass costs throughput, never
+                    # correctness: every request falls back to its solo
+                    # stage 0.  (Chunk-level faults inside the shared
+                    # launches are already degraded per chunk by the
+                    # pipeline's supervisor and never raise to here.)
+                    if classify(exc) == "propagate":
+                        raise
+                    obs.event("degraded", site="serve.batch",
+                              error=type(exc).__name__,
+                              detail=str(exc)[:200])
+                    stage0_by_id = {}
+            for req in batch:
+                self._run_request(req, stage0_by_id.get(req.id))
+
+    def _batch_pipe(self, cfg):
+        from fairify_tpu.parallel.pipeline import LaunchPipeline
+
+        sup = Supervisor(max_retries=cfg.max_launch_retries,
+                         backoff_s=cfg.launch_backoff_s,
+                         deadline_s=cfg.chunk_deadline_s, seed=cfg.seed)
+        return LaunchPipeline(cfg.pipeline_depth, supervisor=sup)
+
+    # --- request execution ------------------------------------------------
+
+    def _run_request(self, req: VerifyRequest, stage0) -> None:
+        registry = obs.registry()
+        req.started_at = time.monotonic()
+        registry.histogram("serve_queue_wait_s").observe(req.queue_wait_s)
+        with obs.span("serve.request", request=req.id, model=req.model_name,
+                      preset=req.cfg.name) as sp:
+            try:
+                faults_mod.check("request.deadline")
+                left = req.deadline_left()
+                if left is not None and left <= 0.0:
+                    req.deadline_missed = True
+                    registry.counter("serve_deadline_miss").inc(stage="queue")
+                    raise AdmissionRejected(
+                        f"deadline expired in queue "
+                        f"(SLA {req.deadline_s:.2f}s, waited "
+                        f"{req.queue_wait_s:.2f}s)")
+                req.status = RUNNING
+                self._journal(req)
+                report = self._execute(req, stage0, left)
+            except BaseException as exc:
+                if classify(exc) == "propagate":
+                    raise
+                req.status = FAILED
+                req.reason = req.reason or \
+                    f"{type(exc).__name__}: {str(exc)[:200]}"
+                req.finished_at = time.monotonic()
+                registry.counter("serve_requests").inc(status=FAILED)
+                registry.counter("serve_request_failures").inc(
+                    error=type(exc).__name__)
+                self.admission.release(req)
+                sp.set(status=req.status, reason=req.reason)
+                self._finish(req)
+                return
+            req.finished_at = time.monotonic()
+            if req.status == REQUEUED:
+                # Span-granular drain preempted it: _execute_spans already
+                # journaled the requeue (and released its backlog share);
+                # the rate EMA must not see its partial elapsed time.
+                sp.set(status=req.status)
+                return
+            req.report = report
+            req.partitions = report.partitions_total
+            req.status = DONE
+            left = req.deadline_left(req.finished_at)
+            if left is not None and left < 0.0 and not req.deadline_missed:
+                # not already counted by a span-granular deadline break
+                req.deadline_missed = True
+                registry.counter("serve_deadline_miss").inc(stage="run")
+            registry.counter("serve_requests").inc(status=DONE)
+            self.admission.finished(req, partitions=req.partitions,
+                                    elapsed_s=req.run_s)
+            sp.set(status=req.status, queue_wait_s=round(req.queue_wait_s, 4),
+                   deadline_missed=req.deadline_missed)
+            self._finish(req)
+
+    def _execute(self, req: VerifyRequest, stage0, deadline_left):
+        """One request's sweep: whole-span, span-granular, or sharded."""
+        from fairify_tpu.verify import sweep as sweep_mod
+
+        cfg = req.cfg
+        if deadline_left is not None:
+            # The SLA bounds refinement spend the same way the hard budget
+            # does; the sweep's own budget honesty enforces it per phase.
+            cfg = cfg.with_(hard_timeout_s=min(cfg.hard_timeout_s,
+                                               deadline_left))
+        if self.cfg.n_shards is not None:
+            from fairify_tpu.parallel import shards as shards_mod
+
+            return shards_mod.sweep_sharded(
+                req.net, cfg, model_name=req.model_name, dataset=req.dataset,
+                n_shards=self.cfg.n_shards, resume=True,
+                partition_span=req.partition_span)
+        if self.cfg.span_chunks <= 0:
+            return sweep_mod.verify_model(
+                req.net, cfg, model_name=req.model_name, dataset=req.dataset,
+                resume=True, stage0=stage0,
+                partition_span=req.partition_span)
+        return self._execute_spans(req, cfg, stage0, sweep_mod)
+
+    def _execute_spans(self, req: VerifyRequest, cfg, stage0, sweep_mod):
+        """Span-granular refinement: yield points for drain + deadline.
+
+        Sub-spans are chunk-aligned so every RNG stream keeps its global
+        key; all sub-runs share ONE sink (the request's full span), so the
+        ledger is a single resumable file whatever the granule.
+        """
+        full = req.partition_span
+        if full is None:
+            full = (0, self._span_size(cfg, None))
+        start, stop = int(full[0]), int(full[1])
+        sink = f"{req.model_name}@{start}-{stop}"
+        granule = max(1, self.cfg.span_chunks) * max(cfg.grid_chunk, 1)
+        outcomes = []
+        reports = []
+        attempted = 0
+        for s in range(start, stop, granule):
+            with self._cv:
+                draining = self._draining
+            if draining:
+                req.status = REQUEUED
+                req.reason = f"drained mid-request at partition {s}"
+                self._requeue(req)
+                break
+            faults_mod.check("request.deadline")
+            left = req.deadline_left()
+            if left is not None and left <= 0.0:
+                req.deadline_missed = True
+                obs.registry().counter("serve_deadline_miss").inc(stage="run")
+                req.reason = (f"deadline hit at partition {s} "
+                              f"({s - start}/{stop - start} attempted)")
+                # Fail, don't report partial coverage as ``done``: the
+                # unattempted tail has NO ledger records (unlike the
+                # whole-span path, whose clamped budget at least ledgers
+                # UNKNOWNs), and §13's contract is expired-SLA → fails
+                # fast.  The partial ledger stays for resume.
+                raise AdmissionRejected(req.reason)
+            e = min(s + granule, stop)
+            sub_cfg = cfg if left is None else \
+                cfg.with_(hard_timeout_s=min(cfg.hard_timeout_s, left))
+            rep = sweep_mod.verify_model(
+                req.net, sub_cfg, model_name=req.model_name,
+                dataset=req.dataset, resume=True,
+                stage0=(None if stage0 is None else
+                        batcher.slice_stage0(stage0, s - start, e - start)),
+                partition_span=(s, e), sink_name=sink)
+            reports.append(rep)
+            outcomes.extend(rep.outcomes)
+            attempted += e - s
+        return sweep_mod.ModelReport(
+            model=req.model_name, dataset=cfg.dataset, outcomes=outcomes,
+            original_acc=next((r.original_acc for r in reports
+                               if r.original_acc), 0.0),
+            total_time_s=sum(r.total_time_s for r in reports),
+            # Attempted, not span width: a deadline break leaves the tail
+            # unattempted with no ledger records, and this count feeds the
+            # admission rate EMA — inflating it would cascade into
+            # admitting infeasible deadlines.
+            partitions_total=attempted, sink_name=sink,
+            ledger_skipped_lines=sum(r.ledger_skipped_lines for r in reports),
+            degraded=sum(r.degraded for r in reports),
+        )
+
+    # --- sinks ------------------------------------------------------------
+
+    def _journal(self, req: VerifyRequest) -> None:
+        self._journal_record(req.to_record())
+
+    def _journal_record(self, rec: dict) -> None:
+        if self._journal_writer is not None:
+            self._journal_writer.append({"ts": round(time.time(), 3), **rec})
+        obs.event("request", **rec)
+
+    def _finish(self, req: VerifyRequest) -> None:
+        """Terminal bookkeeping: journal + client-visible status.json."""
+        self._journal(req)
+        if os.path.isdir(req.cfg.result_dir):
+            _atomic_json(os.path.join(req.cfg.result_dir, "status.json"),
+                         req.to_record())
+        with self._cv:
+            self._cv.notify_all()   # wake wait()ers on the terminal state
+
+    # --- spool inbox ------------------------------------------------------
+
+    def _scan_inbox(self) -> None:
+        inbox = os.path.join(self.cfg.spool, "inbox")
+        try:
+            names = sorted(os.listdir(inbox))
+        except OSError:
+            return
+        for name in names:
+            with self._cv:
+                if self._draining:
+                    # Leave the rest of the inbox untouched for the next
+                    # server (submit() requeues any file already in
+                    # flight, so nothing is lost either way).
+                    return
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(inbox, name)
+            try:
+                with open(path) as fp:
+                    payload = json.load(fp)
+            except OSError:
+                continue  # consumed by a racing server, or an fs flake
+            except json.JSONDecodeError as exc:
+                # The client commit is rename-atomic, so a visible .json
+                # is complete: this is permanent corruption, not a
+                # mid-write.  Quarantine it (never re-parse every poll)
+                # and reject terminally so the client unblocks.
+                self._quarantine(path, name, exc)
+                continue
+            try:
+                os.remove(path)
+            except OSError:
+                continue  # a racing server consumed it first
+            try:
+                self._submit_payload(payload)
+            except BaseException as exc:
+                if classify(exc) == "propagate":
+                    raise
+                obs.event("degraded", site="serve.inbox", file=name,
+                          error=type(exc).__name__, detail=str(exc)[:200])
+
+    def _quarantine(self, path: str, name: str, exc: Exception) -> None:
+        """Move a corrupt inbox payload aside and reject it terminally."""
+        try:
+            os.replace(path, f"{path}.corrupt")
+        except OSError:
+            return  # a racing server got to it first
+        obs.event("degraded", site="serve.inbox", file=name,
+                  error=type(exc).__name__, detail=str(exc)[:200])
+        rid = name[:-len(".json")]
+        rec = {"request": rid, "status": REJECTED, "model": "?",
+               "preset": "?",
+               "reason": f"corrupt payload (quarantined to {name}.corrupt): "
+                         f"{str(exc)[:200]}"}
+        obs.registry().counter("serve_requests").inc(status=REJECTED)
+        self._journal_record(rec)
+        rdir = os.path.join(self.cfg.spool, "requests", rid)
+        os.makedirs(rdir, exist_ok=True)
+        _atomic_json(os.path.join(rdir, "status.json"), rec)
+
+    def _submit_payload(self, payload: dict) -> Optional[VerifyRequest]:
+        from fairify_tpu.serve.client import resolve_payload
+
+        req_id = payload.get("id") or new_request_id()
+        payload = dict(payload, id=req_id)
+        rdir = os.path.join(self.cfg.spool, "requests", req_id)
+        os.makedirs(rdir, exist_ok=True)
+        _atomic_json(os.path.join(rdir, "request.json"), payload)
+        try:
+            cfg, net, model_name, dataset = resolve_payload(payload, rdir)
+            deadline = payload.get("deadline_s", self.cfg.default_deadline_s)
+            span = payload.get("span")
+            ts = payload.get("submitted_ts")
+            return self.submit(
+                cfg, net, model_name, dataset=dataset,
+                deadline_s=None if deadline is None else float(deadline),
+                partition_span=None if span is None else (int(span[0]),
+                                                          int(span[1])),
+                request_id=req_id, spool_payload=payload,
+                submitted_at=None if ts is None
+                else monotonic_from_epoch(float(ts)))
+        except BaseException as exc:
+            if classify(exc) == "propagate":
+                raise
+            # An unprocessable payload — unresolvable (unknown
+            # preset/model, mismatched net) or one whose overrides blow
+            # up grid construction before it queues — is a terminal
+            # rejection: the inbox file is already consumed, so the
+            # waiting client needs a status.json and the journal needs
+            # the transition.  (submit() reports admission refusals by
+            # return value; anything raising through it never queued.)
+            rec = {"request": req_id, "status": REJECTED,
+                   "model": payload.get("model", "?"),
+                   "preset": payload.get("preset", "?"),
+                   "reason": f"{type(exc).__name__}: {str(exc)[:200]}"}
+            obs.registry().counter("serve_requests").inc(status=REJECTED)
+            self._journal_record(rec)
+            _atomic_json(os.path.join(rdir, "status.json"), rec)
+            return None
+
+

@@ -375,7 +375,7 @@ def _stage0_family(stacked, enc: PairEncoding, lo, hi, cfg: SweepConfig,
 
 
 def stage0_families(stacks, enc: PairEncoding, lo, hi, cfg: SweepConfig,
-                    mesh=None, pipe=None):
+                    mesh=None, pipe=None, seed_offset: int = 0):
     """Stage 0 for SEVERAL stacked families through one shared launch queue.
 
     Every (family, grid-chunk) block is an independent launch, so they all
@@ -385,6 +385,12 @@ def stage0_families(stacks, enc: PairEncoding, lo, hi, cfg: SweepConfig,
     AC suite never drains the device queue between architecture groups.
     Returns one result list (per-model ``(unsat, sat, witnesses)``) per
     entry of ``stacks``.
+
+    ``seed_offset`` ties the attack RNG to the grid's GLOBAL start index
+    (same contract as :func:`_stage0_certify_and_attack`): a caller handing
+    a span-local ``lo``/``hi`` slice (the serve batcher coalescing span
+    requests) passes the span start so every chunk draws exactly the
+    samples a whole-grid run would.
     """
     P = lo.shape[0]
     step, spans = _chunk_spans(P, cfg.grid_chunk)
@@ -419,7 +425,7 @@ def stage0_families(stacks, enc: PairEncoding, lo, hi, cfg: SweepConfig,
                     lambda gi=gi, stacked=stacked, s=s, e=e:
                     _family_block_submit(
                         stacked, enc, lo[s:e], hi[s:e], cfg, mesh,
-                        cfg.engine.seed + s, pad_to=step),
+                        cfg.engine.seed + seed_offset + s, pad_to=step),
                     meta=(gi, s, e)):
                 consume(*item)
     for item in pipe.drain():

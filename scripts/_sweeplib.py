@@ -177,20 +177,19 @@ def _budgeted_model_sweep_impl(cfg, net, model_name, dataset):
         # remaining budget — the reference's loop breaks BETWEEN partitions
         # when cumulative time passes the hard budget
         # (``stress/GC/Verify-GC.py:31-35``); a span is this harness's
-        # partition-granule analog.  The 0.5 factor absorbs rate
-        # misestimates (a span that hits a hard-root tail can run ~2× its
-        # stage-0-dominated prediction) so the wall stays within ~10% of
-        # the label instead of overshooting on a last-minute span.  0.4
-        # (was 0.5): a measured 77 s wall on a 60 s relaxed-AC row came
-        # from a third span admitted on a noisy rate estimate.
-        #
-        # In-flight admission: with the async launch pipeline the moment a
-        # span starts, up to ``pipeline_depth`` chunk launches are committed
-        # device work that must drain even if the budget trips mid-span —
-        # so the minimum admissible cost of STARTING a span is the whole
+        # partition-granule analog.  The predicate (and its safety factor
+        # with the rate-misestimate rationale) lives in
+        # ``fairify_tpu.serve.admission.span_admissible`` — the service's
+        # SLA admission applies the same rule at request granularity, and
+        # the two must not drift.  With the async launch pipeline, the
+        # moment a span starts ``depth × chunk`` launches are committed
+        # device work that must drain even if the budget trips mid-span, so
+        # the minimum admissible cost of STARTING a span is the whole
         # in-flight backlog, not one chunk.
+        from fairify_tpu.serve.admission import span_admissible
+
         depth = max(1, int(getattr(cfg, "pipeline_depth", 1)))
-        if rate is not None and (depth * chunk) / rate > 0.4 * left:
+        if not span_admissible(rate, depth, chunk, left):
             break
         stop = min(P, span + K)
         t_block = time.perf_counter()

@@ -24,8 +24,17 @@ IDENTICAL, nothing degraded); a fatal loss must quarantine the shard's
 device group, elastically re-shard its span onto the survivors, and still
 converge to the fault-free verdict map without a resume pass.
 
+Serve cells (``--serve``) extend the matrix to the persistent server
+(``fairify_tpu/serve``): ``launch.*`` and ``request.*`` faults injected
+while TWO concurrent clients share coalesced launches.  The contract
+inside the server loop mirrors DESIGN.md §13's blast-radius table: the
+server never crashes, a faulted *request* degrades or rejects alone while
+its neighbor's decided verdicts stay bit-equal to a solo run, and a
+resubmit after disarm (``resume=True`` over the same request sink)
+converges to the fault-free map.
+
 Usage: python scripts/chaos_matrix.py [--out chaos] [--span 48]
-           [--grid-chunk 16] [--preset GC] [--shards 3]
+           [--grid-chunk 16] [--preset GC] [--shards 3] [--serve]
 """
 from __future__ import annotations
 
@@ -82,6 +91,9 @@ def main() -> int:
     ap.add_argument("--shards", type=int, default=3,
                     help="fault domains for the shard-loss cells "
                          "(0 disables them)")
+    ap.add_argument("--serve", action="store_true",
+                    help="also run the server-loop cells: launch.*/"
+                         "request.* faults under two concurrent clients")
     args = ap.parse_args()
 
     from fairify_tpu.models.train import init_mlp
@@ -226,6 +238,139 @@ def main() -> int:
                     row["ok"] = bool(got == want and row["shard_failures"] >= 1)
                 failures += 0 if row["ok"] else 1
                 print(json.dumps(row), flush=True)
+
+    # Serve cells: faults inside the persistent server loop, two
+    # concurrent clients coalesced into shared launches.  The schedule is
+    # armed GLOBALLY around the server lifetime (requests carry empty
+    # inject_faults, so verify_model's own arming scope is a no-op and the
+    # worker thread sees the plan).
+    if args.serve:
+        from fairify_tpu.resilience import faults as faults_lib
+        from fairify_tpu.serve import ServeConfig, VerificationServer
+
+        net_b = init_mlp((len(cfg0.query().columns), 8, 1), seed=5)
+        base_b = sweep.verify_model(
+            net_b, cfg0.with_(result_dir=os.path.join(args.out, "serve_bb")),
+            model_name="mb", resume=False, partition_span=span)
+        want_b = _vmap(base_b)
+
+        SERVE_CELLS = [
+            # (cell, spec, absorbed): absorbed=True means retries must hide
+            # the fault entirely (identical maps, both done); False means
+            # degradation is allowed but a disarm-resubmit must converge.
+            ("serve/launch.submit/transient", "launch.submit:transient:2",
+             True),
+            ("serve/launch.decode/transient", "launch.decode:transient:2",
+             True),
+            ("serve/launch.submit/exhausted", "launch.submit:transient:2+",
+             False),
+            ("serve/request.deadline/transient", "request.deadline:transient:1",
+             False),
+        ]
+        for cell, spec, absorbed in SERVE_CELLS:
+            rdir = os.path.join(args.out, cell.replace("/", "_").replace(".", "_"))
+            row = {"cell": cell, "spec": spec}
+            dirs = {"ma": os.path.join(rdir, "a"), "mb": os.path.join(rdir, "b")}
+            try:
+                with faults_lib.armed((spec,), seed=cfg0.seed):
+                    srv = VerificationServer(
+                        ServeConfig(batch_window_s=0.4, max_batch=4))
+                    ra = srv.submit(cfg0.with_(result_dir=dirs["ma"]), net,
+                                    "ma", partition_span=span)
+                    rb = srv.submit(cfg0.with_(result_dir=dirs["mb"]), net_b,
+                                    "mb", partition_span=span)
+                    srv.start()
+                    fa = srv.wait(ra.id, timeout=900.0)
+                    fb = srv.wait(rb.id, timeout=900.0)
+                    srv.drain()
+            except BaseException as exc:  # clause 1: the loop never crashes
+                row["crashed"] = f"{type(exc).__name__}: {exc}"
+                row["ok"] = False
+                failures += 1
+                print(json.dumps(row), flush=True)
+                continue
+            row["status"] = {"ma": fa.status, "mb": fb.status}
+            maps = {}
+            for req, name in ((fa, "ma"), (fb, "mb")):
+                maps[name] = {} if req.report is None else _vmap(req.report)
+            wants = {"ma": want, "mb": want_b}
+            decided_match = all(
+                maps[n].get(p) == wants[n][p]
+                for n in maps for p in maps[n] if maps[n][p] != "unknown")
+            row["decided_match"] = decided_match
+            if absorbed:
+                row["ok"] = bool(fa.status == fb.status == "done"
+                                 and maps["ma"] == want
+                                 and maps["mb"] == want_b)
+            else:
+                # Per-request blast radius + recovery: disarm, resubmit
+                # over the same sinks; resume=True must converge both.
+                srv2 = VerificationServer(
+                    ServeConfig(batch_window_s=0.4, max_batch=4))
+                r2a = srv2.submit(cfg0.with_(result_dir=dirs["ma"]), net,
+                                  "ma", partition_span=span)
+                r2b = srv2.submit(cfg0.with_(result_dir=dirs["mb"]), net_b,
+                                  "mb", partition_span=span)
+                srv2.start()
+                f2a = srv2.wait(r2a.id, timeout=900.0)
+                f2b = srv2.wait(r2b.id, timeout=900.0)
+                srv2.drain()
+                row["resume_converged"] = bool(
+                    f2a.status == f2b.status == "done"
+                    and _vmap(f2a.report) == want
+                    and _vmap(f2b.report) == want_b)
+                row["ok"] = bool(decided_match and row["resume_converged"])
+            failures += 0 if row["ok"] else 1
+            print(json.dumps(row), flush=True)
+
+        # request.admit: the decision itself faults — the request is
+        # rejected, never executed, and the server survives to serve the
+        # next client.
+        row = {"cell": "serve/request.admit/transient",
+               "spec": "request.admit:transient:1"}
+        try:
+            with faults_lib.armed(("request.admit:transient:1",),
+                                  seed=cfg0.seed):
+                srv = VerificationServer(ServeConfig(batch_window_s=0.1))
+                ra = srv.submit(
+                    cfg0.with_(result_dir=os.path.join(args.out, "adm_a")),
+                    net, "ma", partition_span=span)
+                rb = srv.submit(
+                    cfg0.with_(result_dir=os.path.join(args.out, "adm_b")),
+                    net_b, "mb", partition_span=span)
+                srv.start()
+                fb = srv.wait(rb.id, timeout=900.0)
+                srv.drain()
+            row["status"] = {"ma": ra.status, "mb": fb.status}
+            row["ok"] = bool(ra.status == "rejected"
+                             and "request.admit" in ra.reason
+                             and fb.status == "done"
+                             and _vmap(fb.report) == want_b)
+        except BaseException as exc:
+            row["crashed"] = f"{type(exc).__name__}: {exc}"
+            row["ok"] = False
+        failures += 0 if row["ok"] else 1
+        print(json.dumps(row), flush=True)
+
+        # serve.drain: a fault during shutdown must not make the drain
+        # deniable — queued requests still requeue, the journal closes.
+        row = {"cell": "serve/serve.drain/transient",
+               "spec": "serve.drain:transient:1"}
+        try:
+            with faults_lib.armed(("serve.drain:transient:1",),
+                                  seed=cfg0.seed):
+                srv = VerificationServer(ServeConfig())  # never started:
+                rq = srv.submit(                         # stays queued
+                    cfg0.with_(result_dir=os.path.join(args.out, "drn")),
+                    net, "ma", partition_span=span)
+                requeued = srv.drain()
+            row["ok"] = bool([r.id for r in requeued] == [rq.id]
+                             and rq.status == "requeued")
+        except BaseException as exc:
+            row["crashed"] = f"{type(exc).__name__}: {exc}"
+            row["ok"] = False
+        failures += 0 if row["ok"] else 1
+        print(json.dumps(row), flush=True)
 
     print(json.dumps({"cells_failed": failures}), flush=True)
     return 1 if failures else 0

@@ -39,6 +39,17 @@ verdict consistency), so a minimal driver baseline gates a rich scaling
 candidate: the throughput metrics simply join the gate once both sides
 carry them.
 
+**SERVE records** (``SERVE_r*.json`` from ``scripts/serve_bench.py``;
+``"kind": "SERVE"``) gate the verification service: per client level,
+``serve.p95_ms@<n>c`` and ``serve.deadline_miss_rate@<n>c`` are
+**lower-is-better** single samples (p95 growth past ``--rel-tol`` fails;
+miss rate gets a 2-point absolute floor on top so a 0.0 baseline doesn't
+fail on one unlucky miss), ``serve.requests_per_s@<n>c`` and
+``serve.batch_occupancy@<n>c`` gate higher-is-better, and
+``serve.warm_xla_compiles`` is lower-is-better with the same 0.5 absolute
+floor as ``n_compiles`` — a warm server that starts recompiling fails
+outright.
+
 ``--self-test`` runs the built-in contract checks (wired into tier-1 via
 ``tests/test_perfdiff.py``): identical records pass, a 2x slowdown fails,
 overlapping noisy bands pass, doubled launches fail.
@@ -93,6 +104,44 @@ def _flat(v: float, strict: bool = False) -> dict:
     return rec
 
 
+def _flat_lower(v: float, floor: float = 0.0) -> dict:
+    """Zero-width-band record for a LOWER-is-better single sample.
+
+    Regression iff the candidate exceeds baseline by the relative
+    tolerance plus ``floor`` absolute slack (the floor lets a 0.0
+    baseline — miss rate, warm compiles — gate growth without failing on
+    measurement grain).
+    """
+    v = float(v)
+    return {"value": v, "min": v, "max": v, "banded": False,
+            "lower": True, "floor": float(floor)}
+
+
+def _serve_records(obj: dict) -> Dict[str, dict]:
+    """Metrics of one SERVE record (``scripts/serve_bench.py``)."""
+    if obj.get("kind") != "SERVE":
+        return {}
+    out: Dict[str, dict] = {}
+    if obj.get("warm_xla_compiles") is not None:
+        out["serve.warm_xla_compiles"] = _flat_lower(
+            obj["warm_xla_compiles"], floor=0.5)
+    for n, row in sorted((obj.get("clients") or {}).items(),
+                         key=lambda kv: int(kv[0])):
+        if not isinstance(row, dict):
+            continue
+        if row.get("p95_ms") is not None:
+            out[f"serve.p95_ms@{n}c"] = _flat_lower(row["p95_ms"])
+        if row.get("deadline_miss_rate") is not None:
+            out[f"serve.deadline_miss_rate@{n}c"] = _flat_lower(
+                row["deadline_miss_rate"], floor=0.02)
+        if row.get("requests_per_s") is not None:
+            out[f"serve.requests_per_s@{n}c"] = _flat(row["requests_per_s"])
+        if row.get("batch_occupancy_mean") is not None:
+            out[f"serve.batch_occupancy@{n}c"] = _flat(
+                row["batch_occupancy_mean"])
+    return out
+
+
 def _multichip_records(obj: dict) -> Dict[str, dict]:
     """Metrics of one MULTICHIP record (``n_devices`` marks the shape).
 
@@ -145,6 +194,10 @@ def load_records(path: str) -> Dict[str, dict]:
         if rec is not None:
             out[_metric_key(obj["metric"])] = rec
             continue
+        sv = _serve_records(obj)
+        if sv:
+            out.update(sv)
+            continue
         mc = _multichip_records(obj)
         if mc:
             out.update(mc)
@@ -176,6 +229,17 @@ def compare(base: Dict[str, dict], cand: Dict[str, dict],
         if c is None:
             findings.append({"metric": key, "kind": "missing",
                              "detail": "metric absent from candidate"})
+            continue
+        # Lower-is-better single samples (SERVE latency/miss-rate): grow
+        # past the tolerance plus the metric's absolute floor and fail.
+        if b.get("lower"):
+            floor = b.get("floor", 0.0)
+            if c["min"] - b["max"] > floor + rel_tol * abs(b["value"]):
+                findings.append({
+                    "metric": key, "kind": "regression",
+                    "detail": (f"grew {b['value']} -> {c['value']} "
+                               f"(> baseline + {rel_tol:.2f}x + {floor} "
+                               f"floor; lower is better)")})
             continue
         # Higher-is-better rate with the noise-band rule; strict metrics
         # (deterministic flags/counts) regress on ANY decrease.
@@ -289,6 +353,32 @@ def self_test() -> int:
         {"n_devices": 8, "ok": True,
          "model_partitions_per_sec": {"1": 98.0, "8": 430.0},
          "scaling_x": 4.4})
+    sv = {"kind": "SERVE", "warm_xla_compiles": 0,
+          "clients": {"4": {"p95_ms": 800.0, "deadline_miss_rate": 0.0,
+                            "requests_per_s": 5.0,
+                            "batch_occupancy_mean": 3.5}}}
+    sv_base = _serve_records(sv)
+    sv_same = _serve_records(json.loads(json.dumps(sv)))
+    sv_slow = _serve_records(
+        {"kind": "SERVE", "warm_xla_compiles": 0,
+         "clients": {"4": {"p95_ms": 1900.0, "deadline_miss_rate": 0.0,
+                           "requests_per_s": 5.0,
+                           "batch_occupancy_mean": 3.5}}})
+    sv_missy = _serve_records(
+        {"kind": "SERVE", "warm_xla_compiles": 0,
+         "clients": {"4": {"p95_ms": 800.0, "deadline_miss_rate": 0.25,
+                           "requests_per_s": 5.0,
+                           "batch_occupancy_mean": 3.5}}})
+    sv_cold = _serve_records(
+        {"kind": "SERVE", "warm_xla_compiles": 5,
+         "clients": {"4": {"p95_ms": 800.0, "deadline_miss_rate": 0.0,
+                           "requests_per_s": 5.0,
+                           "batch_occupancy_mean": 3.5}}})
+    sv_jitter = _serve_records(
+        {"kind": "SERVE", "warm_xla_compiles": 0,
+         "clients": {"4": {"p95_ms": 880.0, "deadline_miss_rate": 0.01,
+                           "requests_per_s": 4.6,
+                           "batch_occupancy_mean": 3.3}}})
     checks = [
         ("identical records pass", compare(base, same), 0),
         ("2x slowdown flagged", compare(base, slow), 1),
@@ -309,6 +399,11 @@ def self_test() -> int:
          compare(mc_base, mc_one_lost), 1),
         ("in-tolerance throughput jitter passes",
          compare(mc_base, mc_jitter), 0),
+        ("identical serve records pass", compare(sv_base, sv_same), 0),
+        ("serve p95 growth flagged", compare(sv_base, sv_slow), 1),
+        ("serve deadline misses flagged", compare(sv_base, sv_missy), 1),
+        ("warm server recompiling flagged", compare(sv_base, sv_cold), 1),
+        ("serve latency/miss jitter passes", compare(sv_base, sv_jitter), 0),
     ]
     failed = 0
     for name, findings, want in checks:

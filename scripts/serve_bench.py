@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""Service-level benchmark: request latency under concurrent clients.
+
+    python scripts/serve_bench.py --out SERVE_r01.json [--clients 1 4 16]
+        [--preset GC] [--span 48] [--grid-chunk 16] [--rounds 2]
+
+Runs ONE warm in-process :class:`fairify_tpu.serve.VerificationServer` and,
+for each client level C, submits C concurrent same-architecture requests
+(distinct synthetic models, so cross-request arch-bucketed coalescing is
+exercised, not per-model caching) and measures per-request wall latency
+submit → terminal state.  The record a round produces is the ``SERVE``
+perfdiff kind::
+
+    {"kind": "SERVE", "clients": {"4": {"p50_ms": ..., "p95_ms": ...,
+     "p99_ms": ..., "deadline_miss_rate": ..., "batch_occupancy_mean": ...,
+     "requests_per_s": ...}, ...},
+     "warm_xla_compiles": 0, "coalesced_device_launches": N,
+     "sequential_device_launches": M}
+
+Two service-health headlines ride along (ISSUE 8 acceptance):
+
+* ``warm_xla_compiles`` — XLA compiles during the 4-client level (the
+  acceptance cell) after warmup.  A warm server must not recompile
+  whatever mix of same-bucket requests arrives: the healthy value is 0.
+  Each level row also carries its own ``xla_compiles`` — the 16-client
+  stress level may legitimately compile *refinement*-path kernels
+  (sign-BaB, pair-LP) the first time a pathological model's UNKNOWNs
+  reach them; that is a new code path, not shape churn, and it shows up
+  in its level's row instead of silently failing the warm gate.
+* ``coalesced_device_launches`` vs ``sequential_device_launches`` — device
+  launches for the 4-client concurrent level vs 4 solo ``verify_model``
+  runs of the same spans.  Coalescing is measurably working iff
+  coalesced < sequential.
+
+``scripts/perfdiff.py`` gates p95 latency and deadline-miss growth between
+two SERVE records (lower-is-better with noise tolerances; see its
+docstring).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _percentiles(latencies_s):
+    import numpy as np
+
+    ms = np.asarray(sorted(latencies_s)) * 1000.0
+    return {
+        "p50_ms": round(float(np.percentile(ms, 50)), 1),
+        "p95_ms": round(float(np.percentile(ms, 95)), 1),
+        "p99_ms": round(float(np.percentile(ms, 99)), 1),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="SERVE_r01.json")
+    ap.add_argument("--preset", default="GC")
+    ap.add_argument("--span", type=int, default=48,
+                    help="partitions per request (one contiguous span)")
+    ap.add_argument("--grid-chunk", type=int, default=16)
+    ap.add_argument("--clients", type=int, nargs="*", default=[1, 4, 16],
+                    help="concurrent-client levels to measure")
+    ap.add_argument("--rounds", type=int, default=2,
+                    help="measurement rounds per level (latency sample size "
+                         "= clients x rounds)")
+    ap.add_argument("--deadline", type=float, default=120.0,
+                    help="per-request SLA in seconds (misses are counted, "
+                         "not fatal; also clamps a pathological request's "
+                         "refinement tail — FIFO refinement means one hard "
+                         "tail delays everything behind it)")
+    ap.add_argument("--work-dir", default="serve_bench_work",
+                    help="scratch directory for request sinks (wiped)")
+    args = ap.parse_args()
+
+    from fairify_tpu import obs
+    from fairify_tpu.models.train import init_mlp
+    from fairify_tpu.obs import compile as compile_obs
+    from fairify_tpu.serve import ServeConfig, VerificationServer
+    from fairify_tpu.verify import presets, sweep
+
+    cfg0 = presets.get(args.preset).with_(
+        soft_timeout_s=10.0, hard_timeout_s=600.0, sim_size=64,
+        exact_certify_masks=False, grid_chunk=args.grid_chunk,
+        launch_backoff_s=1e-4)
+    span = (0, args.span)
+    in_dim = len(cfg0.query().columns)
+    shutil.rmtree(args.work_dir, ignore_errors=True)
+
+    registry = obs.registry()
+    launches = registry.counter("device_launches")
+    # serve_batch_occupancy counts requests that actually entered a
+    # coalesced stage-0 wave (serve_batch_size would also count solo
+    # batches — queue pressure, not coalescing, and it would read full
+    # even with coalescing broken).
+    batch_hist = registry.histogram("serve_batch_occupancy")
+
+    def _net(seed):
+        return init_mlp((in_dim, 8, 1), seed=seed)
+
+    # Sequential baseline: 4 solo runs, counted warm (after one throwaway
+    # cold run that pays the compiles the server's warmup also pays).
+    sweep.verify_model(
+        _net(0), cfg0.with_(result_dir=os.path.join(args.work_dir, "warm")),
+        model_name="warm", resume=False, partition_span=span)
+    seq0 = launches.total()
+    for i in range(4):
+        sweep.verify_model(
+            _net(100 + i),
+            cfg0.with_(result_dir=os.path.join(args.work_dir, f"solo-{i}")),
+            model_name=f"solo-{i}", resume=False, partition_span=span)
+    sequential_launches = int(launches.total() - seq0)
+
+    srv = VerificationServer(ServeConfig(batch_window_s=0.2, max_batch=8))
+    srv.start()
+    # Server warmup: one solo request (solo kernels) plus one coalesced
+    # wave (the fixed-width family executable — pad_models means any later
+    # occupancy reuses it).  After this, the measured levels must hit the
+    # warm executable cache only.
+    w = srv.submit(cfg0.with_(result_dir=os.path.join(args.work_dir, "w0")),
+                   _net(0), "w0", partition_span=span)
+    srv.wait(w.id, timeout=900.0)
+    wave = [srv.submit(
+        cfg0.with_(result_dir=os.path.join(args.work_dir, f"wv{i}")),
+        _net(900 + i), f"wv{i}", partition_span=span) for i in range(2)]
+    for req in wave:
+        srv.wait(req.id, timeout=900.0)
+    compiles0 = compile_obs.snapshot_totals()["n_compiles"]
+
+    levels = {}
+    coalesced_launches = None
+    seed = 1000
+    for n_clients in args.clients:
+        latencies = []
+        misses = 0
+        total = 0
+        b_sum0, b_cnt0 = batch_hist.sum(), batch_hist.count()
+        lvl_l0 = launches.total()
+        lvl_c0 = compile_obs.snapshot_totals()["n_compiles"]
+        t_lvl = time.perf_counter()
+        for rnd in range(args.rounds):
+            reqs = []
+            for c in range(n_clients):
+                seed += 1
+                rdir = os.path.join(args.work_dir,
+                                    f"c{n_clients}-r{rnd}-{c}")
+                reqs.append(srv.submit(
+                    cfg0.with_(result_dir=rdir), _net(seed),
+                    f"m{seed}", deadline_s=args.deadline,
+                    partition_span=span))
+            for req in reqs:
+                done = srv.wait(req.id, timeout=900.0)
+                total += 1
+                if done is None or done.finished_at is None:
+                    misses += 1  # never finished: worse than a miss
+                    continue
+                latencies.append(done.finished_at - done.submitted_at)
+                misses += int(done.deadline_missed
+                              or done.status != "done")
+        wall = time.perf_counter() - t_lvl
+        b_cnt = batch_hist.count() - b_cnt0
+        occupancy = ((batch_hist.sum() - b_sum0) / b_cnt) if b_cnt else 0.0
+        if n_clients == 4:
+            coalesced_launches = int((launches.total() - lvl_l0)
+                                     / args.rounds)
+        levels[str(n_clients)] = {
+            "requests": total,
+            **_percentiles(latencies),
+            "deadline_miss_rate": round(misses / max(total, 1), 4),
+            "batch_occupancy_mean": round(occupancy, 3),
+            "requests_per_s": round(total / wall, 3),
+            "xla_compiles": int(compile_obs.snapshot_totals()["n_compiles"]
+                                - lvl_c0),
+        }
+        print(f"serve_bench: {n_clients:>2} client(s): "
+              f"{levels[str(n_clients)]}", file=sys.stderr)
+    # The warm gate is the acceptance cell: 4 concurrent requests on a
+    # warmed server compile nothing (falls back to the total across levels
+    # when 4 wasn't measured).
+    if "4" in levels:
+        warm_compiles = levels["4"]["xla_compiles"]
+    else:
+        warm_compiles = compile_obs.snapshot_totals()["n_compiles"] - compiles0
+    srv.drain()
+
+    record = {
+        "kind": "SERVE",
+        "preset": args.preset,
+        "span": args.span,
+        "grid_chunk": args.grid_chunk,
+        "rounds": args.rounds,
+        "deadline_s": args.deadline,
+        "clients": levels,
+        "warm_xla_compiles": int(warm_compiles),
+        "coalesced_device_launches": coalesced_launches,
+        "sequential_device_launches": sequential_launches,
+    }
+    with open(args.out, "w") as fp:
+        json.dump(record, fp, indent=1)
+    print(json.dumps(record))
+    ok = warm_compiles == 0 and (
+        coalesced_launches is None or coalesced_launches < sequential_launches)
+    print(f"serve_bench: warm compiles {warm_compiles} "
+          f"(healthy: 0), coalesced launches {coalesced_launches} vs "
+          f"{sequential_launches} sequential -> "
+          f"{'OK' if ok else 'NOT COALESCING'}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

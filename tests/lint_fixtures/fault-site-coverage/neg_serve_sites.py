@@ -1,0 +1,13 @@
+# rel: fairify_tpu/serve/fx_serve.py
+from fairify_tpu.resilience import faults as faults_mod
+
+
+def admit_and_run(request, run):
+    # Literal anchors for the service sites: admission decisions, the
+    # per-request deadline check, and graceful drain each stay a named
+    # chaos-injectable site.
+    faults_mod.check("request.admit")
+    faults_mod.check("request.deadline")
+    rep = run(request)
+    faults_mod.check("serve.drain")
+    return rep

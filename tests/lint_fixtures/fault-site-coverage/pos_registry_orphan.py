@@ -1,4 +1,5 @@
 # rel: fairify_tpu/resilience/faults.py
 FAULT_SITES = frozenset({"demo.used", "demo.orphan", "shard.dispatch",  # EXPECT
-                         "shard.gather", "device.lost"})
+                         "shard.gather", "device.lost", "request.admit",
+                         "request.deadline", "serve.drain"})
 FAULT_KINDS = frozenset({"transient", "fatal", "crash"})

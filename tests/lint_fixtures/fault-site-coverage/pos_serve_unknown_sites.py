@@ -1,0 +1,11 @@
+# rel: fairify_tpu/serve/fx_serve_typos.py
+from fairify_tpu.resilience import faults as faults_mod
+
+
+def admit_and_run_typoed(request, run):
+    # Misspelled service sites: every --inject-fault spec targeting them
+    # is rejected at the CLI while these paths run unprotected.
+    faults_mod.check("request.admitt")  # EXPECT
+    rep = run(request)
+    faults_mod.check("serve.drained")  # EXPECT
+    return rep

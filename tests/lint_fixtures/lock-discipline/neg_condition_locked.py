@@ -1,0 +1,18 @@
+# rel: fairify_tpu/serve/fx_queue_ok.py
+import threading
+
+
+class Queue:
+    def __init__(self):
+        self._cv = threading.Condition(threading.Lock())
+        self._items = []
+
+    def put(self, item):
+        with self._cv:
+            self._items.append(item)
+            self._items = list(self._items)
+            self._cv.notify_all()
+
+    def peek(self):
+        with self._cv:
+            return self._items[-1]

@@ -1,0 +1,28 @@
+# rel: fairify_tpu/serve/fx_queue.py
+import threading
+
+
+class Queue:
+    """A Condition wraps a lock; `with self._cv:` acquires it — state
+    assigned inside that block is lock-protected like any Lock's."""
+
+    def __init__(self):
+        self._cv = threading.Condition(threading.Lock())
+        self._items = []
+        self._draining = False
+
+    def put(self, item):
+        with self._cv:
+            self._items.append(item)
+            self._items = list(self._items)
+            self._cv.notify_all()
+
+    def drain(self):
+        with self._cv:
+            self._draining = True
+
+    def unsafe_peek(self):
+        return self._items[-1]  # EXPECT
+
+    def unsafe_is_draining(self):
+        return self._draining  # EXPECT

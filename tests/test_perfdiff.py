@@ -185,5 +185,48 @@ def test_multichip_record_gating(tmp_path):
     assert perfdiff.main([str(a), str(b)]) == 1
 
 
+def test_serve_record_gating(tmp_path):
+    """SERVE records (scripts/serve_bench.py) load as gated metrics:
+    p95 latency and deadline-miss growth fail (lower-is-better with the
+    miss rate's 2-point absolute floor), a warm server that starts
+    recompiling fails, in-tolerance jitter passes."""
+    base = {"kind": "SERVE", "warm_xla_compiles": 0,
+            "clients": {"1": {"p95_ms": 400.0, "deadline_miss_rate": 0.0,
+                              "requests_per_s": 2.0,
+                              "batch_occupancy_mean": 1.0},
+                        "4": {"p95_ms": 900.0, "deadline_miss_rate": 0.0,
+                              "requests_per_s": 4.0,
+                              "batch_occupancy_mean": 3.5}}}
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(base))
+    assert set(perfdiff.load_records(str(a))) == {
+        "serve.warm_xla_compiles",
+        "serve.p95_ms@1c", "serve.deadline_miss_rate@1c",
+        "serve.requests_per_s@1c", "serve.batch_occupancy@1c",
+        "serve.p95_ms@4c", "serve.deadline_miss_rate@4c",
+        "serve.requests_per_s@4c", "serve.batch_occupancy@4c"}
+    b.write_text(json.dumps(base))
+    assert perfdiff.main([str(a), str(b)]) == 0
+    cand = json.loads(json.dumps(base))
+    cand["clients"]["4"]["p95_ms"] = 2400.0
+    b.write_text(json.dumps(cand))
+    assert perfdiff.main([str(a), str(b)]) == 1
+    cand = json.loads(json.dumps(base))
+    cand["clients"]["4"]["deadline_miss_rate"] = 0.3
+    b.write_text(json.dumps(cand))
+    assert perfdiff.main([str(a), str(b)]) == 1
+    cand = json.loads(json.dumps(base))
+    cand["warm_xla_compiles"] = 4
+    b.write_text(json.dumps(cand))
+    assert perfdiff.main([str(a), str(b)]) == 1
+    # One unlucky miss over a clean baseline stays inside the floor, and
+    # latency jitter inside --rel-tol passes.
+    cand = json.loads(json.dumps(base))
+    cand["clients"]["4"]["deadline_miss_rate"] = 0.015
+    cand["clients"]["4"]["p95_ms"] = 1000.0
+    b.write_text(json.dumps(cand))
+    assert perfdiff.main([str(a), str(b)]) == 0
+
+
 def test_self_test_cli_flag():
     assert perfdiff.main(["--self-test"]) == 0

@@ -1,0 +1,532 @@
+"""The persistent verification server (``fairify_tpu/serve``, DESIGN.md §13).
+
+Four contracts:
+
+* **cross-request isolation** — concurrent requests coalesced into shared
+  arch-bucketed family launches produce ledgers bit-equal to their solo
+  runs (same pinning style as the pipeline depth-invariance tests: the
+  family kernels are the solo kernels under vmap with globally-keyed RNG);
+* **SLA admission** — the budgeted-sweep predicate at request granularity:
+  infeasible deadlines are rejected at submit, queue-expired deadlines
+  fail fast without executing, and ``scripts/_sweeplib.py`` delegates its
+  span predicate here so harness and service cannot drift;
+* **graceful drain** — queued requests requeue to the spool inbox for the
+  next server, a drain mid-request (span-granular mode) preempts at a
+  chunk-aligned boundary, and ``resume=True`` pickup converges to the
+  solo verdict map;
+* **warm-cache economics** — after one warmup request, a batch of
+  concurrent same-bucket requests compiles nothing and launches strictly
+  less than the same requests run sequentially (the coalescing headline).
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from fairify_tpu import obs
+from fairify_tpu.models.train import init_mlp
+from fairify_tpu.obs import compile as compile_obs
+from fairify_tpu.serve import (
+    AdmissionController,
+    AdmissionRejected,
+    ServeConfig,
+    VerificationServer,
+    span_admissible,
+)
+from fairify_tpu.serve import batcher
+from fairify_tpu.serve import client as client_mod
+from fairify_tpu.serve import request as request_mod
+from fairify_tpu.verify import presets, sweep
+
+SPAN = (0, 48)
+
+
+def _cfg(tmp_path, name, **kw):
+    kw.setdefault("grid_chunk", 16)
+    return presets.get("GC").with_(
+        result_dir=str(tmp_path / name), soft_timeout_s=30.0,
+        hard_timeout_s=600.0, sim_size=64, exact_certify_masks=False,
+        launch_backoff_s=1e-4, **kw)
+
+
+def _net(seed=3):
+    return init_mlp((20, 8, 1), seed=seed)
+
+
+def _omap(rep):
+    """partition -> (verdict, counterexample bytes): the bit-equality key."""
+    out = {}
+    for o in rep.outcomes:
+        ce = None if o.counterexample is None else tuple(
+            np.asarray(x).tobytes() for x in o.counterexample)
+        out[o.partition_id] = (o.verdict, ce)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Admission: the budgeted-sweep predicate at request granularity
+# ---------------------------------------------------------------------------
+
+
+def test_span_admissible_is_the_sweeplib_predicate():
+    # No measured rate: the span doubles as the throughput probe.
+    assert span_admissible(None, depth=2, chunk=2048, left_s=0.1)
+    # Committed in-flight backlog is depth x chunk, not one chunk.
+    assert span_admissible(100.0, depth=1, chunk=100, left_s=10.0)
+    assert not span_admissible(100.0, depth=8, chunk=100, left_s=10.0)
+    # The harness's 0.4 safety factor is the default.
+    assert not span_admissible(100.0, depth=1, chunk=100, left_s=2.0)
+    assert span_admissible(100.0, depth=1, chunk=100, left_s=2.6)
+
+
+class _Stub:
+    def __init__(self, rid, partitions, deadline_s=None):
+        self.id = rid
+        self.partitions = partitions
+        self.deadline_s = deadline_s
+
+
+def test_admission_rejects_infeasible_deadline_once_rate_measured():
+    ctl = AdmissionController()
+    # First request always admits (it IS the throughput probe)...
+    ctl.admit(_Stub("a", partitions=1000, deadline_s=0.5))
+    # ...and its completion measures the service rate.
+    ctl.finished(_Stub("a", 1000), partitions=1000, elapsed_s=10.0)
+    assert ctl.rate() == pytest.approx(100.0)
+    # 10k partitions at 100/s = 100s >> 0.8 * 2s deadline: reject.
+    with pytest.raises(AdmissionRejected):
+        ctl.admit(_Stub("b", partitions=10_000, deadline_s=2.0))
+    # Best effort (no deadline) always admits.
+    ctl.admit(_Stub("c", partitions=10_000))
+    # Backlog accounting: c committed 100s of work; a feasible-alone
+    # request must now see the queue ahead of it.
+    with pytest.raises(AdmissionRejected):
+        ctl.admit(_Stub("d", partitions=1000, deadline_s=12.0))
+    ctl.release(_Stub("c", 10_000))
+    ctl.admit(_Stub("d2", partitions=1000, deadline_s=15.0))
+
+
+def test_admission_backlog_frees_on_finish():
+    ctl = AdmissionController()
+    ctl.admit(_Stub("a", 100))
+    ctl.finished(_Stub("a", 100), partitions=100, elapsed_s=1.0)
+    ctl.admit(_Stub("b", 500, deadline_s=60.0))
+    assert ctl.backlog_s() == pytest.approx(5.0)
+    ctl.finished(_Stub("b", 500), partitions=500, elapsed_s=5.0)
+    assert ctl.backlog_s() == 0.0
+    assert ctl.estimate_s(100) == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# Batcher: bucketing rules
+# ---------------------------------------------------------------------------
+
+
+def test_plan_buckets_same_signature_and_arch_only(tmp_path):
+    cfg = _cfg(tmp_path, "a")
+
+    def req(rid, net, cfg=cfg, span=SPAN):
+        r = request_mod.VerifyRequest(
+            id=rid, cfg=cfg, net=net, model_name=rid, partition_span=span)
+        return r
+
+    a, b = req("a", _net(1)), req("b", _net(2))
+    c = req("c", init_mlp((20, 6, 1), seed=3))      # different arch
+    d = req("d", _net(4), cfg=cfg.with_(seed=7))    # different grid seed
+    e = req("e", _net(5), span=(16, 48))            # different span
+    buckets = batcher.plan_buckets([a, b, c, d, e])
+    assert [sorted(r.id for r in bk) for bk in buckets] == [["a", "b"]]
+
+
+def test_stage0_signature_excludes_budgets(tmp_path):
+    cfg = _cfg(tmp_path, "a")
+    sig1 = batcher.stage0_signature(cfg, None)
+    sig2 = batcher.stage0_signature(
+        cfg.with_(soft_timeout_s=1.0, hard_timeout_s=2.0,
+                  result_dir=str(tmp_path / "elsewhere")), None)
+    assert sig1 == sig2
+    assert batcher.stage0_signature(cfg.with_(grid_chunk=8), None) != sig1
+
+
+# ---------------------------------------------------------------------------
+# Cross-request verdict isolation (the tentpole contract)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def solo_maps(tmp_path_factory):
+    """Solo-run verdict maps for the nets the coalescing tests share."""
+    td = tmp_path_factory.mktemp("serve_solo")
+    out = {}
+    for seed in (3, 5):
+        rep = sweep.verify_model(
+            _net(seed), _cfg(td, f"solo-{seed}"), model_name=f"m{seed}",
+            resume=False, partition_span=SPAN)
+        out[seed] = _omap(rep)
+    rep = sweep.verify_model(
+        init_mlp((20, 6, 1), seed=9), _cfg(td, "solo-odd"),
+        model_name="modd", resume=False, partition_span=SPAN)
+    out["odd"] = _omap(rep)
+    assert out[3], "solo span produced no outcomes"
+    return out
+
+
+def test_concurrent_requests_coalesced_bit_equal_solo(tmp_path, solo_maps):
+    """Two same-arch requests coalesce into shared family launches; a
+    third odd-arch request rides the same batch on the solo path.  All
+    three ledgers must be bit-equal to their solo runs."""
+    srv = VerificationServer(ServeConfig(batch_window_s=0.5, max_batch=8))
+    # Queue BEFORE starting the worker: all three are guaranteed to land
+    # in one batch, so the coalesced path (not timing luck) is under test.
+    ra = srv.submit(_cfg(tmp_path, "ra"), _net(3), "m3", partition_span=SPAN)
+    rb = srv.submit(_cfg(tmp_path, "rb"), _net(5), "m5", partition_span=SPAN)
+    rc = srv.submit(_cfg(tmp_path, "rc"), init_mlp((20, 6, 1), seed=9),
+                    "modd", partition_span=SPAN)
+    h = obs.registry().histogram("serve_batch_occupancy")
+    occ0 = h.count()
+    srv.start()
+    fa = srv.wait(ra.id, timeout=600.0)
+    fb = srv.wait(rb.id, timeout=600.0)
+    fc = srv.wait(rc.id, timeout=600.0)
+    srv.drain()
+    assert (fa.status, fb.status, fc.status) == ("done",) * 3, \
+        (fa.reason, fb.reason, fc.reason)
+    assert h.count() > occ0, "batch never coalesced: test proved nothing"
+    assert _omap(fa.report) == solo_maps[3]
+    assert _omap(fb.report) == solo_maps[5]
+    assert _omap(fc.report) == solo_maps["odd"]
+    # The streamed ledger is the client-visible result: same verdicts.
+    led = os.path.join(str(tmp_path / "ra"),
+                       f"GC-{fa.report.sink_name}.ledger.jsonl")
+    with open(led) as fp:
+        recs = {r["partition_id"]: r["verdict"]
+                for r in map(json.loads, fp) if "partition_id" in r}
+    assert recs == {pid: v for pid, (v, _) in solo_maps[3].items()}
+
+
+def test_warm_server_no_recompile_and_fewer_launches(tmp_path):
+    """ISSUE 8 acceptance shape (CI scale): after warmup (one solo
+    request + one coalesced wave that compiles the fixed-width family
+    executable) a 4-request concurrent batch compiles nothing and
+    launches strictly less than the same 4 spans run sequentially."""
+    launches = obs.registry().counter("device_launches")
+    # Sequential baseline, measured warm in this same process.
+    seq0 = launches.total()
+    seq_maps = {}
+    for i, seed in enumerate((11, 12, 13, 14)):
+        rep = sweep.verify_model(
+            _net(seed), _cfg(tmp_path, f"seq-{i}"), model_name=f"s{seed}",
+            resume=False, partition_span=SPAN)
+        seq_maps[seed] = _omap(rep)
+    sequential = launches.total() - seq0
+    srv = VerificationServer(ServeConfig(batch_window_s=0.5, max_batch=4))
+    # Warmup: solo kernels (one request) + the 4-wide family executable
+    # (a coalesced pair — pad_models stretches it to the full max_batch
+    # width, so ANY later occupancy hits the same compiled shape).
+    w = srv.submit(_cfg(tmp_path, "w"), _net(99), "w", partition_span=SPAN)
+    w1 = srv.submit(_cfg(tmp_path, "w1"), _net(21), "w1", partition_span=SPAN)
+    w2 = srv.submit(_cfg(tmp_path, "w2"), _net(22), "w2", partition_span=SPAN)
+    srv.start()
+    for req in (w, w1, w2):
+        assert srv.wait(req.id, timeout=600.0).status == "done"
+    compiles0 = compile_obs.snapshot_totals()["n_compiles"]
+    served0 = launches.total()
+    reqs = [srv.submit(_cfg(tmp_path, f"c-{i}"), _net(seed), f"s{seed}",
+                       partition_span=SPAN)
+            for i, seed in enumerate((11, 12, 13, 14))]
+    finals = [srv.wait(r.id, timeout=600.0) for r in reqs]
+    served = launches.total() - served0
+    srv.drain()
+    assert all(f.status == "done" for f in finals)
+    assert compile_obs.snapshot_totals()["n_compiles"] == compiles0, \
+        "a warm server recompiled on a same-bucket batch"
+    assert served < sequential, \
+        f"coalescing not working: {served} served vs {sequential} sequential"
+    for f, seed in zip(finals, (11, 12, 13, 14)):
+        assert _omap(f.report) == seq_maps[seed], f"request s{seed} diverged"
+
+
+def test_sharded_server_routes_through_fleet_bit_equal(tmp_path, solo_maps):
+    """``--shards N`` routes requests through the PR 7 shard fleet (per-
+    request fault domains over the virtual 8-device mesh); verdicts stay
+    bit-equal to the single-chip solo run."""
+    srv = VerificationServer(ServeConfig(n_shards=2))
+    req = srv.submit(_cfg(tmp_path, "sh"), _net(3), "m3", partition_span=SPAN)
+    srv.start()
+    final = srv.wait(req.id, timeout=600.0)
+    srv.drain()
+    assert final.status == "done", final.reason
+    assert {p: v for p, (v, _) in _omap(final.report).items()} \
+        == {p: v for p, (v, _) in solo_maps[3].items()}
+
+
+# ---------------------------------------------------------------------------
+# SLA enforcement inside the server loop
+# ---------------------------------------------------------------------------
+
+
+def test_queue_expired_deadline_fails_fast_without_executing(tmp_path):
+    srv = VerificationServer(ServeConfig(batch_window_s=0.05))
+    launches = obs.registry().counter("device_launches")
+    l0 = launches.total()
+    req = srv.submit(_cfg(tmp_path, "r"), _net(3), "m",
+                     deadline_s=1e-4, partition_span=SPAN)
+    time.sleep(0.01)  # guarantee the SLA is already blown in queue
+    srv.start()
+    final = srv.wait(req.id, timeout=60.0)
+    srv.drain()
+    assert final.status == "failed"
+    assert final.deadline_missed
+    assert "deadline expired in queue" in final.reason
+    assert launches.total() == l0, "an expired request reached the device"
+
+
+def test_submit_after_drain_rejected(tmp_path):
+    srv = VerificationServer(ServeConfig())
+    srv.start()
+    srv.drain()
+    cfg = _cfg(tmp_path, "r")
+    os.makedirs(cfg.result_dir, exist_ok=True)
+    req = srv.submit(cfg, _net(3), "m", partition_span=SPAN)
+    assert req.status == "rejected"
+    assert "draining" in req.reason
+    # Rejection is terminal: the client-visible status.json must land so
+    # a polling client unblocks instead of waiting out its timeout.
+    with open(os.path.join(cfg.result_dir, "status.json")) as fp:
+        assert json.load(fp)["status"] == "rejected"
+
+
+# ---------------------------------------------------------------------------
+# Graceful drain + spool resume
+# ---------------------------------------------------------------------------
+
+
+def test_drain_requeues_queued_to_inbox_and_next_server_finishes(
+        tmp_path, solo_maps):
+    spool = str(tmp_path / "spool")
+    payload = client_mod.build_payload(
+        "GC", init={"sizes": [20, 8, 1], "seed": 3},
+        overrides={"soft_timeout_s": 30.0, "hard_timeout_s": 600.0,
+                   "sim_size": 64, "exact_certify_masks": False,
+                   "grid_chunk": 16, "launch_backoff_s": 1e-4},
+        span=SPAN)
+    rid = client_mod.submit(spool, payload)
+    # Server 1 ingests the inbox but drains before the worker runs it.
+    srv1 = VerificationServer(ServeConfig(spool=spool))
+    srv1._scan_inbox()
+    requeued = srv1.drain()
+    assert [r.id for r in requeued] == [rid]
+    assert os.path.exists(os.path.join(spool, "inbox", f"{rid}.json")), \
+        "drain must write the queued request back to the inbox"
+    # Server 2 picks it up and converges to the solo verdict map.
+    srv2 = VerificationServer(ServeConfig(spool=spool, poll_s=0.02))
+    srv2.start()
+    final = srv2.wait(rid, timeout=600.0)
+    srv2.drain()
+    assert final is not None and final.status == "done", \
+        (final and final.reason)
+    assert {p: v for p, (v, _) in _omap(final.report).items()} \
+        == {p: v for p, (v, _) in solo_maps[3].items()}
+    # The lifecycle journal recorded the requeue then the completion.
+    with open(os.path.join(spool, "serve.journal.jsonl")) as fp:
+        statuses = [r["status"] for r in map(json.loads, fp)
+                    if r.get("request") == rid]
+    assert "requeued" in statuses and statuses[-1] == "done"
+
+
+def test_drain_mid_request_preempts_at_span_boundary_then_resumes(
+        tmp_path, solo_maps):
+    """Span-granular mode: a drain lands between chunk-aligned granules;
+    the requeued request's next server replays the ledger and converges."""
+    spool = str(tmp_path / "spool")
+    payload = client_mod.build_payload(
+        "GC", init={"sizes": [20, 8, 1], "seed": 3},
+        overrides={"soft_timeout_s": 30.0, "hard_timeout_s": 600.0,
+                   "sim_size": 64, "exact_certify_masks": False,
+                   "grid_chunk": 16, "launch_backoff_s": 1e-4},
+        span=SPAN)
+    rid = client_mod.submit(spool, payload)
+    srv1 = VerificationServer(
+        ServeConfig(spool=spool, span_chunks=1, poll_s=0.02))
+    srv1.start()
+    ledger = os.path.join(spool, "requests", rid,
+                          f"GC-init20x8x1-s3@{SPAN[0]}-{SPAN[1]}.ledger.jsonl")
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 300.0:  # first granule decided?
+        if os.path.exists(ledger) and os.path.getsize(ledger) > 0:
+            break
+        time.sleep(0.02)
+    srv1.drain()
+    mid = srv1.get(rid)
+    assert mid is not None
+    # Deterministically preempted mid-request unless the whole request
+    # outran the poll (tiny span): either way the next server converges.
+    assert mid.status in ("requeued", "done"), mid.reason
+    if mid.status == "requeued":
+        assert "drained mid-request" in mid.reason
+        srv2 = VerificationServer(
+            ServeConfig(spool=spool, span_chunks=1, poll_s=0.02))
+        srv2.start()
+        final = srv2.wait(rid, timeout=600.0)
+        srv2.drain()
+        assert final is not None and final.status == "done", \
+            (final and final.reason)
+        got = final
+    else:
+        got = mid
+    assert {p: v for p, (v, _) in _omap(got.report).items()} \
+        == {p: v for p, (v, _) in solo_maps[3].items()}
+
+
+# ---------------------------------------------------------------------------
+# Client protocol + report table
+# ---------------------------------------------------------------------------
+
+
+def test_build_payload_validates():
+    with pytest.raises(ValueError):
+        client_mod.build_payload("GC")  # neither model nor init
+    with pytest.raises(ValueError):
+        client_mod.build_payload("GC", model="GC-1",
+                                 init={"sizes": [4, 1]})  # both
+    with pytest.raises(ValueError):
+        client_mod.build_payload("GC", init={"sizes": [4]})  # no layers
+
+
+def test_resolve_payload_rejects_mismatched_input_dim(tmp_path):
+    # A 16-input net against GC's 20-attribute domain would fatally
+    # degrade every launch — the resolve gate mirrors run_sweep's.
+    payload = client_mod.build_payload(
+        "GC", init={"sizes": [16, 6, 1], "seed": 0})
+    with pytest.raises(ValueError, match="domain dim"):
+        client_mod.resolve_payload(payload, str(tmp_path / "rdir"))
+
+
+def test_unresolvable_payload_writes_rejected_status(tmp_path):
+    """A bad spool payload must unblock the waiting client with a terminal
+    ``rejected`` status.json before any device launch, not hang it."""
+    spool = str(tmp_path / "spool")
+    rid = client_mod.submit(spool, client_mod.build_payload(
+        "GC", init={"sizes": [16, 6, 1], "seed": 0}))
+    launches = obs.registry().counter("device_launches")
+    l0 = launches.total()
+    srv = VerificationServer(ServeConfig(spool=spool))
+    srv._scan_inbox()
+    srv.drain()
+    rec = client_mod.status(spool, rid)
+    assert rec is not None and rec["status"] == "rejected"
+    assert "domain dim" in rec["reason"]
+    assert launches.total() == l0, "a rejected payload reached the device"
+    with open(os.path.join(spool, "serve.journal.jsonl")) as fp:
+        statuses = [r["status"] for r in map(json.loads, fp)
+                    if r.get("request") == rid]
+    assert statuses and statuses[-1] == "rejected"
+
+
+def test_requeued_pickup_preserves_sla_clock(tmp_path):
+    """The deadline is wall-clock from the ORIGINAL submit: a payload that
+    sat through a drain/requeue handoff must not get a fresh SLA clock at
+    the next server."""
+    spool = str(tmp_path / "spool")
+    payload = client_mod.build_payload(
+        "GC", init={"sizes": [20, 8, 1], "seed": 3},
+        overrides={"grid_chunk": 16}, deadline_s=60.0, span=SPAN)
+    rid = client_mod.submit(spool, payload)
+    path = os.path.join(spool, "inbox", f"{rid}.json")
+    with open(path) as fp:
+        rec = json.load(fp)
+    assert "submitted_ts" in rec
+    rec["submitted_ts"] -= 100.0    # original submit was 100 s ago
+    with open(path, "w") as fp:
+        json.dump(rec, fp)
+    srv = VerificationServer(ServeConfig(spool=spool, poll_s=0.02))
+    srv.start()
+    final = srv.wait(rid, timeout=120.0)
+    srv.drain()
+    assert final is not None and final.status == "failed", \
+        (final and final.status)
+    assert final.deadline_missed
+    assert "deadline expired in queue" in final.reason
+
+
+def test_grid_cache_builds_once_per_signature(tmp_path, monkeypatch):
+    from fairify_tpu.verify import sweep as sweep_mod
+
+    calls = {"n": 0}
+    real = sweep_mod.build_partitions
+
+    def counting(cfg):
+        calls["n"] += 1
+        return real(cfg)
+
+    monkeypatch.setattr(sweep_mod, "build_partitions", counting)
+    srv = VerificationServer(ServeConfig())
+    cfg = _cfg(tmp_path, "a")
+    assert srv._span_size(cfg, None) > 0
+    # Same signature (budgets/sinks excluded): admission sizing and the
+    # batcher's grid_fn both hit the memo.
+    srv._span_size(cfg.with_(result_dir=str(tmp_path / "b")), None)
+    srv._grid(cfg.with_(soft_timeout_s=1.0))
+    assert calls["n"] == 1
+
+
+def test_corrupt_inbox_payload_quarantined_and_rejected(tmp_path):
+    """A torn .json can't be a mid-write (the client commit is
+    rename-atomic): quarantine it — never re-parse it every poll — and
+    reject terminally so the submitting client unblocks."""
+    spool = str(tmp_path / "spool")
+    srv = VerificationServer(ServeConfig(spool=spool))
+    path = os.path.join(spool, "inbox", "rbad.json")
+    with open(path, "w") as fp:
+        fp.write("{not json")
+    srv._scan_inbox()
+    srv.drain()
+    assert not os.path.exists(path)
+    assert os.path.exists(path + ".corrupt")
+    rec = client_mod.status(spool, "rbad")
+    assert rec is not None and rec["status"] == "rejected"
+    assert "corrupt payload" in rec["reason"]
+
+
+def test_resolve_payload_pins_result_dir(tmp_path):
+    payload = client_mod.build_payload(
+        "GC", init={"sizes": [20, 8, 1], "seed": 3},
+        overrides={"result_dir": "/somewhere/evil", "grid_chunk": 16})
+    cfg, net, model_name, dataset = client_mod.resolve_payload(
+        payload, str(tmp_path / "rdir"))
+    assert cfg.result_dir == str(tmp_path / "rdir")
+    assert cfg.grid_chunk == 16
+    assert net.in_dim == 20 and net.layer_sizes == (8, 1)
+    assert model_name == "init20x8x1-s3"
+    assert dataset is None
+
+
+def test_report_renders_request_table(tmp_path, capsys):
+    from fairify_tpu.obs import report as report_mod
+
+    log = tmp_path / "serve.events.jsonl"
+    rows = [
+        {"type": "event", "name": "request", "ts": 1.0, "tid": 1,
+         "attrs": {"request": "r1", "status": "queued", "model": "m3",
+                   "queue_wait_s": 0.0, "run_s": 0.0,
+                   "deadline_missed": False}},
+        {"type": "event", "name": "request", "ts": 2.0, "tid": 1,
+         "attrs": {"request": "r1", "status": "done", "model": "m3",
+                   "queue_wait_s": 0.2, "run_s": 4.5, "sat": 1, "unsat": 47,
+                   "unknown": 0, "deadline_missed": False}},
+        {"type": "event", "name": "request", "ts": 2.0, "tid": 1,
+         "attrs": {"request": "r2", "status": "failed", "model": "m5",
+                   "queue_wait_s": 3.0, "run_s": 0.0,
+                   "deadline_missed": True, "reason": "deadline expired"}},
+    ]
+    log.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    agg = report_mod.aggregate([str(log)])
+    assert agg["requests"]["r1"]["status"] == "done"  # last wins
+    assert agg["requests"]["r1"]["decided"] == 48
+    assert agg["requests"]["r2"]["deadline_missed"]
+    assert report_mod.main([str(log)]) == 0
+    out = capsys.readouterr().out
+    assert "deadline misses: 1" in out
+    assert "r1" in out and "done" in out
