@@ -1,7 +1,16 @@
-"""Post-verification analysis & repair (the reference's L4 layer).
+"""Post-verification analysis & repair (the reference's L4 layer) and the
+jaxpr/IR-level static-analysis suite.
 
-Covers SURVEY.md §2.3: group fairness metrics (an AIF360-equivalent suite in
+L4 (SURVEY.md §2.3): group fairness metrics (an AIF360-equivalent suite in
 numpy/jax — the reference imports ``aif360``), the causal-discrimination
 black-box tester, biased-neuron localization, masked gradient repair,
 two-stage counterexample retraining, and the hybrid fair/original router.
+
+IR suite (DESIGN.md §11 "IR-level passes", ``fairify_tpu lint --ir``):
+:mod:`.avals` (representative avals + per-kernel specs), :mod:`.ir` (the
+shared lowered-registry traversal), :mod:`.passes_host` /
+:mod:`.passes_sound` / :mod:`.passes_recompile` / :mod:`.passes_buffers`
+(the four passes), and :mod:`.irlint` (the ``fairify_tpu.lint`` rule
+adapters).  None of these import at package-import time — the L4 layer
+stays importable without lowering any kernels.
 """

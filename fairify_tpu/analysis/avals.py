@@ -1,0 +1,367 @@
+"""Representative avals + per-kernel specs for the IR analysis suite.
+
+Every kernel registered through ``obs_jit`` is lowered to its closed jaxpr
+under ONE small, deterministic "analysis world" (tiny net, tiny encoding,
+tiny grid) before any pass runs.  The world is chosen so each kernel traces
+the same code paths production does — a PA dim with two assignments, an RA
+dim with ε = 1 (so the RA-widening and RA-lattice branches are live), one
+hidden layer (so sign-BaB and CROWN relaxations are live), and a stacked
+two-model family — while staying small enough that tracing all 19 kernels
+plus the buffer pass's compiles finishes well inside the 30 s CPU budget
+(``tests/test_analysis.py`` pins it).
+
+A :class:`KernelSpec` is the reviewed contract for one kernel:
+
+* ``build(world)`` — the representative ``(args, kwargs)``, assembled the
+  way the real call sites assemble them (``_stage0_block_submit``,
+  ``pgd_attack_submit``, ``decide_box_exhaustive``, …), so the lowered
+  signature IS the production signature shape-for-shape;
+* ``sound`` — whether the kernel's float outputs carry verdict weight
+  (certify path).  The soundness pass restricts exactly these kernels to
+  the sound-ops allowlist; attack/sampling kernels are exempt because
+  their outputs are exact-validated on host before any verdict settles;
+* ``dead_ok`` — reviewed dead-argument exemptions (keystr of the flattened
+  leaf, e.g. the MLP final-layer mask: all-ones by contract, 4 bytes, and
+  part of the single network pytree — not a transfer problem);
+* ``variants`` — production call-shape variants with a declared
+  same-executable expectation; the recompile pass checks the declaration
+  against the ground-truth ``ObsJit.signature_key`` of each variant;
+* ``expected_signatures`` — the compile-signature budget over the baseline
+  + variants (e.g. ``engine.certify_attack`` legitimately buckets into
+  stage-0 (``alpha_iters=0``) and BaB (``alpha_iters=8``) executables —
+  PR 3 measured exactly those 2).
+
+``SOUND_KERNELS`` (derived) names which kernels carry verdict weight; it is
+the registry DESIGN.md §11's soundness catalog documents.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Variant:
+    """One production call-shape variant of a kernel.
+
+    ``same_exec`` declares whether this variant must reuse the baseline
+    executable (same obs_jit cache key).  A declaration the lowered
+    signature contradicts is a finding either way: ``same_exec=True`` with
+    a differing key is a predicted silent recompile; ``same_exec=False``
+    with an equal key is a stale bucketing expectation.
+    """
+
+    desc: str
+    build: Callable[["AnalysisWorld"], Tuple[tuple, dict]]
+    same_exec: bool
+
+
+@dataclass(frozen=True)
+class KernelSpec:
+    name: str
+    build: Callable[["AnalysisWorld"], Tuple[tuple, dict]]
+    sound: bool = False
+    dead_ok: Tuple[str, ...] = ()
+    variants: Tuple[Variant, ...] = ()
+    expected_signatures: Optional[int] = None
+
+
+class AnalysisWorld:
+    """The deterministic tiny universe every kernel is lowered under.
+
+    d = 5 input dims: PA dim 0 (range {0, 1} → V = 2 assignments), RA dim 1
+    (ε = 1), shared dims 2-4 (width 4 each).  One 5→8→1 net (n_hidden = 1),
+    a 2-model stacked family, B = 4 partition boxes, S = 8 attack samples.
+    """
+
+    def __init__(self):
+        import jax
+        import jax.numpy as jnp
+
+        from fairify_tpu.models import mlp as mlp_mod
+        from fairify_tpu.parallel.mesh import stack_models
+        from fairify_tpu.utils.prng import grid_keys
+        from fairify_tpu.verify import engine
+        from fairify_tpu.verify import property as prop
+
+        self.d = d = 5
+        self.B = B = 4
+        self.S = S = 8
+        self.sim_size = 16
+
+        def tiny_net(seed):
+            r = np.random.default_rng(seed)
+            w1 = r.normal(size=(d, 8)).astype(np.float32)
+            b1 = r.normal(size=(8,)).astype(np.float32)
+            w2 = r.normal(size=(8, 1)).astype(np.float32)
+            b2 = r.normal(size=(1,)).astype(np.float32)
+            return mlp_mod.from_numpy([w1, w2], [b1, b2])
+
+        self.net = tiny_net(0)
+        self.stacked = stack_models([tiny_net(0), tiny_net(1)])
+        self.enc = prop.PairEncoding(
+            pa_idx=np.array([0], dtype=np.int32),
+            ra_idx=np.array([1], dtype=np.int32),
+            eps=1,
+            assignments=np.array([[0], [1]], dtype=np.int32),
+            valid_pair=np.array([[False, True], [True, False]]),
+            n_dim=d)
+        self.lo = np.tile(np.array([0, 0, 0, 0, 0], np.int64), (B, 1))
+        self.hi = np.tile(np.array([1, 4, 3, 3, 3], np.int64), (B, 1))
+        self.flo = self.lo.astype(np.float32)
+        self.fhi = self.hi.astype(np.float32)
+        (self.x_lo, self.x_hi, self.xp_lo, self.xp_hi,
+         self.valid) = prop.role_boxes(self.enc, self.flo, self.fhi)
+        (self.assign_vals, self.pa_mask,
+         self.ra_mask) = engine._enc_tensors(self.enc, d)
+        rng = np.random.default_rng(0)
+        self.xr, self.pr = engine.build_attack_candidates(
+            self.enc, rng, self.lo, self.hi, S)
+        self.eps = float(self.enc.eps)
+        self.vp = self.enc.valid_pair
+        self.vp_f = self.vp.astype(np.float32)
+        self.key = jax.random.PRNGKey(0)
+        self.keys = grid_keys(0, 0, B)
+        self.sign0 = (np.zeros((B, 8), np.float32),)  # n_hidden = 1
+        # Parity alive masks: HIDDEN layers only (the kernel rebuilds the
+        # final all-ones mask itself — the IR buffer pass found the old
+        # all-layers tuple shipped a dead (P, 1) buffer per launch).
+        self.alive_hidden = (np.ones((B, 8), np.float32),)
+
+        # Lattice scan layouts (decide_box_exhaustive's device tensors).
+        # Non-RA: suffix dims (2, 3, 4), width 4 each → 64 points.
+        self.lat = dict(
+            strides=np.array([16, 4, 1], np.int32),
+            widths=np.array([4, 4, 4], np.int32),
+            lo_shared=np.array([0, 0, 0], np.int32),
+            chunk=64, dims_tuple=(2, 3, 4), n_total=64)
+        # RA: dim 1 expanded ±ε (width 5 + 2 = 7) laid out innermost.
+        self.lat_ra = dict(
+            strides=np.array([112, 28, 7, 1], np.int32),
+            widths=np.array([4, 4, 4, 7], np.int32),
+            lo_shared=np.array([0, 0, 0, -1], np.int32),
+            chunk=63, dims_tuple=(2, 3, 4, 1), n_total=448, ra_ws=(7,))
+        bases = np.tile(self.flo[0], (self.enc.n_assign, 1))
+        bases[:, 0] = [0.0, 1.0]
+        self.bases = bases.astype(np.float32)
+        self.valid_mask = np.array([True, True])
+        self.jnp = jnp
+
+
+#: Flattened-leaf keystrs of the MLP final-layer mask (all-ones by the
+#: model contract — ``utils/prune.py:235-236`` never prunes the output
+#: layer) for a net passed as argument 0.  Reviewed dead-arg exemption.
+_NET_FINAL_MASK = "[0][0].masks[1]"
+
+
+def _shift(lo, hi, by=1):
+    """Same-shape variant boxes: shifted shared dims (a ragged-but-padded
+    later chunk of the same sweep — must reuse the executable)."""
+    lo2, hi2 = lo.copy(), hi.copy()
+    lo2[:, 2:] += by
+    hi2[:, 2:] += by
+    return lo2, hi2
+
+
+def _role_args(w: AnalysisWorld, lo, hi):
+    from fairify_tpu.verify import property as prop
+
+    flo, fhi = lo.astype(np.float32), hi.astype(np.float32)
+    x_lo, x_hi, xp_lo, xp_hi, valid = prop.role_boxes(w.enc, flo, fhi)
+    return flo, fhi, x_lo, x_hi, xp_lo, xp_hi, valid
+
+
+def _certify_args(w: AnalysisWorld, lo, hi, alpha_iters: int):
+    flo, fhi, x_lo, x_hi, xp_lo, xp_hi, valid = _role_args(w, lo, hi)
+    return ((w.net, x_lo, x_hi, xp_lo, xp_hi, flo, fhi, w.assign_vals,
+             w.pa_mask, w.ra_mask, w.eps, valid, w.vp),
+            {"alpha_iters": alpha_iters})
+
+
+def _certify_attack_args(w: AnalysisWorld, lo, hi, alpha_iters: int):
+    args, kw = _certify_args(w, lo, hi, alpha_iters)
+    return args + (w.xr, w.pr), kw
+
+
+def _family_certify_args(w: AnalysisWorld, alpha_iters: int):
+    return ((w.stacked, w.x_lo, w.x_hi, w.xp_lo, w.xp_hi, w.flo, w.fhi,
+             w.assign_vals, w.pa_mask, w.ra_mask, w.eps, w.valid, w.vp),
+            {"alpha_iters": alpha_iters})
+
+
+def _pgd_args(w: AnalysisWorld, steps: int, restarts: int):
+    return ((w.net, w.flo, w.fhi, w.assign_vals, w.pa_mask, w.ra_mask,
+             w.valid, w.eps, w.key), {"steps": steps, "restarts": restarts})
+
+
+def _lat_args(w: AnalysisWorld, c0: int):
+    L = w.lat
+    return ((w.net, np.int32(c0), np.int32(L["n_total"]), L["strides"],
+             L["widths"], L["lo_shared"], w.bases, w.valid_mask, w.vp_f),
+            {"chunk": L["chunk"], "dims_tuple": L["dims_tuple"], "d": w.d})
+
+
+def _lat_ra_args(w: AnalysisWorld, c0: int):
+    L = w.lat_ra
+    return ((w.net, np.int32(c0), np.int32(L["n_total"]), L["strides"],
+             L["widths"], L["lo_shared"], w.bases, w.valid_mask, w.vp_f),
+            {"chunk": L["chunk"], "dims_tuple": L["dims_tuple"], "d": w.d,
+             "ra_ws": L["ra_ws"], "eps": 1})
+
+
+def kernel_specs() -> Dict[str, KernelSpec]:
+    """The reviewed spec registry: one entry per obs_jit kernel."""
+    specs = [
+        KernelSpec(
+            "engine.role_logit_bounds",
+            lambda w: ((w.net, w.x_lo, w.x_hi, w.xp_lo, w.xp_hi, True), {}),
+            sound=True, dead_ok=(_NET_FINAL_MASK,),
+            variants=(Variant(
+                "shifted boxes, same shapes",
+                lambda w: ((w.net,) + _role_args(w, *_shift(w.lo, w.hi))[2:6]
+                           + (True,), {}),
+                same_exec=True),),
+            expected_signatures=1),
+        KernelSpec(
+            "engine.role_certify",
+            lambda w: _certify_args(w, w.lo, w.hi, 0),
+            sound=True, dead_ok=(_NET_FINAL_MASK,),
+            variants=(
+                Variant("shifted boxes, same shapes",
+                        lambda w: _certify_args(w, *_shift(w.lo, w.hi), 0),
+                        same_exec=True),
+                Variant("BaB bucket (alpha_iters=8)",
+                        lambda w: _certify_args(w, w.lo, w.hi, 8),
+                        same_exec=False),
+            ),
+            expected_signatures=2),
+        KernelSpec(
+            "engine.certify_attack",
+            lambda w: _certify_attack_args(w, w.lo, w.hi, 0),
+            sound=True, dead_ok=(_NET_FINAL_MASK,),
+            variants=(
+                Variant("shifted boxes, same shapes",
+                        lambda w: _certify_attack_args(
+                            w, *_shift(w.lo, w.hi), 0),
+                        same_exec=True),
+                Variant("BaB bucket (alpha_iters=8)",
+                        lambda w: _certify_attack_args(w, w.lo, w.hi, 8),
+                        same_exec=False),
+            ),
+            expected_signatures=2),
+        KernelSpec(
+            "engine.attack_logits",
+            lambda w: ((w.net, w.xr, w.pr), {}),
+            dead_ok=(_NET_FINAL_MASK,)),
+        KernelSpec(
+            "engine.pgd_attack_kernel",
+            lambda w: _pgd_args(w, 30, 32),
+            dead_ok=(_NET_FINAL_MASK,),
+            variants=(Variant("deep-PGD bucket (60, 96)",
+                              lambda w: _pgd_args(w, 60, 96),
+                              same_exec=False),),
+            expected_signatures=2),
+        KernelSpec(
+            "engine.sign_bound_kernel",
+            lambda w: ((w.net, w.flo, w.fhi, w.sign0),
+                       {"alpha_iters": 0}),
+            sound=True, dead_ok=(_NET_FINAL_MASK,),
+            variants=(Variant(
+                "BaB bucket (alpha_iters=8)",
+                lambda w: ((w.net, w.flo, w.fhi, w.sign0),
+                           {"alpha_iters": 8}),
+                same_exec=False),),
+            expected_signatures=2),
+        KernelSpec(
+            "engine.inter_bounds_kernel",
+            lambda w: ((w.net, w.flo, w.fhi), {}),
+            sound=True, dead_ok=(_NET_FINAL_MASK,),
+            expected_signatures=1),
+        KernelSpec(
+            "engine.sample_role_logits",
+            lambda w: ((w.net, w.xr, w.pr), {}),
+            dead_ok=(_NET_FINAL_MASK,)),
+        KernelSpec(
+            "sweep.family_certify_kernel",
+            lambda w: _family_certify_args(w, 0),
+            sound=True, dead_ok=(_NET_FINAL_MASK,),
+            variants=(Variant("BaB bucket (alpha_iters=8)",
+                              lambda w: _family_certify_args(w, 8),
+                              same_exec=False),),
+            expected_signatures=2),
+        KernelSpec(
+            "sweep.family_stage0_kernel",
+            lambda w: (_family_certify_args(w, 0)[0] + (w.xr, w.pr),
+                       {"alpha_iters": 0}),
+            sound=True, dead_ok=(_NET_FINAL_MASK,),
+            expected_signatures=1),
+        KernelSpec(
+            "sweep.family_bounds_kernel",
+            lambda w: ((w.stacked, w.x_lo, w.x_hi, w.xp_lo, w.xp_hi, True),
+                       {}),
+            sound=True, dead_ok=(_NET_FINAL_MASK,)),
+        KernelSpec(
+            "sweep.family_logits_kernel",
+            lambda w: ((w.stacked, w.xr, w.pr), {}),
+            dead_ok=(_NET_FINAL_MASK,)),
+        KernelSpec(
+            "sweep.parity_grid_from_keys",
+            lambda w: ((w.net, w.keys, w.flo, w.fhi, w.alive_hidden),
+                       {"sim_size": w.sim_size}),
+            dead_ok=(_NET_FINAL_MASK,),
+            expected_signatures=1),
+        KernelSpec(
+            "sweep.sim_rows",
+            lambda w: ((w.keys[0], w.flo[0], w.fhi[0]),
+                       {"sim_size": w.sim_size})),
+        KernelSpec(
+            "pruning.sim_and_bounds",
+            lambda w: ((w.net, w.keys, w.flo, w.fhi),
+                       {"sim_size": w.sim_size, "with_sim": True}),
+            dead_ok=(_NET_FINAL_MASK,),
+            variants=(Variant(
+                "transfer-light bucket (with_sim=False)",
+                lambda w: ((w.net, w.keys, w.flo, w.fhi),
+                           {"sim_size": w.sim_size, "with_sim": False}),
+                same_exec=False),),
+            expected_signatures=2),
+        KernelSpec(
+            "pruning.sim_stats",
+            lambda w: ((w.net, w.keys, w.flo, w.fhi),
+                       {"sim_size": w.sim_size}),
+            dead_ok=(_NET_FINAL_MASK,)),
+        KernelSpec(
+            "lattice.lattice_scan_kernel",
+            lambda w: _lat_args(w, 0),
+            sound=True, dead_ok=(_NET_FINAL_MASK,),
+            variants=(Variant("later chunk (c0=64), same shapes",
+                              lambda w: _lat_args(w, 64),
+                              same_exec=True),),
+            expected_signatures=1),
+        KernelSpec(
+            "lattice.lattice_signs_kernel",
+            lambda w: ((w.net, np.int32(0), w.lat["strides"],
+                        w.lat["widths"], w.lat["lo_shared"], w.bases),
+                       {"chunk": w.lat["chunk"],
+                        "dims_tuple": w.lat["dims_tuple"], "d": w.d}),
+            sound=True, dead_ok=(_NET_FINAL_MASK,)),
+        KernelSpec(
+            "lattice.lattice_scan_kernel_ra",
+            lambda w: _lat_ra_args(w, 0),
+            sound=True, dead_ok=(_NET_FINAL_MASK,),
+            variants=(Variant("later chunk (c0=63), same shapes",
+                              lambda w: _lat_ra_args(w, 63),
+                              same_exec=True),),
+            expected_signatures=1),
+    ]
+    return {s.name: s for s in specs}
+
+
+def sound_kernels() -> Tuple[str, ...]:
+    """Kernels whose float outputs carry verdict weight (certify path)."""
+    return tuple(sorted(n for n, s in kernel_specs().items() if s.sound))
+
+
+SOUND_KERNELS = sound_kernels

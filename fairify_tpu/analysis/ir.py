@@ -1,0 +1,363 @@
+"""Jaxpr-level kernel view + one cached traversal for the IR passes.
+
+The AST lint engine (PR 6) sees Python source; this module sees what XLA
+sees.  :class:`KernelIR` lowers one ``obs_jit`` kernel to its closed jaxpr
+under the representative avals of :mod:`fairify_tpu.analysis.avals` —
+through :meth:`ObsJit.lowered_for_analysis`, the same explicit AOT path the
+compile registry uses, minus the accounting (analysis must never pollute
+``xla_compiles`` or the kernel stats) — and precomputes everything every
+pass needs:
+
+* the recursive equation list (sub-jaxprs of ``scan``/``cond``/``pjit``/
+  custom calls flattened in),
+* the flat dynamic-leaf list with tree keystrs, aligned 1:1 with the
+  jaxpr's invars (dead-argument attribution by name, not index),
+* the ground-truth executable-cache signature key (and one per declared
+  production variant),
+* lazily, the compiled executable's ``memory_analysis()`` (buffer pass
+  cross-check).
+
+:class:`IRContext` builds the whole registry once and is shared by all
+four pass rules — the "one cached traversal" contract: tracing all 19
+kernels costs ~3 s on CPU, so each pass iterating its own lowering would
+blow the 30 s sweep budget four times over.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from fairify_tpu.analysis import avals as avals_mod
+
+
+def iter_eqns(jaxpr, _seen=None) -> Iterable:
+    """Every equation of ``jaxpr`` including all nested sub-jaxprs.
+
+    Sub-jaxprs hide in eqn params (``jaxpr``/``branches``/``cond_jaxpr``/
+    ``call_jaxpr``…) as either open jaxprs or ClosedJaxpr wrappers; the
+    walk dedupes by id so shared closures are visited once.
+    """
+    if _seen is None:
+        _seen = set()
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for pv in eqn.params.values():
+            vals = pv if isinstance(pv, (list, tuple)) else [pv]
+            for v in vals:
+                inner = getattr(v, "jaxpr", None)
+                if inner is not None:
+                    j = inner if hasattr(inner, "eqns") else inner.jaxpr
+                    if id(j) not in _seen:
+                        _seen.add(id(j))
+                        yield from iter_eqns(j, _seen)
+                elif hasattr(v, "eqns") and id(v) not in _seen:
+                    _seen.add(id(v))
+                    yield from iter_eqns(v, _seen)
+
+
+def aval_bytes(aval) -> int:
+    """Byte size of one abstract value (0 when it has no array layout,
+    e.g. extended PRNG-key dtypes whose itemsize is opaque)."""
+    try:
+        n = 1
+        for s in aval.shape:
+            n *= int(s)
+        return n * int(aval.dtype.itemsize)
+    except Exception:
+        return 0
+
+
+@dataclass
+class KernelIR:
+    """One kernel's lowered view + spec metadata (see module docstring)."""
+
+    name: str
+    path: str  # repo-relative source file of the wrapped function
+    line: int  # def line of the wrapped function
+    function: str  # attribution key (wrapped function's __name__)
+    spec: Optional[avals_mod.KernelSpec] = None
+    closed_jaxpr: Any = None
+    lower_error: Optional[str] = None
+    statics: Tuple = ()
+    signature_key: Any = None
+    #: [(keystr, aval)] aligned with closed_jaxpr.jaxpr.invars.
+    leaves: List[Tuple[str, Any]] = field(default_factory=list)
+    #: Variant desc → (signature_key | None, same_exec declaration).
+    variant_keys: Dict[str, Tuple[Any, bool]] = field(default_factory=dict)
+    #: Runtime stats of the live ObsJit (None for fixture kernels).
+    stats: Any = None
+    jit_kwargs: Dict[str, Any] = field(default_factory=dict)
+    _compiled: Any = None
+    _compile_error: Optional[str] = None
+
+    # -- derived views ----------------------------------------------------
+    def eqns(self) -> Iterable:
+        return iter_eqns(self.closed_jaxpr.jaxpr) if self.closed_jaxpr \
+            else ()
+
+    def consts(self) -> list:
+        return list(self.closed_jaxpr.consts) if self.closed_jaxpr else []
+
+    def arg_bytes(self) -> int:
+        return sum(aval_bytes(v.aval)
+                   for v in self.closed_jaxpr.jaxpr.invars)
+
+    def out_bytes(self) -> int:
+        return sum(aval_bytes(getattr(v, "aval", None)) if hasattr(
+            getattr(v, "aval", None), "shape") else 0
+            for v in self.closed_jaxpr.jaxpr.outvars)
+
+    def largest_intermediate(self) -> Tuple[int, str]:
+        """(bytes, 'prim:aval') of the biggest single equation output —
+        the jaxpr-derived temp-buffer estimate the buffer pass
+        cross-checks against ``memory_analysis()``."""
+        big, desc = 0, ""
+        for eqn in self.eqns():
+            for ov in eqn.outvars:
+                av = getattr(ov, "aval", None)
+                if av is not None and hasattr(av, "shape"):
+                    nb = aval_bytes(av)
+                    if nb > big:
+                        big = nb
+                        desc = f"{eqn.primitive.name}:{av.str_short()}"
+        return big, desc
+
+    def dead_invars(self) -> List[Tuple[str, Any]]:
+        """Top-level invars no equation consumes (keystr, aval).
+
+        Jaxprs are lexically scoped, so an argument used only inside a
+        ``scan``/``cond``/``pjit`` body still appears in that call
+        equation's invars — the top-level scan is exact for top-level
+        deadness (deadness *inside* an inner call is the inner kernel's
+        own report).
+        """
+        if self.closed_jaxpr is None:
+            return []
+        used = set()
+        for eqn in self.closed_jaxpr.jaxpr.eqns:
+            for iv in eqn.invars:
+                if not _is_literal(iv):
+                    used.add(id(iv))
+        # An argument returned verbatim IS consumed — that case is the
+        # passthrough finding, not a dead argument ("drop it" would be
+        # wrong advice for a value the caller reads back).
+        for ov in self.closed_jaxpr.jaxpr.outvars:
+            if not _is_literal(ov):
+                used.add(id(ov))
+        out = []
+        invars = self.closed_jaxpr.jaxpr.invars
+        for i, v in enumerate(invars):
+            if id(v) not in used:
+                ks = self.leaves[i][0] if i < len(self.leaves) else f"[{i}]"
+                out.append((ks, v.aval))
+        return out
+
+    def passthrough_outputs(self) -> List[str]:
+        """Outputs that are verbatim inputs (a pointless round-trip)."""
+        if self.closed_jaxpr is None:
+            return []
+        inv = {id(v): i for i, v in
+               enumerate(self.closed_jaxpr.jaxpr.invars)}
+        out = []
+        for v in self.closed_jaxpr.jaxpr.outvars:
+            if id(v) in inv:
+                i = inv[id(v)]
+                ks = self.leaves[i][0] if i < len(self.leaves) else f"[{i}]"
+                out.append(ks)
+        return out
+
+    # -- compiled view (lazy; buffer pass only) ---------------------------
+    def memory_analysis(self):
+        """``memory_analysis()`` of the compiled executable, or None.
+
+        Compiled lazily and cached; every failure mode (backend without
+        the analysis, compile error) degrades to None — the cross-check
+        is an extra gauge, never a gate on its own availability.
+        """
+        if self._compiled is None and self._compile_error is None \
+                and self.closed_jaxpr is not None and self._lowered is not None:
+            try:
+                self._compiled = self._lowered.compile()
+            except Exception as exc:  # pragma: no cover - backend-specific
+                self._compile_error = f"{type(exc).__name__}: {exc}"
+        if self._compiled is None:
+            return None
+        try:
+            return self._compiled.memory_analysis()
+        except Exception:  # pragma: no cover - backend-specific
+            return None
+
+    _lowered: Any = None
+
+    # -- constructors -----------------------------------------------------
+    @classmethod
+    def from_obs_jit(cls, kernel, spec: avals_mod.KernelSpec,
+                     world: avals_mod.AnalysisWorld,
+                     include_stats: bool = False) -> "KernelIR":
+        """``include_stats=True`` attaches the kernel's LIVE process stats
+        (fallback-only detection in the recompile pass) — for interactive
+        diagnosis of a running process.  The lint gate leaves it off:
+        process-cumulative stats depend on what else ran first (chaos
+        tests inject compile faults), and a repo gate must be a function
+        of the repo, not of test ordering.
+        """
+        fn = getattr(kernel, "__wrapped__", kernel)
+        code = fn.__code__
+        kir = cls(name=kernel.name, path=_rel(code.co_filename),
+                  line=code.co_firstlineno, function=fn.__name__,
+                  spec=spec,
+                  stats=getattr(kernel, "stats", None) if include_stats
+                  else None,
+                  jit_kwargs=dict(getattr(kernel, "_jit_kwargs", {}) or {}))
+        try:
+            args, kwargs = spec.build(world)
+            traced = kernel.lowered_for_analysis(*args, **kwargs)
+            kir.closed_jaxpr = traced.jaxpr
+            kir._lowered = traced.lower()
+            kir.signature_key = kernel.signature_key(*args, **kwargs)
+            _, _, kir.statics = kernel._split(args, kwargs)
+            kir.leaves = _leaf_paths(kernel, args, kwargs)
+        except Exception as exc:
+            kir.lower_error = f"{type(exc).__name__}: {exc}"
+            return kir
+        for var in spec.variants:
+            try:
+                vargs, vkwargs = var.build(world)
+                vkey = kernel.signature_key(*vargs, **vkwargs)
+            except Exception:
+                vkey = None
+            kir.variant_keys[var.desc] = (vkey, var.same_exec)
+        return kir
+
+    @classmethod
+    def from_fn(cls, fn, args, kwargs=None, static_argnames=(),
+                name: Optional[str] = None,
+                spec: Optional[avals_mod.KernelSpec] = None,
+                **jit_kwargs) -> "KernelIR":
+        """Lower a plain function the way the registry kernels are lowered
+        — the entry the fixture corpus (and ad-hoc tooling) uses.  Wraps
+        with an UNREGISTERED ObsJit so signature keys and the split logic
+        are the real ones, without polluting :func:`obs.compile.kernels`.
+        """
+        from fairify_tpu.obs.compile import ObsJit
+
+        kernel = ObsJit(fn, name=name or f"fixture.{fn.__name__}",
+                        static_argnames=static_argnames, register=False,
+                        **jit_kwargs)
+        kwargs = kwargs or {}
+        spec = spec or avals_mod.KernelSpec(kernel.name,
+                                            lambda w: (args, kwargs))
+        code = getattr(fn, "__code__", None)
+        kir = cls(name=kernel.name,
+                  path=_rel(code.co_filename) if code else "<fixture>",
+                  line=code.co_firstlineno if code else 0,
+                  function=getattr(fn, "__name__", "<fixture>"),
+                  spec=spec, stats=kernel.stats,
+                  jit_kwargs=dict(jit_kwargs))
+        try:
+            traced = kernel.lowered_for_analysis(*args, **kwargs)
+            kir.closed_jaxpr = traced.jaxpr
+            kir._lowered = traced.lower()
+            kir.signature_key = kernel.signature_key(*args, **kwargs)
+            _, _, kir.statics = kernel._split(args, kwargs)
+            kir.leaves = _leaf_paths(kernel, args, kwargs)
+        except Exception as exc:
+            kir.lower_error = f"{type(exc).__name__}: {exc}"
+            return kir
+        for var in spec.variants:
+            try:
+                vargs, vkwargs = var.build(None)
+                vkey = kernel.signature_key(*vargs, **vkwargs)
+            except Exception:
+                vkey = None
+            kir.variant_keys[var.desc] = (vkey, var.same_exec)
+        return kir
+
+
+def _is_literal(v) -> bool:
+    return v.__class__.__name__ == "Literal"
+
+
+def _leaf_paths(kernel, args, kwargs) -> List[Tuple[str, Any]]:
+    import jax.tree_util as jtu
+
+    dyn_args, dyn_kwargs, _ = kernel._split(args, kwargs)
+    flat, _ = jtu.tree_flatten_with_path((dyn_args, dyn_kwargs))
+    return [(jtu.keystr(path), leaf) for path, leaf in flat]
+
+
+def _rel(path: str) -> str:
+    from fairify_tpu.lint.core import repo_root
+
+    try:
+        return os.path.relpath(path, repo_root()).replace(os.sep, "/")
+    except ValueError:  # pragma: no cover - cross-drive on win
+        return path
+
+
+def kernel_in_scope(kernel) -> bool:
+    """True iff the kernel's wrapped function lives under ``fairify_tpu/``.
+
+    The IR suite audits the repo's kernels — the same path-prefix scope
+    the AST rules use.  Kernels registered by test files, fixtures, or
+    scratch scripts (anything outside the package) are out of scope, so
+    the repo gate is independent of which tests ran first in the process.
+    """
+    fn = getattr(kernel, "__wrapped__", kernel)
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return False
+    return _rel(code.co_filename).startswith("fairify_tpu/")
+
+
+class IRContext:
+    """Lowered view of the whole obs_jit registry, built once, shared.
+
+    Importing the kernel modules is what populates the registry — the
+    constructor imports exactly the modules the registry contract names
+    (``verify.engine`` / ``verify.sweep`` / ``verify.pruning`` /
+    ``ops.lattice``), then lowers every registered kernel under the one
+    :class:`avals.AnalysisWorld`.  ``missing_specs`` names kernels that
+    registered without a spec — the recompile pass turns those into
+    findings, so a new kernel cannot silently dodge IR analysis.
+    """
+
+    def __init__(self, include_stats: bool = False):
+        import time
+
+        t0 = time.perf_counter()
+        # Registry population: the four kernel-bearing modules.
+        import fairify_tpu.ops.lattice  # noqa: F401
+        import fairify_tpu.verify.engine  # noqa: F401
+        import fairify_tpu.verify.pruning  # noqa: F401
+        import fairify_tpu.verify.sweep  # noqa: F401
+        from fairify_tpu.obs import compile as obs_compile
+
+        specs = avals_mod.kernel_specs()
+        world = avals_mod.AnalysisWorld()
+        self.world = world
+        self.kernels: List[KernelIR] = []
+        self.missing_specs: List[Any] = []
+        for name, kernel in sorted(obs_compile.kernels().items()):
+            if not kernel_in_scope(kernel):
+                continue  # test/scratch kernels: outside the repo scope
+            spec = specs.get(name)
+            if spec is None:
+                self.missing_specs.append(kernel)
+                continue
+            self.kernels.append(KernelIR.from_obs_jit(
+                kernel, spec, world, include_stats=include_stats))
+        self.unlowered_specs = sorted(set(specs) - set(
+            obs_compile.kernels()))
+        self.build_s = time.perf_counter() - t0
+
+
+_SHARED: Dict[str, IRContext] = {}
+
+
+def shared_context() -> IRContext:
+    """The process-wide cached context all four pass rules share."""
+    if "ctx" not in _SHARED:
+        _SHARED["ctx"] = IRContext()
+    return _SHARED["ctx"]

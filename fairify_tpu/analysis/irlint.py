@@ -1,0 +1,97 @@
+"""IR passes as ``fairify_tpu.lint`` rules: ``fairify_tpu lint --ir``.
+
+Each pass module exposes ``PASS_ID`` + ``check_kernel(KernelIR) -> [msg]``;
+this module wraps the four of them as :class:`fairify_tpu.lint.core.Rule`
+plugins so findings ride the existing machinery unchanged — severities,
+``# lint: disable=<id>`` inline suppressions (on the kernel's ``def``
+line), ``audits/lint_baseline.json`` grandfathering, ``--ratchet``, text
+and JSON rendering.  Findings are attributed to the kernel's real source
+location (``path:def-line``, function = the wrapped function's name), so
+baseline keys look like ``ir-buffers::fairify_tpu/verify/sweep.py::
+_parity_grid_from_keys``.
+
+All four rules share ONE :class:`fairify_tpu.analysis.ir.IRContext`
+(process-cached): the registry is imported, specced, and lowered exactly
+once per run — the passes are different views over the same cached
+traversal.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+from fairify_tpu.lint.core import Finding, Rule
+
+IR_RULE_IDS = ("ir-host-transfer", "ir-soundness", "ir-recompile",
+               "ir-buffers")
+
+
+class IRRule(Rule):
+    """Adapter: run one pass over the shared lowered registry.
+
+    Per-file ``check`` is a no-op — kernels, not files, are the unit —
+    and all findings come from ``finalize`` so the engine's suppression
+    lookup (which needs the file contexts) applies normally.
+    """
+
+    scope = ("fairify_tpu/",)
+
+    def __init__(self, pass_mod, ctx=None):
+        self._pass = pass_mod
+        self._ctx = ctx
+        self.id = pass_mod.PASS_ID
+        self.description = (pass_mod.__doc__ or "").strip().splitlines()[0]
+
+    def _context(self):
+        if self._ctx is None:
+            from fairify_tpu.analysis import ir as ir_mod
+
+            self._ctx = ir_mod.shared_context()
+        return self._ctx
+
+    def finalize(self, files: Dict[str, object]) -> Iterable[Finding]:
+        ctx = self._context()
+        for kir in ctx.kernels:
+            for msg in self._pass.check_kernel(kir):
+                yield Finding(rule=self.id, path=kir.path, line=kir.line,
+                              function=kir.function, message=msg,
+                              severity=self.severity)
+        if self.id == "ir-recompile":
+            # Registered-but-unspecced kernels dodge every pass — the
+            # recompile rule owns visibility, so it reports them.
+            for kernel in ctx.missing_specs:
+                fn = getattr(kernel, "__wrapped__", kernel)
+                code = getattr(fn, "__code__", None)
+                from fairify_tpu.analysis.ir import _rel
+
+                yield Finding(
+                    rule=self.id,
+                    path=_rel(code.co_filename) if code else "<unknown>",
+                    line=code.co_firstlineno if code else 0,
+                    function=getattr(fn, "__name__", kernel.name),
+                    message=(
+                        f"kernel '{kernel.name}' is registered in obs_jit "
+                        f"but has no aval spec in analysis.avals — it is "
+                        f"invisible to every IR pass; add a KernelSpec"),
+                    severity=self.severity)
+
+
+def ir_rules(ctx=None) -> List[Rule]:
+    """Fresh rule instances for the four IR passes, sharing one context."""
+    from fairify_tpu.analysis import (
+        passes_buffers,
+        passes_host,
+        passes_recompile,
+        passes_sound,
+    )
+
+    mods = (passes_host, passes_sound, passes_recompile, passes_buffers)
+    return [IRRule(m, ctx=ctx) for m in mods]
+
+
+def run_ir_lint(root: Optional[str] = None, baseline=None, ratchet=False,
+                ctx=None):
+    """One-call IR sweep: ``core.run_lint`` with the IR rule set."""
+    from fairify_tpu.lint import core
+
+    return core.run_lint(root=root, rules=ir_rules(ctx=ctx),
+                         baseline=baseline, ratchet=ratchet)

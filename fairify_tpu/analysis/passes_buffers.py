@@ -1,0 +1,147 @@
+"""IR pass ``ir-buffers``: launch-payload and executable-footprint audit.
+
+On the tunnelled chip the launch cost is transfer-dominated; this pass
+audits what each executable actually moves and holds:
+
+* **dead arguments** — a top-level invar no equation consumes is payload
+  uploaded per launch for nothing.  Flagged by tree keystr (e.g.
+  ``[0][4][1]`` = the parity kernel's old final-layer alive mask, a dead
+  ``(P, 1)`` buffer this pass found and this PR removed); the spec's
+  ``dead_ok`` carries the reviewed exemptions (the MLP final-layer
+  all-ones mask contract).
+* **pass-through outputs** — an output that is verbatim an input is a
+  pointless device→host copy at drain time.
+* **wasted donation** — a kernel that declares ``donate_argnums``/
+  ``donate_argnames`` for a buffer no output can absorb (XLA aliases a
+  donated input only into a shape+dtype-matching output) keeps the
+  donated buffer live AND loses it to the caller: worst of both.
+* **temp blowup** — the largest single equation output is the
+  jaxpr-derived temp estimate; if it exceeds ``BLOWUP_RATIO`` × the
+  larger of argument/output bytes, the kernel materialises a tensor its
+  interface never pays for (the (B, V, V, d) class the certify scan
+  exists to avoid).  The same estimate is cross-checked against the
+  compiled ``memory_analysis().temp_size_in_bytes`` gauge: an actual
+  temp footprint ``TEMP_XCHECK_RATIO`` × beyond the biggest op we wrote
+  means XLA failed to fuse the kernel (head ratios are ≤ ~6×).
+"""
+from __future__ import annotations
+
+from typing import List
+
+from fairify_tpu.analysis.ir import KernelIR, aval_bytes
+
+PASS_ID = "ir-buffers"
+
+#: Largest-intermediate : max(args, outs) ratio beyond which a kernel is
+#: materialising an interface-invisible tensor (head max is ~12x, on the
+#: lattice sign kernel whose V x chunk tensor IS the point).
+BLOWUP_RATIO = 64
+
+#: memory_analysis() temp : largest-intermediate ratio beyond which XLA
+#: failed to fuse (head max is ~6x on CPU).
+TEMP_XCHECK_RATIO = 64
+
+
+def _check_donation(kir: KernelIR):
+    """Wasted donation: a donated leaf with no shape/dtype-matching output.
+
+    XLA can only alias a donated input into an output of identical
+    shape+dtype; a donated buffer no output matches is lost to the caller
+    AND stays live in the executable — worst of both.  Checked at the
+    jaxpr level (deterministic, backend-independent; the runtime alias
+    table is not exposed by jax's ``Compiled``).  Donation composed with
+    static args shifts positional indices, which no kernel here uses —
+    skipped with a finding so the limitation is loud, not silent.
+    """
+    argnums = kir.jit_kwargs.get("donate_argnums")
+    argnames = kir.jit_kwargs.get("donate_argnames")
+    if not argnums and not argnames:
+        return
+    if kir.statics:
+        yield (f"kernel '{kir.name}' combines donation with static args — "
+               f"positional donation indices shift after the static split; "
+               f"the buffer audit cannot attribute them (restructure, or "
+               f"teach _check_donation the mapping)")
+        return
+    if isinstance(argnums, int):
+        argnums = (argnums,)
+    # Multiset of output (shape, dtype): each output can absorb one donor.
+    budget = {}
+    for ov in kir.closed_jaxpr.jaxpr.outvars:
+        av = getattr(ov, "aval", None)
+        if av is not None and hasattr(av, "shape"):
+            k = (tuple(av.shape), str(av.dtype))
+            budget[k] = budget.get(k, 0) + 1
+    for keystr, leaf_aval in _donated_leaves(kir, argnums, argnames):
+        k = (tuple(leaf_aval.shape), str(leaf_aval.dtype))
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+        else:
+            yield (f"kernel '{kir.name}' donates argument leaf {keystr} "
+                   f"({leaf_aval.str_short()}) but no output matches its "
+                   f"shape/dtype — XLA cannot alias it, so the buffer is "
+                   f"lost to the caller AND stays live in the executable")
+
+
+def _donated_leaves(kir: KernelIR, argnums, argnames):
+    """(keystr, aval) of every flattened leaf under a donated argument.
+
+    Leaf keystrs for positional args start ``[0][<i>]`` (dynamic args
+    tuple first, kwargs dict second — `_leaf_paths` flattens
+    ``(dyn_args, dyn_kwargs)``).
+    """
+    roots = tuple(f"[0][{i}]" for i in (argnums or ())) + \
+        tuple(f"[1]['{n}']" for n in (argnames or ()))
+
+    def under(keystr: str) -> bool:
+        # Exact leaf, or a strict subtree entry ("[0][1].x" / "[0][1][0]")
+        # — plain startswith would also match "[0][10]".
+        return any(keystr == r or keystr.startswith(r + ".")
+                   or keystr.startswith(r + "[") for r in roots)
+
+    invars = kir.closed_jaxpr.jaxpr.invars
+    for i, (keystr, _leaf) in enumerate(kir.leaves):
+        if under(keystr) and i < len(invars):
+            yield keystr, invars[i].aval
+
+
+def check_kernel(kir: KernelIR) -> List[str]:
+    if kir.closed_jaxpr is None:
+        return []
+    out: List[str] = []
+    dead_ok = set(kir.spec.dead_ok) if kir.spec else set()
+    for keystr, aval in kir.dead_invars():
+        if keystr in dead_ok:
+            continue
+        out.append(
+            f"kernel '{kir.name}' argument leaf {keystr} "
+            f"({aval.str_short()}) is dead — uploaded per launch, "
+            f"consumed by nothing; drop it from the kernel signature or "
+            f"add a reviewed dead_ok entry to its aval spec")
+    for keystr in kir.passthrough_outputs():
+        out.append(
+            f"kernel '{kir.name}' returns argument leaf {keystr} "
+            f"verbatim — a pointless device->host copy at drain; return "
+            f"only computed values")
+    out.extend(_check_donation(kir))
+    big, desc = kir.largest_intermediate()
+    base = max(kir.arg_bytes(), kir.out_bytes(), 1)
+    if big > BLOWUP_RATIO * base:
+        out.append(
+            f"kernel '{kir.name}' materialises a {big}-byte intermediate "
+            f"({desc}) — {big // base}x its whole argument/output "
+            f"footprint; restructure (scan/chunk) so the tensor is never "
+            f"materialised whole")
+    ma = kir.memory_analysis()
+    if ma is not None:
+        try:
+            temp = int(ma.temp_size_in_bytes)
+        except Exception:
+            temp = None
+        if temp is not None and big > 0 and temp > TEMP_XCHECK_RATIO * big:
+            out.append(
+                f"kernel '{kir.name}' compiled temp footprint is {temp} "
+                f"bytes vs a {big}-byte largest written intermediate "
+                f"({desc}) — {temp // max(big, 1)}x; XLA failed to fuse "
+                f"this kernel")
+    return out

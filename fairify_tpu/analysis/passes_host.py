@@ -1,0 +1,68 @@
+"""IR pass ``ir-host-transfer``: dispatch-floor killers inside hot kernels.
+
+Every launch on the tunnelled chip costs ~110 ms flat (PERF.md); a host
+callback inside a registered kernel doesn't add a launch — it adds a
+device→host→device round trip *per executed callback*, which is strictly
+worse and invisible to the launch counters.  This pass walks the closed
+jaxpr (all sub-jaxprs included) and flags:
+
+* **callback primitives** — ``pure_callback`` / ``io_callback`` /
+  ``debug_callback`` (``jax.debug.print`` lowers to the latter) and the
+  legacy infeed/outfeed pair.  There is no legitimate use inside a
+  registered hot kernel: diagnostics belong on the heartbeat/span layer,
+  host math belongs in the decode half of the pipeline.
+* **large captured constants** — a closed-over host array (≥ 64 KiB)
+  becomes an executable constant re-uploaded per compile and bloating the
+  executable image; big tensors must be arguments so the runtime manages
+  them as device buffers.
+
+Pass functions take a :class:`fairify_tpu.analysis.ir.KernelIR` and return
+finding messages — the rule adapter in ``irlint`` owns locations/severity,
+and the fixture corpus calls :func:`check_kernel` directly.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from fairify_tpu.analysis.ir import KernelIR
+
+PASS_ID = "ir-host-transfer"
+
+#: Primitives that move control or data through the host mid-kernel.
+HOST_TRANSFER_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "host_callback_call", "outside_call", "infeed", "outfeed",
+})
+
+#: Captured constants at or above this size are flagged (bytes).
+CONST_BYTES_LIMIT = 64 * 1024
+
+
+def check_kernel(kir: KernelIR) -> List[str]:
+    if kir.closed_jaxpr is None:
+        return []  # the recompile pass owns unlowerable kernels
+    out: List[str] = []
+    hits = {}
+    for eqn in kir.eqns():
+        pname = eqn.primitive.name
+        if pname in HOST_TRANSFER_PRIMS:
+            hits[pname] = hits.get(pname, 0) + 1
+    for pname, n in sorted(hits.items()):
+        out.append(
+            f"kernel '{kir.name}' executes host-transfer primitive "
+            f"'{pname}' x{n} inside its jaxpr — a device->host round trip "
+            f"per call on the hot path; move diagnostics to obs spans and "
+            f"host math to the pipeline decode half")
+    for i, const in enumerate(kir.consts()):
+        try:
+            nbytes = int(np.asarray(const).nbytes)
+        except Exception:
+            continue
+        if nbytes >= CONST_BYTES_LIMIT:
+            out.append(
+                f"kernel '{kir.name}' captures a {nbytes}-byte host "
+                f"constant (const #{i}) — baked into every executable and "
+                f"re-uploaded per compile; pass it as an argument instead")
+    return out

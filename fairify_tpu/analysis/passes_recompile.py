@@ -1,0 +1,134 @@
+"""IR pass ``ir-recompile``: compile-signature ground truth per kernel.
+
+The AST rule ``recompile-hazard`` (PR 6) guesses from source patterns;
+this pass asks the executable cache itself.  For every kernel the spec
+declares the production call-shape variants, and the pass computes each
+variant's REAL ``obs_jit`` cache key (``ObsJit.signature_key`` — the same
+``(avals, treedef, statics)`` triple ``__call__`` dispatches on).  Checks:
+
+* **declared-vs-actual executable sharing** — a variant declared
+  ``same_exec=True`` (e.g. "a later ragged-but-padded chunk") whose key
+  differs from the baseline is a predicted silent recompile, attributed
+  to the exact component that diverged (a leaf aval — weak-typed scalar
+  vs numpy scalar called out explicitly — or a static value); a variant
+  declared ``same_exec=False`` whose key collapses into the baseline is a
+  stale bucketing expectation.
+* **signature budget** — the distinct-key count over baseline+variants
+  must equal the spec's ``expected_signatures`` (the reviewed compile
+  budget; ``engine.certify_attack``'s is 2 — PR 3's measured
+  stage-0-vs-BaB bucketing).
+* **unstable statics** — a float (or float-containing tuple) static
+  value creates one executable per distinct value; statics must be
+  ints/bools/shape tuples.
+* **fallback-invisible kernels** — a kernel that failed the analysis
+  lowering never registers a signature: it is invisible to IR analysis
+  and to the compile registry's recompile attribution.  When a KernelIR
+  carries LIVE process stats (``IRContext(include_stats=True)`` —
+  interactive diagnosis, never the lint gate, whose input must be the
+  repo alone), compiles served only by the plain-jit fallback
+  (``n_compiles == 0``, ``fallbacks > 0``) are reported the same way.
+  Registered kernels missing an aval spec are reported by the rule
+  adapter.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from fairify_tpu.analysis.ir import KernelIR
+
+PASS_ID = "ir-recompile"
+
+
+def _has_float(value) -> bool:
+    if isinstance(value, float):
+        return True
+    if isinstance(value, (tuple, list)):
+        return any(_has_float(v) for v in value)
+    return False
+
+
+def _diff_keys(base, other) -> str:
+    """Human description of why two cache keys differ."""
+    if base is None or other is None:
+        return "variant key unavailable"
+    b_avals, b_tree, b_statics = base
+    o_avals, o_tree, o_statics = other
+    if b_statics != o_statics:
+        bd, od = dict(b_statics), dict(o_statics)
+        names = sorted(k for k in set(bd) | set(od)
+                       if bd.get(k) != od.get(k))
+        return ("static arg(s) " +
+                ", ".join(f"{n}: {bd.get(n)!r} != {od.get(n)!r}"
+                          for n in names))
+    if b_tree != o_tree:
+        return "argument tree structure differs"
+    for i, (ba, oa) in enumerate(zip(b_avals, o_avals)):
+        if ba != oa:
+            b_aval, o_aval = ba[0], oa[0]
+            desc = f"leaf #{i}: {b_aval} != {o_aval}"
+            if getattr(b_aval, "weak_type", False) != \
+                    getattr(o_aval, "weak_type", False):
+                desc += (" (weak-typed scalar on one side — a Python "
+                         "number and a numpy scalar crossing the jit "
+                         "boundary do not share an executable)")
+            return desc
+    if len(b_avals) != len(o_avals):
+        return f"leaf count {len(b_avals)} != {len(o_avals)}"
+    return "keys differ (component not attributable)"
+
+
+def check_kernel(kir: KernelIR) -> List[str]:
+    out: List[str] = []
+    if kir.lower_error is not None:
+        out.append(
+            f"kernel '{kir.name}' failed AOT lowering under the analysis "
+            f"avals ({kir.lower_error}) — it can only ever compile via "
+            f"the plain-jit fallback, invisible to IR analysis and to "
+            f"signature registration")
+        return out
+    st = kir.stats
+    if st is not None and getattr(st, "n_compiles", 0) == 0 and \
+            getattr(st, "fallbacks", 0) > 0:
+        out.append(
+            f"kernel '{kir.name}' compiled only via the plain-jit "
+            f"fallback in this process ({st.fallbacks} fallback(s), 0 AOT "
+            f"compiles) — its signatures were never registered; see "
+            f"xla_compile_fallbacks for the attribution")
+    for name, value in kir.statics:
+        if _has_float(value):
+            out.append(
+                f"kernel '{kir.name}' static arg '{name}' carries a float "
+                f"value ({value!r}) — every distinct value is a fresh "
+                f"executable; pass floats as traced scalars")
+    for c in kir.consts():
+        aval = getattr(c, "aval", None)
+        if getattr(aval, "weak_type", False):
+            out.append(
+                f"kernel '{kir.name}' captures a weak-typed constant — a "
+                f"Python scalar closed over at trace time; bind it as an "
+                f"explicit argument or a typed constant")
+    keys = {repr(kir.signature_key)}
+    for desc, (vkey, same_exec) in sorted(kir.variant_keys.items()):
+        if vkey is None:
+            out.append(
+                f"kernel '{kir.name}' variant '{desc}' failed signature "
+                f"derivation — its production call shape cannot be keyed")
+            continue
+        keys.add(repr(vkey))
+        if same_exec and vkey != kir.signature_key:
+            out.append(
+                f"kernel '{kir.name}' variant '{desc}' predicts a SILENT "
+                f"RECOMPILE: declared same-executable but the cache key "
+                f"diverges — {_diff_keys(kir.signature_key, vkey)}")
+        elif not same_exec and vkey == kir.signature_key:
+            out.append(
+                f"kernel '{kir.name}' variant '{desc}' declared a "
+                f"separate compile bucket but keys to the SAME executable "
+                f"— stale bucketing expectation in the spec")
+    budget = kir.spec.expected_signatures if kir.spec else None
+    if budget is not None and len(keys) != budget:
+        out.append(
+            f"kernel '{kir.name}' compiles {len(keys)} distinct "
+            f"signature(s) over its declared production call shapes — "
+            f"reviewed budget is {budget}")
+    return out

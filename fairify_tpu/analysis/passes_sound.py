@@ -1,0 +1,122 @@
+"""IR pass ``ir-soundness``: certify-path kernels vs the sound-ops allowlist.
+
+A float UNSAT certificate is only sound under the repo's error model:
+f32 arithmetic whose round-off is absorbed by outward widening
+(``ops.interval.SOUND_SLACK_*``, the lattice kernels' per-point roundoff
+recurrence) and matmuls pinned to ``Precision.HIGHEST``
+(``utils.num.matmul`` — the TPU MXU's default path multiplies in bf16,
+which the interval-arithmetic toolbox line of work (PAPERS.md: arxiv
+2306.15340) shows breaks interval-bound soundness outright).  The kernels
+whose outputs carry verdict weight are named by
+``analysis.avals.SOUND_KERNELS``; for exactly those this pass flags, on
+the lowered jaxpr:
+
+* **low-precision contractions** — any ``dot_general`` whose precision is
+  not HIGHEST (the "fastmath-rewritable reduction": XLA may legally
+  rewrite a default-precision contraction into bf16 passes on TPU);
+* **float downcasts** — ``convert_element_type`` to a float type with
+  fewer mantissa bits (f32→bf16/f16, f64→f32) anywhere inside a bound
+  computation, and the ``reduce_precision`` primitive at all;
+* **primitives outside the sound-ops allowlist** — the reviewed closure
+  of everything the certify kernels legitimately lower to (affine maps,
+  lattice decodes, comparisons, structural ops, the CROWN relaxation's
+  guarded divide).  A transcendental (``exp``/``log``/``tanh``…) or RNG
+  primitive showing up in a certify kernel means bound math drifted
+  outside the error model — exactly the non-directed-rounding
+  subtract/multiply regime the widening slack cannot be shown to cover.
+
+Attack/sampling kernels are exempt by design: their outputs only propose
+counterexamples, which are re-proved in exact rational arithmetic before
+any SAT settles.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from fairify_tpu.analysis.ir import KernelIR
+
+PASS_ID = "ir-soundness"
+
+#: Mantissa bits per float dtype (ordering for downcast detection).
+_FBITS = {"float64": 52, "float32": 23, "float16": 10, "bfloat16": 7}
+
+#: The reviewed closure of primitives the certify-path kernels lower to.
+#: Assembled from the head inventory of every SOUND_KERNELS jaxpr; grows
+#: only by review (a new primitive here is a soundness-model decision,
+#: not a formality).  Notable EXCLUSIONS: exp/log/tanh/pow (transcendental
+#: round-off is not covered by the additive slack model), random_* (a
+#: certify kernel must be deterministic in its inputs), sort/top_k
+#: (order-dependent f32 reductions).
+SOUND_PRIMS = frozenset({
+    # arithmetic under the slack model
+    "add", "sub", "mul", "div", "neg", "abs", "sign", "max", "min",
+    "dot_general", "reduce_sum", "reduce_max", "reduce_min", "cumsum",
+    "rem", "round", "floor", "ceil", "integer_pow",
+    # comparisons / boolean structure
+    "eq", "ne", "lt", "le", "gt", "ge", "and", "or", "not", "xor",
+    "reduce_or", "reduce_and", "select_n", "argmax", "argmin",
+    # dtype/structural (downcasts are separately screened)
+    "convert_element_type", "broadcast_in_dim", "reshape", "transpose",
+    "squeeze", "slice", "dynamic_slice", "dynamic_update_slice",
+    "concatenate", "gather", "scatter", "scatter-add", "iota", "rev",
+    "pad", "device_put", "copy",
+    # control / call structure
+    "scan", "while", "cond", "pjit", "closed_call", "custom_jvp_call",
+    "custom_vjp_call", "remat",
+})
+
+
+def _precision_ok(prec) -> bool:
+    """True iff a dot_general's precision pins the f32-exact MXU path."""
+    if prec is None:
+        return False
+    vals = prec if isinstance(prec, (tuple, list)) else (prec,)
+    return all("HIGHEST" in str(p) for p in vals)
+
+
+def check_kernel(kir: KernelIR) -> List[str]:
+    if kir.closed_jaxpr is None or kir.spec is None or not kir.spec.sound:
+        return []
+    out: List[str] = []
+    bad_prec = 0
+    downcasts = {}
+    outside = {}
+    reduce_prec = 0
+    for eqn in kir.eqns():
+        pname = eqn.primitive.name
+        if pname == "dot_general":
+            if not _precision_ok(eqn.params.get("precision")):
+                bad_prec += 1
+        elif pname == "convert_element_type":
+            src = getattr(eqn.invars[0].aval.dtype, "name", "")
+            dst = getattr(eqn.params.get("new_dtype"), "name", "")
+            if src in _FBITS and dst in _FBITS and _FBITS[dst] < _FBITS[src]:
+                key = f"{src}->{dst}"
+                downcasts[key] = downcasts.get(key, 0) + 1
+        elif pname == "reduce_precision":
+            reduce_prec += 1
+        if pname not in SOUND_PRIMS and pname != "reduce_precision":
+            outside[pname] = outside.get(pname, 0) + 1
+    if bad_prec:
+        out.append(
+            f"certify kernel '{kir.name}' contracts {bad_prec} "
+            f"dot_general(s) below Precision.HIGHEST — the MXU default is "
+            f"bf16-pass rewritable; route every verification matmul "
+            f"through utils.num.matmul")
+    for key, n in sorted(downcasts.items()):
+        out.append(
+            f"certify kernel '{kir.name}' downcasts {key} x{n} inside a "
+            f"bound computation — mantissa loss is outside the "
+            f"SOUND_SLACK error model")
+    if reduce_prec:
+        out.append(
+            f"certify kernel '{kir.name}' applies reduce_precision x"
+            f"{reduce_prec} — explicit mantissa truncation on the "
+            f"certify path")
+    for pname, n in sorted(outside.items()):
+        out.append(
+            f"certify kernel '{kir.name}' lowers to primitive '{pname}' "
+            f"x{n}, outside the sound-ops allowlist — extend "
+            f"passes_sound.SOUND_PRIMS only after reviewing its round-off "
+            f"against the widening slack model")
+    return out

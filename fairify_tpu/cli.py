@@ -540,8 +540,10 @@ def main(argv=None) -> int:
                           "iff the request finished `done`")
 
     lint = sub.add_parser(
-        "lint", help="run the nine-rule static-analysis engine over "
-                     "fairify_tpu/ (DESIGN.md §11)")
+        "lint", help="run the static-analysis engine over fairify_tpu/: "
+                     "nine AST rules by default, the four jaxpr/IR passes "
+                     "over the obs_jit kernel registry with --ir "
+                     "(DESIGN.md §11)")
     from fairify_tpu.lint.core import add_cli_args as _lint_cli_args
 
     _lint_cli_args(lint)

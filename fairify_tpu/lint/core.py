@@ -222,6 +222,7 @@ class LintResult:
     findings: List[Finding] = field(default_factory=list)  # actionable
     baselined: List[Finding] = field(default_factory=list)  # grandfathered
     suppressed: int = 0
+    suppressed_by_rule: Dict[str, int] = field(default_factory=dict)
     parse_errors: List[Finding] = field(default_factory=list)
     rules: List[str] = field(default_factory=list)
     n_files: int = 0
@@ -253,6 +254,8 @@ class LintResult:
             "baselined": [f.as_dict() for f in self.baselined],
             "parse_errors": [f.as_dict() for f in self.parse_errors],
             "suppressed": self.suppressed,
+            "suppressed_by_rule": dict(sorted(
+                self.suppressed_by_rule.items())),
             "ratchet_breaches": list(self.ratchet_breaches),
         }
 
@@ -385,6 +388,8 @@ def run_lint(root: Optional[str] = None,
         ctx = contexts.get(f.path)
         if ctx is not None and ctx.suppressed(f.line, f.rule):
             result.suppressed += 1
+            result.suppressed_by_rule[f.rule] = \
+                result.suppressed_by_rule.get(f.rule, 0) + 1
         else:
             kept.append(f)
     kept.sort(key=lambda f: (f.path, f.line, f.rule))
@@ -413,6 +418,10 @@ def render_text(result: LintResult, verbose_baselined: bool = False) -> str:
             lines.append(f"{f.render()}  (baselined)")
     for b in result.ratchet_breaches:
         lines.append(f"ratchet: {b}")
+    if result.suppressed_by_rule:
+        per = ", ".join(f"{r}={n}" for r, n in
+                        sorted(result.suppressed_by_rule.items()))
+        lines.append(f"suppressed by rule: {per}")
     n = len(result.findings) + len(result.parse_errors)
     lines.append(
         f"lint: {n} finding(s), {len(result.baselined)} baselined, "
@@ -426,6 +435,11 @@ def add_cli_args(ap) -> None:
     by the ``fairify_tpu lint`` subparser (``cli._cmd_lint`` forwards its
     parsed namespace straight to :func:`run_cli`)."""
     ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--ir", action="store_true",
+                    help="run the jaxpr/IR-level passes over the obs_jit "
+                         "kernel registry instead of the AST rules "
+                         "(imports jax and lowers every kernel; see "
+                         "DESIGN.md §11 'IR-level passes')")
     ap.add_argument("--ratchet", action="store_true",
                     help="also fail if any rule's finding count exceeds the "
                          "committed baseline total (growth gate)")
@@ -434,7 +448,8 @@ def add_cli_args(ap) -> None:
                          f"'none' disables)")
     ap.add_argument("--root", default=None, help="repo root override")
     ap.add_argument("--rules", default=None,
-                    help="comma-separated rule-id subset (default: all nine)")
+                    help="comma-separated rule-id subset (default: every "
+                         "rule of the active mode)")
     ap.add_argument("--show-baselined", action="store_true",
                     help="also print grandfathered findings (text format)")
 
@@ -445,7 +460,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     ap = argparse.ArgumentParser(
         prog="fairify_tpu lint",
-        description="AST rule engine over fairify_tpu/ (see DESIGN.md §11)")
+        description="static-analysis rule engine over fairify_tpu/: AST "
+                    "rules by default, jaxpr/IR passes over the obs_jit "
+                    "registry with --ir (see DESIGN.md §11)")
     add_cli_args(ap)
     return run_cli(ap.parse_args(argv))
 
@@ -454,10 +471,17 @@ def run_cli(args) -> int:
     """Run the engine from a parsed :func:`add_cli_args` namespace."""
     import sys
 
-    from fairify_tpu.lint.rules import all_rules
-
     root = args.root or repo_root()
-    rules = all_rules()
+    if getattr(args, "ir", False):
+        # Deferred import: the IR suite needs jax + the kernel modules;
+        # the AST engine must stay importable without either.
+        from fairify_tpu.analysis.irlint import ir_rules
+
+        rules = ir_rules()
+    else:
+        from fairify_tpu.lint.rules import all_rules
+
+        rules = all_rules()
     if args.rules:
         want = {s.strip() for s in args.rules.split(",") if s.strip()}
         unknown = want - {r.id for r in rules}

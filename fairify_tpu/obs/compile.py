@@ -62,6 +62,17 @@ except Exception:  # pragma: no cover - version drift
 # Sentinel: this signature's AOT path failed — serve it via plain jax.jit.
 _FALLBACK = object()
 
+# Re-entrancy flag: >0 while an ObsJit is being traced FOR ANALYSIS
+# (lowered_for_analysis).  Nested obs_jit kernels called during that trace
+# hit __call__'s tracer branch exactly like production composition, but an
+# analysis trace must not bump trace-inline accounting — the IR sweep
+# promises zero effect on the metrics real runs are gated on.
+_analysis_trace = threading.local()
+
+
+def _in_analysis_trace() -> bool:
+    return getattr(_analysis_trace, "depth", 0) > 0
+
 
 @dataclass
 class KernelStats:
@@ -72,7 +83,13 @@ class KernelStats:
     n_compiles: int = 0
     compile_s: float = 0.0  # total trace+lower+compile seconds
     fallbacks: int = 0  # calls served by plain jax.jit (AOT path unusable)
+    trace_inlines: int = 0  # calls seen while tracing (outer jit owns them)
     signatures: Set[Any] = field(default_factory=set)
+    # Signatures whose compiles were served ONLY by the plain-jit fallback:
+    # they never reach `signatures`, so without this set a kernel that only
+    # ever fell back would look like it never compiled at all (the
+    # ir-recompile pass warns on exactly that shape).
+    fallback_signatures: Set[Any] = field(default_factory=set)
     # First-compile executable analyses (None until known / unavailable).
     flops: Optional[float] = None
     bytes_accessed: Optional[float] = None
@@ -86,7 +103,9 @@ class KernelStats:
             "n_compiles": self.n_compiles,
             "compile_s": self.compile_s,
             "fallbacks": self.fallbacks,
+            "trace_inlines": self.trace_inlines,
             "n_signatures": len(self.signatures),
+            "n_fallback_signatures": len(self.fallback_signatures),
             "flops": self.flops,
             "bytes_accessed": self.bytes_accessed,
             "arg_bytes": self.arg_bytes,
@@ -142,7 +161,8 @@ class ObsJit:
     """
 
     def __init__(self, fun, name: Optional[str] = None,
-                 static_argnames: Tuple[str, ...] = (), **jit_kwargs):
+                 static_argnames: Tuple[str, ...] = (), register: bool = True,
+                 **jit_kwargs):
         if isinstance(static_argnames, str):
             static_argnames = (static_argnames,)
         self._fun = fun
@@ -151,6 +171,7 @@ class ObsJit:
         self.__doc__ = getattr(fun, "__doc__", None)
         self.name = name or _default_name(fun)
         self._static = tuple(static_argnames)
+        self._jit_kwargs = dict(jit_kwargs)
         self._jitted = jax.jit(fun, static_argnames=static_argnames or None,
                                **jit_kwargs)
         try:
@@ -162,11 +183,45 @@ class ObsJit:
         self._lock = threading.Lock()
         self._execs: Dict[Any, Any] = {}
         self.stats = KernelStats(self.name)
-        _KERNELS[self.name] = self
+        if register:
+            _KERNELS[self.name] = self
 
     # -- plumbing ----------------------------------------------------------
     def lower(self, *args, **kwargs):
         return self._jitted.lower(*args, **kwargs)
+
+    def lowered_for_analysis(self, *args, **kwargs):
+        """Traced (jaxpr-bearing) view for the IR analysis suite.
+
+        The same explicit AOT entry `_compile` drives, minus every side
+        effect: no executable cache write, no compile span, no metrics —
+        analysis lowering under representative avals must never pollute
+        the compile accounting real sweeps are gated on.  That includes
+        NESTED kernels: tracing an outer kernel re-enters every composed
+        obs_jit through ``__call__``'s tracer branch, so trace-inline
+        counting is suspended for the duration.  The returned ``Traced``
+        exposes ``.jaxpr`` (closed) and ``.lower()``.
+        """
+        _analysis_trace.depth = getattr(_analysis_trace, "depth", 0) + 1
+        try:
+            return self._jitted.trace(*args, **kwargs)
+        finally:
+            _analysis_trace.depth -= 1
+
+    def signature_key(self, *args, **kwargs):
+        """The executable-cache key this call WOULD dispatch on.
+
+        Ground truth for the ``ir-recompile`` pass: two call shapes share
+        a compiled executable iff their keys are equal.  Raises on an
+        unhashable key — exactly the calls `__call__` serves via the
+        plain-jit fallback.
+        """
+        dyn_args, dyn_kwargs, statics = self._split(args, kwargs)
+        leaves, treedef = jax.tree_util.tree_flatten((dyn_args, dyn_kwargs))
+        avals = tuple(_leaf_key(l) for l in leaves)
+        key = (avals, treedef, statics)
+        hash(key)
+        return key
 
     def _split(self, args, kwargs):
         """(dyn_args, dyn_kwargs, static_items) preserving call structure."""
@@ -189,8 +244,15 @@ class ObsJit:
         return tuple(dyn_args), dyn_kwargs, tuple(sorted(statics,
                                                          key=lambda kv: kv[0]))
 
-    def _note_fallback(self) -> None:
+    def _note_fallback(self, key=None) -> None:
+        """Count one plain-jit-served call; register its signature when the
+        key is derivable, so a kernel that ONLY ever falls back is still
+        attributable (satellite of the ir-recompile pass: such a kernel
+        never reaches `stats.signatures` and is invisible to IR analysis).
+        """
         self.stats.fallbacks += 1
+        if key is not None:
+            self.stats.fallback_signatures.add(key)
         metrics_mod.registry().counter("xla_compile_fallbacks").inc(
             kernel=self.name)
 
@@ -200,7 +262,16 @@ class ObsJit:
         leaves, treedef = jax.tree_util.tree_flatten((dyn_args, dyn_kwargs))
         if any(isinstance(l, jax.core.Tracer) for l in leaves):
             # Composed inside an outer trace: the outer kernel owns the
-            # compile; inline through the plain jit path untracked.
+            # compile; inline through the plain jit path.  Counted under a
+            # distinct series of the fallback metric (kind="trace") — a
+            # kernel served ONLY this way registers no signatures and the
+            # ir-recompile pass must be able to see that.  Analysis traces
+            # are exempt (lowered_for_analysis must leave accounting
+            # untouched).
+            if not _in_analysis_trace():
+                self.stats.trace_inlines += 1
+                metrics_mod.registry().counter("xla_compile_fallbacks").inc(
+                    kernel=self.name, kind="trace")
             return self._jitted(*args, **kwargs)
         try:
             avals = tuple(_leaf_key(l) for l in leaves)
@@ -219,7 +290,7 @@ class ObsJit:
         except Exception:
             # Executable/argument mismatch (e.g. layout or sharding drift
             # invisible to the key): never fail the kernel over accounting.
-            self._note_fallback()
+            self._note_fallback(key)
             self._execs[key] = _FALLBACK
             return self._jitted(*args, **kwargs)
 
@@ -247,7 +318,7 @@ class ObsJit:
 
                 if classify(exc) == "propagate":  # injected crash-kind etc.
                     raise
-                self._note_fallback()
+                self._note_fallback(key)
                 with self._lock:
                     self._execs[key] = _FALLBACK
                 return _FALLBACK
@@ -318,18 +389,23 @@ _KERNELS: Dict[str, ObsJit] = {}
 
 
 def obs_jit(fun=None, *, name: Optional[str] = None,
-            static_argnames: Tuple[str, ...] = (), **jit_kwargs):
+            static_argnames: Tuple[str, ...] = (), register: bool = True,
+            **jit_kwargs):
     """Drop-in for ``jax.jit`` / ``partial(jax.jit, static_argnames=...)``.
 
     Usable bare (``@obs_jit``), with options
     (``@obs_jit(static_argnames=("k",))``), or call-style
     (``obs_jit(fn, name="engine.certify", static_argnames=("k",))``).
+    ``register=False`` keeps the kernel out of the process registry —
+    for fixture/scratch kernels that want the accounting machinery
+    without appearing in :func:`kernels` (the IR analysis sweep iterates
+    that registry).
     """
     if fun is None:
         return lambda f: obs_jit(f, name=name, static_argnames=static_argnames,
-                                 **jit_kwargs)
+                                 register=register, **jit_kwargs)
     return ObsJit(fun, name=name, static_argnames=static_argnames,
-                  **jit_kwargs)
+                  register=register, **jit_kwargs)
 
 
 def kernels() -> Dict[str, ObsJit]:

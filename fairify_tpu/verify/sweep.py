@@ -555,13 +555,19 @@ def _parity_grid_from_keys(net, keys, lo, hi, alive, sim_size: int):
     sample tensor never crosses the host↔device link — on the tunnelled
     single-chip setup that transfer dominated the whole stage-0 wall time
     for the adult grid (~0.8 GB per model).
+
+    ``alive`` covers the HIDDEN layers only; the final layer is never
+    pruned, so its all-ones mask is rebuilt from the net here instead of
+    shipping a per-partition (P, 1) ones buffer the kernel never reads
+    (the ``ir-buffers`` pass flagged exactly that dead argument).
     """
     from fairify_tpu.ops import simulate as sim_ops
 
     def one(k, l, h, masks):
         s = sim_ops.simulate_box(k, l, h, sim_size)
         orig = mlp_mod.forward(net, s) > 0.0
-        masked = mlp_mod.forward(net.with_masks(masks), s) > 0.0
+        pruned = net.with_masks(tuple(masks) + (net.masks[-1],))
+        masked = mlp_mod.forward(pruned, s) > 0.0
         return jnp.mean((orig == masked).astype(jnp.float32))
 
     return jax.vmap(one)(keys, lo, hi, alive)
@@ -869,9 +875,11 @@ def _verify_model_impl(
             parity = np.zeros(P, dtype=np.float32)
 
             def _parity_submit(s, e):
+                # Hidden layers only: the final layer is never pruned and
+                # the kernel rebuilds its all-ones mask from the net.
                 alive = tuple(
                     jnp.asarray(_pad_rows(1.0 - d[s:e], step), jnp.float32)
-                    for d in prune.st_deads)
+                    for d in prune.st_deads[:-1])
                 keys = pruning.grid_keys(cfg.seed, span_start + s, step)
                 profiling.bump_launch()
                 block = _parity_grid_from_keys(

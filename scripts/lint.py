@@ -8,9 +8,12 @@ package installed (inserts the repo root on sys.path).  Typical CI lines:
     python scripts/lint.py --format json        # machine-readable result
     python scripts/lint.py --ratchet            # also gate per-rule growth
                                                 # vs audits/lint_baseline.json
+    python scripts/lint.py --ir                 # jaxpr/IR passes over the
+                                                # obs_jit kernel registry
+                                                # (imports jax; ~15 s CPU)
 
-See DESIGN.md §11 for the rule catalog and the allowlist / suppression /
-baseline workflow.
+See DESIGN.md §11 for the rule catalog (AST and IR) and the allowlist /
+suppression / baseline workflow.
 """
 import os
 import sys

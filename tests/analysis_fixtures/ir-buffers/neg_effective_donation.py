@@ -1,0 +1,15 @@
+"""NEGATIVE: in-place-style update — the donated buffer has a
+shape/dtype-matching output to alias into; nothing else to flag."""
+import numpy as np
+
+
+def make():
+    from fairify_tpu.analysis.ir import KernelIR
+
+    def accumulate_kernel(buf, delta):
+        return buf + delta
+
+    return KernelIR.from_fn(
+        accumulate_kernel,
+        (np.ones((16, 16), np.float32), np.ones((16, 16), np.float32)),
+        donate_argnums=(0,))

@@ -1,0 +1,16 @@
+"""NEGATIVE: every argument consumed, outputs computed, intermediates
+proportional to the interface — nothing for the buffer audit."""
+import numpy as np
+
+
+def make():
+    from fairify_tpu.analysis.ir import KernelIR
+    from fairify_tpu.utils.num import matmul
+
+    def lean_kernel(w, x):
+        h = matmul(x, w)
+        return h.max(axis=-1), h.min(axis=-1)
+
+    return KernelIR.from_fn(
+        lean_kernel,
+        (np.ones((8, 8), np.float32), np.ones((4, 8), np.float32)))

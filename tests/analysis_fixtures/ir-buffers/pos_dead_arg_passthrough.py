@@ -1,0 +1,14 @@
+"""POSITIVE: a dead (32, 32) argument uploaded per launch for nothing,
+and an input returned verbatim — both flagged by the buffer audit."""
+import numpy as np
+
+
+def make():
+    from fairify_tpu.analysis.ir import KernelIR
+
+    def wasteful_kernel(x, stale_cache):
+        return x + 1.0, x  # second output is the input, verbatim
+
+    return KernelIR.from_fn(
+        wasteful_kernel,
+        (np.ones(8, np.float32), np.ones((32, 32), np.float32)))

@@ -1,0 +1,16 @@
+"""POSITIVE: a donated (16, 16) buffer no output can absorb — XLA aliases
+donated inputs only into shape/dtype-matching outputs, so the buffer is
+lost to the caller AND stays live in the executable."""
+import numpy as np
+
+
+def make():
+    from fairify_tpu.analysis.ir import KernelIR
+
+    def shrinking_kernel(buf, x):
+        return (buf * x).sum(axis=0) + x  # outputs (16,), never (16, 16)
+
+    return KernelIR.from_fn(
+        shrinking_kernel,
+        (np.ones((16, 16), np.float32), np.ones(16, np.float32)),
+        donate_argnums=(0,))

@@ -1,0 +1,14 @@
+"""NEGATIVE: pure device math — no callbacks, no captured constants."""
+import numpy as np
+
+
+def make():
+    from fairify_tpu.analysis.ir import KernelIR
+    from fairify_tpu.utils.num import matmul
+
+    def clean_kernel(w, x):
+        return matmul(x, w).sum(axis=-1)
+
+    return KernelIR.from_fn(
+        clean_kernel,
+        (np.ones((8, 8), np.float32), np.ones((4, 8), np.float32)))

@@ -1,0 +1,19 @@
+"""POSITIVE: a closed-over 256 KiB host array becomes an executable
+constant, re-uploaded per compile instead of managed as a device buffer."""
+import numpy as np
+
+_BIG = np.ones((256, 256), np.float32)  # 256 KiB captured constant
+
+
+def make():
+    import jax.numpy as jnp
+
+    from fairify_tpu.analysis.ir import KernelIR
+
+    big = jnp.asarray(_BIG)
+
+    def capturing_kernel(x):
+        return x @ big
+
+    return KernelIR.from_fn(capturing_kernel,
+                            (np.ones((4, 256), np.float32),))

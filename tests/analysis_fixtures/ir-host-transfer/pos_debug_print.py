@@ -1,0 +1,15 @@
+"""POSITIVE: jax.debug.print inside a hot kernel lowers to debug_callback
+— a device->host round trip per executed print."""
+import numpy as np
+
+
+def make():
+    import jax
+
+    from fairify_tpu.analysis.ir import KernelIR
+
+    def noisy_kernel(x):
+        jax.debug.print("sum={s}", s=x.sum())
+        return x * 2.0
+
+    return KernelIR.from_fn(noisy_kernel, (np.ones((8, 8), np.float32),))

@@ -1,0 +1,23 @@
+"""NEGATIVE: a later chunk of the same sweep — different values, same
+shapes/dtypes/statics — keys to the same executable, matching its
+declared signature budget of 1."""
+import numpy as np
+
+
+def make():
+    from fairify_tpu.analysis.avals import KernelSpec, Variant
+    from fairify_tpu.analysis.ir import KernelIR
+
+    def window_kernel(x, k: int):
+        return x[:, :k].sum(axis=1)
+
+    spec = KernelSpec(
+        "fixture.window_kernel", lambda w: ((), {}),
+        variants=(Variant(
+            "later chunk, same shapes",
+            lambda w: ((np.full((4, 8), 7.0, np.float32),), {"k": 4}),
+            same_exec=True),),
+        expected_signatures=1)
+    return KernelIR.from_fn(window_kernel, (np.zeros((4, 8), np.float32),),
+                            kwargs={"k": 4}, static_argnames=("k",),
+                            spec=spec)

@@ -1,0 +1,15 @@
+"""POSITIVE: a float-valued static argument — every distinct value is a
+fresh trace+compile; thresholds must be traced scalars or quantized
+statics."""
+import numpy as np
+
+
+def make():
+    from fairify_tpu.analysis.ir import KernelIR
+
+    def threshold_kernel(x, cut: float):
+        return (x > cut).sum()
+
+    return KernelIR.from_fn(threshold_kernel,
+                            (np.ones((8, 8), np.float32), 0.75),
+                            static_argnames=("cut",))

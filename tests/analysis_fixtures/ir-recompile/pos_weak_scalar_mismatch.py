@@ -1,0 +1,21 @@
+"""POSITIVE: two production call sites pass the same scalar as a Python
+float and a numpy scalar — weak vs strong typing means two executables
+for one kernel, predicted from the ground-truth cache key."""
+import numpy as np
+
+
+def make():
+    from fairify_tpu.analysis.avals import KernelSpec, Variant
+    from fairify_tpu.analysis.ir import KernelIR
+
+    def scale_kernel(x, s):
+        return x * s
+
+    spec = KernelSpec(
+        "fixture.scale_kernel", lambda w: ((), {}),
+        variants=(Variant(
+            "second call site passes np.float32",
+            lambda w: ((np.ones(8, np.float32), np.float32(2.0)), {}),
+            same_exec=True),))
+    return KernelIR.from_fn(scale_kernel, (np.ones(8, np.float32), 2.0),
+                            spec=spec)

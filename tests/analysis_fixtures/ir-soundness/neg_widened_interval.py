@@ -1,0 +1,28 @@
+"""NEGATIVE: sound interval arithmetic — sign-split affine image through
+the pinned-precision matmul, outward-widened; every primitive is inside
+the sound-ops allowlist."""
+import numpy as np
+
+
+def make():
+    import jax.numpy as jnp
+
+    from fairify_tpu.analysis.avals import KernelSpec
+    from fairify_tpu.analysis.ir import KernelIR
+    from fairify_tpu.ops.interval import SOUND_SLACK_ABS, SOUND_SLACK_REL
+    from fairify_tpu.utils.num import matmul
+
+    def sound_bounds(w, b, lo, hi):
+        wp = jnp.maximum(w, 0.0)
+        wn = jnp.minimum(w, 0.0)
+        zlo = matmul(lo, wp) + matmul(hi, wn) + b
+        zhi = matmul(hi, wp) + matmul(lo, wn) + b
+        slack = SOUND_SLACK_REL * jnp.maximum(jnp.abs(zlo),
+                                              jnp.abs(zhi)) + SOUND_SLACK_ABS
+        return zlo - slack, zhi + slack
+
+    spec = KernelSpec("fixture.sound_bounds", lambda w: ((), {}),
+                      sound=True)
+    args = (np.ones((8, 8), np.float32), np.zeros(8, np.float32),
+            np.zeros((4, 8), np.float32), np.ones((4, 8), np.float32))
+    return KernelIR.from_fn(sound_bounds, args, spec=spec)

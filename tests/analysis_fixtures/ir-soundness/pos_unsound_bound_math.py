@@ -1,0 +1,24 @@
+"""POSITIVE: a 'certify-path' kernel with all three unsound patterns —
+default-precision contraction (bf16-rewritable on the MXU), a float
+downcast inside the bound computation, and a transcendental outside the
+sound-ops allowlist."""
+import numpy as np
+
+
+def make():
+    import jax.numpy as jnp
+
+    from fairify_tpu.analysis.avals import KernelSpec
+    from fairify_tpu.analysis.ir import KernelIR
+
+    def sloppy_bounds(w, lo, hi):
+        mid = 0.5 * (lo + hi)
+        y = jnp.matmul(mid, w)  # default precision: NOT utils.num.matmul
+        soft = jnp.exp(y)  # transcendental in a bound computation
+        return soft.astype(jnp.bfloat16)  # mantissa loss on the verdict
+
+    spec = KernelSpec("fixture.sloppy_bounds", lambda w: ((), {}),
+                      sound=True)
+    args = (np.ones((8, 8), np.float32), np.zeros((4, 8), np.float32),
+            np.ones((4, 8), np.float32))
+    return KernelIR.from_fn(sloppy_bounds, args, spec=spec)

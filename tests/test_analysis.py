@@ -325,3 +325,288 @@ def test_evaluate_hybrid_report_keys():
     for v in out.values():
         assert "consistency" in v and "disparate_impact" in v
     assert routing.routed_fair + routing.routed_original + routing.routed_miss == 40
+
+
+# ===========================================================================
+# IR-level static analysis: fairify_tpu lint --ir (DESIGN.md §11)
+# ===========================================================================
+#
+# Three layers, mirroring tests/test_lint.py:
+#
+# * repo gate — the committed obs_jit registry is green under all four IR
+#   passes with the committed (empty) baseline, in ratchet mode, inside the
+#   30 s CPU budget (the sweep must never become the slow tier-1 path).
+# * fixture corpus — tests/analysis_fixtures/<pass-id>/ holds tiny-kernel
+#   pos_*/neg_* fixtures; a meta-test requires ≥1 of each per shipped pass.
+# * machinery — IR findings ride the existing lint engine: inline
+#   suppression on the kernel's def line, baseline grandfathering, JSON.
+
+import importlib.util
+import json
+import pathlib
+import sys
+
+from fairify_tpu.lint import core as lint_core
+
+IR_FIXTURE_ROOT = pathlib.Path(__file__).parent / "analysis_fixtures"
+
+
+def _pass_modules():
+    from fairify_tpu.analysis import (
+        passes_buffers,
+        passes_host,
+        passes_recompile,
+        passes_sound,
+    )
+
+    return {m.PASS_ID: m for m in (passes_host, passes_sound,
+                                   passes_recompile, passes_buffers)}
+
+
+@pytest.fixture(scope="session")
+def ir_result():
+    """ONE full IR sweep per test session (context is process-cached)."""
+    from fairify_tpu.analysis import irlint
+
+    root = lint_core.repo_root()
+    baseline = lint_core.load_baseline(
+        str(pathlib.Path(root) / lint_core.BASELINE_REL))
+    return irlint.run_ir_lint(baseline=baseline, ratchet=True)
+
+
+def test_ir_repo_gate_green_with_empty_baseline(ir_result):
+    from fairify_tpu.analysis.irlint import IR_RULE_IDS
+
+    assert tuple(ir_result.rules) == IR_RULE_IDS
+    assert not ir_result.parse_errors
+    assert not ir_result.findings, "\n" + "\n".join(
+        f.render() for f in ir_result.findings)
+    assert not ir_result.baselined  # real findings get FIXED, not baselined
+    assert not ir_result.ratchet_breaches
+    assert ir_result.ok
+
+
+def test_ir_sweep_runtime_budget(ir_result):
+    """The full registry sweep (lower + 4 passes + buffer-pass compiles)
+    must stay under 30 s on CPU — reported like the AST sweep's ~1.2 s."""
+    assert ir_result.duration_s < 30.0, (
+        f"IR sweep took {ir_result.duration_s:.1f}s — the lint gate is "
+        f"becoming the slow path")
+
+
+def test_ir_every_registry_kernel_lowers():
+    """Every obs_jit kernel has a spec and lowers under its analysis
+    avals; no spec is stale (naming an unregistered kernel)."""
+    from fairify_tpu.analysis import ir as ir_mod
+
+    ctx = ir_mod.shared_context()
+    assert len(ctx.kernels) >= 19
+    assert not ctx.missing_specs, [k.name for k in ctx.missing_specs]
+    assert ctx.unlowered_specs == []
+    for kir in ctx.kernels:
+        assert kir.lower_error is None, f"{kir.name}: {kir.lower_error}"
+        assert kir.closed_jaxpr is not None
+        assert kir.signature_key is not None
+        assert kir.path.startswith("fairify_tpu/"), kir.path
+        assert len(kir.leaves) == len(kir.closed_jaxpr.jaxpr.invars)
+
+
+def test_ir_sound_kernel_registry_names_verdict_kernels():
+    from fairify_tpu.analysis.avals import sound_kernels
+
+    sk = sound_kernels()
+    # The certify path: role bounds, combined certificates, sign/inter
+    # bounds, family stacks, and the lattice scan — NOT the attack/PGD/
+    # sampling kernels (exact-validated on host before verdict weight).
+    assert "engine.role_certify" in sk and "engine.certify_attack" in sk
+    assert "lattice.lattice_scan_kernel" in sk
+    assert "engine.pgd_attack_kernel" not in sk
+    assert "engine.attack_logits" not in sk
+
+
+def _load_fixture(path: pathlib.Path):
+    name = "irfx_" + path.stem
+    spec = importlib.util.spec_from_file_location(name, str(path))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.modules.pop(name, None)
+    return mod.make()
+
+
+@pytest.mark.parametrize("pass_id", ["ir-host-transfer", "ir-soundness",
+                                     "ir-recompile", "ir-buffers"])
+def test_ir_fixture_corpus_golden(pass_id):
+    """pos_* fixtures draw ≥1 finding from THEIR pass, neg_* draw none."""
+    mod = _pass_modules()[pass_id]
+    d = IR_FIXTURE_ROOT / pass_id
+    for p in sorted(d.glob("pos_*.py")):
+        kir = _load_fixture(p)
+        assert kir.lower_error is None, f"{p.name}: {kir.lower_error}"
+        findings = mod.check_kernel(kir)
+        assert findings, f"{p.name}: positive fixture drew no finding"
+    for p in sorted(d.glob("neg_*.py")):
+        kir = _load_fixture(p)
+        assert kir.lower_error is None, f"{p.name}: {kir.lower_error}"
+        findings = mod.check_kernel(kir)
+        assert not findings, f"{p.name}: negative fixture drew {findings}"
+
+
+def test_ir_every_pass_has_positive_and_negative_fixtures():
+    """Meta-test: a shipped IR pass without a corpus cannot regress."""
+    pass_ids = set(_pass_modules())
+    for pass_id in pass_ids:
+        d = IR_FIXTURE_ROOT / pass_id
+        assert d.is_dir(), f"missing fixture dir for pass {pass_id!r}"
+        assert sorted(d.glob("pos_*.py")), f"{pass_id}: no positive fixture"
+        assert sorted(d.glob("neg_*.py")), f"{pass_id}: no negative fixture"
+    extra = {d.name for d in IR_FIXTURE_ROOT.iterdir() if d.is_dir()} \
+        - pass_ids
+    assert not extra, f"fixture dirs without a shipped pass: {sorted(extra)}"
+
+
+def test_ir_findings_ride_lint_machinery(tmp_path):
+    """IR findings attribute to real source lines, so inline suppression
+    and baseline grandfathering apply unchanged."""
+    fx = IR_FIXTURE_ROOT / "ir-buffers" / "pos_dead_arg_passthrough.py"
+    from fairify_tpu.analysis import passes_buffers
+    from fairify_tpu.analysis.irlint import IRRule
+
+    class _Ctx:
+        missing_specs = ()
+
+        def __init__(self, kernels):
+            self.kernels = kernels
+
+    def run(src_line_suppressed, baseline=None):
+        kir = _load_fixture(fx)
+        rel = "fairify_tpu/verify/fx.py"
+        body = "def wasteful_kernel(x, stale_cache):\n    return x\n"
+        if src_line_suppressed:
+            body = ("def wasteful_kernel(x, stale_cache):"
+                    "  # lint: disable=ir-buffers\n    return x\n")
+        p = tmp_path / "fx.py"
+        p.write_text(body)
+        kir.path, kir.line, kir.function = rel, 1, "wasteful_kernel"
+        rule = IRRule(passes_buffers, ctx=_Ctx([kir]))
+        return lint_core.run_lint(rules=[rule], files=[(str(p), rel)],
+                                  baseline=baseline)
+
+    live = run(False)
+    assert len(live.findings) == 2  # dead arg + passthrough
+    assert all(f.rule == "ir-buffers" for f in live.findings)
+    assert live.findings[0].key == \
+        "ir-buffers::fairify_tpu/verify/fx.py::wasteful_kernel"
+
+    muted = run(True)
+    assert not muted.findings and muted.suppressed == 2
+    assert muted.suppressed_by_rule == {"ir-buffers": 2}
+
+    key = "ir-buffers::fairify_tpu/verify/fx.py::wasteful_kernel"
+    grand = run(False, baseline={key: {"count": 2, "reason": "test"}})
+    assert not grand.findings and len(grand.baselined) == 2 and grand.ok
+
+
+def test_ir_recompile_reports_unspecced_kernel():
+    """A kernel registered in obs_jit without an aval spec is itself a
+    finding — nothing dodges IR analysis silently."""
+    from fairify_tpu.analysis import passes_recompile
+    from fairify_tpu.analysis.irlint import IRRule
+    from fairify_tpu.obs.compile import ObsJit
+
+    ghost = ObsJit(lambda x: x + 1.0, name="t.ghost_unspecced",
+                   register=False)
+
+    class _Ctx:
+        kernels = ()
+        missing_specs = (ghost,)
+
+    rule = IRRule(passes_recompile, ctx=_Ctx())
+    found = list(rule.finalize({}))
+    assert len(found) == 1
+    assert "no aval spec" in found[0].message
+
+
+def test_ir_cli_mode_runs_selected_pass(capsys):
+    """`fairify_tpu lint --ir` shares the engine CLI: JSON, --rules."""
+    rc = lint_core.main(["--ir", "--rules", "ir-host-transfer",
+                         "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["ok"] is True
+    assert doc["rules"] == ["ir-host-transfer"]
+    assert doc["counts"] == {"ir-host-transfer": 0}
+    assert "suppressed_by_rule" in doc
+
+
+def test_ir_context_scope_excludes_test_registered_kernels():
+    """A kernel registered from outside fairify_tpu/ (tests, scratch
+    scripts) is out of the IR suite's scope — the repo gate must not
+    depend on which tests ran first in the process."""
+    from fairify_tpu.analysis.ir import kernel_in_scope
+    from fairify_tpu.obs.compile import ObsJit, kernels
+
+    probe = ObsJit(lambda x: x + 1.0, name="t.scope_probe", register=False)
+    assert not kernel_in_scope(probe)  # defined in tests/, not the package
+    real = kernels()["engine.role_certify"]
+    assert kernel_in_scope(real)
+
+
+def test_ir_dead_arg_distinct_from_passthrough():
+    """An argument returned verbatim is the passthrough finding, never a
+    dead argument ('drop it' would be wrong advice for a value the caller
+    reads back)."""
+    from fairify_tpu.analysis import passes_buffers
+    from fairify_tpu.analysis.ir import KernelIR
+
+    def echo_kernel(x, y):
+        return x + 1.0, y
+
+    kir = KernelIR.from_fn(
+        echo_kernel, (np.ones(4, np.float32), np.ones(4, np.float32)))
+    findings = passes_buffers.check_kernel(kir)
+    assert len(findings) == 1 and "verbatim" in findings[0]
+
+
+def test_ir_context_build_leaves_compile_accounting_untouched():
+    """Analysis tracing re-enters nested obs_jit kernels through the
+    tracer branch; that must NOT bump trace-inline/fallback accounting —
+    the IR sweep promises zero effect on gated metrics."""
+    from fairify_tpu.analysis import ir as ir_mod
+    from fairify_tpu.obs import compile as compile_mod
+    from fairify_tpu.obs import metrics as metrics_mod
+
+    before_ti = {n: k.stats.trace_inlines
+                 for n, k in compile_mod.kernels().items()}
+    before_fb = metrics_mod.registry().counter(
+        "xla_compile_fallbacks").total()
+    ctx = ir_mod.IRContext()  # fresh build, not the session-shared one
+    assert len(ctx.kernels) >= 19
+    for n, k in compile_mod.kernels().items():
+        assert k.stats.trace_inlines == before_ti.get(n, 0), n
+    assert metrics_mod.registry().counter(
+        "xla_compile_fallbacks").total() == before_fb
+
+
+def test_ir_recompile_stats_branch_is_opt_in():
+    """The fallback-only warning reads LIVE stats only when a context is
+    built with include_stats=True — the lint gate's input is the repo,
+    never process history (chaos tests poison stats with compile faults)."""
+    from fairify_tpu.analysis import passes_recompile
+    from fairify_tpu.analysis.ir import KernelIR
+
+    def ok_kernel(x):
+        return x + 1.0
+
+    kir = KernelIR.from_fn(ok_kernel, (np.ones(4, np.float32),))
+    assert passes_recompile.check_kernel(kir) == []
+
+    class _PoisonedStats:
+        n_compiles = 0
+        fallbacks = 3
+
+    kir.stats = _PoisonedStats()  # what include_stats=True would attach
+    msgs = passes_recompile.check_kernel(kir)
+    assert len(msgs) == 1 and "plain-jit fallback" in msgs[0]

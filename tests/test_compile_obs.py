@@ -369,3 +369,65 @@ def test_compile_flag_survives_closed_stream():
     assert hb_mod.active() is hb
     hb_mod.notify_compile("engine.certify_attack")  # must not raise
     assert hb_mod.active() is None
+
+
+def test_nested_trace_fallbacks_are_counted_distinctly():
+    """The plain-jit inline path a nested trace takes must not be silent:
+    it registers no signatures, so it is counted per kernel under the
+    xla_compile_fallbacks metric's kind="trace" series and in
+    stats.trace_inlines (the ir-recompile pass reads exactly these)."""
+    inner = _fresh_kernel("t.trace_inline")
+
+    @jax.jit
+    def outer(x):
+        return inner(x) * 2.0
+
+    outer(jnp.ones((3, 3)))
+    assert inner.stats.n_compiles == 0
+    assert inner.stats.trace_inlines >= 1
+    assert obs.registry().counter("xla_compile_fallbacks").value(
+        kernel="t.trace_inline", kind="trace") >= 1
+    d = inner.stats.as_dict()
+    assert d["trace_inlines"] >= 1 and d["n_fallback_signatures"] == 0
+
+
+def test_aot_fallback_registers_signature(monkeypatch):
+    """An AOT-failure fallback still records WHICH signature it served —
+    a kernel that only ever falls back stays attributable."""
+    k = _fresh_kernel("t.aot_sig")
+
+    class _NoLower:
+        def __init__(self, jitted):
+            self._jitted = jitted
+
+        def __call__(self, *a, **kw):
+            return self._jitted(*a, **kw)
+
+        def lower(self, *a, **kw):
+            raise RuntimeError("AOT path unavailable")
+
+        def trace(self, *a, **kw):
+            raise RuntimeError("AOT path unavailable")
+
+    monkeypatch.setattr(k, "_jitted", _NoLower(k._jitted))
+    k(np.ones((3, 3), np.float32))
+    assert k.stats.n_compiles == 0
+    assert len(k.stats.fallback_signatures) == 1
+    assert len(k.stats.signatures) == 0
+
+
+def test_lowered_for_analysis_and_signature_key_have_no_side_effects():
+    """The IR-analysis hooks reuse the AOT path without touching the
+    executable cache, stats, or metrics."""
+    k = _fresh_kernel("t.analysis_hook")
+    x = np.ones((4, 4), np.float32)
+    traced = k.lowered_for_analysis(x)
+    assert traced.jaxpr is not None
+    key1 = k.signature_key(x)
+    key2 = k.signature_key(np.zeros((4, 4), np.float32))
+    assert key1 == key2  # same aval, same executable
+    assert key1 != k.signature_key(np.ones((5, 4), np.float32))
+    assert k.stats.n_compiles == 0 and k.stats.fallbacks == 0
+    assert not k._execs
+    assert obs.registry().counter("xla_compiles").value(
+        kernel="t.analysis_hook") == 0

@@ -242,3 +242,45 @@ def test_lint_obs_shim_surface(tmp_path):
     msgs = shim.check_file(str(p), "fairify_tpu/verify/bad.py")
     assert len(msgs) == 1 and "time.time()" in msgs[0]
     assert shim.main([]) == 0  # whole-tree legacy sweep is clean
+
+
+def test_json_and_text_emit_per_rule_suppression_counts(tmp_path):
+    """--format json must carry the per-rule suppression breakdown the
+    text renderer prints (suppressions are counted, never silent)."""
+    from fairify_tpu.lint.rules import all_rules
+
+    p = tmp_path / "fx.py"
+    p.write_text(
+        "import time\n"
+        "def f(i):\n"
+        "    print(i)  # lint: disable=obs-print\n"
+        "    print(i)  # lint: disable=obs-print\n"
+        "    t = time.time()  # lint: disable=obs-time-time\n")
+    result = core.run_lint(rules=all_rules(),
+                           files=[(str(p), "fairify_tpu/verify/fx.py")])
+    assert result.suppressed == 3
+    assert result.suppressed_by_rule == {"obs-print": 2, "obs-time-time": 1}
+    doc = result.as_dict()
+    assert doc["suppressed_by_rule"] == {"obs-print": 2,
+                                         "obs-time-time": 1}
+    text = core.render_text(result)
+    assert "suppressed by rule: obs-print=2, obs-time-time=1" in text
+
+
+def test_baseline_rejects_whitespace_only_reason(tmp_path):
+    """A grandfathered entry with a whitespace-only reason is as useless
+    as a missing one — the ratchet gate must refuse to load it."""
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps(
+        {"findings": {"obs-print::x.py::f": {"count": 1, "reason": "   "}}}))
+    with pytest.raises(ValueError, match="reason"):
+        core.load_baseline(str(p))
+    # And through the CLI ratchet path: exit 2, loud on stderr.
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "scripts" / "lint.py"),
+         "--ratchet", "--baseline", str(p)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 2
+    assert "reason" in proc.stderr
