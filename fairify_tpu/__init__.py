@@ -28,4 +28,16 @@ Architectural stance (TPU-first, not a port):
 
 __version__ = "0.1.0"
 
-from fairify_tpu.models.mlp import MLP  # noqa: F401
+
+def __getattr__(name):
+    # Lazy MLP re-export (PEP 562): importing the package must stay cheap
+    # for jax-free subprocesses — the SMT worker (fairify_tpu.smt.worker)
+    # imports fairify_tpu.smt.* hundreds of times per sweep across
+    # respawns, and models.mlp drags the whole jax stack in (~2 s + a
+    # large address-space map that would collide with the worker's
+    # RLIMIT_AS cap).
+    if name == "MLP":
+        from fairify_tpu.models.mlp import MLP
+
+        return MLP
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

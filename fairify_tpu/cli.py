@@ -89,6 +89,15 @@ def _overridden_cfg(args):
 
         faults.parse_specs(args.inject_fault)
         overrides["inject_faults"] = tuple(args.inject_fault)
+    if getattr(args, "smt_retry", None):
+        overrides["smt_retry_timeouts_s"] = tuple(
+            float(t) for t in args.smt_retry)
+    if getattr(args, "smt_workers", None) is not None:
+        overrides["smt_workers"] = int(args.smt_workers)
+    if getattr(args, "smt_memory_cap", None) is not None:
+        overrides["smt_memory_cap_mb"] = int(args.smt_memory_cap)
+    if getattr(args, "smt_portfolio", None) is not None:
+        overrides["smt_portfolio"] = int(args.smt_portfolio)
     return cfg.with_(**overrides) if overrides else cfg
 
 
@@ -255,7 +264,9 @@ def _cmd_serve(args) -> int:
         spool=args.spool, batch_window_s=args.batch_window,
         max_batch=args.max_batch, span_chunks=args.span_chunks,
         poll_s=args.poll_interval, default_deadline_s=args.default_deadline,
-        n_shards=args.shards)
+        n_shards=args.shards, smt_workers=args.smt_workers,
+        smt_memory_cap_mb=args.smt_memory_cap,
+        smt_portfolio=args.smt_portfolio)
     stop = threading.Event()
 
     def _sig(_signum, _frame):
@@ -430,7 +441,24 @@ def main(argv=None) -> int:
                      help="chaos testing: schedule a fault, e.g. "
                           "launch.submit:transient:3 or compile:crash:1 "
                           "(repeatable; sites: launch.submit launch.decode "
-                          "compile smt.query ledger.append)")
+                          "compile smt.query ledger.append "
+                          "smt.worker.{spawn,crash,hang,memout} ...)")
+    run.add_argument("--smt-retry", type=float, nargs="*", default=None,
+                     metavar="S",
+                     help="escalating SMT timeout ladder in seconds (e.g. "
+                          "--smt-retry 300 900): enables the out-of-process "
+                          "solver tier for UNKNOWN boxes (DESIGN.md §14)")
+    run.add_argument("--smt-workers", type=int, default=None,
+                     help="SMT solver worker subprocesses; UNKNOWN boxes "
+                          "fan out across all of them (default 1)")
+    run.add_argument("--smt-memory-cap", type=int, default=None,
+                     metavar="MB",
+                     help="RLIMIT_AS per SMT worker in MB (0 = uncapped); "
+                          "a memout retries once on a doubled cap")
+    run.add_argument("--smt-portfolio", type=int, default=None,
+                     metavar="K",
+                     help="race K solver seed variants per SMT query and "
+                          "take the first decisive answer (0/1 = off)")
 
     ben = sub.add_parser("bench", help="run the headline benchmark")
     ben.add_argument("--trace-out", default=None,
@@ -510,6 +538,14 @@ def main(argv=None) -> int:
     srv.add_argument("--trace-out", default=None,
                      help="JSONL span/event log (request lifecycle events "
                           "feed the `fairify_tpu report` request table)")
+    srv.add_argument("--smt-workers", type=int, default=1,
+                     help="server-wide SMT worker pool size shared by every "
+                          "SMT-enabled request (default 1)")
+    srv.add_argument("--smt-memory-cap", type=int, default=0, metavar="MB",
+                     help="RLIMIT_AS per SMT worker in MB (0 = uncapped)")
+    srv.add_argument("--smt-portfolio", type=int, default=0, metavar="K",
+                     help="race K solver seed variants per SMT query "
+                          "(0/1 = off)")
 
     sbm = sub.add_parser(
         "submit", help="submit one verification job to a running server")

@@ -1,10 +1,11 @@
 """Lock discipline for the classes threads actually share.
 
-The obs metrics registry, the launch pipeline, the resilience journal and
+The obs metrics registry, the launch pipeline, the resilience journal,
 the serve subsystem (request queue, admission controller, server worker)
-are the modules whose instances are touched concurrently (span and
-heartbeat consumers, supervised retries, client submit threads racing the
-server worker, multi-threaded tests).  Their concurrency contract is
+and the SMT worker pool (dispatch lanes racing checkout/checkin) are the
+modules whose instances are touched concurrently (span and heartbeat
+consumers, supervised retries, client submit threads racing the server
+worker, multi-threaded tests).  Their concurrency contract is
 simple: any instance attribute that is *assigned* inside a ``with
 self.<lock>`` block is lock-protected, and every other read or write of it
 in the same class must also hold that lock.
@@ -84,6 +85,9 @@ class LockDisciplineRule(Rule):
         "fairify_tpu/parallel/pipeline.py",
         "fairify_tpu/resilience/journal.py",
         "fairify_tpu/serve/",
+        # The SMT worker pool: dispatch lanes, the serve drainer, and
+        # client submit threads all share SmtPool's worker/queue state.
+        "fairify_tpu/smt/",
     )
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:

@@ -113,6 +113,11 @@ ALLOW_BROAD_EXCEPT = frozenset({
     "fairify_tpu/analysis/ir.py::_rel",
     "fairify_tpu/analysis/passes_buffers.py::check_kernel",
     "fairify_tpu/analysis/passes_host.py::check_kernel",
+    # SMT pool dispatch lane: any error is captured in the query's future
+    # (the consumer classifies it); the lane itself must keep draining so
+    # sibling queries never stall — it re-raises nothing by contract,
+    # though it DOES return (die) on propagate-class errors.
+    "fairify_tpu/smt/pool.py::_lane",
 })
 
 _FETCH_HINT = (

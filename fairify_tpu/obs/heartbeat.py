@@ -141,6 +141,15 @@ class Heartbeat:
             # Fault visibility (resilience/): a flaky device shows up here
             # beats before anything degrades; omitted entirely when healthy.
             parts.append(f"| retries={retries} degraded={degr}")
+        smt_workers = reg.gauge("smt_pool_workers").value()
+        if smt_workers:
+            # SMT pool visibility (fairify_tpu/smt): host solving in
+            # flight/queued and the live worker count — omitted entirely
+            # when no pool is running (zero-noise like the fault suffix).
+            active = int(reg.gauge("smt_pool_active").value() or 0)
+            queued = int(reg.gauge("smt_pool_queue_depth").value() or 0)
+            parts.append(f"| smt: {active}/{queued} "
+                         f"workers={int(smt_workers)}")
         if self._last is not None and now > self._last:
             # Fold this beat's window into the recent-rate EMA (the first
             # beat has no window → whole-run-mean fallback below).

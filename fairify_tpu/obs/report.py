@@ -21,6 +21,11 @@ results directory's worth), produce
   machine-readable reason code (``site:kind``), read from degraded verdict
   events or directly from verdict-ledger files (``*.ledger.jsonl`` may be
   passed as inputs; their ``failure`` records are the source of truth);
+* an **SMT outcome table** — per-reason query outcomes of the worker pool
+  (``fairify_tpu/smt``): decided vs ``timeout`` / ``memout`` /
+  ``solver-error`` / ``smt.worker:*`` worker-death reasons, read from the
+  ``smt_queries`` counter series of each run's closing metrics snapshot —
+  next to the degradation table so host-solver health reads at a glance;
 * a **per-shard table** — for sharded sweeps (``parallel.shards``; span-
   qualified sinks ``model@start-stop`` or ``failure`` records carrying a
   ``shard`` index): per shard, verdict counts and how many partitions
@@ -85,6 +90,7 @@ def aggregate(paths: Iterable[str]) -> dict:
     anon: List[dict] = []  # verdict events without a partition id
     requests: Dict[str, dict] = {}  # request id -> lifecycle attrs, last wins
     compiles: Dict[str, dict] = {}  # kernel -> compile-table row
+    smt_outcomes: Dict[str, int] = {}  # decided / per-reason query counts
     for path in paths:
         files += 1
         records, skipped = trace_mod.load_events(path, count_skipped=True)
@@ -150,6 +156,15 @@ def aggregate(paths: Iterable[str]) -> dict:
                 # runs appended to one file sum correctly.
                 metrics = rec.get("metrics", {})
                 launches += _counter_total(metrics, "device_launches")
+                # SMT pool outcomes: decided verdicts fold into one row,
+                # unknowns keep their machine-readable reason (timeout /
+                # memout / solver-error / smt.worker:<death>).
+                for s in metrics.get("smt_queries", {}).get("series", []):
+                    labels = dict(s.get("labels", {}))
+                    key = "decided" if labels.get("verdict") in \
+                        ("sat", "unsat") else labels.get("reason", "?")
+                    smt_outcomes[key] = smt_outcomes.get(key, 0) \
+                        + int(s.get("value", 0))
                 # Compiles that happened while no tracer was active (e.g. a
                 # warm-up pass inside the traced scope's registry window)
                 # have no compile.<kernel> span; the closing snapshot's
@@ -256,6 +271,7 @@ def aggregate(paths: Iterable[str]) -> dict:
         "attempted": decided + verdicts["unknown"],
         "via": via,
         "degraded": dict(sorted(degraded.items(), key=lambda kv: -kv[1])),
+        "smt": dict(sorted(smt_outcomes.items(), key=lambda kv: -kv[1])),
         "shards": {k: shards[k] for k in sorted(shards)},
         "requests": request_table,
         "models": models,
@@ -307,6 +323,12 @@ def render(agg: dict) -> str:
         lines.append(f"{'degradation reason':<{w}}  {'partitions':>10}")
         for reason, n in agg["degraded"].items():
             lines.append(f"{reason:<{w}}  {n:>10}")
+    if agg.get("smt"):
+        w = max(max(len(k) for k in agg["smt"]), len("smt outcome"))
+        lines.append("")
+        lines.append(f"{'smt outcome':<{w}}  {'queries':>8}")
+        for reason, n in agg["smt"].items():
+            lines.append(f"{reason:<{w}}  {n:>8}")
     if agg.get("shards"):
         w = max(max(len(k) for k in agg["shards"]), len("shard"))
         lines.append("")

@@ -28,6 +28,18 @@ site                  where :func:`check` is called
                       request (or its next span) starts executing
 ``serve.drain``       :meth:`serve.server.VerificationServer.drain`
                       journaling queued requests for resume pickup
+``smt.worker.spawn``  :class:`smt.pool.SmtPool` forking a solver worker
+                      subprocess (an injected fault models a fork/exec
+                      failure; exhaustion degrades the query)
+``smt.worker.crash``  pool dispatch of one query to a live worker — an
+                      injected fault here SIGKILLs the worker subprocess
+                      mid-query, so the real death-containment path runs
+``smt.worker.hang``   pool dispatch — an injected fault wedges the worker
+                      (it sleeps through its deadline), exercising the
+                      hard wall-clock kill after grace
+``smt.worker.memout`` pool dispatch — an injected fault makes the worker
+                      allocate past its RSS cap, exercising the memout
+                      containment + higher-cap retry policy
 ====================  =====================================================
 
 A **spec** is ``site:kind:nth``:
@@ -59,7 +71,9 @@ from typing import Dict, Iterable, List, Optional, Tuple
 FAULT_SITES = frozenset(
     {"launch.submit", "launch.decode", "compile", "smt.query", "ledger.append",
      "shard.dispatch", "shard.gather", "device.lost",
-     "request.admit", "request.deadline", "serve.drain"})
+     "request.admit", "request.deadline", "serve.drain",
+     "smt.worker.spawn", "smt.worker.crash", "smt.worker.hang",
+     "smt.worker.memout"})
 FAULT_KINDS = frozenset({"transient", "fatal", "crash"})
 
 _SPEC_RE = re.compile(

@@ -28,7 +28,7 @@ predicate as a library function (``_sweeplib`` delegates to it), and
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 from fairify_tpu.resilience import faults as faults_mod
 
@@ -53,9 +53,17 @@ def span_admissible(rate: Optional[float], depth: int, chunk: int,
 
 
 class AdmissionController:
-    """Thread-safe request admission over a throughput EMA + backlog."""
+    """Thread-safe request admission over a throughput EMA + backlog.
 
-    def __init__(self, ema_alpha: float = 0.3, factor: float = 0.8):
+    ``smt_backlog`` (a zero-arg callable returning seconds) folds
+    HOST-side solver work into feasibility: the device-rate EMA knows
+    nothing about the SMT pool's queue, so an UNKNOWN-heavy request
+    stream could otherwise admit deadlines the Z3 phase is guaranteed to
+    blow.  The server wires this to ``SmtPool.backlog_s``.
+    """
+
+    def __init__(self, ema_alpha: float = 0.3, factor: float = 0.8,
+                 smt_backlog: Optional[Callable[[], float]] = None):
         # ``factor`` is the admission analog of the harness's span factor:
         # the fraction of a request's SLA window its predicted completion
         # (backlog ahead of it + its own cost) may fill.  0.8 leaves the
@@ -65,6 +73,7 @@ class AdmissionController:
         # and visible.
         self._alpha = float(ema_alpha)
         self._factor = float(factor)
+        self._smt_backlog = smt_backlog
         self._lock = threading.Lock()
         self._rate: Optional[float] = None      # partitions/sec EMA
         self._backlog_s: float = 0.0            # committed cost, seconds
@@ -93,18 +102,21 @@ class AdmissionController:
         crash (the server classifies and converts; crash-kind propagates).
         """
         faults_mod.check("request.admit")
+        # Host-side solver backlog (measured outside the lock: the pool
+        # has its own): committed work the device-rate EMA cannot see.
+        smt_s = self._smt_backlog() if self._smt_backlog is not None else 0.0
         with self._lock:
             est = None if self._rate is None \
                 else request.partitions / max(self._rate, 1e-9)
             if request.deadline_s is not None and est is not None:
-                predicted = self._backlog_s + est
+                predicted = self._backlog_s + smt_s + est
                 if predicted > self._factor * request.deadline_s:
                     raise AdmissionRejected(
                         f"deadline-infeasible: predicted "
                         f"{predicted:.2f}s of committed work against a "
                         f"{request.deadline_s:.2f}s deadline "
                         f"(rate {self._rate:.1f} parts/s, backlog "
-                        f"{self._backlog_s:.2f}s)")
+                        f"{self._backlog_s:.2f}s device + {smt_s:.2f}s smt)")
             self._est[request.id] = est or 0.0
             self._backlog_s += est or 0.0
 

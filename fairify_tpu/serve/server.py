@@ -97,6 +97,16 @@ class ServeConfig:
     # devices; disables cross-request stage-0 stacking, which is
     # grid-global while shards are span-local).
     n_shards: Optional[int] = None
+    # --- SMT worker pool (fairify_tpu/smt, DESIGN.md §14) ---------------
+    # One server-wide pool shared by every request whose cfg enables the
+    # SMT UNKNOWN-retry ladder; sized here (not per request) because the
+    # workers are a host resource like the device.  The worker loop's SMT
+    # phase is NON-blocking: still-solving queries come back as a
+    # report.smt_pending drain that a background thread finishes while
+    # the next request's device launches proceed.
+    smt_workers: int = 1
+    smt_memory_cap_mb: int = 0
+    smt_portfolio: int = 0
 
 
 class VerificationServer:
@@ -111,7 +121,7 @@ class VerificationServer:
 
     def __init__(self, cfg: ServeConfig = ServeConfig()):
         self.cfg = cfg
-        self.admission = AdmissionController()
+        self.admission = AdmissionController(smt_backlog=self._smt_backlog_s)
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._queue: deque = deque()
@@ -121,6 +131,10 @@ class VerificationServer:
         self._thread: Optional[threading.Thread] = None
         self._sup = Supervisor(max_retries=2, backoff_s=0.05)
         self._journal_writer: Optional[JournalWriter] = None
+        self._smt_pool = None                   # lazy; server-wide
+        self._smt_drain_q: deque = deque()      # (req, report) to finish
+        self._smt_drainer: Optional[threading.Thread] = None
+        self._smt_draining_id: Optional[str] = None  # popped, in drain()
         if cfg.spool:
             os.makedirs(os.path.join(cfg.spool, "inbox"), exist_ok=True)
             os.makedirs(os.path.join(cfg.spool, "requests"), exist_ok=True)
@@ -167,6 +181,24 @@ class VerificationServer:
         if self._thread is not None:
             self._thread.join()
             self._thread = None
+        # In-flight work finishes — including requests parked on the SMT
+        # drainer: everything queued BEFORE the sentinel completes (the
+        # pool's hard deadlines bound the wait), then the pool's workers
+        # are reaped.
+        with self._cv:
+            drainer = self._smt_drainer
+            if drainer is not None:
+                self._smt_drain_q.append(None)
+                self._cv.notify_all()
+        if drainer is not None:
+            drainer.join()
+            with self._cv:
+                self._smt_drainer = None
+        with self._cv:
+            pool = self._smt_pool
+            self._smt_pool = None
+        if pool is not None:
+            pool.close()
         # The worker may have preempted its running request at a span
         # boundary; it requeues that one itself before exiting — fold it
         # into the return value so the drain report is complete.
@@ -290,6 +322,82 @@ class VerificationServer:
         lo, _hi = self._grid(cfg)
         return int(lo.shape[0])
 
+    # --- SMT pool (server-wide; DESIGN.md §14) ----------------------------
+
+    def _smt_backlog_s(self) -> float:
+        """Host-solver backlog for SLA admission (0 without a pool)."""
+        with self._cv:
+            pool = self._smt_pool
+        return pool.backlog_s() if pool is not None else 0.0
+
+    def _smt_pool_get(self, cfg):
+        """The shared pool, created on the first SMT-enabled request."""
+        if not cfg.smt_retry_timeouts_s:
+            return None
+        with self._cv:
+            if self._smt_pool is None:
+                from fairify_tpu.smt.pool import PoolConfig, SmtPool
+
+                self._smt_pool = SmtPool(PoolConfig(
+                    workers=max(int(self.cfg.smt_workers), 1),
+                    memory_cap_mb=self.cfg.smt_memory_cap_mb,
+                    portfolio=self.cfg.smt_portfolio))
+            return self._smt_pool
+
+    def _smt_defer(self, req: VerifyRequest, report) -> None:
+        """Park a request whose SMT queries are still solving: the worker
+        loop moves on to the next request's device launches; a background
+        drainer finishes this one when the pool answers."""
+        with self._cv:
+            self._smt_drain_q.append((req, report))
+            if self._smt_drainer is None or not self._smt_drainer.is_alive():
+                self._smt_drainer = threading.Thread(
+                    target=self._smt_drain_loop, name="fairify-smt-drain",
+                    daemon=True)
+                self._smt_drainer.start()
+            self._cv.notify_all()
+
+    def _smt_drain_loop(self) -> None:
+        registry = obs.registry()
+        while True:
+            with self._cv:
+                while not self._smt_drain_q:
+                    self._cv.wait(timeout=0.5)
+                item = self._smt_drain_q.popleft()
+                self._smt_draining_id = None if item is None else item[0].id
+            if item is None:
+                return  # drain() sentinel: everything before it is done
+            req, report = item
+            try:
+                with obs.span("serve.smt_drain", request=req.id,
+                              queries=report.smt_pending.pending):
+                    report.smt_pending.drain()
+                report.smt_pending = None
+            except BaseException as exc:
+                if classify(exc) == "propagate":
+                    # Leave the request client-visible before the drainer
+                    # dies (mirrors the worker-loop crash contract).
+                    req.status = FAILED
+                    req.reason = f"smt drain crash: {type(exc).__name__}"
+                    req.finished_at = time.monotonic()
+                    self.admission.release(req)
+                    self._finish(req)
+                    raise
+                req.status = FAILED
+                req.reason = f"{type(exc).__name__}: {str(exc)[:200]}"
+                req.finished_at = time.monotonic()
+                registry.counter("serve_requests").inc(status=FAILED)
+                registry.counter("serve_request_failures").inc(
+                    error=type(exc).__name__)
+                self.admission.release(req)
+                self._finish(req)
+                with self._cv:
+                    self._smt_draining_id = None
+                continue
+            self._complete(req, report)
+            with self._cv:
+                self._smt_draining_id = None
+
     def alive(self) -> bool:
         """True while the worker thread is running.
 
@@ -341,8 +449,21 @@ class VerificationServer:
                 # crash beat to the device would otherwise be stranded
                 # ``queued`` forever: spool-backed ones go back to the
                 # inbox for the next server, in-process ones fail.
+                with self._cv:
+                    draining_ids = {item[0].id for item in self._smt_drain_q
+                                    if item is not None}
+                    if self._smt_draining_id is not None:
+                        # Popped and actively draining: that thread owns
+                        # its terminal transition — touching it here would
+                        # double-release its admission share and flip a
+                        # client-visible FAILED back to DONE.
+                        draining_ids.add(self._smt_draining_id)
                 for req in batch:
                     if req.status not in (QUEUED, RUNNING):
+                        continue
+                    if req.id in draining_ids:
+                        # Parked on the SMT drainer: that thread owns its
+                        # terminal transition and survives this crash.
                         continue
                     req.reason = f"server crash: {type(exc).__name__}"
                     if req.status == QUEUED and self.cfg.spool \
@@ -456,27 +577,49 @@ class VerificationServer:
                 sp.set(status=req.status, reason=req.reason)
                 self._finish(req)
                 return
-            req.finished_at = time.monotonic()
             if req.status == REQUEUED:
                 # Span-granular drain preempted it: _execute_spans already
                 # journaled the requeue (and released its backlog share);
                 # the rate EMA must not see its partial elapsed time.
+                req.finished_at = time.monotonic()
                 sp.set(status=req.status)
                 return
-            req.report = report
-            req.partitions = report.partitions_total
-            req.status = DONE
-            left = req.deadline_left(req.finished_at)
-            if left is not None and left < 0.0 and not req.deadline_missed:
-                # not already counted by a span-granular deadline break
-                req.deadline_missed = True
-                registry.counter("serve_deadline_miss").inc(stage="run")
-            registry.counter("serve_requests").inc(status=DONE)
-            self.admission.finished(req, partitions=req.partitions,
-                                    elapsed_s=req.run_s)
-            sp.set(status=req.status, queue_wait_s=round(req.queue_wait_s, 4),
+            if getattr(report, "smt_pending", None) is not None \
+                    and report.smt_pending.pending:
+                # Non-blocking SMT phase: the request stays RUNNING while
+                # the pool finishes its host solving on the drainer
+                # thread; the worker loop is free for the next request's
+                # device launches RIGHT NOW.
+                req.report = report
+                sp.set(status=req.status,
+                       smt_pending=report.smt_pending.pending)
+                self._smt_defer(req, report)
+                return
+            report.smt_pending = None  # empty drain: nothing to wait for
+            self._complete(req, report, sp=sp)
+
+    def _complete(self, req: VerifyRequest, report, sp=None) -> None:
+        """Terminal DONE bookkeeping — from the worker loop (inline SMT or
+        none) or from the drainer thread (deferred SMT finished).  The SLA
+        clock includes drain time: ``finished_at`` is stamped HERE."""
+        registry = obs.registry()
+        req.finished_at = time.monotonic()
+        req.report = report
+        req.partitions = report.partitions_total
+        req.status = DONE
+        left = req.deadline_left(req.finished_at)
+        if left is not None and left < 0.0 and not req.deadline_missed:
+            # not already counted by a span-granular deadline break
+            req.deadline_missed = True
+            registry.counter("serve_deadline_miss").inc(stage="run")
+        registry.counter("serve_requests").inc(status=DONE)
+        self.admission.finished(req, partitions=req.partitions,
+                                elapsed_s=req.run_s)
+        if sp is not None:
+            sp.set(status=req.status,
+                   queue_wait_s=round(req.queue_wait_s, 4),
                    deadline_missed=req.deadline_missed)
-            self._finish(req)
+        self._finish(req)
 
     def _execute(self, req: VerifyRequest, stage0, deadline_left):
         """One request's sweep: whole-span, span-granular, or sharded."""
@@ -495,11 +638,13 @@ class VerificationServer:
                 req.net, cfg, model_name=req.model_name, dataset=req.dataset,
                 n_shards=self.cfg.n_shards, resume=True,
                 partition_span=req.partition_span)
+        pool = self._smt_pool_get(cfg)
         if self.cfg.span_chunks <= 0:
             return sweep_mod.verify_model(
                 req.net, cfg, model_name=req.model_name, dataset=req.dataset,
                 resume=True, stage0=stage0,
-                partition_span=req.partition_span)
+                partition_span=req.partition_span,
+                smt_pool=pool, smt_defer=pool is not None)
         return self._execute_spans(req, cfg, stage0, sweep_mod)
 
     def _execute_spans(self, req: VerifyRequest, cfg, stage0, sweep_mod):
@@ -547,7 +692,12 @@ class VerificationServer:
                 dataset=req.dataset, resume=True,
                 stage0=(None if stage0 is None else
                         batcher.slice_stage0(stage0, s - start, e - start)),
-                partition_span=(s, e), sink_name=sink)
+                partition_span=(s, e), sink_name=sink,
+                # Shared pool, but BLOCKING per sub-span: a granule must
+                # be fully ledgered before the next drain/deadline check
+                # (the span-preemption contract) — fan-out inside the
+                # granule still parallelizes its own queries.
+                smt_pool=self._smt_pool_get(sub_cfg))
             reports.append(rep)
             outcomes.extend(rep.outcomes)
             attempted += e - s

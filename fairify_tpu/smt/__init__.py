@@ -1,0 +1,39 @@
+"""Out-of-process SMT: worker pool, wire protocol, solver backends.
+
+The package that contains Z3 (DESIGN.md §14).  Public surface:
+
+* :class:`fairify_tpu.smt.pool.SmtPool` / :class:`PoolConfig` — the
+  worker pool (hard wall-clock kills, RSS caps, crash containment,
+  parallel fan-out, portfolio racing).
+* :func:`fairify_tpu.smt.pool.solve_box` / ``submit_box`` — the
+  ``decide_box_smt``-shaped entry points the sweep and serve stack use.
+* :mod:`fairify_tpu.smt.worker` — the subprocess entry
+  (``python -m fairify_tpu.smt.worker``).
+* :mod:`fairify_tpu.smt.protocol` / :mod:`fairify_tpu.smt.brute` —
+  stdlib-only wire format and exact enumeration backend.
+
+Exports resolve lazily (PEP 562): the worker subprocess imports this
+package on every spawn and must never pay for the pool's obs/resilience
+imports, let alone jax.
+"""
+from __future__ import annotations
+
+_LAZY = {
+    "SmtPool": ("fairify_tpu.smt.pool", "SmtPool"),
+    "PoolConfig": ("fairify_tpu.smt.pool", "PoolConfig"),
+    "SmtResult": ("fairify_tpu.smt.pool", "SmtResult"),
+    "WorkerDied": ("fairify_tpu.smt.pool", "WorkerDied"),
+    "solve_box": ("fairify_tpu.smt.pool", "solve_box"),
+    "submit_box": ("fairify_tpu.smt.pool", "submit_box"),
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    entry = _LAZY.get(name)
+    if entry is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(entry[0]), entry[1])

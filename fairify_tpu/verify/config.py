@@ -77,9 +77,23 @@ class SweepConfig:
     # (resilience.faults.parse_spec), armed for the duration of each
     # verify_model call.  Empty = no injection (production).
     inject_faults: Tuple[str, ...] = ()
-    # Escalating per-attempt Z3 timeouts for the SMT UNKNOWN-retry path
-    # (verify.smt.decide_box_smt retry_timeouts_s).
+    # Escalating per-attempt solver timeouts for the SMT UNKNOWN-retry
+    # path.  Non-empty enables the tier: still-unknown boxes after BaB +
+    # heuristic retry fan out to the out-of-process worker pool
+    # (fairify_tpu/smt, DESIGN.md §14) with this ladder.
     smt_retry_timeouts_s: Tuple[float, ...] = ()
+    # --- SMT worker pool (fairify_tpu/smt, DESIGN.md §14) ---------------
+    # Solver worker subprocesses; UNKNOWN boxes fan out across all of
+    # them in parallel (the solver is single-threaded — this is the SMT
+    # phase's only concurrency).
+    smt_workers: int = 1
+    # RLIMIT_AS per worker in MB (0 = uncapped): a solver memory blowup
+    # dies in its own process and is retried ONCE on a doubled cap.
+    smt_memory_cap_mb: int = 0
+    # Race this many solver seed variants per query and take the first
+    # decisive answer (0/1 = off).  Verdicts stay deterministic (sound
+    # backends agree); witnesses may differ between runs.
+    smt_portfolio: int = 0
 
     def query(self) -> FairnessQuery:
         domain = get_domain(self.dataset)

@@ -1072,6 +1072,13 @@ class Decision:
     leaves: int = 0
     elapsed_s: float = 0.0
     stats: dict = field(default_factory=dict)
+    # Why an 'unknown' root stayed unknown: 'deadline' (the batch budget
+    # tripped with sub-boxes still open — more time may decide it) or
+    # 'frontier' (the box survived every phase at full budget).  None for
+    # decided roots.  Surfaced as the `engine_reason` attr on the sweep's
+    # unknown verdict events, so budget-vs-hardness reads off the event
+    # log (the deep-retry harnesses re-attempt both kinds today).
+    reason: Optional[str] = None
 
 
 def _branch_dims(enc: PairEncoding, d: int) -> np.ndarray:
@@ -1338,17 +1345,21 @@ def decide_many(
     main_deadline = pair_deadline * (1.0 - cfg.lp_pair_frac) if use_pair \
         else pair_deadline
 
-    def settle(r: int, verdict: str, ce=None):
+    unknown_reasons: Dict[int, str] = {}
+
+    def settle(r: int, verdict: str, ce=None, reason: Optional[str] = None):
         if verdicts[r] is None:
             verdicts[r] = verdict
             ces[r] = ce
+            if verdict == "unknown":
+                unknown_reasons[r] = reason or "frontier"
 
     with obs.span("engine.bab", roots=int(len(frontier))) as sp_bab:
         while frontier:
             timed_out = (time.perf_counter() - t0) > main_deadline
             if timed_out:
                 for _, _, r in frontier:
-                    settle(r, "unknown")
+                    settle(r, "unknown", reason="deadline")
                 break
 
             t_iter = time.perf_counter()
@@ -1532,7 +1543,11 @@ def decide_many(
 
     for r in range(R):
         if verdicts[r] is None:
-            settle(r, "unsat" if open_boxes[r] == 0 else "unknown")
+            # Open boxes at loop exit mean the deadline (not the proof)
+            # ended this root — the distinction the SMT tier's ladder and
+            # the deep-retry harness key off.
+            settle(r, "unsat" if open_boxes[r] == 0 else "unknown",
+                   reason="deadline")
 
     pair_cost = np.zeros(R, dtype=np.float64)  # lat_cost init'd at Phase E0
     if use_pair and any(v == "unknown" for v in verdicts):
@@ -1563,7 +1578,9 @@ def decide_many(
                         "t_lp": float(sign_lp_cost[r]),
                         "t_bab": float(cost_s[r]),
                         "t_pair": float(pair_cost[r]),
-                        "t_lattice": float(lat_cost[r])})
+                        "t_lattice": float(lat_cost[r])},
+                 reason=(unknown_reasons.get(r, "frontier")
+                         if verdicts[r] == "unknown" else None))
         for r in range(R)
     ]
 

@@ -66,16 +66,18 @@ def _z3_net(x, weights, biases):
 
 
 def _unknown_reason(reason_str: str) -> str:
-    """Map z3's ``reason_unknown`` to the degradation taxonomy's two codes.
+    """Map z3's ``reason_unknown`` to the degradation taxonomy's codes.
 
-    ``timeout`` (budget ran out — escalating the timeout may decide it)
-    vs ``solver-error`` (the query itself defeated the solver — more time
-    rarely helps).  Both are sound: UNKNOWN is always a legal answer.
+    ``timeout`` (budget ran out — escalating the timeout may decide it),
+    ``memout`` (memory/resource exhaustion — re-running at a BIGGER time
+    budget only OOMs harder, so the escalation ladder must skip it; the
+    worker pool instead retries once on a higher-RSS-cap worker), or
+    ``solver-error`` (the query itself defeated the solver — more time
+    rarely helps).  All are sound: UNKNOWN is always a legal answer.
     """
-    r = (reason_str or "").lower()
-    if "timeout" in r or "canceled" in r or "resource" in r:
-        return "timeout"
-    return "solver-error"
+    from fairify_tpu.smt import protocol as smt_protocol
+
+    return smt_protocol.unknown_reason(reason_str)
 
 
 def decide_box_smt(
@@ -176,10 +178,37 @@ def decide_box_smt(
                                                       reason=reason)
             if reason == "timeout":
                 continue  # escalate to the next timeout tier
-            break  # solver-error: more time rarely helps
+            break  # solver-error/memout: more time never helps (a memout
+            # re-run at a bigger budget only OOMs harder — the pool's
+            # higher-RSS-cap retry is the sanctioned second attempt)
         obs.registry().counter("smt_queries").inc(verdict=verdict)
         return verdict, ce, None
     return "unknown", None, reason
+
+
+def build_query(net: MLP, enc: PairEncoding, lo: np.ndarray, hi: np.ndarray,
+                name: str = "partition") -> dict:
+    """Wire-format query for the out-of-process worker pool
+    (:mod:`fairify_tpu.smt`): the :func:`to_smtlib` serialization plus the
+    box/property metadata a backend needs to bound enumeration and to name
+    the witness variables (``x{i}``/``xp{i}``) when extracting a model.
+
+    This is the ONLY serialization the pool ships to workers — a worker
+    never receives Python objects, so a solver crash can corrupt nothing
+    but its own process.
+    """
+    return {
+        "smtlib": to_smtlib(net, enc, lo, hi, name=name),
+        "meta": {
+            "dims": int(len(lo)),
+            "lo": [int(v) for v in lo],
+            "hi": [int(v) for v in hi],
+            "pa": [int(i) for i in enc.pa_idx],
+            "ra": [int(i) for i in enc.ra_idx],
+            "eps": int(enc.eps),
+            "name": name,
+        },
+    }
 
 
 # ---------------------------------------------------------------------------

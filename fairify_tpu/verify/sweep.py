@@ -85,6 +85,11 @@ class ModelReport:
     # chunk (subset of counts["unknown"]; each carries a ledger `failure`
     # record and is re-attempted by a later resume=True pass).
     degraded: int = 0
+    # Deferred SMT finalization (smt_defer mode, serve stack): a
+    # sweep.SmtDrain whose drain() consumes the still-in-flight pool
+    # futures and patches outcomes/ledger in place; None when the SMT
+    # tier completed inline (the default) or never ran.
+    smt_pending: Optional[object] = None
 
     @property
     def counts(self) -> Dict[str, int]:
@@ -140,6 +145,192 @@ def _unretried_failure(site: str, exc: BaseException) -> ChunkFailure:
     kind = "transient-exhausted" if classify(exc) == "transient" else "fatal"
     return ChunkFailure(site=site, kind=kind, error=type(exc).__name__,
                         detail=str(exc), retries=0)
+
+
+class _SmtTier:
+    """The sweep's out-of-process SMT second-opinion tier (DESIGN.md §14).
+
+    Created right after BaB: every still-unknown root's serialized query
+    fans out across the worker pool IMMEDIATELY and in parallel (the
+    pre-pool ladder ran one in-process Z3 query per partition, serially),
+    and the reporting loop consumes each answer when it reaches that
+    partition — host solving overlaps the loop's own work, and under the
+    serve stack's shared pool, other requests' device launches.  A
+    partition the heuristic retry decides meanwhile has its query
+    cancelled, never awaited.
+    """
+
+    def __init__(self, net, enc, lo, hi, candidates, cfg, pool=None):
+        from fairify_tpu.smt import pool as pool_mod
+
+        self._owns = pool is None
+        if pool is None:
+            pool = pool_mod.SmtPool(pool_mod.PoolConfig(
+                workers=max(int(cfg.smt_workers), 1),
+                memory_cap_mb=cfg.smt_memory_cap_mb,
+                portfolio=cfg.smt_portfolio, seed=cfg.seed,
+                # Worker deaths spend the same retry budget as any other
+                # transient fault in this run (DESIGN.md §10/§14).
+                max_retries=cfg.max_launch_retries,
+                backoff_s=cfg.launch_backoff_s))
+        self.pool = pool
+        self._futures = {
+            p: pool_mod.submit_box(
+                pool, net, enc, lo[p], hi[p],
+                soft_timeout_s=cfg.soft_timeout_s,
+                retry_timeouts_s=cfg.smt_retry_timeouts_s)
+            for p in candidates}
+
+    def __contains__(self, p) -> bool:
+        return p in self._futures
+
+    def done(self, p) -> bool:
+        """Non-blocking: is this partition's answer already in?"""
+        fut = self._futures.get(p)
+        return fut is None or fut.done()
+
+    def result(self, p):
+        """Blocking ``(verdict, ce, reason)`` for one partition — bounded
+        by the pool's hard per-dispatch deadlines, so a wedged solver can
+        never hang the reporting loop.  Never raises a non-propagate
+        error: anything escaping the pool's own containment is one more
+        worker-crash UNKNOWN."""
+        from concurrent.futures import CancelledError
+
+        from fairify_tpu.smt import protocol
+
+        fut = self._futures.pop(p)
+        try:
+            return fut.result().triple
+        except CancelledError:
+            return "unknown", None, protocol.REASON_SPAWN
+        except BaseException as exc:
+            if classify(exc) == "propagate":
+                raise
+            return "unknown", None, protocol.REASON_CRASH
+
+    def cancel(self, p) -> None:
+        fut = self._futures.pop(p, None)
+        if fut is not None:
+            fut.cancel()
+
+    def close(self) -> None:
+        for fut in self._futures.values():
+            fut.cancel()
+        self._futures.clear()
+        if self._owns:
+            self.pool.close()
+
+
+@dataclass
+class SmtDrain:
+    """Deferred SMT finalization — the serve stack's non-blocking phase.
+
+    Under ``smt_defer`` the reporting loop never blocks on a pool future:
+    partitions whose query is still in flight get a provisional UNKNOWN
+    outcome whose LEDGER row is withheld (a crash before the drain leaves
+    them unledgered, so ``resume=True`` re-attempts them — sound), and
+    this object finishes them off the device thread.  ``drain()`` blocks
+    on the remaining futures (bounded by the pool's hard per-dispatch
+    deadlines), replays SAT witnesses on the host net, appends the final
+    ledger records, and mutates the report's outcomes in place — so the
+    serve worker loop hands it to a background drainer and moves on to
+    the next request's device launches while host solving finishes.
+
+    The per-request CSV keeps the provisional UNKNOWN rows (the ledger is
+    the serve result contract; DESIGN.md §14 documents the drift).
+    """
+
+    tier: _SmtTier
+    items: List  # (local index, pid, PartitionOutcome) still in flight
+    report: "ModelReport"
+    cfg: SweepConfig
+    weights: List
+    biases: List
+    ledger_path: str
+    model_name: str
+    sink_name: str
+
+    @property
+    def pending(self) -> int:
+        return len(self.items)
+
+    def drain(self) -> Dict[str, int]:
+        """Consume every deferred answer; returns decided/degraded counts."""
+        decided = degraded = 0
+        ledger = JournalWriter(self.ledger_path, fault_site="ledger.append")
+        try:
+            with obs.span("smt.drain", queries=len(self.items)):
+                for p, pid, out in self.items:
+                    v, ce, reason = self.tier.result(p)
+                    if v == "sat" and ce is not None \
+                            and not engine.validate_pair(self.weights,
+                                                         self.biases, *ce):
+                        v, ce, reason = "unknown", None, "invalid-witness"
+                    fail_rec = None
+                    extra = {}
+                    if v != "unknown":
+                        out.verdict = v
+                        out.counterexample = ce
+                        decided += 1
+                        via = "smt"
+                    elif reason is not None \
+                            and reason.startswith("smt.worker:"):
+                        fail_rec = ChunkFailure(
+                            site="smt.worker", kind=reason.split(":", 1)[1],
+                            error="WorkerDied", detail=reason,
+                            retries=self.cfg.max_launch_retries).to_record()
+                        degraded += 1
+                        self.report.degraded += 1
+                        obs.registry().counter("chunks_degraded").inc(
+                            site="smt.worker")
+                        obs.event("degraded", **fail_rec, phase="smt_drain",
+                                  partitions=1)
+                        extra = {"failure": fail_rec["reason"]}
+                        via = "degraded"
+                    else:
+                        via = "bab"
+                        if reason is not None:
+                            extra = {"smt_reason": reason}
+                    # Last-record-wins everywhere downstream: the drain's
+                    # verdict event supersedes the loop's provisional one,
+                    # and this append is the partition's FIRST ledger row.
+                    obs.event("verdict", model=self.model_name,
+                              partition_id=pid, verdict=out.verdict,
+                              via=via, **extra)
+                    rec = {"partition_id": pid, "verdict": out.verdict,
+                           "ce": [out.counterexample[0].tolist(),
+                                  out.counterexample[1].tolist()]
+                           if out.counterexample else None,
+                           "time_s": round(out.times.get("total", 0.0), 4)}
+                    if fail_rec is not None:
+                        rec["failure"] = fail_rec
+                    ledger.append(rec)
+                    if out.counterexample is not None:
+                        # The reporting loop appends the ce CSV only for
+                        # rows it ledgers itself; drain-decided SATs are
+                        # this sink's responsibility or the artifact
+                        # silently misses every deferred witness.
+                        self._append_ce_csv(pid, out.counterexample)
+        finally:
+            ledger.close()
+            self.tier.close()
+            self.items = []
+        return {"decided": decided, "degraded": degraded}
+
+    def _append_ce_csv(self, pid: int, ce) -> None:
+        import csv as _csv
+
+        ce_path = os.path.join(self.cfg.result_dir,
+                               f"{self.sink_name}-counterexamples.csv")
+        new_file = not os.path.isfile(ce_path)
+        with open(ce_path, "a", newline="") as fp:
+            wr = _csv.writer(fp)
+            if new_file:
+                wr.writerow(["partition_id", "role"]
+                            + list(self.cfg.query().columns))
+            wr.writerow([pid, "x"] + [int(v) for v in ce[0]])
+            wr.writerow([pid, "x'"] + [int(v) for v in ce[1]])
 
 
 def _stage0_certify_and_attack(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
@@ -700,6 +891,8 @@ def verify_model(
     host_index=None,
     host_count=None,
     sink_name=None,
+    smt_pool=None,
+    smt_defer: bool = False,
 ) -> ModelReport:
     """Run the full sweep for one model; write CSV + ledger rows as we go.
 
@@ -712,6 +905,14 @@ def verify_model(
     (:mod:`fairify_tpu.parallel.shards`) pins every re-dispatch of a failed
     shard's partitions to the INITIAL shard's journal, so a span keeps one
     ledger across elastic re-shards.
+
+    ``smt_pool`` shares an existing :class:`fairify_tpu.smt.SmtPool` for
+    the UNKNOWN-retry SMT tier (the serve stack's server-wide pool);
+    None = the run owns a pool sized from ``cfg.smt_workers`` for exactly
+    this call (created only if the tier has candidates).  ``smt_defer``
+    makes the SMT phase non-blocking: in-flight queries come back on
+    ``report.smt_pending`` (an :class:`SmtDrain`) instead of stalling the
+    reporting loop — the serve worker's contract.
     """
     from fairify_tpu.obs import heartbeat as hb_mod
 
@@ -722,7 +923,8 @@ def verify_model(
             try:
                 rep = _verify_model_impl(
                     net, cfg, model_name, dataset, mesh, resume, retry_unknown,
-                    stage0, partition_span, host_index, host_count, sink_name)
+                    stage0, partition_span, host_index, host_count, sink_name,
+                    smt_pool, smt_defer)
             except BaseException:
                 # The impl registers this run's heartbeat as the live one
                 # (compile flags); a raise would otherwise leak it, and
@@ -750,6 +952,8 @@ def _verify_model_impl(
     host_index,
     host_count,
     sink_override,
+    smt_pool=None,
+    smt_defer: bool = False,
 ) -> ModelReport:
     from fairify_tpu.utils.cache import enable_persistent_cache
 
@@ -1061,6 +1265,23 @@ def _verify_model_impl(
                 tot = sum(d.stats.get(ph, 0.0) for d in decisions)
                 if tot > 0.0:
                     timer.phases[f"engine_{ph[2:]}"] = tot
+    # Out-of-process SMT second opinions (fairify_tpu/smt, DESIGN.md §14):
+    # every root still unknown after BaB fans its serialized query out
+    # across the worker pool NOW, so host solving runs in parallel with
+    # the reporting loop below (and, under a shared serve pool, with other
+    # requests' device work).  This tier is the sweep's ONLY road to a
+    # native solver — nothing in-process can wedge or crash the run.
+    smt_tier: Optional[_SmtTier] = None
+    smt_deferred_items: List = []
+    smt_transfer = False
+    if cfg.smt_retry_timeouts_s and timer.total() <= cfg.hard_timeout_s:
+        smt_candidates = [p for p, d in bab.items()
+                          if d.verdict == "unknown" and p not in failed]
+        if smt_candidates:
+            with obs.timed_span(timer, "smt_fanout",
+                                queries=len(smt_candidates)):
+                smt_tier = _SmtTier(net, enc, lo, hi, smt_candidates, cfg,
+                                    pool=smt_pool)
     cumulative = timer.total()
 
     orig_acc = 0.0
@@ -1090,243 +1311,298 @@ def _verify_model_impl(
     # a transient filesystem error is retried; exhaustion is counted
     # (`ledger_append_failures`) and the sweep continues — the verdict
     # stays in this report, and a later resume re-decides it (sound).
-    ledger = JournalWriter(ledger_path, fault_site="ledger.append",
-                           supervisor=sup)
-    for p in range(P):
-        pid = span_start + p + 1
-        if pid in done:
-            rec = done[pid]
-            ce = rec.get("ce")
-            out = PartitionOutcome(pid, rec["verdict"],
-                                   counterexample=_ledger_ce(ce))
-            outcomes.append(out)
-            counts = {"sat": sat_count, "unsat": unsat_count, "unknown": unk_count}
-            counts[rec["verdict"]] += 1
-            sat_count, unsat_count, unk_count = counts["sat"], counts["unsat"], counts["unknown"]
+    try:
+        ledger = JournalWriter(ledger_path, fault_site="ledger.append",
+                               supervisor=sup)
+        for p in range(P):
+            pid = span_start + p + 1
+            if pid in done:
+                rec = done[pid]
+                ce = rec.get("ce")
+                out = PartitionOutcome(pid, rec["verdict"],
+                                       counterexample=_ledger_ce(ce))
+                outcomes.append(out)
+                counts = {"sat": sat_count, "unsat": unsat_count, "unknown": unk_count}
+                counts[rec["verdict"]] += 1
+                sat_count, unsat_count, unk_count = counts["sat"], counts["unsat"], counts["unknown"]
+                obs.event("verdict", model=model_name, partition_id=pid,
+                          verdict=rec["verdict"], via="ledger")
+                if heartbeat is not None:
+                    heartbeat.beat(decided=sat_count + unsat_count,
+                                   attempted=len(outcomes), unknown=unk_count)
+                continue
+            t_part = time.perf_counter()
+            fail_rec = failed.get(p)
+            dead = pruning.partition_masks(prune, p) if prune is not None else None
+
+            h_attempt = h_success = 0
+            smt_decided = False
+            smt_unknown_reason = None
+            smt_deferred_this = False
+            sv_time = hv_time = h_time = 0.0
+            ce = None
+            nodes = 0
+            if fail_rec is not None:
+                # A runtime fault degraded this partition's chunk: UNKNOWN with
+                # a machine-readable reason, never a wrong answer — the row is
+                # ledgered with the failure record and re-attempted on resume.
+                verdict = "unknown"
+            elif sat0[p]:
+                verdict, ce = "sat", witnesses[p]
+            elif unsat0[p]:
+                verdict = "unsat"
+            else:
+                dec = bab[p]
+                sv_time = dec.elapsed_s  # per-root attributed cost (engine.decide_many)
+                nodes = dec.nodes
+                verdict, ce = dec.verdict, dec.counterexample
+                if verdict == "unknown" and prune is not None \
+                        and cumulative <= cfg.hard_timeout_s:
+                    # Heuristic retry: kill borderline-quiet neurons, re-decide on
+                    # the masked net (``src/GC/Verify-GC.py:172-211``).
+                    h_attempt = 1
+                    obs.registry().counter("unknown_retries").inc()
+                    t_h = time.perf_counter()
+                    try:
+                        h_dead, merged = heur_ops.heuristic_prune(
+                            [l[p] for l in prune.ws_lb], [l[p] for l in prune.ws_ub],
+                            [l[p] for l in prune.candidates], [l[p] for l in prune.surviving],
+                            dead, cfg.heuristic_threshold,
+                        )
+                        h_net = mask_ops.apply_dead_masks(net, [jnp.asarray(d) for d in merged])
+                        dec2 = engine.decide_box(
+                            h_net, enc, lo[p], hi[p],
+                            replace(cfg.engine, soft_timeout_s=cfg.soft_timeout_s),
+                        )
+                    except BaseException as exc:
+                        # A fault in the retry only loses the retry: the root's
+                        # verdict stays the (sound) UNKNOWN it already has.
+                        if classify(exc) == "propagate":
+                            raise
+                        _degrade([p], _unretried_failure("bab", exc),
+                                 "heuristic_retry")
+                        fail_rec = failed.get(p)
+                        h_time = time.perf_counter() - t_h
+                    else:
+                        hv_time = dec2.elapsed_s
+                        h_time = time.perf_counter() - t_h
+                        nodes += dec2.nodes
+                        if dec2.verdict != "unknown":
+                            h_success = 1
+                            verdict, ce = dec2.verdict, dec2.counterexample
+                            # A SAT from the unsoundly-pruned net must replay on the
+                            # original to count (the reference's V-accurate check).
+                            if verdict == "sat" and not engine.validate_pair(weights, biases, *ce):
+                                verdict, ce = "unknown", None
+                                h_success = 0
+                        dead = merged
+                if smt_tier is not None and p in smt_tier:
+                    if verdict != "unknown" or fail_rec is not None \
+                            or cumulative > cfg.hard_timeout_s:
+                        # The heuristic retry decided it (or its chunk
+                        # degraded / the budget tripped): the prefetched
+                        # query's answer is no longer needed — cancel, never
+                        # await.
+                        smt_tier.cancel(p)
+                    elif smt_defer and not smt_tier.done(p):
+                        # Non-blocking serve mode: the answer is still
+                        # solving out of process — report a provisional
+                        # UNKNOWN whose ledger row is WITHHELD, and let
+                        # the SmtDrain attached to the report finish it
+                        # off the device thread.
+                        smt_deferred_this = True
+                    else:
+                        # Last tier of the UNKNOWN-retry ladder (opt-in via
+                        # cfg.smt_retry_timeouts_s): the out-of-process worker
+                        # pool's second opinion on the ORIGINAL net with the
+                        # escalating per-attempt timeout ladder — the
+                        # reference's re-run-with-a-larger-argv-soft-timeout
+                        # escalation (src/GC/Verify-GC.py:146-149), prefetched
+                        # in parallel right after BaB (_SmtTier).  Worker
+                        # faults come back as UNKNOWN-with-reason, never a
+                        # crashed run (DESIGN.md §14).
+                        smt_verdict, smt_ce, smt_reason = smt_tier.result(p)
+                        if smt_verdict == "sat" and smt_ce is not None \
+                                and not engine.validate_pair(weights, biases,
+                                                             *smt_ce):
+                            # An out-of-process witness must replay on the host
+                            # net to count (the same V-accurate rule the
+                            # heuristic retry obeys): a sound backend never
+                            # fails this, so a corrupted worker reply can
+                            # never smuggle in a wrong SAT.
+                            smt_verdict, smt_ce, smt_reason = \
+                                "unknown", None, "invalid-witness"
+                        if smt_verdict != "unknown":
+                            verdict, ce = smt_verdict, smt_ce
+                            smt_decided = True
+                        elif smt_reason is not None \
+                                and smt_reason.startswith("smt.worker:"):
+                            # Worker-death exhaustion degrades EXACTLY this
+                            # partition: a machine-readable failure record in
+                            # the ledger, re-attempted by resume=True.
+                            _degrade([p], ChunkFailure(
+                                site="smt.worker",
+                                kind=smt_reason.split(":", 1)[1],
+                                error="WorkerDied", detail=smt_reason,
+                                retries=cfg.max_launch_retries), "smt")
+                            fail_rec = failed.get(p)
+                        else:
+                            smt_unknown_reason = smt_reason
+
+            c_check = v_accurate = 0
+            if verdict == "sat" and ce is not None and dead is not None:
+                # dead is None only when pruning itself degraded — a C-check
+                # against a nonexistent pruned net would trivially "pass";
+                # report 0, consistent with the zeroed compression columns.
+                c_check, v_accurate = _c_check_np(weights, biases, dead, ce)
+            if h_attempt and fail_rec is None:  # masks changed after parity pass
+                pruned_acc = _parity_resim(
+                    weights, biases, dead,
+                    pruning.grid_keys(cfg.seed, span_start + p, 1)[0],
+                    lo[p], hi[p], cfg.sim_size)
+            else:
+                pruned_acc = float(parity[p])
+
+            if verdict == "sat":
+                sat_count += 1
+            elif verdict == "unsat":
+                unsat_count += 1
+            else:
+                unk_count += 1
+            if fail_rec is not None:
+                degraded_count += 1
+            counter.record(verdict, via_stage0=bool(sat0[p] or unsat0[p]))
+            if h_success:
+                obs.registry().counter("unknown_retry_success").inc()
+            extra = {"failure": fail_rec["reason"]} if fail_rec is not None else {}
+            if smt_unknown_reason is not None:
+                extra["smt_reason"] = smt_unknown_reason
+            if verdict == "unknown" and fail_rec is None and p in bab \
+                    and bab[p].reason is not None:
+                # Budget-vs-hardness attribution for the event log: did
+                # the engine run out of deadline or out of ideas?
+                extra["engine_reason"] = bab[p].reason
             obs.event("verdict", model=model_name, partition_id=pid,
-                      verdict=rec["verdict"], via="ledger")
+                      verdict=verdict,
+                      via="degraded" if fail_rec is not None
+                      else "stage0" if (sat0[p] or unsat0[p])
+                      else "smt" if smt_decided
+                      else ("heuristic" if h_success else "bab"), **extra)
+
+            # Per-row accounting: amortized stage-0 share + this row's attributed
+            # BaB cost (sv_time) + its own loop work (heuristic retry, replay).
+            total_time = stage0_per_part + sv_time + (time.perf_counter() - t_part)
+            cumulative += time.perf_counter() - t_part
+            obs.registry().histogram("partition_latency_s").observe(total_time)
+            if prune is not None:
+                comp = {
+                    "b": mask_ops.compression_ratio([l[p] for l in prune.b_deads]),
+                    "s": mask_ops.compression_ratio([l[p] for l in prune.s_deads]),
+                    "st": mask_ops.compression_ratio([l[p] for l in prune.st_deads]),
+                    "h": mask_ops.compression_ratio(dead) if h_attempt else 0.0,
+                    "t": mask_ops.compression_ratio(dead),
+                }
+            else:  # pruning itself degraded — no masks exist for this span
+                comp = {"b": 0.0, "s": 0.0, "st": 0.0, "h": 0.0, "t": 0.0}
+            out = PartitionOutcome(
+                pid, verdict, ce, h_attempt, h_success, nodes,
+                times={"sv": sv_time, "s": stage0_per_part + sv_time, "hv": hv_time,
+                       "h": h_time, "total": total_time},
+                compressions=comp, c_check=c_check, v_accurate=v_accurate,
+                pruned_acc=pruned_acc,
+            )
+            outcomes.append(out)
+            if smt_deferred_this:
+                smt_deferred_items.append((p, pid, out))
             if heartbeat is not None:
                 heartbeat.beat(decided=sat_count + unsat_count,
                                attempted=len(outcomes), unknown=unk_count)
-            continue
-        t_part = time.perf_counter()
-        fail_rec = failed.get(p)
-        dead = pruning.partition_masks(prune, p) if prune is not None else None
 
-        h_attempt = h_success = 0
-        smt_decided = False
-        sv_time = hv_time = h_time = 0.0
-        ce = None
-        nodes = 0
-        if fail_rec is not None:
-            # A runtime fault degraded this partition's chunk: UNKNOWN with
-            # a machine-readable reason, never a wrong answer — the row is
-            # ledgered with the failure record and re-attempted on resume.
-            verdict = "unknown"
-        elif sat0[p]:
-            verdict, ce = "sat", witnesses[p]
-        elif unsat0[p]:
-            verdict = "unsat"
-        else:
-            dec = bab[p]
-            sv_time = dec.elapsed_s  # per-root attributed cost (engine.decide_many)
-            nodes = dec.nodes
-            verdict, ce = dec.verdict, dec.counterexample
-            if verdict == "unknown" and prune is not None \
-                    and cumulative <= cfg.hard_timeout_s:
-                # Heuristic retry: kill borderline-quiet neurons, re-decide on
-                # the masked net (``src/GC/Verify-GC.py:172-211``).
-                h_attempt = 1
-                obs.registry().counter("unknown_retries").inc()
-                t_h = time.perf_counter()
-                try:
-                    h_dead, merged = heur_ops.heuristic_prune(
-                        [l[p] for l in prune.ws_lb], [l[p] for l in prune.ws_ub],
-                        [l[p] for l in prune.candidates], [l[p] for l in prune.surviving],
-                        dead, cfg.heuristic_threshold,
-                    )
-                    h_net = mask_ops.apply_dead_masks(net, [jnp.asarray(d) for d in merged])
-                    dec2 = engine.decide_box(
-                        h_net, enc, lo[p], hi[p],
-                        replace(cfg.engine, soft_timeout_s=cfg.soft_timeout_s),
-                    )
-                except BaseException as exc:
-                    # A fault in the retry only loses the retry: the root's
-                    # verdict stays the (sound) UNKNOWN it already has.
-                    if classify(exc) == "propagate":
-                        raise
-                    _degrade([p], _unretried_failure("bab", exc),
-                             "heuristic_retry")
-                    fail_rec = failed.get(p)
-                    h_time = time.perf_counter() - t_h
-                else:
-                    hv_time = dec2.elapsed_s
-                    h_time = time.perf_counter() - t_h
-                    nodes += dec2.nodes
-                    if dec2.verdict != "unknown":
-                        h_success = 1
-                        verdict, ce = dec2.verdict, dec2.counterexample
-                        # A SAT from the unsoundly-pruned net must replay on the
-                        # original to count (the reference's V-accurate check).
-                        if verdict == "sat" and not engine.validate_pair(weights, biases, *ce):
-                            verdict, ce = "unknown", None
-                            h_success = 0
-                    dead = merged
-            if verdict == "unknown" and fail_rec is None \
-                    and cfg.smt_retry_timeouts_s \
-                    and cumulative <= cfg.hard_timeout_s:
-                # Last tier of the UNKNOWN-retry ladder (opt-in via
-                # cfg.smt_retry_timeouts_s): a Z3 second opinion on the
-                # ORIGINAL net with escalating per-attempt timeouts — the
-                # reference's re-run-with-a-larger-argv-soft-timeout
-                # escalation (src/GC/Verify-GC.py:146-149) as a config
-                # knob.  No-op where z3-solver is not installed; faults/
-                # solver errors come back as UNKNOWN-with-reason, never
-                # propagate (decide_box_smt's own contract).
-                from fairify_tpu.verify import smt as smt_mod
+            if pm is not None and fail_rec is None and dead is not None:
+                # Reference artifact shape (``src/CP/Verify-CP.py:448-458``):
+                # Partition ID, orig/pruned test acc + F1, then the group
+                # metrics.  One deliberate delta, documented: the reference
+                # recomputes DI..TI from the UNPRUNED net every partition
+                # (identical numbers each row); here they come from the
+                # partition's masked net, so the column actually varies with
+                # the partition — the per-partition quantity worth recording.
+                import csv as _csv
 
-                if smt_mod.HAVE_Z3:
-                    smt_verdict, smt_ce, _reason = smt_mod.decide_box_smt(
-                        net, enc, lo[p], hi[p],
-                        soft_timeout_s=cfg.soft_timeout_s,
-                        retry_timeouts_s=cfg.smt_retry_timeouts_s)
-                    if smt_verdict != "unknown":
-                        verdict, ce = smt_verdict, smt_ce
-                        smt_decided = True
+                p_pred = mlp_mod.predict_np(weights, biases, pm["X"], dead=dead)
+                rep = pm["gm"].group_report(
+                    pm["X"], pm["y"], p_pred, pm["prot"], privileged_value=1)
+                new_file = not os.path.isfile(pm["path"])
+                with open(pm["path"], "a", newline="") as fp:
+                    wr = _csv.writer(fp)
+                    if new_file:
+                        wr.writerow(["Partition ID", "Original Accuracy",
+                                     "Original F1 Score", "Pruned Accuracy",
+                                     "Pruned F1", "DI", "SPD", "EOD", "AOD",
+                                     "ERD", "CNT", "TI"])
+                    wr.writerow([
+                        pid, round(orig_acc, 6), round(pm["orig_f1"], 6),
+                        round(float((p_pred == pm["y"]).mean()), 6),
+                        round(pm["gm"].f1_score(pm["y"], p_pred), 6),
+                        round(rep.disparate_impact, 6),
+                        round(rep.statistical_parity_difference, 6),
+                        round(rep.equal_opportunity_difference, 6),
+                        round(rep.average_odds_difference, 6),
+                        round(rep.error_rate_difference, 6),
+                        round(rep.consistency, 6),
+                        round(rep.theil_index, 6)])
 
-        c_check = v_accurate = 0
-        if verdict == "sat" and ce is not None and dead is not None:
-            # dead is None only when pruning itself degraded — a C-check
-            # against a nonexistent pruned net would trivially "pass";
-            # report 0, consistent with the zeroed compression columns.
-            c_check, v_accurate = _c_check_np(weights, biases, dead, ce)
-        if h_attempt and fail_rec is None:  # masks changed after parity pass
-            pruned_acc = _parity_resim(
-                weights, biases, dead,
-                pruning.grid_keys(cfg.seed, span_start + p, 1)[0],
-                lo[p], hi[p], cfg.sim_size)
-        else:
-            pruned_acc = float(parity[p])
-
-        if verdict == "sat":
-            sat_count += 1
-        elif verdict == "unsat":
-            unsat_count += 1
-        else:
-            unk_count += 1
-        if fail_rec is not None:
-            degraded_count += 1
-        counter.record(verdict, via_stage0=bool(sat0[p] or unsat0[p]))
-        if h_success:
-            obs.registry().counter("unknown_retry_success").inc()
-        extra = {"failure": fail_rec["reason"]} if fail_rec is not None else {}
-        obs.event("verdict", model=model_name, partition_id=pid,
-                  verdict=verdict,
-                  via="degraded" if fail_rec is not None
-                  else "stage0" if (sat0[p] or unsat0[p])
-                  else "smt" if smt_decided
-                  else ("heuristic" if h_success else "bab"), **extra)
-
-        # Per-row accounting: amortized stage-0 share + this row's attributed
-        # BaB cost (sv_time) + its own loop work (heuristic retry, replay).
-        total_time = stage0_per_part + sv_time + (time.perf_counter() - t_part)
-        cumulative += time.perf_counter() - t_part
-        obs.registry().histogram("partition_latency_s").observe(total_time)
-        if prune is not None:
-            comp = {
-                "b": mask_ops.compression_ratio([l[p] for l in prune.b_deads]),
-                "s": mask_ops.compression_ratio([l[p] for l in prune.s_deads]),
-                "st": mask_ops.compression_ratio([l[p] for l in prune.st_deads]),
-                "h": mask_ops.compression_ratio(dead) if h_attempt else 0.0,
-                "t": mask_ops.compression_ratio(dead),
+            csvio.append_row(csv_path, csvio.PartitionRow(
+                partition_id=pid, verdict=verdict,
+                sat_count=sat_count, unsat_count=unsat_count, unk_count=unk_count,
+                h_attempt=h_attempt, h_success=h_success,
+                b_compression=comp["b"], s_compression=comp["s"], st_compression=comp["st"],
+                h_compression=comp["h"], t_compression=comp["t"],
+                sv_time=sv_time, s_time=out.times["s"], hv_time=hv_time, h_time=h_time,
+                total_time=total_time, c_check=c_check, v_accurate=v_accurate,
+                original_acc=orig_acc, pruned_acc=pruned_acc,
+                c1=ce[0] if ce else None, c2=ce[1] if ce else None,
+            ))
+            led_rec = {
+                "partition_id": pid, "verdict": verdict,
+                "ce": [ce[0].tolist(), ce[1].tolist()] if ce else None,
+                "time_s": round(total_time, 4),
             }
-        else:  # pruning itself degraded — no masks exist for this span
-            comp = {"b": 0.0, "s": 0.0, "st": 0.0, "h": 0.0, "t": 0.0}
-        out = PartitionOutcome(
-            pid, verdict, ce, h_attempt, h_success, nodes,
-            times={"sv": sv_time, "s": stage0_per_part + sv_time, "hv": hv_time,
-                   "h": h_time, "total": total_time},
-            compressions=comp, c_check=c_check, v_accurate=v_accurate,
-            pruned_acc=pruned_acc,
-        )
-        outcomes.append(out)
-        if heartbeat is not None:
-            heartbeat.beat(decided=sat_count + unsat_count,
-                           attempted=len(outcomes), unknown=unk_count)
+            if fail_rec is not None:
+                led_rec["failure"] = fail_rec
+            if not smt_deferred_this:
+                # A deferred partition's ledger row is written by the
+                # SmtDrain once its pool answer lands — leaving it
+                # UNLEDGERED until then, so a crash in between resumes it.
+                ledger.append(led_rec)
+            if ce is not None:
+                # Counterexample CSV, encoded form (``src/CP/Verify-CP.py:310-326``),
+                # appended per partition like the ledger: crash-safe, and resumed
+                # partitions (written by the run that decided them) never repeat.
+                # Decoded form: analysis.decode.counterexample_table.
+                import csv as _csv
 
-        if pm is not None and fail_rec is None and dead is not None:
-            # Reference artifact shape (``src/CP/Verify-CP.py:448-458``):
-            # Partition ID, orig/pruned test acc + F1, then the group
-            # metrics.  One deliberate delta, documented: the reference
-            # recomputes DI..TI from the UNPRUNED net every partition
-            # (identical numbers each row); here they come from the
-            # partition's masked net, so the column actually varies with
-            # the partition — the per-partition quantity worth recording.
-            import csv as _csv
+                ce_path = os.path.join(cfg.result_dir, f"{sink_name}-counterexamples.csv")
+                new_file = not os.path.isfile(ce_path)
+                with open(ce_path, "a", newline="") as fp:
+                    wr = _csv.writer(fp)
+                    if new_file:
+                        wr.writerow(["partition_id", "role"] + list(cfg.query().columns))
+                    wr.writerow([pid, "x"] + [int(v) for v in ce[0]])
+                    wr.writerow([pid, "x'"] + [int(v) for v in ce[1]])
 
-            p_pred = mlp_mod.predict_np(weights, biases, pm["X"], dead=dead)
-            rep = pm["gm"].group_report(
-                pm["X"], pm["y"], p_pred, pm["prot"], privileged_value=1)
-            new_file = not os.path.isfile(pm["path"])
-            with open(pm["path"], "a", newline="") as fp:
-                wr = _csv.writer(fp)
-                if new_file:
-                    wr.writerow(["Partition ID", "Original Accuracy",
-                                 "Original F1 Score", "Pruned Accuracy",
-                                 "Pruned F1", "DI", "SPD", "EOD", "AOD",
-                                 "ERD", "CNT", "TI"])
-                wr.writerow([
-                    pid, round(orig_acc, 6), round(pm["orig_f1"], 6),
-                    round(float((p_pred == pm["y"]).mean()), 6),
-                    round(pm["gm"].f1_score(pm["y"], p_pred), 6),
-                    round(rep.disparate_impact, 6),
-                    round(rep.statistical_parity_difference, 6),
-                    round(rep.equal_opportunity_difference, 6),
-                    round(rep.average_odds_difference, 6),
-                    round(rep.error_rate_difference, 6),
-                    round(rep.consistency, 6),
-                    round(rep.theil_index, 6)])
+            # Hard budget is enforced where work happens: the BaB deadline above
+            # and the heuristic-retry guard.  Verdicts already computed are always
+            # reported — no work is discarded by a reporting-loop break.
 
-        csvio.append_row(csv_path, csvio.PartitionRow(
-            partition_id=pid, verdict=verdict,
-            sat_count=sat_count, unsat_count=unsat_count, unk_count=unk_count,
-            h_attempt=h_attempt, h_success=h_success,
-            b_compression=comp["b"], s_compression=comp["s"], st_compression=comp["st"],
-            h_compression=comp["h"], t_compression=comp["t"],
-            sv_time=sv_time, s_time=out.times["s"], hv_time=hv_time, h_time=h_time,
-            total_time=total_time, c_check=c_check, v_accurate=v_accurate,
-            original_acc=orig_acc, pruned_acc=pruned_acc,
-            c1=ce[0] if ce else None, c2=ce[1] if ce else None,
-        ))
-        led_rec = {
-            "partition_id": pid, "verdict": verdict,
-            "ce": [ce[0].tolist(), ce[1].tolist()] if ce else None,
-            "time_s": round(total_time, 4),
-        }
-        if fail_rec is not None:
-            led_rec["failure"] = fail_rec
-        ledger.append(led_rec)
-        if ce is not None:
-            # Counterexample CSV, encoded form (``src/CP/Verify-CP.py:310-326``),
-            # appended per partition like the ledger: crash-safe, and resumed
-            # partitions (written by the run that decided them) never repeat.
-            # Decoded form: analysis.decode.counterexample_table.
-            import csv as _csv
-
-            ce_path = os.path.join(cfg.result_dir, f"{sink_name}-counterexamples.csv")
-            new_file = not os.path.isfile(ce_path)
-            with open(ce_path, "a", newline="") as fp:
-                wr = _csv.writer(fp)
-                if new_file:
-                    wr.writerow(["partition_id", "role"] + list(cfg.query().columns))
-                wr.writerow([pid, "x"] + [int(v) for v in ce[0]])
-                wr.writerow([pid, "x'"] + [int(v) for v in ce[1]])
-
-        # Hard budget is enforced where work happens: the BaB deadline above
-        # and the heuristic-retry guard.  Verdicts already computed are always
-        # reported — no work is discarded by a reporting-loop break.
-
-    ledger.close()
+        ledger.close()
+        smt_transfer = bool(smt_deferred_items)
+    finally:
+        if smt_tier is not None and not smt_transfer:
+            # Unconsumed futures (partitions decided elsewhere) are
+            # cancelled; a run-owned pool's workers are reaped here even
+            # when the loop above raised.  In smt_defer mode a CLEAN exit
+            # hands the tier to the report's SmtDrain instead.
+            smt_tier.close()
     if retry_unknown:
         # Re-decided rows were appended after their original 'unknown' rows;
         # restore one-row-per-partition ascending order for row-for-row
@@ -1356,12 +1632,18 @@ def _verify_model_impl(
         heartbeat.beat(decided=sat_count + unsat_count, attempted=len(outcomes),
                        unknown=unk_count, force=True)
         heartbeat.close()
-    return ModelReport(
+    report = ModelReport(
         model=model_name, dataset=cfg.dataset, outcomes=outcomes,
         original_acc=orig_acc, total_time_s=timer.total(), partitions_total=P,
         sink_name=sink_name, ledger_skipped_lines=led_skipped,
         degraded=degraded_count,
     )
+    if smt_deferred_items:
+        report.smt_pending = SmtDrain(
+            tier=smt_tier, items=smt_deferred_items, report=report, cfg=cfg,
+            weights=weights, biases=biases, ledger_path=ledger_path,
+            model_name=model_name, sink_name=sink_name)
+    return report
 
 
 def run_sweep(

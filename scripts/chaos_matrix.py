@@ -33,8 +33,25 @@ its neighbor's decided verdicts stay bit-equal to a solo run, and a
 resubmit after disarm (``resume=True`` over the same request sink)
 converges to the fault-free map.
 
+SMT worker-pool cells (``fairify_tpu/smt``, DESIGN.md §14) extend the
+matrix to the out-of-process solver: ``smt.worker.{crash,hang,memout}`` ×
+{transient (one arrival — the fresh-worker retry must absorb it: verdict
+map IDENTICAL, nothing degraded), exhausted (every arrival — exactly the
+faulted queries' partitions degrade to UNKNOWN with a machine-readable
+``smt.worker:*`` failure record, and a disarmed resume converges)}.  The
+injected faults convert to REAL subprocess events (SIGKILL mid-dispatch,
+a wedged worker killed at its hard deadline, an allocation past the RSS
+cap), so these cells exercise the true containment machinery.  BaB and
+stage 0 are substituted with always-unknown stubs for these cells only:
+CROWN certifies any tiny-box world outright, so no real config funnels
+work to the solver deterministically — the machinery under test (fan-out,
+death classification, degradation, resume) is entirely real.  With
+``--serve``, the same faults run inside the persistent server under two
+concurrent clients sharing the server-wide pool.
+
 Usage: python scripts/chaos_matrix.py [--out chaos] [--span 48]
            [--grid-chunk 16] [--preset GC] [--shards 3] [--serve]
+           [--no-smt]
 """
 from __future__ import annotations
 
@@ -94,6 +111,8 @@ def main() -> int:
     ap.add_argument("--serve", action="store_true",
                     help="also run the server-loop cells: launch.*/"
                          "request.* faults under two concurrent clients")
+    ap.add_argument("--no-smt", action="store_true",
+                    help="skip the smt.worker.* pool cells")
     args = ap.parse_args()
 
     from fairify_tpu.models.train import init_mlp
@@ -371,6 +390,176 @@ def main() -> int:
             row["ok"] = False
         failures += 0 if row["ok"] else 1
         print(json.dumps(row), flush=True)
+
+    # SMT worker-pool cells: see module docstring.  workers=1 keeps the
+    # dispatch arrival order (and therefore nth-based schedules)
+    # deterministic; memory_cap enables the memout higher-cap retry tier.
+    if not args.no_smt:
+        from fairify_tpu.data.domains import get_domain
+        from fairify_tpu.verify import engine as engine_mod
+        from fairify_tpu.verify import sweep as sweep_mod
+        from fairify_tpu.verify.engine import EngineConfig
+        from fairify_tpu.verify.sweep import _ledger_path
+
+        def _dull_decode(host, ctx):
+            import numpy as np
+
+            n = ctx["n"]
+            return np.zeros(n, bool), np.zeros(n, bool), {}
+
+        def _unknown_many(net_, enc_, rlo, rhi, ecfg, **kw):
+            return [engine_mod.Decision("unknown")
+                    for _ in range(rlo.shape[0])]
+
+        ov = {c: (0, 0) for c in get_domain("german").columns}
+        ov.update(age=(0, 1), month=(0, 5), purpose=(0, 5),
+                  credit_amount=(0, 2))
+        smt_cfg0 = presets.get("GC").with_(
+            soft_timeout_s=1.0, hard_timeout_s=600.0, sim_size=16,
+            exact_certify_masks=False, grid_chunk=8, launch_backoff_s=1e-4,
+            max_launch_retries=1, domain_overrides=ov, partition_threshold=2,
+            smt_retry_timeouts_s=(5.0,), smt_workers=1,
+            smt_memory_cap_mb=128, engine=EngineConfig(pgd_phase=False))
+        smt_net = init_mlp((len(smt_cfg0.query().columns), 4, 1), seed=3)
+        smt_span = (0, 8)
+        saved = (sweep_mod._stage0_block_decode, engine_mod.decide_many,
+                 engine_mod.decide_box)
+        sweep_mod._stage0_block_decode = _dull_decode
+        engine_mod.decide_many = _unknown_many
+        engine_mod.decide_box = \
+            lambda *a, **k: engine_mod.Decision("unknown")
+        try:
+            smt_base = sweep_mod.verify_model(
+                smt_net, smt_cfg0.with_(
+                    result_dir=os.path.join(args.out, "smt_base")),
+                model_name="m", resume=False, partition_span=smt_span)
+            smt_want = _vmap(smt_base)
+            row = {"cell": "smt/fault-free",
+                   "all_decided": all(v != "unknown"
+                                      for v in smt_want.values())}
+            failures += 0 if row["all_decided"] else 1
+            print(json.dumps(row), flush=True)
+
+            SMT_CELLS = [(site, label,
+                          f"{site}:transient:{'2' if label == 'transient' else '2+'}",
+                          label == "transient")
+                         for site in ("smt.worker.crash", "smt.worker.hang",
+                                      "smt.worker.memout")
+                         for label in ("transient", "exhausted")]
+            for site, label, spec, absorbed in SMT_CELLS:
+                rdir = os.path.join(
+                    args.out, f"{site}-{label}".replace(".", "_"))
+                cfg = smt_cfg0.with_(result_dir=rdir, inject_faults=(spec,))
+                row = {"cell": f"{site}/{label}", "spec": spec}
+                try:
+                    rep = sweep_mod.verify_model(
+                        smt_net, cfg, model_name="m", resume=False,
+                        partition_span=smt_span)
+                except BaseException as exc:  # clause 1: must not crash
+                    row["crashed"] = f"{type(exc).__name__}: {exc}"
+                    row["ok"] = False
+                    failures += 1
+                    print(json.dumps(row), flush=True)
+                    continue
+                got = _vmap(rep)
+                decided_match = all(got[k] == smt_want[k] for k in got
+                                    if got[k] != "unknown")
+                row.update(degraded=rep.degraded, **rep.counts,
+                           decided_match=decided_match)
+                if absorbed:
+                    # One worker death: the fresh-worker retry absorbs it.
+                    row["ok"] = bool(got == smt_want and rep.degraded == 0)
+                else:
+                    # Exhaustion: the faulted queries' partitions degrade
+                    # with the site's machine-readable reason, and a
+                    # disarmed resume converges to the fault-free map.
+                    recs, _sk = sweep_mod._read_ledger(
+                        _ledger_path(cfg, rep.sink_name))
+                    reasons = {r["failure"]["reason"] for r in recs
+                               if r.get("failure")}
+                    want_reason = f"smt.worker:{site.rsplit('.', 1)[-1]}"
+                    resumed = sweep_mod.verify_model(
+                        smt_net, cfg.with_(inject_faults=()), model_name="m",
+                        resume=True, partition_span=smt_span)
+                    row["reasons"] = sorted(reasons)
+                    row["resume_converged"] = _vmap(resumed) == smt_want
+                    row["ok"] = bool(decided_match and rep.degraded > 0
+                                     and reasons == {want_reason}
+                                     and row["resume_converged"])
+                failures += 0 if row["ok"] else 1
+                print(json.dumps(row), flush=True)
+
+            # Serve-mode smt cells: the same faults inside the persistent
+            # server, two clients sharing the server-wide pool.
+            if args.serve:
+                from fairify_tpu.resilience import faults as faults_lib
+                from fairify_tpu.serve import ServeConfig, VerificationServer
+
+                for label, spec, absorbed in [
+                        ("transient", "smt.worker.crash:transient:2", True),
+                        ("exhausted", "smt.worker.crash:transient:2+", False)]:
+                    row = {"cell": f"serve/smt.worker.crash/{label}",
+                           "spec": spec}
+                    rdir = os.path.join(args.out, f"serve_smt_{label}")
+                    dirs = {"ma": os.path.join(rdir, "a"),
+                            "mb": os.path.join(rdir, "b")}
+                    try:
+                        with faults_lib.armed((spec,), seed=smt_cfg0.seed):
+                            srv = VerificationServer(ServeConfig(
+                                batch_window_s=0.2, max_batch=4,
+                                smt_workers=1))
+                            ra = srv.submit(
+                                smt_cfg0.with_(result_dir=dirs["ma"]),
+                                smt_net, "ma", partition_span=smt_span)
+                            rb = srv.submit(
+                                smt_cfg0.with_(result_dir=dirs["mb"]),
+                                smt_net, "mb", partition_span=smt_span)
+                            srv.start()
+                            fa = srv.wait(ra.id, timeout=900.0)
+                            fb = srv.wait(rb.id, timeout=900.0)
+                            srv.drain()
+                    except BaseException as exc:  # the loop never crashes
+                        row["crashed"] = f"{type(exc).__name__}: {exc}"
+                        row["ok"] = False
+                        failures += 1
+                        print(json.dumps(row), flush=True)
+                        continue
+                    row["status"] = {"ma": fa.status, "mb": fb.status}
+                    maps = {n_: ({} if r.report is None else _vmap(r.report))
+                            for r, n_ in ((fa, "ma"), (fb, "mb"))}
+                    decided_match = all(
+                        maps[n_].get(p) == smt_want[p]
+                        for n_ in maps for p in maps[n_]
+                        if maps[n_][p] != "unknown")
+                    row["decided_match"] = decided_match
+                    if absorbed:
+                        row["ok"] = bool(fa.status == fb.status == "done"
+                                         and maps["ma"] == smt_want
+                                         and maps["mb"] == smt_want)
+                    else:
+                        srv2 = VerificationServer(ServeConfig(
+                            batch_window_s=0.2, max_batch=4, smt_workers=1))
+                        r2a = srv2.submit(
+                            smt_cfg0.with_(result_dir=dirs["ma"]), smt_net,
+                            "ma", partition_span=smt_span)
+                        r2b = srv2.submit(
+                            smt_cfg0.with_(result_dir=dirs["mb"]), smt_net,
+                            "mb", partition_span=smt_span)
+                        srv2.start()
+                        f2a = srv2.wait(r2a.id, timeout=900.0)
+                        f2b = srv2.wait(r2b.id, timeout=900.0)
+                        srv2.drain()
+                        row["resume_converged"] = bool(
+                            f2a.status == f2b.status == "done"
+                            and _vmap(f2a.report) == smt_want
+                            and _vmap(f2b.report) == smt_want)
+                        row["ok"] = bool(decided_match
+                                         and row["resume_converged"])
+                    failures += 0 if row["ok"] else 1
+                    print(json.dumps(row), flush=True)
+        finally:
+            (sweep_mod._stage0_block_decode, engine_mod.decide_many,
+             engine_mod.decide_box) = saved
 
     print(json.dumps({"cells_failed": failures}), flush=True)
     return 1 if failures else 0

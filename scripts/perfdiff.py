@@ -50,6 +50,14 @@ fail on one unlucky miss), ``serve.requests_per_s@<n>c`` and
 floor as ``n_compiles`` — a warm server that starts recompiling fails
 outright.
 
+**SMT records** (``audits/SMT_r*.json`` from ``scripts/smt_bench.py``;
+``"kind": "SMT"``) gate the out-of-process solver pool: per worker count,
+``smt.qps@<n>w`` (queries/s) and the 1→N ``smt.speedup_x`` ratio gate
+higher-is-better as band-less single samples, while
+``smt.worker_crashes`` and ``smt.memouts`` are **lower-is-better** with a
+0.5 absolute floor — a healthy bench run contains ZERO worker deaths, so
+any growth from 0 is a containment regression, not noise.
+
 ``--self-test`` runs the built-in contract checks (wired into tier-1 via
 ``tests/test_perfdiff.py``): identical records pass, a 2x slowdown fails,
 overlapping noisy bands pass, doubled launches fail.
@@ -142,6 +150,25 @@ def _serve_records(obj: dict) -> Dict[str, dict]:
     return out
 
 
+def _smt_records(obj: dict) -> Dict[str, dict]:
+    """Metrics of one SMT pool record (``scripts/smt_bench.py``)."""
+    if obj.get("kind") != "SMT":
+        return {}
+    out: Dict[str, dict] = {}
+    for n, row in sorted((obj.get("workers") or {}).items(),
+                         key=lambda kv: int(kv[0])):
+        if isinstance(row, dict) and row.get("queries_per_s") is not None:
+            out[f"smt.qps@{n}w"] = _flat(row["queries_per_s"])
+    if obj.get("speedup_x") is not None:
+        out["smt.speedup_x"] = _flat(obj["speedup_x"])
+    if obj.get("worker_crashes") is not None:
+        out["smt.worker_crashes"] = _flat_lower(obj["worker_crashes"],
+                                                floor=0.5)
+    if obj.get("memouts") is not None:
+        out["smt.memouts"] = _flat_lower(obj["memouts"], floor=0.5)
+    return out
+
+
 def _multichip_records(obj: dict) -> Dict[str, dict]:
     """Metrics of one MULTICHIP record (``n_devices`` marks the shape).
 
@@ -197,6 +224,10 @@ def load_records(path: str) -> Dict[str, dict]:
         sv = _serve_records(obj)
         if sv:
             out.update(sv)
+            continue
+        sm = _smt_records(obj)
+        if sm:
+            out.update(sm)
             continue
         mc = _multichip_records(obj)
         if mc:
@@ -379,6 +410,23 @@ def self_test() -> int:
          "clients": {"4": {"p95_ms": 880.0, "deadline_miss_rate": 0.01,
                            "requests_per_s": 4.6,
                            "batch_occupancy_mean": 3.3}}})
+    sm = {"kind": "SMT", "queries": 16,
+          "workers": {"1": {"queries_per_s": 3.0},
+                      "4": {"queries_per_s": 10.5}},
+          "speedup_x": 3.5, "worker_crashes": 0, "memouts": 0}
+    sm_base = _smt_records(sm)
+    sm_same = _smt_records(json.loads(json.dumps(sm)))
+    sm_serial = _smt_records(
+        {"kind": "SMT", "queries": 16,
+         "workers": {"1": {"queries_per_s": 3.0},
+                     "4": {"queries_per_s": 3.2}},
+         "speedup_x": 1.07, "worker_crashes": 0, "memouts": 0})
+    sm_crashy = _smt_records(dict(sm, worker_crashes=4, memouts=2))
+    sm_jitter = _smt_records(
+        {"kind": "SMT", "queries": 16,
+         "workers": {"1": {"queries_per_s": 2.8},
+                     "4": {"queries_per_s": 9.9}},
+         "speedup_x": 3.3, "worker_crashes": 0, "memouts": 0})
     checks = [
         ("identical records pass", compare(base, same), 0),
         ("2x slowdown flagged", compare(base, slow), 1),
@@ -404,6 +452,12 @@ def self_test() -> int:
         ("serve deadline misses flagged", compare(sv_base, sv_missy), 1),
         ("warm server recompiling flagged", compare(sv_base, sv_cold), 1),
         ("serve latency/miss jitter passes", compare(sv_base, sv_jitter), 0),
+        ("identical smt records pass", compare(sm_base, sm_same), 0),
+        ("lost smt scaling flagged (qps@4w + speedup_x)",
+         compare(sm_base, sm_serial), 2),
+        ("smt worker deaths from a 0 baseline flagged",
+         compare(sm_base, sm_crashy), 2),
+        ("smt qps jitter passes", compare(sm_base, sm_jitter), 0),
     ]
     failed = 0
     for name, findings, want in checks:

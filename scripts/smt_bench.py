@@ -1,0 +1,134 @@
+"""SMT worker-pool throughput bench → ``audits/SMT_r*.json`` (perfdiff-gated).
+
+Measures the SMT phase the sweep's UNKNOWN-retry ladder actually runs
+(``verify.sweep._SmtTier``: serialize → fan out → consume), isolated from
+device work so the number is the pool's own: Q identical-cost queries are
+fanned out across 1 worker and then N workers, and the record carries
+``queries_per_s`` per worker count, the 1→N ``speedup_x``, and the
+containment health counters (``worker_crashes`` / ``memouts`` — a healthy
+bench has ZERO of each; perfdiff fails any growth).
+
+The solver is single-threaded, so before the pool the sweep's SMT phase
+was serial no matter the host: speedup_x is the headline robustness win —
+an UNKNOWN-heavy ladder's host-solving wall time divides by the worker
+count (acceptance target: ≥ 2x at 4 workers).
+
+Queries are UNSAT by construction (a constant-sign logit), forcing the
+brute backend through its FULL enumeration — deterministic per-query cost,
+no early-SAT shortcuts.  Where z3-solver is installed the worker backend
+resolves to z3 automatically and the record's ``backend`` field says so.
+
+Usage: python scripts/smt_bench.py [--queries 16] [--workers 4]
+           [--out audits/SMT_r10.json] [--box 24]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _queries(n: int, box: int):
+    """n serialized pair-property queries with identical enumeration cost."""
+    import numpy as np
+
+    from fairify_tpu.data.domains import DomainSpec
+    from fairify_tpu.models import mlp
+    from fairify_tpu.verify import property as prop
+    from fairify_tpu.verify import smt as smt_mod
+
+    ranges = {"a": (0, box), "b": (0, box), "c": (0, 3), "pa": (0, 1)}
+    dom = DomainSpec(name="smtbench", columns=tuple(ranges),
+                     ranges={k: tuple(v) for k, v in ranges.items()},
+                     label="y")
+    q = prop.FairnessQuery(domain=dom, protected=("pa",))
+    enc = prop.encode(q)
+    lo, hi = q.domain.lo_hi()
+    out = []
+    for i in range(n):
+        rng = np.random.default_rng(1000 + i)
+        ws = [rng.normal(size=(4, 6)).astype(np.float32) * 0.25,
+              rng.normal(size=(6, 1)).astype(np.float32) * 0.25]
+        # Large positive bias: the logit never crosses zero, so the
+        # query is UNSAT and the backend must walk every pair.
+        bs = [np.zeros(6, np.float32), np.array([50.0], np.float32)]
+        net = mlp.from_numpy(ws, bs)
+        out.append(smt_mod.build_query(net, enc, lo.astype(np.int64),
+                                       hi.astype(np.int64), name=f"q{i}"))
+    return out
+
+
+def _run_level(queries, workers: int) -> dict:
+    from fairify_tpu.smt.pool import PoolConfig, SmtPool
+
+    with SmtPool(PoolConfig(workers=workers, backend="auto")) as pool:
+        # Warm spawn outside the timed window (the sweep's pool lives for
+        # the whole run; spawn cost is not per-query cost).
+        warm = pool.solve_serialized(queries[0], soft_timeout_s=120.0)
+        t0 = time.perf_counter()
+        futs = [pool.submit_serialized(q, soft_timeout_s=120.0)
+                for q in queries]
+        results = [f.result() for f in futs]
+        wall = time.perf_counter() - t0
+    bad = [r.verdict for r in results + [warm] if r.verdict != "unsat"]
+    return {
+        "queries_per_s": round(len(queries) / wall, 3),
+        "smt_wall_s": round(wall, 3),
+        "unexpected_verdicts": len(bad),
+        "backend": results[0].backend if results else "?",
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--queries", type=int, default=16)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--box", type=int, default=24,
+                    help="per-attribute range width (enumeration cost knob)")
+    ap.add_argument("--out", default=None,
+                    help="write the SMT record JSON here (e.g. "
+                         "audits/SMT_r10.json)")
+    args = ap.parse_args()
+
+    from fairify_tpu import obs
+
+    queries = _queries(args.queries, args.box)
+    reg = obs.registry()
+    crashes0 = reg.counter("smt_worker_crashes").total()
+    memouts0 = reg.counter("smt_memouts").total()
+    levels = {}
+    for w in sorted({1, max(args.workers, 1)}):
+        levels[str(w)] = _run_level(queries, w)
+        print(json.dumps({"workers": w, **levels[str(w)]}), flush=True)
+    qps1 = levels["1"]["queries_per_s"]
+    qpsn = levels[str(max(args.workers, 1))]["queries_per_s"]
+    record = {
+        "kind": "SMT",
+        "queries": args.queries,
+        "backend": levels["1"]["backend"],
+        "workers": {k: {"queries_per_s": v["queries_per_s"],
+                        "smt_wall_s": v["smt_wall_s"]}
+                    for k, v in levels.items()},
+        "speedup_x": round(qpsn / max(qps1, 1e-9), 2),
+        "worker_crashes": int(reg.counter("smt_worker_crashes").total()
+                              - crashes0),
+        "memouts": int(reg.counter("smt_memouts").total() - memouts0),
+        "ok": all(v["unexpected_verdicts"] == 0 for v in levels.values()),
+    }
+    print(json.dumps(record), flush=True)
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as fp:
+            json.dump(record, fp, indent=2)
+    return 0 if record["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

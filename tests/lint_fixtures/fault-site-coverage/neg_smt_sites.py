@@ -1,0 +1,15 @@
+# rel: fairify_tpu/smt/fx_pool.py
+from fairify_tpu.resilience import faults as faults_mod
+
+
+def spawn_and_dispatch(spawn, send):
+    # Literal anchors for the SMT worker-pool sites: spawning a solver
+    # subprocess, and the three dispatch-path chaos conversions (SIGKILL
+    # mid-query / wedge past deadline / allocate past the RSS cap) each
+    # stay a named chaos-injectable site.
+    faults_mod.check("smt.worker.spawn")
+    w = spawn()
+    faults_mod.check("smt.worker.crash")
+    faults_mod.check("smt.worker.hang")
+    faults_mod.check("smt.worker.memout")
+    return send(w)

@@ -1,5 +1,7 @@
 # rel: fairify_tpu/resilience/faults.py
 FAULT_SITES = frozenset({"demo.used", "demo.orphan", "shard.dispatch",  # EXPECT
                          "shard.gather", "device.lost", "request.admit",
-                         "request.deadline", "serve.drain"})
+                         "request.deadline", "serve.drain",
+                         "smt.worker.spawn", "smt.worker.crash",
+                         "smt.worker.hang", "smt.worker.memout"})
 FAULT_KINDS = frozenset({"transient", "fatal", "crash"})

@@ -1,0 +1,10 @@
+# rel: fairify_tpu/smt/fx_pool_typos.py
+from fairify_tpu.resilience import faults
+
+
+def dispatch_typoed(send):
+    # Misspelled pool sites: every --inject-fault spec targeting them is
+    # rejected at the CLI while these paths run unprotected.
+    faults.check("smt.worker.crashed")  # EXPECT
+    faults.check("smt.worker.oom")  # EXPECT
+    return send()

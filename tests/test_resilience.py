@@ -533,10 +533,13 @@ def test_report_renders_degradation_table_from_ledger(tmp_path, capsys):
 
 
 def test_smt_retry_ladder_wired_into_unknown_retry(tmp_path, monkeypatch):
-    """cfg.smt_retry_timeouts_s reaches decide_box_smt from the sweep's
-    UNKNOWN-retry path (stubbed Z3 backend — the wiring is what's pinned)."""
+    """cfg.smt_retry_timeouts_s reaches the worker pool's dispatch from the
+    sweep's UNKNOWN-retry path (stubbed pool fan-out — the wiring is what's
+    pinned; the pool itself is covered by tests/test_smt_pool.py)."""
+    from concurrent.futures import Future
+
+    from fairify_tpu.smt import pool as pool_mod
     from fairify_tpu.verify import engine as engine_mod
-    from fairify_tpu.verify import smt as smt_mod
 
     span = (0, 16)
 
@@ -549,22 +552,125 @@ def test_smt_retry_ladder_wired_into_unknown_retry(tmp_path, monkeypatch):
 
     calls = []
 
-    def fake_smt(net, enc, lo, hi, soft_timeout_s=100.0, retry_timeouts_s=()):
-        calls.append(tuple(retry_timeouts_s))
-        return "unsat", None, None
+    def fake_submit(pool, net, enc, lo, hi, soft_timeout_s=100.0,
+                    retry_timeouts_s=()):
+        calls.append((soft_timeout_s, tuple(retry_timeouts_s)))
+        fut = Future()
+        fut.set_result(pool_mod.SmtResult("unsat", None, None))
+        return fut
 
     monkeypatch.setattr(sweep, "_stage0_block_decode", dull_decode)
     monkeypatch.setattr(engine_mod, "decide_many", unknown_many)
     monkeypatch.setattr(engine_mod, "decide_box",
                         lambda *a, **k: engine_mod.Decision("unknown"))
-    monkeypatch.setattr(smt_mod, "HAVE_Z3", True)
-    monkeypatch.setattr(smt_mod, "decide_box_smt", fake_smt)
-    rep = sweep.verify_model(
-        _net(), _cfg(tmp_path, "smt", smt_retry_timeouts_s=(7.0, 21.0),
-                     engine=engine_mod.EngineConfig(pgd_phase=False)),
-        model_name="m", resume=False, partition_span=span)
-    assert calls and all(c == (7.0, 21.0) for c in calls)
+    monkeypatch.setattr(pool_mod, "submit_box", fake_submit)
+    cfg = _cfg(tmp_path, "smt", smt_retry_timeouts_s=(7.0, 21.0),
+               engine=engine_mod.EngineConfig(pgd_phase=False))
+    rep = sweep.verify_model(_net(), cfg, model_name="m", resume=False,
+                             partition_span=span)
+    assert calls and all(c == (cfg.soft_timeout_s, (7.0, 21.0))
+                         for c in calls)
+    assert len(calls) == rep.partitions_total  # parallel fan-out: one
+    # query per still-unknown root, submitted up front
     assert rep.counts["unsat"] == rep.partitions_total  # SMT tier decided
+
+
+def _smt_toy_cfg(tmp_path, name, **kw):
+    """GC preset shrunk to a tiny grid of brute-solvable boxes with the
+    SMT worker pool enabled (mirrors tests/test_smt_pool.py; workers=1 so
+    dispatch arrival order — and therefore nth-based chaos schedules —
+    is deterministic)."""
+    from fairify_tpu.data.domains import get_domain
+    from fairify_tpu.verify.engine import EngineConfig
+
+    ov = {c: (0, 0) for c in get_domain("german").columns}
+    ov["age"] = (0, 1)
+    ov["month"] = (0, 5)
+    ov["purpose"] = (0, 5)
+    ov["credit_amount"] = (0, 2)
+    kw.setdefault("smt_retry_timeouts_s", (10.0,))
+    kw.setdefault("engine", EngineConfig(pgd_phase=False))
+    return presets.get("GC").with_(
+        result_dir=str(tmp_path / name), soft_timeout_s=10.0,
+        hard_timeout_s=600.0, sim_size=16, exact_certify_masks=False,
+        grid_chunk=8, launch_backoff_s=1e-4, max_launch_retries=1,
+        domain_overrides=ov, partition_threshold=2, smt_workers=1, **kw)
+
+
+def _all_unknown_engine(monkeypatch):
+    """Stage 0 + BaB decide nothing, so every partition reaches the pool
+    (the real stage 0 certifies tiny boxes outright)."""
+    from fairify_tpu.verify import engine as engine_mod
+
+    def dull_decode(host, ctx):
+        n = ctx["n"]
+        return np.zeros(n, bool), np.zeros(n, bool), {}
+
+    monkeypatch.setattr(sweep, "_stage0_block_decode", dull_decode)
+    monkeypatch.setattr(
+        engine_mod, "decide_many",
+        lambda net, enc, rlo, rhi, cfg, **kw: [
+            engine_mod.Decision("unknown") for _ in range(rlo.shape[0])])
+    monkeypatch.setattr(engine_mod, "decide_box",
+                        lambda *a, **k: engine_mod.Decision("unknown"))
+
+
+SMT_SPAN = (0, 8)
+
+
+def test_smt_worker_crash_degrades_not_crashes_and_resumes(
+        tmp_path, monkeypatch):
+    """The §14 chaos invariant at the sweep level: with every SMT dispatch
+    killing its worker, verify_model never crashes — exactly the affected
+    partitions degrade to UNKNOWN with a machine-readable
+    ``smt.worker:crash`` failure record, and a disarmed resume=True pass
+    re-attempts exactly those and converges to the fault-free map.
+    (Pool-vs-in-process verdict parity is pinned in tests/test_smt_pool.py,
+    z3-gated where the in-process backend needs the solver.)"""
+    _all_unknown_engine(monkeypatch)
+    net = init_mlp((20, 4, 1), seed=3)
+    base = sweep.verify_model(
+        net, _smt_toy_cfg(tmp_path, "b"), model_name="m", resume=False,
+        partition_span=SMT_SPAN)
+    want = {o.partition_id: o.verdict for o in base.outcomes}
+    assert set(want.values()) <= {"sat", "unsat"}  # the pool decided all
+
+    cfg = _smt_toy_cfg(
+        tmp_path, "f", inject_faults=("smt.worker.crash:transient:2+",))
+    rep = sweep.verify_model(net, cfg, model_name="m", resume=False,
+                             partition_span=SMT_SPAN)
+    got = {o.partition_id: o.verdict for o in rep.outcomes}
+    assert rep.degraded > 0
+    assert all(want[k] == v for k, v in got.items() if v != "unknown")
+    led = sweep._ledger_path(cfg, rep.sink_name)
+    with open(led) as fp:
+        recs = [json.loads(line) for line in fp if line.strip()]
+    reasons = {r["failure"]["reason"] for r in recs if r.get("failure")}
+    assert reasons == {"smt.worker:crash"}
+
+    resumed = sweep.verify_model(
+        net, cfg.with_(inject_faults=()), model_name="m", resume=True,
+        partition_span=SMT_SPAN)
+    assert {o.partition_id: o.verdict for o in resumed.outcomes} == want
+
+
+def test_smt_worker_transient_fault_absorbed(tmp_path, monkeypatch):
+    """One worker death (crash:transient:2, a single arrival) is absorbed
+    by the fresh-worker retry: the verdict map is IDENTICAL to the
+    fault-free run and nothing degrades."""
+    _all_unknown_engine(monkeypatch)
+    net = init_mlp((20, 4, 1), seed=3)
+    base = sweep.verify_model(
+        net, _smt_toy_cfg(tmp_path, "b"), model_name="m", resume=False,
+        partition_span=SMT_SPAN)
+    want = {o.partition_id: o.verdict for o in base.outcomes}
+    rep = sweep.verify_model(
+        net, _smt_toy_cfg(tmp_path, "t",
+                          inject_faults=("smt.worker.crash:transient:2",)),
+        model_name="m", resume=False, partition_span=SMT_SPAN)
+    assert rep.degraded == 0
+    assert {o.partition_id: o.verdict for o in rep.outcomes} == want
+    assert metrics_mod.registry().counter("smt_worker_crashes").total() >= 1
 
 
 def test_parity_fault_never_demotes_stage0_verdicts(tmp_path, fault_free):
@@ -586,7 +692,12 @@ def test_smt_unknown_reason_codes():
 
     assert smt._unknown_reason("timeout") == "timeout"
     assert smt._unknown_reason("canceled") == "timeout"
-    assert smt._unknown_reason("max. resource limit exceeded") == "timeout"
+    # Memory/resource exhaustion is NOT a timeout: the escalating-timeout
+    # ladder must skip it (a bigger time budget only OOMs harder) — the
+    # pool's higher-RSS-cap retry is the sanctioned second attempt.
+    assert smt._unknown_reason("max. resource limit exceeded") == "memout"
+    assert smt._unknown_reason("memout") == "memout"
+    assert smt._unknown_reason("out of memory") == "memout"
     assert smt._unknown_reason("(incomplete (theory arithmetic))") == \
         "solver-error"
     assert smt._unknown_reason("") == "solver-error"
