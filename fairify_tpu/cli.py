@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 
@@ -258,15 +259,25 @@ def _cmd_serve(args) -> int:
     import threading
 
     from fairify_tpu import obs
-    from fairify_tpu.serve import ServeConfig, VerificationServer
+    from fairify_tpu.serve import FleetConfig, ServeConfig, ServerFleet, \
+        VerificationServer
 
+    exec_cache = args.exec_cache
+    if exec_cache == "auto":
+        exec_cache = os.path.join(args.spool, "exec-cache")
+    elif exec_cache in ("off", "none", ""):
+        exec_cache = None
     scfg = ServeConfig(
         spool=args.spool, batch_window_s=args.batch_window,
         max_batch=args.max_batch, span_chunks=args.span_chunks,
         poll_s=args.poll_interval, default_deadline_s=args.default_deadline,
         n_shards=args.shards, smt_workers=args.smt_workers,
         smt_memory_cap_mb=args.smt_memory_cap,
-        smt_portfolio=args.smt_portfolio)
+        smt_portfolio=args.smt_portfolio,
+        max_queue=args.max_queue, preempt_factor=args.preempt_factor,
+        fair_share_factor=args.fair_share,
+        fair_share_idle_exempt=not args.fair_share_strict,
+        exec_cache=exec_cache)
     stop = threading.Event()
 
     def _sig(_signum, _frame):
@@ -275,16 +286,26 @@ def _cmd_serve(args) -> int:
     signal.signal(signal.SIGTERM, _sig)
     signal.signal(signal.SIGINT, _sig)
     with obs.tracing(args.trace_out, run_id="serve"):
-        srv = VerificationServer(scfg).start()
+        if args.replicas and args.replicas > 1:
+            from dataclasses import replace
+
+            srv = ServerFleet(FleetConfig(
+                n_replicas=args.replicas, spool=args.spool,
+                poll_s=args.poll_interval, lease_s=args.lease,
+                replica=replace(scfg, spool=None))).start()
+        else:
+            srv = VerificationServer(scfg).start()
         print(f"fairify_tpu serve: spool={args.spool} "
               f"batch_window={scfg.batch_window_s}s max_batch={scfg.max_batch}"
+              f" replicas={args.replicas or 1}"
+              f" exec_cache={exec_cache or 'off'}"
               f" (SIGTERM drains)", file=sys.stderr)
         worker_died = False
         while not stop.wait(timeout=1.0):
             if not srv.alive():
-                # A propagate-class crash killed the worker; without this
-                # check the process would advertise a live server whose
-                # inbox is never scanned again.
+                # A propagate-class crash killed the worker (or the whole
+                # fleet); without this check the process would advertise a
+                # live server whose inbox is never scanned again.
                 worker_died = True
                 print("fairify_tpu serve: worker thread died — draining",
                       file=sys.stderr)
@@ -316,7 +337,7 @@ def _cmd_submit(args) -> int:
             args.preset, model=args.model, init=init,
             overrides=overrides or None, deadline_s=args.deadline,
             span=tuple(args.span) if args.span else None,
-            model_root=args.model_root)
+            model_root=args.model_root, priority=args.priority)
     except ValueError as exc:
         print(f"submit: {exc}", file=sys.stderr)
         return 2
@@ -535,6 +556,40 @@ def main(argv=None) -> int:
                      help="route requests through the fault-tolerant shard "
                           "fleet (parallel.shards) instead of the "
                           "single-mesh sweep")
+    srv.add_argument("--replicas", type=int, default=1,
+                     help="run N server replicas behind an arch-bucket "
+                          "router with heartbeat failover (serve.fleet; "
+                          "default 1 = single server)")
+    srv.add_argument("--lease", type=float, default=0.0,
+                     help="replica heartbeat lease in seconds (fleet mode): "
+                          "a worker silent past the lease is declared lost "
+                          "and failed over (0 = thread-liveness only)")
+    srv.add_argument("--max-queue", type=int, default=0,
+                     help="bounded queue: shed (reject with a machine-"
+                          "readable 'shed:' reason) submits past this "
+                          "depth, scaled by priority headroom (0 = "
+                          "unbounded)")
+    srv.add_argument("--preempt-factor", type=float, default=0.0,
+                     help="preempt a running request at its next span "
+                          "granule once it exceeds this multiple of its "
+                          "admission estimate and higher-priority work "
+                          "waits (needs --span-chunks > 0; 0 = off)")
+    srv.add_argument("--fair-share", type=float, default=0.0,
+                     help="under contention, clamp a request's hard "
+                          "refinement budget to this multiple of its "
+                          "admission estimate — overrun becomes honest "
+                          "budget-exhausted UNKNOWNs (resumable) instead "
+                          "of tail latency (0 = off)")
+    srv.add_argument("--fair-share-strict", action="store_true",
+                     help="clamp EVERY dispatch (not just contended ones) "
+                          "to its fair share: the latency-predictable "
+                          "tier — exhaustive refinement belongs to batch "
+                          "runs")
+    srv.add_argument("--exec-cache", default="auto", metavar="DIR",
+                     help="persistent executable cache directory: fresh "
+                          "replicas/restarts load AOT-serialized "
+                          "executables instead of recompiling "
+                          "('auto' = <spool>/exec-cache, 'off' disables)")
     srv.add_argument("--trace-out", default=None,
                      help="JSONL span/event log (request lifecycle events "
                           "feed the `fairify_tpu report` request table)")
@@ -554,6 +609,11 @@ def main(argv=None) -> int:
                      help="the server's --spool directory")
     sbm.add_argument("--model", default=None,
                      help="zoo model name (e.g. GC-1)")
+    sbm.add_argument("--priority", default=None,
+                     choices=["low", "normal", "high"],
+                     help="scheduling tier: higher pops first, sheds last, "
+                          "and may preempt a running lower tier "
+                          "(default: normal)")
     sbm.add_argument("--init-sizes", type=int, nargs="*", default=None,
                      metavar="N",
                      help="synthetic net layer sizes instead of --model "

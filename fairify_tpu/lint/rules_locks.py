@@ -84,6 +84,10 @@ class LockDisciplineRule(Rule):
         "fairify_tpu/obs/metrics.py",
         "fairify_tpu/parallel/pipeline.py",
         "fairify_tpu/resilience/journal.py",
+        # The whole serve package: server/admission (PR 8) AND the fleet
+        # router (serve/fleet.py) — replica tables, bucket pins, and
+        # owner maps are shared between the router thread, submit
+        # callers, and failover.
         "fairify_tpu/serve/",
         # The SMT worker pool: dispatch lanes, the serve drainer, and
         # client submit threads all share SmtPool's worker/queue state.

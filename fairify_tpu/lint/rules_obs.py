@@ -35,6 +35,7 @@ ALLOW_TIME_TIME = frozenset({
     "fairify_tpu/serve/request.py::monotonic_from_epoch",
     "fairify_tpu/serve/client.py::submit",
     "fairify_tpu/serve/server.py::_journal_record",
+    "fairify_tpu/serve/fleet.py::_journal_record",  # same epoch `ts` field
 })
 
 ALLOW_PRINT = frozenset({

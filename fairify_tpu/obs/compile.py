@@ -39,10 +39,41 @@ sweep's throughput JSON (``compile_s`` / ``n_compiles`` /
 ``peak_temp_bytes``) and bench's warm-vs-timed compile split; the
 ``compile.<kernel>`` spans and the metrics snapshot feed ``fairify_tpu
 report``'s per-kernel compile table.
+
+**Persistent executable cache** (:func:`enable_exec_cache`, DESIGN.md §15):
+because every miss already runs the explicit ``lower()``+``compile()`` AOT
+path under a stable :meth:`ObsJit.signature_key`, compiled executables can
+be serialized to disk (``jax.experimental.serialize_executable``) and a
+fresh process — a restarted server, a new fleet replica — warms from the
+cache instead of paying the 61–81 %-of-cold-wall compile tax (PERF.md)
+again.  The contract is *never trust the disk*:
+
+* entries are keyed by a SHA-256 of (kernel name, jax+jaxlib versions,
+  backend platform, device kind, ``repr(signature_key)``) — any drift in
+  any component is a different key, so stale executables are unreachable,
+  not mis-loaded;
+* each entry carries a magic header + checksum over the payload and embeds
+  the full identity string; truncation, corruption, or an identity
+  mismatch quarantines the entry to ``<entry>.corrupt`` (counted in
+  ``exec_cache_errors``) and the kernel recompiles — a bad cache can cost
+  time, never correctness;
+* writes are write-tmp → fsync → atomic ``os.replace`` (the
+  ``resilience.journal`` pattern), so replicas racing the same key never
+  tear an entry — last writer wins a byte-identical executable;
+* a disk hit counts in ``exec_cache_hits`` (+ ``exec_cache_load_seconds``)
+  and does NOT bump ``xla_compiles`` — the warm-restart health gate stays
+  ``xla_compiles == 0``.
+
+The cache is opt-in (``fairify_tpu serve --exec-cache`` /
+``FAIRIFY_TPU_EXEC_CACHE_DIR``): batch runs keep their per-process compile
+accounting untouched.
 """
 from __future__ import annotations
 
+import hashlib
 import inspect
+import os
+import pickle
 import threading
 import time
 from dataclasses import dataclass, field
@@ -61,6 +92,42 @@ except Exception:  # pragma: no cover - version drift
 
 # Sentinel: this signature's AOT path failed — serve it via plain jax.jit.
 _FALLBACK = object()
+
+# --- persistent executable cache (module-global, opt-in) -------------------
+_EXEC_MAGIC = b"FAIRIFY-EXEC-V1\n"
+_exec_cache_lock = threading.Lock()
+_exec_cache_dir: Optional[str] = None
+
+
+def enable_exec_cache(path: Optional[str] = None) -> str:
+    """Turn on the on-disk executable cache (idempotent; returns the dir).
+
+    ``path`` defaults to ``$FAIRIFY_TPU_EXEC_CACHE_DIR`` or
+    ``~/.cache/fairify_tpu/exec``; entries are additionally keyed by
+    backend + device kind, so one directory is safe to share across
+    platform selections (unlike raw XLA dumps, a mismatched entry is
+    unreachable rather than loadable).
+    """
+    global _exec_cache_dir
+    path = path or os.environ.get(
+        "FAIRIFY_TPU_EXEC_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "fairify_tpu",
+                     "exec"))
+    os.makedirs(path, exist_ok=True)
+    with _exec_cache_lock:
+        _exec_cache_dir = path
+    return path
+
+
+def disable_exec_cache() -> None:
+    global _exec_cache_dir
+    with _exec_cache_lock:
+        _exec_cache_dir = None
+
+
+def exec_cache_dir() -> Optional[str]:
+    with _exec_cache_lock:
+        return _exec_cache_dir
 
 # Re-entrancy flag: >0 while an ObsJit is being traced FOR ANALYSIS
 # (lowered_for_analysis).  Nested obs_jit kernels called during that trace
@@ -84,6 +151,12 @@ class KernelStats:
     compile_s: float = 0.0  # total trace+lower+compile seconds
     fallbacks: int = 0  # calls served by plain jax.jit (AOT path unusable)
     trace_inlines: int = 0  # calls seen while tracing (outer jit owns them)
+    # Persistent-cache accounting: executables served from / written to the
+    # on-disk cache (enable_exec_cache).  A disk hit is NOT a compile — the
+    # warm-restart health gate is n_compiles == 0 with cache_hits > 0.
+    cache_hits: int = 0
+    cache_stores: int = 0
+    cache_load_s: float = 0.0
     signatures: Set[Any] = field(default_factory=set)
     # Signatures whose compiles were served ONLY by the plain-jit fallback:
     # they never reach `signatures`, so without this set a kernel that only
@@ -104,6 +177,9 @@ class KernelStats:
             "compile_s": self.compile_s,
             "fallbacks": self.fallbacks,
             "trace_inlines": self.trace_inlines,
+            "cache_hits": self.cache_hits,
+            "cache_stores": self.cache_stores,
+            "cache_load_s": self.cache_load_s,
             "n_signatures": len(self.signatures),
             "n_fallback_signatures": len(self.fallback_signatures),
             "flops": self.flops,
@@ -294,7 +370,148 @@ class ObsJit:
             self._execs[key] = _FALLBACK
             return self._jitted(*args, **kwargs)
 
+    # -- persistent executable cache (DESIGN.md §15) -----------------------
+
+    def _exec_identity(self, key) -> str:
+        """Full identity of one executable: anything that could make a
+        stored executable wrong for this call must be in here."""
+        backend = jax.default_backend()
+        try:
+            dev_kind = jax.devices()[0].device_kind
+        except (RuntimeError, IndexError):  # pragma: no cover - init edge
+            dev_kind = "?"
+        import jaxlib
+
+        return "|".join((self.name, jax.__version__, jaxlib.__version__,
+                         backend, dev_kind, repr(key)))
+
+    def _exec_path(self, cache_dir: str, ident: str) -> str:
+        h = hashlib.sha256(ident.encode()).hexdigest()[:32]
+        safe = self.name.replace("/", "_")
+        return os.path.join(cache_dir, f"{safe}.{h}.exec")
+
+    def _load_cached_exec(self, cache_dir: str, key):
+        """Compiled executable from disk, or None (miss / rejected entry).
+
+        Never trusts the file: magic, checksum, and the embedded identity
+        string must all verify, and deserialization itself may fail (e.g.
+        an XLA drift the version fields didn't capture) — any failure
+        quarantines the entry to ``.corrupt`` and the caller recompiles.
+        """
+        ident = self._exec_identity(key)
+        path = self._exec_path(cache_dir, ident)
+        try:
+            with open(path, "rb") as fp:
+                raw = fp.read()
+        except OSError:
+            return None
+        reg = metrics_mod.registry()
+        t0 = time.perf_counter()
+        try:
+            if not raw.startswith(_EXEC_MAGIC):
+                raise ValueError("bad magic")
+            body = raw[len(_EXEC_MAGIC):]
+            digest, _, payload = body.partition(b"\n")
+            if hashlib.sha256(payload).hexdigest().encode() != digest:
+                raise ValueError("checksum mismatch (truncated or corrupt)")
+            meta = pickle.loads(payload)
+            if meta.get("ident") != ident:
+                raise ValueError(f"identity mismatch: "
+                                 f"{meta.get('ident', '?')[:120]!r}")
+            from jax.experimental import serialize_executable as se
+
+            compiled = se.deserialize_and_load(
+                meta["blob"], meta["in_tree"], meta["out_tree"])
+        except BaseException as exc:
+            from fairify_tpu.resilience.supervisor import classify
+
+            if classify(exc) == "propagate":
+                raise
+            # Quarantine, count, recompile — a bad entry must never be
+            # re-parsed on the next miss, and never trusted.
+            try:
+                os.replace(path, f"{path}.corrupt")
+            except OSError:
+                pass
+            reg.counter("exec_cache_errors").inc(kernel=self.name)
+            trace_mod.event("degraded", site="exec_cache", kernel=self.name,
+                            error=type(exc).__name__, detail=str(exc)[:200])
+            return None
+        dur = time.perf_counter() - t0
+        self.stats.cache_hits += 1
+        self.stats.cache_load_s += dur
+        reg.counter("exec_cache_hits").inc(kernel=self.name)
+        reg.histogram("exec_cache_load_seconds").observe(dur,
+                                                         kernel=self.name)
+        return compiled
+
+    def _store_cached_exec(self, cache_dir: str, key, compiled) -> None:
+        """Serialize + atomically publish one executable (best effort).
+
+        Write-tmp → fsync → ``os.replace``: concurrent replicas racing the
+        same key each publish a complete entry and the last rename wins —
+        readers can never observe a torn file.  Serialization failures
+        (e.g. a sharded executable the backend won't export) are counted
+        and skipped; the cache degrades to a smaller cache, never an error.
+        """
+        ident = self._exec_identity(key)
+        path = self._exec_path(cache_dir, ident)
+        tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+        try:
+            from jax.experimental import serialize_executable as se
+
+            blob, in_tree, out_tree = se.serialize(compiled)
+            payload = pickle.dumps({"ident": ident, "blob": blob,
+                                    "in_tree": in_tree,
+                                    "out_tree": out_tree})
+            digest = hashlib.sha256(payload).hexdigest().encode()
+            with open(tmp, "wb") as fp:
+                fp.write(_EXEC_MAGIC + digest + b"\n" + payload)
+                fp.flush()
+                os.fsync(fp.fileno())
+            os.replace(tmp, path)
+        except BaseException as exc:
+            from fairify_tpu.resilience.supervisor import classify
+
+            if classify(exc) == "propagate":
+                raise
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            metrics_mod.registry().counter("exec_cache_store_failures").inc(
+                kernel=self.name)
+            trace_mod.event("degraded", site="exec_cache", kernel=self.name,
+                            error=type(exc).__name__, detail=str(exc)[:200])
+            return
+        self.stats.cache_stores += 1
+        metrics_mod.registry().counter("exec_cache_stores").inc(
+            kernel=self.name)
+
     def _compile(self, key, args, kwargs, statics, avals):
+        cache_dir = exec_cache_dir()
+        if cache_dir is not None:
+            cached = self._load_cached_exec(cache_dir, key)
+            if cached is not None:
+                with trace_mod.span(f"execload.{self.name}",
+                                    kernel=self.name,
+                                    signature=_sig_str(avals), cache="hit"):
+                    pass
+                with self._lock:
+                    self.stats.signatures.add(key)
+                    n_sigs = len(self.stats.signatures)
+                    self._execs[key] = cached
+                reg = metrics_mod.registry()
+                reg.gauge("xla_kernel_signatures").set(n_sigs,
+                                                       kernel=self.name)
+                if self.stats.temp_bytes is None:
+                    # First executable this process has seen for the
+                    # kernel: record the analyses the fresh-compile path
+                    # would have (backend-optional, guarded inside).
+                    with trace_mod.span(f"compileinfo.{self.name}",
+                                        kernel=self.name) as sp:
+                        self._record_analysis(cached, sp)
+                return cached
         heartbeat_mod.notify_compile(self.name)
         static_str = ", ".join(f"{k}={v!r}" for k, v in statics)
         with trace_mod.span(f"compile.{self.name}", kernel=self.name,
@@ -337,6 +554,8 @@ class ObsJit:
             reg.gauge("xla_kernel_signatures").set(n_sigs, kernel=self.name)
             if first:
                 self._record_analysis(compiled, sp)
+        if cache_dir is not None:
+            self._store_cached_exec(cache_dir, key, compiled)
         return compiled
 
     def _record_analysis(self, compiled, sp) -> None:

@@ -28,6 +28,14 @@ site                  where :func:`check` is called
                       request (or its next span) starts executing
 ``serve.drain``       :meth:`serve.server.VerificationServer.drain`
                       journaling queued requests for resume pickup
+``request.preempt``   the server's preemption decision at a span-granule
+                      boundary (an injected fault FORCES the preemption,
+                      so the requeue/resume machinery is chaos-testable
+                      without real overload)
+``replica.lost``      the fleet router's per-replica health check
+                      (:mod:`serve.fleet`) — ``fatal`` kills that replica
+                      and exercises failover re-spooling; ``transient``
+                      models a heartbeat blip the router absorbs
 ``smt.worker.spawn``  :class:`smt.pool.SmtPool` forking a solver worker
                       subprocess (an injected fault models a fork/exec
                       failure; exhaustion degrades the query)
@@ -72,6 +80,7 @@ FAULT_SITES = frozenset(
     {"launch.submit", "launch.decode", "compile", "smt.query", "ledger.append",
      "shard.dispatch", "shard.gather", "device.lost",
      "request.admit", "request.deadline", "serve.drain",
+     "request.preempt", "replica.lost",
      "smt.worker.spawn", "smt.worker.crash", "smt.worker.hang",
      "smt.worker.memout"})
 FAULT_KINDS = frozenset({"transient", "fatal", "crash"})

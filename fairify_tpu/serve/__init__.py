@@ -13,6 +13,8 @@ fleet) into a service:
   coalescing into shared vmapped family launches;
 * :mod:`fairify_tpu.serve.server` — the queue → admit → batch → stream
   worker loop with graceful SIGTERM drain;
+* :mod:`fairify_tpu.serve.fleet` — N replicas behind one arch-bucket
+  router with heartbeat failover (``fairify_tpu serve --replicas N``);
 * :mod:`fairify_tpu.serve.client` — the file-spool submit protocol
   (``fairify_tpu submit``).
 """
@@ -21,5 +23,15 @@ from fairify_tpu.serve.admission import (  # noqa: F401
     AdmissionRejected,
     span_admissible,
 )
-from fairify_tpu.serve.request import VerifyRequest, new_request_id  # noqa: F401
-from fairify_tpu.serve.server import ServeConfig, VerificationServer  # noqa: F401
+from fairify_tpu.serve.fleet import FleetConfig, ServerFleet  # noqa: F401
+from fairify_tpu.serve.request import (  # noqa: F401
+    PRIORITIES,
+    VerifyRequest,
+    new_request_id,
+    parse_priority,
+)
+from fairify_tpu.serve.server import (  # noqa: F401
+    ReplicaKilled,
+    ServeConfig,
+    VerificationServer,
+)

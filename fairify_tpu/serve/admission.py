@@ -24,6 +24,24 @@ predicate as a library function (``_sweeplib`` delegates to it), and
 
 ``request.admit`` is the registered fault-injection site for the decision
 (chaos cells reject a request instead of crashing the server).
+
+**Load shedding** (DESIGN.md §15): under overload the controller stops
+being a binary admit/reject and becomes honest triage.  A request is
+*shed* — fast-failed ``rejected`` with a machine-readable ``shed: ...``
+reason, before it costs any device time — when either
+
+* the bounded queue is full (``max_queue``; higher tiers get a deeper
+  allowance so interactive work still lands while batch work sheds), or
+* the backlog EMA says its SLA is infeasible: predicted completion
+  (committed device backlog + SMT backlog + its own cost) exceeds its
+  deadline window, scaled by a per-priority headroom — low-priority work
+  sheds earliest, high-priority last.
+
+SERVE_r01 is why: without shedding, 16 concurrent clients drove p50 to
+123 s and missed 62.5 % of deadlines — every queued request eventually
+ran, uselessly, after its SLA.  A shed is a *rejection the client can act
+on immediately* (resubmit later, lower the span, raise the deadline), not
+a miss discovered two minutes too late.
 """
 from __future__ import annotations
 
@@ -37,6 +55,12 @@ from fairify_tpu.resilience import faults as faults_mod
 #: honesty note in ``scripts/_sweeplib.py`` (a span that hits a hard-root
 #: tail can run ~2x its stage-0-dominated prediction).
 SAFETY_FACTOR = 0.4
+
+#: Per-priority headroom multipliers on the SLA-feasibility factor and the
+#: queue-depth bound: low-priority work sheds first (60 % of the normal
+#: window), high-priority last (130 % — it may even borrow into the safety
+#: margin, since a preemption path exists to reclaim the time).
+PRIORITY_HEADROOM = {0: 0.6, 1: 1.0, 2: 1.3}
 
 
 def span_admissible(rate: Optional[float], depth: int, chunk: int,
@@ -63,7 +87,8 @@ class AdmissionController:
     """
 
     def __init__(self, ema_alpha: float = 0.3, factor: float = 0.8,
-                 smt_backlog: Optional[Callable[[], float]] = None):
+                 smt_backlog: Optional[Callable[[], float]] = None,
+                 max_queue: int = 0):
         # ``factor`` is the admission analog of the harness's span factor:
         # the fraction of a request's SLA window its predicted completion
         # (backlog ahead of it + its own cost) may fill.  0.8 leaves the
@@ -74,6 +99,11 @@ class AdmissionController:
         self._alpha = float(ema_alpha)
         self._factor = float(factor)
         self._smt_backlog = smt_backlog
+        # Bounded queue (0 = unbounded): the shed threshold in requests.
+        # Scaled by PRIORITY_HEADROOM, so at max_queue=8 a low-priority
+        # submit sheds at depth 4 while a high-priority one still lands
+        # until depth 10.
+        self._max_queue = int(max_queue)
         self._lock = threading.Lock()
         self._rate: Optional[float] = None      # partitions/sec EMA
         self._backlog_s: float = 0.0            # committed cost, seconds
@@ -94,14 +124,27 @@ class AdmissionController:
                 return None
             return partitions / max(self._rate, 1e-9)
 
-    def admit(self, request) -> None:
+    def admit(self, request, queue_depth: int = 0) -> None:
         """Admit ``request`` or raise :class:`AdmissionRejected`.
 
         The decision is a named fault site (``request.admit``): an
         injected fault here surfaces as a rejection reason, never a server
         crash (the server classifies and converts; crash-kind propagates).
+
+        ``queue_depth`` is the server queue length at submit time — the
+        bounded-queue shed input.  Shed rejections carry ``kind="shed"``
+        and a ``shed: ...`` reason prefix so clients, the lifecycle
+        journal, and serve_bench can count them as honest triage rather
+        than failures.
         """
         faults_mod.check("request.admit")
+        headroom = PRIORITY_HEADROOM.get(
+            getattr(request, "priority", 1), 1.0)
+        if self._max_queue > 0 and queue_depth >= self._max_queue * headroom:
+            raise AdmissionRejected(
+                f"shed: queue full ({queue_depth} queued >= "
+                f"{self._max_queue} x {headroom} priority headroom)",
+                kind="shed")
         # Host-side solver backlog (measured outside the lock: the pool
         # has its own): committed work the device-rate EMA cannot see.
         smt_s = self._smt_backlog() if self._smt_backlog is not None else 0.0
@@ -110,13 +153,28 @@ class AdmissionController:
                 else request.partitions / max(self._rate, 1e-9)
             if request.deadline_s is not None and est is not None:
                 predicted = self._backlog_s + smt_s + est
-                if predicted > self._factor * request.deadline_s:
+                if predicted > self._factor * headroom * request.deadline_s:
                     raise AdmissionRejected(
-                        f"deadline-infeasible: predicted "
+                        f"shed: deadline-infeasible: predicted "
                         f"{predicted:.2f}s of committed work against a "
                         f"{request.deadline_s:.2f}s deadline "
                         f"(rate {self._rate:.1f} parts/s, backlog "
-                        f"{self._backlog_s:.2f}s device + {smt_s:.2f}s smt)")
+                        f"{self._backlog_s:.2f}s device + {smt_s:.2f}s smt, "
+                        f"priority headroom {headroom})", kind="shed")
+            self._est[request.id] = est or 0.0
+            self._backlog_s += est or 0.0
+
+    def readmit(self, request) -> None:
+        """Account an already-admitted request re-homed by failover.
+
+        No shed/feasibility decision: the request passed admission once on
+        the replica that died, and turning a replica loss into a client-
+        visible rejection would violate the loss-free handoff contract.
+        Backlog is still committed so subsequent admits see the true load.
+        """
+        with self._lock:
+            est = None if self._rate is None \
+                else request.partitions / max(self._rate, 1e-9)
             self._est[request.id] = est or 0.0
             self._backlog_s += est or 0.0
 
@@ -140,4 +198,14 @@ class AdmissionController:
 
 
 class AdmissionRejected(RuntimeError):
-    """Raised by :meth:`AdmissionController.admit`; the reason is the str."""
+    """Raised by :meth:`AdmissionController.admit`; the reason is the str.
+
+    ``kind`` distinguishes a *shed* (honest overload triage — the client
+    should back off and resubmit) from any other refusal (draining, an
+    unprocessable request); serve_bench and perfdiff count the two
+    differently.
+    """
+
+    def __init__(self, reason: str, kind: str = "rejected"):
+        super().__init__(reason)
+        self.kind = kind

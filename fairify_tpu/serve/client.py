@@ -21,6 +21,9 @@ A **payload** is JSON with:
 ``overrides``    ``SweepConfig.with_`` keyword overrides (timeouts,
                  grid_chunk, pipeline_depth, inject_faults, ...)
 ``deadline_s``   wall-clock SLA from submit; absent = server default
+``priority``     scheduling tier: ``low`` | ``normal`` | ``high`` (or
+                 0/1/2) — higher tiers pop first, shed last, and may
+                 preempt a running lower tier; absent = ``normal``
 ``span``         ``[start, stop)`` global partition indices; absent = all
 ``model_root``   zoo root override (defaults to the server's environment)
 ``id``           optional caller-chosen request id
@@ -42,11 +45,16 @@ def build_payload(preset: str, model: Optional[str] = None,
                   deadline_s: Optional[float] = None,
                   span: Optional[Tuple[int, int]] = None,
                   model_root: Optional[str] = None,
-                  request_id: Optional[str] = None) -> dict:
+                  request_id: Optional[str] = None,
+                  priority: Optional[object] = None) -> dict:
     """Validated payload dict (the submit-side half of the protocol)."""
+    from fairify_tpu.serve.request import parse_priority
+
     if (model is None) == (init is None):
         raise ValueError("exactly one of model= / init= is required")
     payload = {"preset": preset}
+    if priority is not None:
+        payload["priority"] = parse_priority(priority)
     if model is not None:
         payload["model"] = model
     if init is not None:

@@ -1,0 +1,542 @@
+"""Replicated serving: N server replicas, arch-bucket routing, failover.
+
+One :class:`VerificationServer` melts when its single worker loop
+saturates (SERVE_r01: 16 clients → p50 123 s).  The fleet runs N replicas
+— each its own worker loop, launch pipeline, and
+:class:`resilience.Supervisor` fault domain, mirroring the PR 7
+shard-quarantine pattern at the *server* level — behind one router:
+
+* **Routing is bucket-sticky with load spill-over.**  Requests are keyed
+  by the batcher's coalescing bucket (stage-0 signature × architecture,
+  :func:`serve.batcher.stage0_signature` / :func:`~serve.batcher.arch_key`)
+  and a bucket is pinned to one replica (least-loaded at first sight).
+  That keeps the batcher's same-executable trick intact per replica: every
+  replica sees a closed set of architectures, so its warm executable cache
+  is exactly the set it serves — requests of one bucket never smear
+  compiles across the fleet.  Stickiness yields to overload: once the
+  pinned replica's committed load passes ``spill_load``, new requests of
+  the bucket spill to the least-loaded replica (the pin is unchanged) —
+  the shared kernel registry in-process and the persistent executable
+  cache across processes make the spill's compiles a non-event, while a
+  hot bucket stops serializing behind one worker loop.
+* **Death is detected, not assumed.**  The router health-checks every
+  replica each tick: the worker thread gone (``server.alive()``) outside a
+  drain, or a heartbeat lease expired (``lease_s``; 0 disables — a wedged
+  worker is indistinguishable from a long granule without one).
+  ``replica.lost`` is the chaos site for the check: an injected
+  ``transient`` fault is a blip the router absorbs, ``fatal`` *kills the
+  replica* (cooperative SIGKILL analog, :meth:`VerificationServer.kill`)
+  so the real failover machinery runs, ``crash`` propagates.
+* **Failover is loss-free.**  A dead replica performs no cleanup (that is
+  the point); the router walks its request table and re-homes every
+  non-terminal request — queued, running, or parked on the SMT drainer —
+  to a survivor via ``submit(readmit=True)`` (admission accounts the
+  backlog but must not shed an already-admitted request).  The request
+  keeps its id, result_dir, and SLA clock; its partial verdict ledger
+  replays ``resume=True`` on the survivor, so decided verdicts survive the
+  handoff bit-for-bit and only undecided work is re-attempted.  With no
+  survivors, spool-backed requests requeue to the inbox and in-process
+  ones fail terminally with a machine-readable ``replica lost`` reason —
+  never silently stranded.
+
+A replica fleet shares the process-wide ``obs_jit`` kernel registry, so
+in-process replicas share warm executables; across *processes* (one fleet
+per host, restarted replicas) the persistent executable cache
+(``ServeConfig.exec_cache`` → :func:`obs.compile.enable_exec_cache`) is
+what makes a fresh replica warm from disk instead of recompiling.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from fairify_tpu import obs
+from fairify_tpu.resilience import faults as faults_mod
+from fairify_tpu.resilience.supervisor import classify
+from fairify_tpu.serve import batcher
+from fairify_tpu.serve.request import (
+    DONE,
+    FAILED,
+    PRIORITY_NORMAL,
+    REJECTED,
+    REQUEUED,
+    VerifyRequest,
+)
+from fairify_tpu.serve.server import ServeConfig, VerificationServer
+
+_TERMINAL = (DONE, FAILED, REJECTED, REQUEUED)
+
+
+@dataclass(frozen=True)
+class FleetConfig:
+    """Fleet knobs (``fairify_tpu serve --replicas N``)."""
+
+    n_replicas: int = 2
+    # Spool directory (the fleet scans the inbox and routes; replicas run
+    # in-process submits only — one durable inbox, N workers).
+    spool: Optional[str] = None
+    # Router tick: inbox scan + health sweep interval.
+    poll_s: float = 0.05
+    # Heartbeat lease: a replica whose worker hasn't reached a yield point
+    # in this long is declared lost even if the thread object is alive
+    # (wedged).  0 disables — granule-less requests legitimately go dark
+    # for their whole runtime.
+    lease_s: float = 0.0
+    # Bucket spill-over: stickiness is a preference, not a constraint.
+    # When a bucket's pinned replica already holds this many committed
+    # requests (queued + in-flight), the router places the NEW request on
+    # the least-loaded live replica instead — the bucket pin is unchanged,
+    # so locality returns as soon as the hot replica drains.  The
+    # executable cache (in-process shared registry; on-disk across
+    # processes) makes the spilled replica's compiles a non-event.  0
+    # disables spill (strict stickiness).
+    spill_load: int = 2
+    # Per-replica server template; spool is forced None (the fleet owns
+    # the spool) and replica_id is stamped per replica.
+    replica: ServeConfig = field(default_factory=ServeConfig)
+
+
+class ServerFleet:
+    """N replicas behind one bucket-sticky router (see module docstring).
+
+    API-compatible with :class:`VerificationServer` for the operations a
+    client or bench needs: ``submit`` / ``get`` / ``wait`` / ``drain`` /
+    ``alive``.
+    """
+
+    def __init__(self, cfg: FleetConfig = FleetConfig()):
+        if cfg.n_replicas < 1:
+            raise ValueError("fleet needs n_replicas >= 1")
+        self.cfg = cfg
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        # replica index -> server; None = quarantined (lost and failed
+        # over; never reused — mirroring the shard-quarantine pattern).
+        self._journal_writer = None
+        if cfg.spool:
+            import os
+
+            from fairify_tpu.resilience.journal import JournalWriter
+            from fairify_tpu.resilience.supervisor import Supervisor
+
+            os.makedirs(os.path.join(cfg.spool, "inbox"), exist_ok=True)
+            os.makedirs(os.path.join(cfg.spool, "requests"), exist_ok=True)
+            # One fleet-wide lifecycle journal: replicas run spool-less,
+            # but the operator contract (serve.journal.jsonl records every
+            # transition) must hold for `--replicas N` exactly as for a
+            # single server — the writer is thread-safe, so all replicas
+            # share it.
+            self._journal_writer = JournalWriter(
+                os.path.join(cfg.spool, "serve.journal.jsonl"),
+                supervisor=Supervisor(max_retries=2, backoff_s=0.05))
+        self._replicas: List[Optional[VerificationServer]] = [
+            VerificationServer(self._replica_cfg(i),
+                               journal=self._journal_writer)
+            for i in range(cfg.n_replicas)]
+        # Quarantined replicas stay readable: a request that finished (or
+        # was terminally failed) on a replica that later died must remain
+        # visible through get()/wait() — "never silently stranded" covers
+        # lookups too.
+        self._dead: Dict[int, VerificationServer] = {}
+        self._owner: Dict[str, int] = {}      # request id -> replica index
+        self._assign: Dict[tuple, int] = {}   # coalescing bucket -> replica
+        self._draining = False
+        self._thread: Optional[threading.Thread] = None
+
+    def _replica_cfg(self, idx: int) -> ServeConfig:
+        from dataclasses import replace
+
+        return replace(self.cfg.replica, spool=None, replica_id=idx)
+
+    # --- lifecycle --------------------------------------------------------
+
+    def start(self) -> "ServerFleet":
+        with self._cv:
+            replicas = list(self._replicas)
+        for srv in replicas:
+            if srv is not None:
+                srv.start()
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._router,
+                                            name="fairify-fleet",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def __enter__(self) -> "ServerFleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.drain()
+        return False
+
+    def alive(self) -> bool:
+        """True while the router runs and ≥1 replica can take work."""
+        with self._cv:
+            replicas = list(self._replicas)
+            router = self._thread is not None and self._thread.is_alive()
+        return router and any(s is not None and s.alive() for s in replicas)
+
+    def replicas_alive(self) -> int:
+        with self._cv:
+            replicas = list(self._replicas)
+        return sum(1 for s in replicas if s is not None and s.alive())
+
+    def drain(self) -> List[VerifyRequest]:
+        """Drain every live replica; returns all requeued requests."""
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+            replicas = list(self._replicas)
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        requeued: List[VerifyRequest] = []
+        for srv in replicas:
+            if srv is None:
+                continue
+            for req in srv.drain():
+                requeued.append(req)
+                self._respool(req)
+        if self._journal_writer is not None:
+            self._journal_writer.close()
+        return requeued
+
+    def _journal_record(self, rec: dict) -> None:
+        """Fleet-level lifecycle record: the shared serve.journal.jsonl
+        (when spooled) plus the obs event stream, mirroring the server's
+        ``_journal_record``."""
+        if self._journal_writer is not None:
+            self._journal_writer.append({"ts": round(time.time(), 3), **rec})
+        obs.event("request", **rec)
+
+    def _respool(self, req: VerifyRequest) -> None:
+        """Write a requeued request's payload back to the FLEET inbox (the
+        replicas have no spool of their own)."""
+        if not self.cfg.spool or req.spool_payload is None:
+            return
+        import os
+
+        from fairify_tpu.serve.client import write_atomic_json
+
+        write_atomic_json(
+            os.path.join(self.cfg.spool, "inbox", f"{req.id}.json"),
+            req.spool_payload)
+
+    # --- submission / lookup ----------------------------------------------
+
+    def _route(self, cfg, net, partition_span) -> int:
+        """Replica index for a request: sticky per coalescing bucket with
+        load spill-over, least-loaded (fewest owned buckets, then fewest
+        owned requests) on first sight.  Caller must NOT hold the lock."""
+        key = (batcher.stage0_signature(cfg, partition_span),
+               batcher.arch_key(net))
+        with self._cv:
+            live = [i for i, s in enumerate(self._replicas) if s is not None]
+            if not live:
+                raise RuntimeError("no live replicas")
+            loads = {i: self._replicas[i].load() for i in live}
+            idx = self._assign.get(key)
+            if idx is not None and self._replicas[idx] is not None:
+                if self.cfg.spill_load <= 0 \
+                        or loads[idx] < self.cfg.spill_load \
+                        or loads[idx] <= min(loads.values()):
+                    return idx
+                # Spill: the pinned replica is saturated; place THIS
+                # request on the least-loaded replica (pin unchanged).
+                spilled = min(live, key=lambda i: (loads[i], i))
+                obs.registry().counter("fleet_spills").inc()
+                return spilled
+            buckets = {i: 0 for i in live}
+            for b_idx in self._assign.values():
+                if b_idx in buckets:
+                    buckets[b_idx] += 1
+            owned = {i: 0 for i in live}
+            for o_idx in self._owner.values():
+                if o_idx in owned:
+                    owned[o_idx] += 1
+            idx = min(live, key=lambda i: (buckets[i], owned[i], i))
+            self._assign[key] = idx
+            return idx
+
+    def submit(self, cfg, net, model_name: str, dataset=None,
+               deadline_s: Optional[float] = None,
+               partition_span: Optional[Tuple[int, int]] = None,
+               request_id: Optional[str] = None,
+               spool_payload: Optional[dict] = None,
+               submitted_at: Optional[float] = None,
+               priority: int = PRIORITY_NORMAL,
+               readmit: bool = False) -> VerifyRequest:
+        idx = self._route(cfg, net, partition_span)
+        with self._cv:
+            srv = self._replicas[idx]
+        if srv is None:  # quarantined between _route and here
+            return self.submit(cfg, net, model_name, dataset=dataset,
+                               deadline_s=deadline_s,
+                               partition_span=partition_span,
+                               request_id=request_id,
+                               spool_payload=spool_payload,
+                               submitted_at=submitted_at, priority=priority,
+                               readmit=readmit)
+        req = srv.submit(cfg, net, model_name, dataset=dataset,
+                         deadline_s=deadline_s, partition_span=partition_span,
+                         request_id=request_id, spool_payload=spool_payload,
+                         submitted_at=submitted_at, priority=priority,
+                         readmit=readmit)
+        if req.status == REQUEUED and req.reason.startswith("replica killed"):
+            # Raced a failover: the replica was killed around our enqueue.
+            # The failover's orphan snapshot may already have re-homed the
+            # id — prefer that copy; otherwise route it again ourselves.
+            with self._cv:
+                cur = self._owner.get(req.id)
+                cur_srv = None if cur is None else self._replicas[cur]
+            if cur_srv is not None:
+                existing = cur_srv.get(req.id)
+                if existing is not None:
+                    return existing
+            return self.submit(cfg, net, model_name, dataset=dataset,
+                               deadline_s=deadline_s,
+                               partition_span=partition_span,
+                               request_id=req.id,
+                               spool_payload=spool_payload,
+                               submitted_at=submitted_at, priority=priority,
+                               readmit=readmit)
+        with self._cv:
+            self._owner[req.id] = idx
+        return req
+
+    def owner_of(self, request_id: str) -> Optional[int]:
+        with self._cv:
+            return self._owner.get(request_id)
+
+    def get(self, request_id: str) -> Optional[VerifyRequest]:
+        with self._cv:
+            idx = self._owner.get(request_id)
+            srv = None if idx is None \
+                else (self._replicas[idx] or self._dead.get(idx))
+        return None if srv is None else srv.get(request_id)
+
+    def wait(self, request_id: str, timeout: Optional[float] = None
+             ) -> Optional[VerifyRequest]:
+        """Block until terminal — across failovers: the owner may change
+        mid-wait, so this polls ownership between short replica waits."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._cv:
+                idx = self._owner.get(request_id)
+                # Quarantined replicas stay readable: a request that went
+                # terminal before (or during) its replica's death is
+                # still the answer — and a re-homed one flips _owner to
+                # the survivor, which the next loop iteration picks up.
+                srv = None if idx is None \
+                    else (self._replicas[idx] or self._dead.get(idx))
+            if srv is not None:
+                left = None if deadline is None \
+                    else deadline - time.monotonic()
+                step = 0.2 if left is None else max(min(0.2, left), 0.0)
+                req = srv.wait(request_id, timeout=step)
+                if req is not None and req.status in _TERMINAL:
+                    return req
+            if deadline is not None and time.monotonic() >= deadline:
+                return self.get(request_id)
+            if srv is None:
+                time.sleep(0.05)
+
+    # --- router loop ------------------------------------------------------
+
+    def _router(self) -> None:
+        while True:
+            with self._cv:
+                if self._draining:
+                    return
+            if self.cfg.spool:
+                try:
+                    self._scan_inbox()
+                except BaseException as exc:
+                    if classify(exc) == "propagate":
+                        raise
+                    obs.event("degraded", site="fleet.inbox",
+                              error=type(exc).__name__,
+                              detail=str(exc)[:200])
+            self._health_sweep()
+            with self._cv:
+                if self._draining:
+                    return
+                self._cv.wait(timeout=self.cfg.poll_s)
+
+    def _scan_inbox(self) -> None:
+        """Route spool payloads to replicas.
+
+        The fleet owns the inbox (replicas run spool-less), so it resolves
+        payloads itself and routes through :meth:`submit`, mirroring
+        ``VerificationServer._scan_inbox`` where it matters: rename-atomic
+        consume, corruption quarantine, and a terminal ``status.json`` for
+        unprocessable payloads so a waiting client always unblocks.
+        """
+        import json
+        import os
+
+        from fairify_tpu.serve.client import resolve_payload, \
+            write_atomic_json
+        from fairify_tpu.serve.request import monotonic_from_epoch, \
+            new_request_id, parse_priority
+
+        inbox = os.path.join(self.cfg.spool, "inbox")
+        try:
+            names = sorted(os.listdir(inbox))
+        except OSError:
+            return
+        for name in names:
+            with self._cv:
+                if self._draining:
+                    return
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(inbox, name)
+            try:
+                with open(path) as fp:
+                    payload = json.load(fp)
+            except OSError:
+                continue
+            except json.JSONDecodeError as exc:
+                try:
+                    os.replace(path, f"{path}.corrupt")
+                except OSError:
+                    continue
+                rid = name[: -len(".json")]
+                rec = {"request": rid, "status": REJECTED, "model": "?",
+                       "preset": "?",
+                       "reason": f"corrupt payload (quarantined to "
+                                 f"{name}.corrupt): {str(exc)[:200]}"}
+                obs.registry().counter("serve_requests").inc(status=REJECTED)
+                self._journal_record(rec)
+                rdir = os.path.join(self.cfg.spool, "requests", rid)
+                os.makedirs(rdir, exist_ok=True)
+                write_atomic_json(os.path.join(rdir, "status.json"), rec)
+                continue
+            try:
+                os.remove(path)
+            except OSError:
+                continue
+            req_id = payload.get("id") or new_request_id()
+            payload = dict(payload, id=req_id)
+            rdir = os.path.join(self.cfg.spool, "requests", req_id)
+            os.makedirs(rdir, exist_ok=True)
+            write_atomic_json(os.path.join(rdir, "request.json"), payload)
+            try:
+                cfg, net, model_name, dataset = resolve_payload(payload,
+                                                                rdir)
+                deadline = payload.get("deadline_s",
+                                       self.cfg.replica.default_deadline_s)
+                span = payload.get("span")
+                ts = payload.get("submitted_ts")
+                self.submit(
+                    cfg, net, model_name, dataset=dataset,
+                    deadline_s=None if deadline is None else float(deadline),
+                    partition_span=None if span is None
+                    else (int(span[0]), int(span[1])),
+                    request_id=req_id, spool_payload=payload,
+                    submitted_at=None if ts is None
+                    else monotonic_from_epoch(float(ts)),
+                    priority=parse_priority(payload.get("priority",
+                                                        PRIORITY_NORMAL)))
+            except BaseException as exc:
+                if classify(exc) == "propagate":
+                    raise
+                rec = {"request": req_id, "status": REJECTED,
+                       "model": payload.get("model", "?"),
+                       "preset": payload.get("preset", "?"),
+                       "reason": f"{type(exc).__name__}: {str(exc)[:200]}"}
+                obs.registry().counter("serve_requests").inc(status=REJECTED)
+                self._journal_record(rec)
+                write_atomic_json(os.path.join(rdir, "status.json"), rec)
+
+    # --- health + failover ------------------------------------------------
+
+    def _health_sweep(self) -> None:
+        """One pass over the replicas: chaos site + liveness + lease."""
+        with self._cv:
+            replicas = list(self._replicas)
+        for i, srv in enumerate(replicas):
+            if srv is None:
+                continue
+            try:
+                faults_mod.check("replica.lost")
+            except BaseException as exc:
+                kind = classify(exc)
+                if kind == "propagate":
+                    raise
+                if kind == "transient":
+                    # A heartbeat blip: absorbed, the replica lives.
+                    obs.event("degraded", site="replica.lost", replica=i,
+                              error=type(exc).__name__,
+                              detail=str(exc)[:200])
+                    continue
+                # fatal: the injected loss IS the loss — kill the replica
+                # so the genuine death-detection + failover path runs.
+                srv.kill()
+            started = srv.started()
+            dead = srv.killed() or (started and not srv.alive())
+            if not dead and self.cfg.lease_s > 0 and started:
+                dead = srv.lease_age() > self.cfg.lease_s
+            if dead:
+                self._fail_over(i, srv)
+
+    def _fail_over(self, idx: int, srv: VerificationServer) -> None:
+        """Quarantine a dead replica and re-home its non-terminal requests.
+
+        The dead replica did no cleanup (by design): every request it
+        owned that is not terminal — queued, running mid-span, parked on
+        its SMT drainer — is re-submitted to a survivor with
+        ``readmit=True`` (no shedding of already-admitted work), the same
+        id and result_dir, and the original SLA clock.  The survivor's
+        ``resume=True`` run replays the partial ledger: decided verdicts
+        are settled rows, so nothing decided is ever lost or recomputed.
+        """
+        registry = obs.registry()
+        srv.kill()
+        with self._cv:
+            if self._replicas[idx] is None:  # already failed over
+                return
+            self._replicas[idx] = None
+            self._dead[idx] = srv  # stays readable for get()/wait()
+            self._assign = {k: v for k, v in self._assign.items()
+                            if v != idx}
+            survivors = [s for s in self._replicas if s is not None]
+        registry.counter("replica_failures").inc(replica=idx)
+        registry.gauge("fleet_replicas_alive").set(len(survivors))
+        orphans = [r for r in srv.requests() if r.status not in _TERMINAL]
+        obs.event("replica_lost", replica=idx, orphans=len(orphans),
+                  survivors=len(survivors))
+        with obs.span("fleet.failover", replica=idx, orphans=len(orphans),
+                      survivors=len(survivors)):
+            for req in orphans:
+                self._journal_record({"request": req.id, "status": "requeued",
+                                      "model": req.model_name,
+                                      "replica": idx,
+                                      "reason": f"replica {idx} lost"})
+                if not survivors:
+                    if self.cfg.spool and req.spool_payload is not None:
+                        req.status = REQUEUED
+                        req.reason = f"replica {idx} lost; no survivors"
+                        self._respool(req)
+                    else:
+                        req.status = FAILED
+                        req.reason = (f"replica {idx} lost; no survivors "
+                                      f"to fail over to")
+                        registry.counter("serve_requests").inc(status=FAILED)
+                    self._journal_record(req.to_record())
+                    with self._cv:
+                        self._cv.notify_all()
+                    continue
+                self.submit(req.cfg, req.net, req.model_name,
+                            dataset=req.dataset, deadline_s=req.deadline_s,
+                            partition_span=req.partition_span,
+                            request_id=req.id,
+                            spool_payload=req.spool_payload,
+                            submitted_at=req.submitted_at,
+                            priority=req.priority, readmit=True)
+        with self._cv:
+            self._cv.notify_all()

@@ -39,6 +39,27 @@ FAILED = "failed"
 REJECTED = "rejected"
 REQUEUED = "requeued"
 
+#: Priority tiers (DESIGN.md §15): higher pops first, sheds last, and may
+#: preempt a lower tier at a span-granule boundary.  Names are the client
+#: payload vocabulary; the int is what the queue sorts on.
+PRIORITIES = {"low": 0, "normal": 1, "high": 2}
+PRIORITY_NORMAL = PRIORITIES["normal"]
+
+
+def parse_priority(value) -> int:
+    """Payload priority (name or int) → tier; raises on garbage so a bad
+    payload rejects at submit instead of silently running ``normal``."""
+    if isinstance(value, bool):
+        raise ValueError(f"bad priority {value!r}")
+    if isinstance(value, int):
+        if value not in PRIORITIES.values():
+            raise ValueError(f"bad priority {value!r} "
+                             f"(want 0..2 or {sorted(PRIORITIES)})")
+        return value
+    if isinstance(value, str) and value.lower() in PRIORITIES:
+        return PRIORITIES[value.lower()]
+    raise ValueError(f"bad priority {value!r} (want {sorted(PRIORITIES)})")
+
 
 def new_request_id() -> str:
     """Sortable-ish unique id: epoch millis + random suffix."""
@@ -74,6 +95,9 @@ class VerifyRequest:
     deadline_s: Optional[float] = None
     # [start, stop) global partition indices; None = the whole grid.
     partition_span: Optional[Tuple[int, int]] = None
+    # Scheduling tier (PRIORITIES): pops before lower tiers, sheds after
+    # them, and may preempt a running lower-tier request mid-flight.
+    priority: int = PRIORITY_NORMAL
     # Spool-protocol payload (client.py): carried so a drain can journal
     # the request back for the next server; None for in-process submits.
     spool_payload: Optional[dict] = None
@@ -86,6 +110,10 @@ class VerifyRequest:
     finished_at: Optional[float] = None
     deadline_missed: bool = False
     report: Optional[object] = None   # verify.sweep.ModelReport when done
+    # Times this request was preempted at a span-granule boundary and
+    # requeued (bounded by the server's preemption cap — see DESIGN.md §15
+    # starvation note); its partial ledger replays on the next run.
+    preemptions: int = 0
     # Partitions this request's span covers (estimated at admission from
     # the grid size; exact once the report lands).
     partitions: int = 0
@@ -119,7 +147,10 @@ class VerifyRequest:
             "deadline_s": self.deadline_s,
             "deadline_missed": self.deadline_missed,
             "partitions": self.partitions,
+            "priority": self.priority,
         }
+        if self.preemptions:
+            rec["preemptions"] = self.preemptions
         if self.partition_span is not None:
             rec["span"] = f"{self.partition_span[0]}-{self.partition_span[1]}"
         if self.reason:

@@ -34,6 +34,24 @@ running request itself yields at its next chunk-aligned span boundary —
 and every request still queued (or preempted mid-request) is journaled
 ``requeued`` with its spool payload written back to the inbox, so the next
 server picks it up and its ledger replays ``resume=True``.
+
+Overload survival (DESIGN.md §15): the queue is priority-ordered (higher
+tiers pop first), admission sheds honestly once the bounded queue or the
+backlog EMA says an SLA is infeasible (``rejected`` with a ``shed:``
+reason, counted separately from misses), and with ``span_chunks > 0`` a
+running over-budget request is *preempted* at its next chunk-aligned
+granule when strictly-higher-priority work waits — the same yield
+machinery the drain uses, fired mid-flight: the request requeues with its
+partial ledger intact and replays ``resume=True`` when it next pops.
+``request.preempt`` is the chaos site for the decision.
+
+Replica mode (:mod:`serve.fleet`): a fleet-managed replica can be
+:meth:`kill`-ed — the worker, SMT drainer, and any span-granular request
+abandon at their next yield point with NO cleanup (no drain journaling, no
+terminal transitions), mirroring a process SIGKILL as closely as a thread
+can.  The fleet router detects the death via :meth:`alive` and re-spools
+the replica's in-flight + queued requests to survivors; ``resume=True``
+ledger replay makes that handoff loss-free.
 """
 from __future__ import annotations
 
@@ -55,6 +73,7 @@ from fairify_tpu.serve.client import write_atomic_json as _atomic_json
 from fairify_tpu.serve.request import (
     DONE,
     FAILED,
+    PRIORITY_NORMAL,
     QUEUED,
     REJECTED,
     REQUEUED,
@@ -62,7 +81,18 @@ from fairify_tpu.serve.request import (
     VerifyRequest,
     monotonic_from_epoch,
     new_request_id,
+    parse_priority,
 )
+
+
+class ReplicaKilled(BaseException):
+    """Raised at cooperative yield points after :meth:`kill`.
+
+    A ``BaseException`` so no request-level handler converts it into a
+    per-request failure: a killed replica must abandon everything exactly
+    as a SIGKILL'd process would — recovery belongs to the fleet router's
+    failover, which re-spools the dead replica's requests to survivors.
+    """
 
 
 @dataclass(frozen=True)
@@ -107,6 +137,50 @@ class ServeConfig:
     smt_workers: int = 1
     smt_memory_cap_mb: int = 0
     smt_portfolio: int = 0
+    # --- overload control (DESIGN.md §15) -------------------------------
+    # Bounded queue: submits past this depth are shed (rejected with a
+    # machine-readable "shed:" reason) instead of queued into an SLA they
+    # can no longer meet.  Scaled per priority tier (admission.
+    # PRIORITY_HEADROOM); 0 = unbounded (the pre-overload-control
+    # behavior).
+    max_queue: int = 0
+    # Preemption: with span_chunks > 0, a running request that has spent
+    # more than preempt_factor x its admission estimate (or is
+    # best-effort) yields at its next granule when strictly-higher-
+    # priority work waits.  0 disables preemption.
+    preempt_factor: float = 0.0
+    # Starvation bound: a request preempted this many times runs to
+    # completion regardless of waiters.
+    max_preemptions: int = 2
+    # Fair-share budget clamp (overload control): when > 0 and other work
+    # is committed at dispatch time, a request's hard refinement budget is
+    # clamped to fair_share_factor x its admission estimate (but never
+    # below fair_share_min_s).  Device time a request cannot have without
+    # starving the queue becomes honest budget-exhausted UNKNOWNs —
+    # ledgered, client-visible, and resumable off-peak — instead of tail
+    # latency for everything behind it.  The SERVE_r01 16-client collapse
+    # was exactly this shape: one mispredicted request legally consumed
+    # its whole 120 s SLA while the queue starved.  0 = off (a request
+    # may spend up to its SLA, the pre-overload-control behavior).
+    fair_share_factor: float = 0.0
+    fair_share_min_s: float = 2.0
+    # With the exemption on (default), an uncontended dispatch (nothing
+    # queued, nothing else in the batch) escapes the clamp and may spend
+    # its whole SLA on optional refinement.  A latency-predictable
+    # serving tier turns it off: EVERY dispatch is clamped to its fair
+    # share, so the tail request of a burst cannot stretch the level by
+    # 10x just because the queue happened to be empty when it popped —
+    # exhaustive refinement is batch mode's job.
+    fair_share_idle_exempt: bool = True
+    # Persistent executable cache directory (obs.compile.
+    # enable_exec_cache): a restarted server or fresh replica loads
+    # AOT-serialized executables instead of recompiling — near-zero cold
+    # start.  None = per-process compile behavior unchanged.
+    exec_cache: Optional[str] = None
+    # Fleet bookkeeping: the replica's index when this server is one of
+    # serve.fleet's replicas (labels journal records and metrics; enables
+    # nothing by itself).
+    replica_id: Optional[int] = None
 
 
 class VerificationServer:
@@ -119,18 +193,25 @@ class VerificationServer:
             srv.wait(req.id, timeout=120.0)
     """
 
-    def __init__(self, cfg: ServeConfig = ServeConfig()):
+    def __init__(self, cfg: ServeConfig = ServeConfig(), journal=None):
+        """``journal`` injects a shared lifecycle JournalWriter (the fleet
+        passes its fleet-wide one to every replica; the owner closes it)."""
         self.cfg = cfg
-        self.admission = AdmissionController(smt_backlog=self._smt_backlog_s)
+        self.admission = AdmissionController(smt_backlog=self._smt_backlog_s,
+                                             max_queue=cfg.max_queue)
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._queue: deque = deque()
         self._requests: Dict[str, VerifyRequest] = {}
         self._grids: Dict[tuple, Tuple] = {}
         self._draining = False
+        self._killed = False
+        self._last_beat = time.monotonic()
+        self._inflight = 0  # popped-batch members not yet terminal
         self._thread: Optional[threading.Thread] = None
         self._sup = Supervisor(max_retries=2, backoff_s=0.05)
-        self._journal_writer: Optional[JournalWriter] = None
+        self._journal_writer: Optional[JournalWriter] = journal
+        self._owns_journal = journal is None
         self._smt_pool = None                   # lazy; server-wide
         self._smt_drain_q: deque = deque()      # (req, report) to finish
         self._smt_drainer: Optional[threading.Thread] = None
@@ -138,9 +219,14 @@ class VerificationServer:
         if cfg.spool:
             os.makedirs(os.path.join(cfg.spool, "inbox"), exist_ok=True)
             os.makedirs(os.path.join(cfg.spool, "requests"), exist_ok=True)
-            self._journal_writer = JournalWriter(
-                os.path.join(cfg.spool, "serve.journal.jsonl"),
-                supervisor=self._sup)
+            if self._journal_writer is None:
+                self._journal_writer = JournalWriter(
+                    os.path.join(cfg.spool, "serve.journal.jsonl"),
+                    supervisor=self._sup)
+        if cfg.exec_cache:
+            from fairify_tpu.obs import compile as compile_obs
+
+            compile_obs.enable_exec_cache(cfg.exec_cache)
 
     # --- lifecycle --------------------------------------------------------
 
@@ -206,9 +292,54 @@ class VerificationServer:
             seen = {r.id for r in requeued}
             requeued += [r for r in self._requests.values()
                          if r.status == REQUEUED and r.id not in seen]
-        if self._journal_writer is not None:
+        if self._journal_writer is not None and self._owns_journal:
             self._journal_writer.close()
         return requeued
+
+    def kill(self) -> None:
+        """Hard-stop for fleet failover and chaos: NO cleanup.
+
+        The worker loop, SMT drainer, and any span-granular request raise
+        :class:`ReplicaKilled` at their next cooperative yield point and
+        abandon everything — no drain journaling, no terminal
+        transitions, no requeues.  That is deliberate: a real replica
+        loss (OOM kill, host death) performs no cleanup either, and the
+        recovery contract lives entirely in the fleet router's failover
+        (re-spool to survivors) + the crash-safe ledger (``resume=True``
+        replay).  After ``kill()``, :meth:`alive` flips False as soon as
+        the worker reaches a yield point.
+        """
+        with self._cv:
+            self._killed = True
+            self._cv.notify_all()
+
+    def killed(self) -> bool:
+        with self._cv:
+            return self._killed
+
+    def started(self) -> bool:
+        """Has :meth:`start` ever launched the worker (live or not)?"""
+        return self._thread is not None
+
+    def lease_age(self) -> float:
+        """Seconds since the worker last reached a yield point (its
+        heartbeat lease input): batch-loop iterations and span-granule
+        boundaries beat; a long granule-less request legitimately goes
+        dark for its whole runtime — see ``FleetConfig.lease_s``."""
+        with self._cv:
+            return time.monotonic() - self._last_beat
+
+    def requests(self) -> List[VerifyRequest]:
+        """Snapshot of every request this server has seen (fleet failover
+        walks this to find the dead replica's non-terminal requests)."""
+        with self._cv:
+            return list(self._requests.values())
+
+    def load(self) -> int:
+        """Committed request count (queued + popped-but-unfinished): the
+        fleet router's spill-over input."""
+        with self._cv:
+            return len(self._queue) + self._inflight
 
     def _requeue(self, req: VerifyRequest) -> VerifyRequest:
         req.status = REQUEUED
@@ -232,23 +363,40 @@ class VerificationServer:
                partition_span: Optional[Tuple[int, int]] = None,
                request_id: Optional[str] = None,
                spool_payload: Optional[dict] = None,
-               submitted_at: Optional[float] = None) -> VerifyRequest:
+               submitted_at: Optional[float] = None,
+               priority: int = PRIORITY_NORMAL,
+               readmit: bool = False) -> VerifyRequest:
         """Queue one verification job; returns the request (possibly
         already ``rejected`` — check ``status``).  Thread-safe.
 
         ``submitted_at`` (monotonic) backdates the SLA clock — spool
         pickups pass the payload's original submit stamp so a
-        drain/requeue handoff doesn't silently extend the deadline."""
+        drain/requeue handoff doesn't silently extend the deadline.
+
+        ``readmit=True`` skips the shed/feasibility decision (backlog is
+        still accounted): the fleet's failover path re-homes requests a
+        dead replica already admitted once — shedding them again would
+        turn a replica loss into client-visible rejections."""
         req = VerifyRequest(
             id=request_id or new_request_id(), cfg=cfg, net=net,
             model_name=model_name, dataset=dataset, deadline_s=deadline_s,
-            partition_span=partition_span, spool_payload=spool_payload)
+            partition_span=partition_span, spool_payload=spool_payload,
+            priority=priority)
         if submitted_at is not None:
             req.submitted_at = submitted_at
         req.partitions = self._span_size(cfg, partition_span)
         registry = obs.registry()
         with self._cv:
             draining = self._draining
+            if self._killed:
+                # Killed (fleet failover in progress): nothing will ever
+                # pop this queue.  Hand the request straight back as
+                # REQUEUED so the fleet's submit re-routes it to a
+                # survivor instead of stranding it here.
+                req.status = REQUEUED
+                req.reason = "replica killed"
+                self._requests[req.id] = req
+                return req
         if draining and self.cfg.spool and spool_payload is not None:
             # A spool-backed request arriving during drain (the worker's
             # last inbox scan racing the shutdown) must NOT be consumed as
@@ -260,12 +408,19 @@ class VerificationServer:
         try:
             if draining:
                 raise AdmissionRejected("server draining")
-            self.admission.admit(req)
+            with self._cv:
+                depth = len(self._queue) + self._inflight
+            if readmit:
+                self.admission.readmit(req)
+            else:
+                self.admission.admit(req, queue_depth=depth)
         except BaseException as exc:
             if classify(exc) == "propagate":
                 raise
             req.status = REJECTED
             req.reason = str(exc)
+            if getattr(exc, "kind", "") == "shed":
+                registry.counter("serve_shed").inc(priority=req.priority)
             registry.counter("serve_requests").inc(status=REJECTED)
             with self._cv:
                 self._requests[req.id] = req
@@ -295,6 +450,17 @@ class VerificationServer:
             registry.counter("serve_requests").inc(status=REJECTED)
             self._finish(req)
             return req
+        with self._cv:
+            if self._killed and req in self._queue:
+                # kill() landed between the killed check above and the
+                # enqueue — and possibly after the failover's orphan
+                # snapshot, which would then never see this request.
+                # Take it back out and return it REQUEUED for re-routing.
+                self._queue.remove(req)
+                req.status = REQUEUED
+                req.reason = "replica killed during submit"
+                self.admission.release(req)
+                return req
         registry.counter("serve_requests").inc(status=QUEUED)
         self._journal(req)
         return req
@@ -362,7 +528,14 @@ class VerificationServer:
         while True:
             with self._cv:
                 while not self._smt_drain_q:
+                    if self._killed:
+                        return  # abandon parked requests: failover re-runs
                     self._cv.wait(timeout=0.5)
+                if self._killed:
+                    # Parked requests stay RUNNING with their ledger rows
+                    # WITHHELD (smt_defer contract) — the fleet re-spools
+                    # them and resume re-attempts, sound.
+                    return
                 item = self._smt_drain_q.popleft()
                 self._smt_draining_id = None if item is None else item[0].id
             if item is None:
@@ -435,11 +608,26 @@ class VerificationServer:
 
     def _worker(self) -> None:
         while True:
-            batch = self._next_batch()
+            try:
+                batch = self._next_batch()
+            except ReplicaKilled:
+                return  # abandoned: fleet failover owns recovery
             if not batch:
                 return
+            with self._cv:
+                # Popped work is still committed load: the shed decision
+                # must see it, or a burst that pops straight into a batch
+                # resets the bounded queue to "empty" while the device owes
+                # minutes of work.
+                self._inflight = len(batch)
             try:
                 self._run_batch(batch)
+            except ReplicaKilled:
+                # Killed mid-batch: leave every member exactly as it was
+                # (RUNNING/QUEUED) — the fleet re-spools them to survivors
+                # and resume=True replays their partial ledgers.  Cleanup
+                # here would turn a loss-free failover into failures.
+                return
             except BaseException as exc:
                 # A propagate-class error (crash fault, interrupt) escaped
                 # a request: leave every batch member in a client-visible
@@ -474,6 +662,8 @@ class VerificationServer:
                     self.admission.release(req)
                     self._finish(req)
                 raise
+            with self._cv:
+                self._inflight = 0
 
     def _next_batch(self) -> List[VerifyRequest]:
         window_until: Optional[float] = None
@@ -491,6 +681,9 @@ class VerificationServer:
                               detail=str(exc)[:200])
             with self._cv:
                 now = time.monotonic()
+                self._last_beat = now
+                if self._killed:
+                    raise ReplicaKilled()
                 if self._draining:
                     return []
                 if self._queue:
@@ -498,8 +691,7 @@ class VerificationServer:
                         window_until = now + self.cfg.batch_window_s
                     if len(self._queue) >= self.cfg.max_batch \
                             or now >= window_until:
-                        n = min(len(self._queue), self.cfg.max_batch)
-                        batch = [self._queue.popleft() for _ in range(n)]
+                        batch = self._pop_batch(self.cfg.max_batch)
                         obs.registry().gauge("serve_queue_depth").set(
                             len(self._queue))
                         return batch
@@ -507,6 +699,20 @@ class VerificationServer:
                     continue
                 window_until = None
                 self._cv.wait(timeout=self.cfg.poll_s)
+
+    def _pop_batch(self, n: int) -> List[VerifyRequest]:
+        """Pop up to ``n`` requests, highest priority first (FIFO within a
+        tier — queue position doubles as the submit sequence).  Caller
+        holds ``_cv``."""
+        order = sorted(range(len(self._queue)),
+                       key=lambda i: (-self._queue[i].priority, i))[:n]
+        picked = set(order)
+        batch = [self._queue[i] for i in order]
+        survivors = deque(r for i, r in enumerate(self._queue)
+                          if i not in picked)
+        self._queue.clear()
+        self._queue.extend(survivors)
+        return batch
 
     def _run_batch(self, batch: List[VerifyRequest]) -> None:
         registry = obs.registry()
@@ -525,13 +731,17 @@ class VerificationServer:
                     # stage 0.  (Chunk-level faults inside the shared
                     # launches are already degraded per chunk by the
                     # pipeline's supervisor and never raise to here.)
-                    if classify(exc) == "propagate":
+                    if isinstance(exc, ReplicaKilled) \
+                            or classify(exc) == "propagate":
                         raise
                     obs.event("degraded", site="serve.batch",
                               error=type(exc).__name__,
                               detail=str(exc)[:200])
                     stage0_by_id = {}
             for req in batch:
+                with self._cv:
+                    if self._killed:
+                        raise ReplicaKilled()
                 self._run_request(req, stage0_by_id.get(req.id))
 
     def _batch_pipe(self, cfg):
@@ -562,9 +772,14 @@ class VerificationServer:
                         f"{req.queue_wait_s:.2f}s)")
                 req.status = RUNNING
                 self._journal(req)
+                share = self._fair_share(req)
+                if share is not None:
+                    left = share if left is None else min(left, share)
+                    sp.set(fair_share_s=round(share, 3))
                 report = self._execute(req, stage0, left)
             except BaseException as exc:
-                if classify(exc) == "propagate":
+                if isinstance(exc, ReplicaKilled) \
+                        or classify(exc) == "propagate":
                     raise
                 req.status = FAILED
                 req.reason = req.reason or \
@@ -583,6 +798,13 @@ class VerificationServer:
                 # the rate EMA must not see its partial elapsed time.
                 req.finished_at = time.monotonic()
                 sp.set(status=req.status)
+                return
+            if req.status == QUEUED:
+                # Preempted mid-flight: _execute_spans re-enqueued it with
+                # its partial ledger intact; it keeps its admission
+                # backlog share (the remaining work is still committed)
+                # and finishes — with resume replay — when it next pops.
+                sp.set(status="preempted", preemptions=req.preemptions)
                 return
             if getattr(report, "smt_pending", None) is not None \
                     and report.smt_pending.pending:
@@ -666,11 +888,19 @@ class VerificationServer:
         for s in range(start, stop, granule):
             with self._cv:
                 draining = self._draining
+                self._last_beat = time.monotonic()
+                if self._killed:
+                    raise ReplicaKilled()
             if draining:
                 req.status = REQUEUED
                 req.reason = f"drained mid-request at partition {s}"
                 self._requeue(req)
                 break
+            if s > start:  # progress guarantee: ≥1 granule per dispatch
+                why = self._should_preempt(req, s)
+                if why is not None:
+                    self._preempt(req, why)
+                    return None
             faults_mod.check("request.deadline")
             left = req.deadline_left()
             if left is not None and left <= 0.0:
@@ -714,6 +944,93 @@ class VerificationServer:
             ledger_skipped_lines=sum(r.ledger_skipped_lines for r in reports),
             degraded=sum(r.degraded for r in reports),
         )
+
+    def _fair_share(self, req: VerifyRequest) -> Optional[float]:
+        """Fair-share hard-budget clamp for one dispatch (None = no clamp).
+
+        Applies only under contention (other requests queued or sharing
+        the popped batch) — an idle server still lets a request spend its
+        whole SLA on optional refinement."""
+        if self.cfg.fair_share_factor <= 0:
+            return None
+        if self.cfg.fair_share_idle_exempt:
+            with self._cv:
+                contended = bool(self._queue) or self._inflight > 1
+            if not contended:
+                return None
+        est = self.admission.estimate_s(req.partitions)
+        if est is None:
+            return None
+        return max(self.cfg.fair_share_factor * est,
+                   self.cfg.fair_share_min_s)
+
+    # --- preemption (DESIGN.md §15) ---------------------------------------
+
+    def _should_preempt(self, req: VerifyRequest, at_partition: int
+                        ) -> Optional[str]:
+        """Preemption decision at one span-granule boundary.
+
+        Preempt when strictly-higher-priority work waits AND the running
+        request is over budget — it has spent more than ``preempt_factor
+        ×`` its admission estimate, or it is best-effort (no deadline: by
+        definition over any budget once SLA work is waiting).  Bounded by
+        ``max_preemptions`` so a hard request cannot starve.
+
+        ``request.preempt`` is the chaos site: an injected (non-crash)
+        fault FORCES the preemption, so the requeue/resume machinery is
+        testable without manufacturing real overload.
+        """
+        try:
+            faults_mod.check("request.preempt")
+        except BaseException as exc:
+            if classify(exc) == "propagate":
+                raise
+            return (f"preempted at partition {at_partition} "
+                    f"(injected: {exc})")
+        if self.cfg.preempt_factor <= 0 or self.cfg.span_chunks <= 0:
+            return None
+        if req.preemptions >= self.cfg.max_preemptions:
+            return None
+        with self._cv:
+            waiter = any(q.priority > req.priority for q in self._queue)
+        if not waiter:
+            return None
+        est = self.admission.estimate_s(req.partitions)
+        over_budget = (req.deadline_s is None
+                       or (est is not None
+                           and req.run_s > self.cfg.preempt_factor * est))
+        if not over_budget:
+            return None
+        return (f"preempted at partition {at_partition}: over budget "
+                f"(ran {req.run_s:.2f}s vs estimate "
+                f"{0.0 if est is None else est:.2f}s, "
+                f"priority {req.priority}) with higher-priority waiter")
+
+    def _preempt(self, req: VerifyRequest, why: str) -> None:
+        """RUNNING → QUEUED at a granule boundary: the span-granular
+        requeue fired mid-flight instead of at SIGTERM.  The partial
+        ledger stays; the next dispatch replays it ``resume=True``.  The
+        admission backlog share is kept — the remaining work is still
+        committed."""
+        req.preemptions += 1
+        req.status = QUEUED
+        req.reason = why
+        registry = obs.registry()
+        registry.counter("serve_preemptions").inc(priority=req.priority)
+        self._journal(req)
+        with self._cv:
+            draining = self._draining
+            if not draining:
+                self._queue.append(req)
+                registry.gauge("serve_queue_depth").set(len(self._queue))
+                self._cv.notify_all()
+        if draining:
+            # Drain snapped between the granule's drain check and here:
+            # hand it to the drain path so it isn't stranded in a queue
+            # nobody will pop.
+            req.status = REQUEUED
+            req.reason = f"{why}; server draining"
+            self._requeue(req)
 
     # --- sinks ------------------------------------------------------------
 
@@ -808,6 +1125,7 @@ class VerificationServer:
             deadline = payload.get("deadline_s", self.cfg.default_deadline_s)
             span = payload.get("span")
             ts = payload.get("submitted_ts")
+            prio = parse_priority(payload.get("priority", PRIORITY_NORMAL))
             return self.submit(
                 cfg, net, model_name, dataset=dataset,
                 deadline_s=None if deadline is None else float(deadline),
@@ -815,7 +1133,8 @@ class VerificationServer:
                                                           int(span[1])),
                 request_id=req_id, spool_payload=payload,
                 submitted_at=None if ts is None
-                else monotonic_from_epoch(float(ts)))
+                else monotonic_from_epoch(float(ts)),
+                priority=prio)
         except BaseException as exc:
             if classify(exc) == "propagate":
                 raise

@@ -752,9 +752,17 @@ def uniform_sign_bab(
     # sample disqualifies the root immediately (it cannot be uniform).
     rng = np.random.default_rng(cfg.seed + 3)
     xr, pr = build_attack_candidates(enc, rng, roots_lo, roots_hi, 32)
+    # Pad the root axis to the next power of two AFTER drawing candidates
+    # (RNG consumption — and therefore every verdict — is unchanged): R
+    # tracks the UNKNOWN frontier and varies per model, so an unpadded
+    # launch compiles one executable per distinct root count — the
+    # signature churn behind the SERVE_r01 mid-load recompiles (7 at 16
+    # clients).  Pad rows recompute the last root and are sliced away.
+    r_pad = 1 << max(xr.shape[0] - 1, 0).bit_length()
     profiling.bump_launch()
-    lx, lp = _sample_role_logits(net, jnp.asarray(xr), jnp.asarray(pr))
-    lx, lp = np.asarray(lx), np.asarray(lp)
+    lx, lp = _sample_role_logits(net, jnp.asarray(_pad(xr, r_pad)),
+                                 jnp.asarray(_pad(pr, r_pad)))
+    lx, lp = np.asarray(lx)[:xr.shape[0]], np.asarray(lp)[:xr.shape[0]]
     va = None
     if len(enc.pa_idx):
         from fairify_tpu.verify.property import role_boxes

@@ -49,9 +49,21 @@ death classification, degradation, resume) is entirely real.  With
 ``--serve``, the same faults run inside the persistent server under two
 concurrent clients sharing the server-wide pool.
 
+Fleet cells (``--fleet``) extend the matrix to replicated serving
+(``serve/fleet.py``, DESIGN.md §15): ``replica.lost`` × {transient, fatal}
+× {idle, mid-batch, mid-SMT-drain} and ``request.preempt``.  A transient
+loss is a heartbeat blip the router absorbs (nothing dies, verdicts
+identical); a fatal loss kills that replica (cooperative SIGKILL analog)
+and the router's real failover re-homes its in-flight + queued requests to
+survivors — the contract is *zero lost decided verdicts*: every request
+reaches a terminal state and the post-failover verdict map is bit-equal to
+the fault-free run (``resume=True`` ledger replay).  ``request.preempt``
+forces a mid-flight span-granular preemption; the preempted request must
+requeue, complete, and stay bit-equal.
+
 Usage: python scripts/chaos_matrix.py [--out chaos] [--span 48]
            [--grid-chunk 16] [--preset GC] [--shards 3] [--serve]
-           [--no-smt]
+           [--fleet] [--no-smt]
 """
 from __future__ import annotations
 
@@ -111,6 +123,10 @@ def main() -> int:
     ap.add_argument("--serve", action="store_true",
                     help="also run the server-loop cells: launch.*/"
                          "request.* faults under two concurrent clients")
+    ap.add_argument("--fleet", action="store_true",
+                    help="also run the replicated-serving cells: "
+                         "replica.lost x {transient,fatal} x {idle,"
+                         "mid-batch,mid-SMT-drain} + request.preempt")
     ap.add_argument("--no-smt", action="store_true",
                     help="skip the smt.worker.* pool cells")
     args = ap.parse_args()
@@ -391,6 +407,152 @@ def main() -> int:
         failures += 0 if row["ok"] else 1
         print(json.dumps(row), flush=True)
 
+    # Fleet cells: replica.lost x {transient, fatal} x {idle, mid-batch}
+    # + request.preempt over the replicated server (serve/fleet.py).  The
+    # mid-SMT-drain flavor needs the stubbed solver world and lives in the
+    # SMT section below.  Contract (DESIGN.md §15): a transient loss is a
+    # heartbeat blip nothing dies over; a fatal loss kills the replica and
+    # failover re-homes its requests loss-free — every request terminal,
+    # final verdict maps bit-equal to the fault-free runs.
+    if args.fleet:
+        import time as time_mod
+
+        from fairify_tpu.resilience import faults as faults_lib
+        from fairify_tpu.serve import FleetConfig, ServeConfig, ServerFleet
+
+        net_b = init_mlp((len(cfg0.query().columns), 8, 1), seed=5)
+        base_b = sweep.verify_model(
+            net_b, cfg0.with_(result_dir=os.path.join(args.out, "fleet_bb")),
+            model_name="mb", resume=False, partition_span=span)
+        want_b = _vmap(base_b)
+        f_wants = {"ma": want, "mb": want_b}
+        f_nets = {"ma": net, "mb": net_b}
+
+        def _fleet(tag):
+            fl = ServerFleet(FleetConfig(
+                n_replicas=2, poll_s=0.02,
+                replica=ServeConfig(batch_window_s=0.1, max_batch=4,
+                                    span_chunks=1)))
+            rdir = os.path.join(args.out, tag)
+            reqs = {n_: fl.submit(cfg0.with_(result_dir=os.path.join(rdir,
+                                                                     n_)),
+                                  f_nets[n_], n_, partition_span=span)
+                    for n_ in ("ma", "mb")}
+            return fl, reqs
+
+        def _finish(row, fl, reqs, want_alive):
+            finals = {n_: fl.wait(r.id, timeout=900.0)
+                      for n_, r in reqs.items()}
+            row["status"] = {n_: (f.status if f else "?")
+                             for n_, f in finals.items()}
+            maps = {n_: ({} if f is None or f.report is None
+                         else _vmap(f.report)) for n_, f in finals.items()}
+            row["replicas_alive"] = fl.replicas_alive()
+            fl.drain()
+            row["bit_equal"] = all(maps[n_] == f_wants[n_] for n_ in maps)
+            row["ok"] = bool(
+                all(f is not None and f.status == "done"
+                    for f in finals.values())
+                and row["bit_equal"]
+                and row["replicas_alive"] == want_alive)
+            return row
+
+        # replica.lost:transient — a blip during an in-flight batch: the
+        # router absorbs it, nothing dies, nothing degrades.
+        row = {"cell": "fleet/replica.lost/transient",
+               "spec": "replica.lost:transient:1"}
+        try:
+            fl, reqs = _fleet("fleet_transient")
+            with faults_lib.armed(("replica.lost:transient:1",),
+                                  seed=cfg0.seed):
+                fl.start()
+                row = _finish(row, fl, reqs, want_alive=2)
+        except BaseException as exc:
+            row["crashed"] = f"{type(exc).__name__}: {exc}"
+            row["ok"] = False
+        failures += 0 if row["ok"] else 1
+        print(json.dumps(row), flush=True)
+
+        # replica.lost:fatal while IDLE — the loss lands before any work:
+        # later submits must route around the quarantined replica.
+        row = {"cell": "fleet/replica.lost/fatal/idle",
+               "spec": "replica.lost:fatal:1"}
+        try:
+            fl = ServerFleet(FleetConfig(
+                n_replicas=2, poll_s=0.02,
+                replica=ServeConfig(batch_window_s=0.1, max_batch=4)))
+            fl.start()
+            with faults_lib.armed(("replica.lost:fatal:1",), seed=cfg0.seed):
+                t0 = time_mod.monotonic()
+                while fl.replicas_alive() == 2 \
+                        and time_mod.monotonic() - t0 < 30.0:
+                    time_mod.sleep(0.01)
+            rdir = os.path.join(args.out, "fleet_idle")
+            reqs = {n_: fl.submit(cfg0.with_(result_dir=os.path.join(rdir,
+                                                                     n_)),
+                                  f_nets[n_], n_, partition_span=span)
+                    for n_ in ("ma", "mb")}
+            row = _finish(row, fl, reqs, want_alive=1)
+        except BaseException as exc:
+            row["crashed"] = f"{type(exc).__name__}: {exc}"
+            row["ok"] = False
+        failures += 0 if row["ok"] else 1
+        print(json.dumps(row), flush=True)
+
+        # replica.lost:fatal MID-BATCH — kill the replica that owns a
+        # RUNNING request; failover must re-home its in-flight + queued
+        # work to the survivor with zero lost decided verdicts.
+        row = {"cell": "fleet/replica.lost/fatal/mid-batch"}
+        try:
+            fl, reqs = _fleet("fleet_midbatch")
+            fl.start()
+            t0 = time_mod.monotonic()
+            owner = None
+            while time_mod.monotonic() - t0 < 60.0:
+                running = [n_ for n_, r in reqs.items()
+                           if fl.get(r.id) is not None
+                           and fl.get(r.id).status == "running"]
+                if running:
+                    owner = fl.owner_of(reqs[running[0]].id)
+                    break
+                time_mod.sleep(0.005)
+            spec = f"replica.lost:fatal:{(owner or 0) + 1}"
+            row["spec"] = spec
+            with faults_lib.armed((spec,), seed=cfg0.seed):
+                t0 = time_mod.monotonic()
+                while fl.replicas_alive() == 2 \
+                        and time_mod.monotonic() - t0 < 30.0:
+                    time_mod.sleep(0.005)
+            row = _finish(row, fl, reqs, want_alive=1)
+        except BaseException as exc:
+            row["crashed"] = f"{type(exc).__name__}: {exc}"
+            row["ok"] = False
+        failures += 0 if row["ok"] else 1
+        print(json.dumps(row), flush=True)
+
+        # request.preempt — the injected fault FORCES a mid-flight
+        # span-granular preemption; the preempted request requeues,
+        # completes, and stays bit-equal.
+        row = {"cell": "fleet/request.preempt",
+               "spec": "request.preempt:transient:1"}
+        try:
+            from fairify_tpu.obs import metrics as metrics_mod
+
+            pre = metrics_mod.registry().counter("serve_preemptions")
+            p0 = pre.total()
+            fl, reqs = _fleet("fleet_preempt")
+            with faults_lib.armed(("request.preempt:transient:1",),
+                                  seed=cfg0.seed):
+                fl.start()
+                row = _finish(row, fl, reqs, want_alive=2)
+            row["preemptions"] = pre.total() - p0
+            row["ok"] = bool(row["ok"] and row["preemptions"] >= 1)
+        except BaseException as exc:
+            row["crashed"] = f"{type(exc).__name__}: {exc}"
+            row["ok"] = False
+        failures += 0 if row["ok"] else 1
+        print(json.dumps(row), flush=True)
+
     # SMT worker-pool cells: see module docstring.  workers=1 keeps the
     # dispatch arrival order (and therefore nth-based schedules)
     # deterministic; memory_cap enables the memout higher-cap retry tier.
@@ -557,6 +719,69 @@ def main() -> int:
                                          and row["resume_converged"])
                     failures += 0 if row["ok"] else 1
                     print(json.dumps(row), flush=True)
+
+            # Fleet cell: replica.lost:fatal MID-SMT-DRAIN.  A hang fault
+            # wedges the first solver query for ~its hard deadline, which
+            # parks the request on the owning replica's SMT drainer
+            # (non-blocking smt_defer, ledger rows WITHHELD); killing that
+            # replica while parked must lose nothing — failover re-homes
+            # the request and the survivor's own pool re-solves on resume.
+            if args.fleet:
+                import time as time_mod
+
+                from fairify_tpu.resilience import faults as faults_lib
+                from fairify_tpu.serve import FleetConfig, ServeConfig, \
+                    ServerFleet
+
+                row = {"cell": "fleet/replica.lost/fatal/mid-smt-drain"}
+                try:
+                    fl = ServerFleet(FleetConfig(
+                        n_replicas=2, poll_s=0.02,
+                        replica=ServeConfig(batch_window_s=0.1, max_batch=4,
+                                            smt_workers=1)))
+                    rdir = os.path.join(args.out, "fleet_smtdrain")
+                    with faults_lib.armed(("smt.worker.hang:transient:1",),
+                                          seed=smt_cfg0.seed):
+                        ra = fl.submit(
+                            smt_cfg0.with_(result_dir=os.path.join(rdir,
+                                                                   "a")),
+                            smt_net, "ma", partition_span=smt_span)
+                        fl.start()
+                        parked = False
+                        t0 = time_mod.monotonic()
+                        while time_mod.monotonic() - t0 < 60.0:
+                            cur = fl.get(ra.id)
+                            if cur is not None and cur.status == "running" \
+                                    and cur.report is not None:
+                                parked = True
+                                break
+                            if cur is not None and cur.status in (
+                                    "done", "failed", "rejected"):
+                                break
+                            time_mod.sleep(0.005)
+                        owner = fl.owner_of(ra.id)
+                    row["parked"] = parked
+                    spec = f"replica.lost:fatal:{(owner or 0) + 1}"
+                    row["spec"] = spec
+                    with faults_lib.armed((spec,), seed=smt_cfg0.seed):
+                        t0 = time_mod.monotonic()
+                        while fl.replicas_alive() == 2 \
+                                and time_mod.monotonic() - t0 < 30.0:
+                            time_mod.sleep(0.005)
+                    final = fl.wait(ra.id, timeout=900.0)
+                    fl.drain()
+                    got = {} if final is None or final.report is None \
+                        else _vmap(final.report)
+                    row["status"] = final.status if final else "?"
+                    row["replicas_alive"] = fl.replicas_alive()
+                    row["ok"] = bool(parked and final is not None
+                                     and final.status == "done"
+                                     and got == smt_want)
+                except BaseException as exc:
+                    row["crashed"] = f"{type(exc).__name__}: {exc}"
+                    row["ok"] = False
+                failures += 0 if row["ok"] else 1
+                print(json.dumps(row), flush=True)
         finally:
             (sweep_mod._stage0_block_decode, engine_mod.decide_many,
              engine_mod.decide_box) = saved

@@ -48,7 +48,18 @@ fail on one unlucky miss), ``serve.requests_per_s@<n>c`` and
 ``serve.batch_occupancy@<n>c`` gate higher-is-better, and
 ``serve.warm_xla_compiles`` is lower-is-better with the same 0.5 absolute
 floor as ``n_compiles`` — a warm server that starts recompiling fails
-outright.
+outright.  The overload-survival fields (ISSUE 11): ``serve.shed_rate@<n>c``
+gates lower-is-better with a 10-point absolute floor (sheds are honest
+triage, but a step change in shed volume at equal load is a capacity
+regression) and ``serve.preemptions@<n>c`` lower-is-better with a
+2-count floor; ``serve.cold_restart_xla_compiles`` /
+``serve.cold_restart_compile_s`` gate the zero-cold-start contract — a
+restarted process recompiling anything it should have loaded from the
+executable cache fails (0.5 floors match ``n_compiles``/``compile_s``).
+``serve.batch_occupancy@<n>c`` is emitted only for shed-free levels: under
+admission shedding it measures admitted workload shape, not batcher
+packing, so a shedding candidate simply drops the metric (a ``missing``
+warning, not a regression).
 
 **SMT records** (``audits/SMT_r*.json`` from ``scripts/smt_bench.py``;
 ``"kind": "SMT"``) gate the out-of-process solver pool: per worker count,
@@ -142,11 +153,33 @@ def _serve_records(obj: dict) -> Dict[str, dict]:
         if row.get("deadline_miss_rate") is not None:
             out[f"serve.deadline_miss_rate@{n}c"] = _flat_lower(
                 row["deadline_miss_rate"], floor=0.02)
+        if row.get("shed_rate") is not None:
+            out[f"serve.shed_rate@{n}c"] = _flat_lower(
+                row["shed_rate"], floor=0.10)
+        if row.get("preemptions") is not None:
+            out[f"serve.preemptions@{n}c"] = _flat_lower(
+                row["preemptions"], floor=2.0)
         if row.get("requests_per_s") is not None:
             out[f"serve.requests_per_s@{n}c"] = _flat(row["requests_per_s"])
-        if row.get("batch_occupancy_mean") is not None:
+        if row.get("batch_occupancy_mean") is not None \
+                and not row.get("shed_rate"):
+            # Occupancy is a coalescing-health gate only at shed-free
+            # levels: under admission shedding it measures how much work
+            # was ADMITTED per window (workload shape), not how well the
+            # batcher packed it — a level that honestly sheds half its
+            # burst must not fail for coalescing "worse" than a level
+            # that queued everything.  The coalesced-vs-sequential launch
+            # check in serve_bench still guards coalescing itself.
             out[f"serve.batch_occupancy@{n}c"] = _flat(
                 row["batch_occupancy_mean"])
+    cold = obj.get("cold_restart")
+    if isinstance(cold, dict):
+        if cold.get("n_compiles") is not None:
+            out["serve.cold_restart_xla_compiles"] = _flat_lower(
+                cold["n_compiles"], floor=0.5)
+        if cold.get("compile_s") is not None:
+            out["serve.cold_restart_compile_s"] = _flat_lower(
+                cold["compile_s"], floor=0.5)
     return out
 
 
@@ -389,6 +422,36 @@ def self_test() -> int:
                             "requests_per_s": 5.0,
                             "batch_occupancy_mean": 3.5}}}
     sv_base = _serve_records(sv)
+    svo = {"kind": "SERVE", "warm_xla_compiles": 0,
+           "clients": {"16": {"p95_ms": 9000.0, "deadline_miss_rate": 0.0,
+                              "shed_rate": 0.25, "preemptions": 1,
+                              "requests_per_s": 2.0,
+                              "batch_occupancy_mean": 6.0}},
+           "cold_restart": {"n_compiles": 0, "compile_s": 0.1}}
+    svo_base = _serve_records(svo)
+    svo_same = _serve_records(json.loads(json.dumps(svo)))
+    svo_sheddy = _serve_records(
+        {**svo, "clients": {"16": {**svo["clients"]["16"],
+                                   "shed_rate": 0.8}}})
+    svo_thrashy = _serve_records(
+        {**svo, "clients": {"16": {**svo["clients"]["16"],
+                                   "preemptions": 14}}})
+    svo_jitter = _serve_records(
+        {**svo, "clients": {"16": {**svo["clients"]["16"],
+                                   "shed_rate": 0.31, "preemptions": 3}}})
+    svo_coldly = _serve_records(
+        {**svo, "cold_restart": {"n_compiles": 9, "compile_s": 21.0}})
+    sv16_melt = _serve_records(       # the r01 shape: no shedding, melted
+        {"kind": "SERVE",
+         "clients": {"16": {"p95_ms": 126226.2, "deadline_miss_rate": 0.625,
+                            "batch_occupancy_mean": 8.0,
+                            "requests_per_s": 0.128}}})
+    sv16_shedding = _serve_records(   # the r02 shape: honest triage
+        {"kind": "SERVE",
+         "clients": {"16": {"p95_ms": 9000.0, "deadline_miss_rate": 0.0,
+                            "shed_rate": 0.3, "preemptions": 1,
+                            "batch_occupancy_mean": 4.0,
+                            "requests_per_s": 2.0}}})
     sv_same = _serve_records(json.loads(json.dumps(sv)))
     sv_slow = _serve_records(
         {"kind": "SERVE", "warm_xla_compiles": 0,
@@ -452,6 +515,14 @@ def self_test() -> int:
         ("serve deadline misses flagged", compare(sv_base, sv_missy), 1),
         ("warm server recompiling flagged", compare(sv_base, sv_cold), 1),
         ("serve latency/miss jitter passes", compare(sv_base, sv_jitter), 0),
+        ("identical overload records pass", compare(svo_base, svo_same), 0),
+        ("shed-rate step change flagged", compare(svo_base, svo_sheddy), 1),
+        ("preemption thrash flagged", compare(svo_base, svo_thrashy), 1),
+        ("shed/preempt jitter passes", compare(svo_base, svo_jitter), 0),
+        ("cold restart recompiling flagged (n_compiles + compile_s)",
+         compare(svo_base, svo_coldly), 2),
+        ("shedding candidate's occupancy not gated vs melted baseline",
+         compare(sv16_melt, sv16_shedding), 0),
         ("identical smt records pass", compare(sm_base, sm_same), 0),
         ("lost smt scaling flagged (qps@4w + speedup_x)",
          compare(sm_base, sm_serial), 2),

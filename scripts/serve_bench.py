@@ -3,6 +3,7 @@
 
     python scripts/serve_bench.py --out SERVE_r01.json [--clients 1 4 16]
         [--preset GC] [--span 48] [--grid-chunk 16] [--rounds 2]
+        [--priority-mix] [--replicas N]
 
 Runs ONE warm in-process :class:`fairify_tpu.serve.VerificationServer` and,
 for each client level C, submits C concurrent same-architecture requests
@@ -32,9 +33,35 @@ Two service-health headlines ride along (ISSUE 8 acceptance):
   runs of the same spans.  Coalescing is measurably working iff
   coalesced < sequential.
 
-``scripts/perfdiff.py`` gates p95 latency and deadline-miss growth between
-two SERVE records (lower-is-better with noise tolerances; see its
-docstring).
+**Overload scenario** (``--priority-mix``, ISSUE 11 / SERVE_r02): the
+measured levels run with the overload-survival layer live — a bounded
+queue (``--max-queue``), priority tiers in a high:normal:normal:low
+rotation (high gets a quarter of the SLA, low is best-effort), span-
+granular preemption (``--preempt-factor``) — and each level row splits
+honest triage from failure: ``shed_rate`` (rejected with a ``shed:``
+reason before costing device time) and ``preemptions`` are reported
+separately, latencies and ``deadline_miss_rate`` cover ADMITTED requests
+only.  A shed is a fast, actionable rejection; counting it as a miss
+(as a naive reading of r01 would) rewards servers that bury clients in a
+two-minute queue instead of answering.  ``requests_per_s`` is completed-
+request goodput (``done / wall``); r01 counted every terminal request, so
+across that seam the comparison is conservative — goodput can only
+under-claim against a throughput baseline.
+
+``--replicas N`` routes the levels through
+:class:`serve.fleet.ServerFleet`.  Every client submits the SAME span and
+architecture (one coalescing bucket): it is the router's load
+*spill-over* — not workload partitioning — that spreads an overloaded
+bucket across replicas, exactly as production traffic would.  The
+executable cache is always on (under ``--work-dir``, or a persistent
+``--exec-cache-dir`` for steady-state runs), and the record closes with a
+``cold_restart`` block: a fresh subprocess re-runs one span against the
+populated cache — ``n_compiles == 0`` with ``compile_s ~ 0`` is the
+zero-cold-start headline.
+
+``scripts/perfdiff.py`` gates p95 latency, deadline-miss, shed-rate,
+preemption-count, and cold-restart compile growth between two SERVE
+records (lower-is-better with noise tolerances; see its docstring).
 """
 from __future__ import annotations
 
@@ -54,12 +81,58 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 def _percentiles(latencies_s):
     import numpy as np
 
+    if not latencies_s:
+        return {"p50_ms": 0.0, "p95_ms": 0.0, "p99_ms": 0.0}
     ms = np.asarray(sorted(latencies_s)) * 1000.0
     return {
         "p50_ms": round(float(np.percentile(ms, 50)), 1),
         "p95_ms": round(float(np.percentile(ms, 95)), 1),
         "p99_ms": round(float(np.percentile(ms, 99)), 1),
     }
+
+
+def _cold_restart(args, exec_dir: str, in_dim: int) -> dict:
+    """Fresh-process probe of the zero-cold-start contract: a subprocess
+    with empty in-memory caches re-runs the warmup span against the
+    executable cache this bench populated.  ``n_compiles == 0`` with
+    ``compile_s ~ 0`` is the headline — every kernel loads from disk."""
+    import subprocess
+
+    rdir = os.path.join(os.path.abspath(args.work_dir), "cold-restart")
+    code = f"""
+import json, os, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+t_import = time.perf_counter()
+from fairify_tpu.obs import compile as compile_obs
+compile_obs.enable_exec_cache({exec_dir!r})
+from fairify_tpu.models.train import init_mlp
+from fairify_tpu.verify import presets, sweep
+cfg = presets.get({args.preset!r}).with_(
+    soft_timeout_s=10.0, hard_timeout_s=600.0, sim_size=64,
+    exact_certify_masks=False, grid_chunk={args.grid_chunk},
+    launch_backoff_s=1e-4, result_dir={rdir!r})
+net = init_mlp(({in_dim}, 8, 1), seed=0)
+t0 = time.perf_counter()
+sweep.verify_model(net, cfg, model_name="cold", resume=False,
+                   partition_span=(0, {args.span}))
+tot = compile_obs.snapshot_totals()
+hits = sum(k.stats.cache_hits for k in compile_obs.kernels().values())
+print(json.dumps({{
+    "wall_s": round(time.perf_counter() - t0, 3),
+    "import_s": round(t0 - t_import, 3),
+    "n_compiles": tot["n_compiles"],
+    "compile_s": round(tot["compile_s"], 3),
+    "exec_cache_hits": hits,
+}}))
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code], cwd=ROOT, env=env,
+                         capture_output=True, text=True, timeout=900)
+    if out.returncode != 0:
+        print(f"serve_bench: cold-restart probe failed:\n{out.stderr[-2000:]}",
+              file=sys.stderr)
+        return {"error": out.stderr[-400:]}
+    return json.loads(out.stdout.strip().splitlines()[-1])
 
 
 def main() -> int:
@@ -81,12 +154,39 @@ def main() -> int:
                          "tail delays everything behind it)")
     ap.add_argument("--work-dir", default="serve_bench_work",
                     help="scratch directory for request sinks (wiped)")
+    ap.add_argument("--priority-mix", action="store_true",
+                    help="overload scenario: priority tiers, bounded-queue "
+                         "shedding, and span-granular preemption at every "
+                         "level (the SERVE_r02 configuration)")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="route levels through a ServerFleet of N replicas "
+                         "(clients spread over N span groups)")
+    ap.add_argument("--max-queue", type=int, default=8,
+                    help="bounded-queue shed depth in --priority-mix mode")
+    ap.add_argument("--preempt-factor", type=float, default=2.0,
+                    help="over-budget preemption multiple in --priority-mix "
+                         "mode (span_chunks=1)")
+    ap.add_argument("--fair-share", type=float, default=4.0,
+                    help="fair-share hard-budget clamp multiple in "
+                         "--priority-mix mode: under contention a request "
+                         "gets this multiple of its admission estimate; "
+                         "overrun degrades to resumable UNKNOWNs")
+    ap.add_argument("--no-cold-restart", action="store_true",
+                    help="skip the cold-restart-from-cache subprocess probe")
+    ap.add_argument("--exec-cache-dir", default=None,
+                    help="persistent executable cache directory (default: "
+                         "<work-dir>/exec-cache, wiped with it).  Point it "
+                         "somewhere persistent to measure the steady state "
+                         "a deployed fleet actually runs in: first-touch "
+                         "refinement compiles are paid once per deployment, "
+                         "not once per load spike")
     args = ap.parse_args()
 
     from fairify_tpu import obs
     from fairify_tpu.models.train import init_mlp
     from fairify_tpu.obs import compile as compile_obs
-    from fairify_tpu.serve import ServeConfig, VerificationServer
+    from fairify_tpu.serve import FleetConfig, ServeConfig, ServerFleet, \
+        VerificationServer
     from fairify_tpu.verify import presets, sweep
 
     cfg0 = presets.get(args.preset).with_(
@@ -96,6 +196,28 @@ def main() -> int:
     span = (0, args.span)
     in_dim = len(cfg0.query().columns)
     shutil.rmtree(args.work_dir, ignore_errors=True)
+    # Executable cache on from the very first compile: the warmup runs
+    # populate it, the cold_restart probe proves a fresh process loads it.
+    exec_dir = args.exec_cache_dir or os.path.join(
+        os.path.abspath(args.work_dir), "exec-cache")
+    compile_obs.enable_exec_cache(exec_dir)
+
+    # One coalescing bucket for every client (same span, same arch): the
+    # fleet's spill-over routing — not span partitioning — is what spreads
+    # an overloaded bucket across replicas, exactly like production load.
+
+    # Priority rotation (25 % high / 50 % normal / 25 % low) + SLA shape:
+    # high gets a quarter of the window (interactive), low twice of it
+    # (batch — still a deadline: a best-effort request would spend every
+    # optional refinement budget and is a different workload, not a tier).
+    # Applied only at overload levels (>= 8 clients): the small levels
+    # stay bit-comparable with the r01 methodology.
+    def _prio_of(c):
+        tier = ("high", "normal", "normal", "low")[c % 4]
+        prio = {"low": 0, "normal": 1, "high": 2}[tier]
+        deadline = {"low": 2.0 * args.deadline, "normal": args.deadline,
+                    "high": args.deadline / 4.0}[tier]
+        return prio, deadline
 
     registry = obs.registry()
     launches = registry.counter("device_launches")
@@ -121,12 +243,37 @@ def main() -> int:
             model_name=f"solo-{i}", resume=False, partition_span=span)
     sequential_launches = int(launches.total() - seq0)
 
-    srv = VerificationServer(ServeConfig(batch_window_s=0.2, max_batch=8))
+    mix = args.priority_mix
+    scfg = ServeConfig(
+        batch_window_s=0.2, max_batch=8, exec_cache=exec_dir,
+        max_queue=args.max_queue if mix else 0,
+        preempt_factor=args.preempt_factor if mix else 0.0,
+        fair_share_factor=args.fair_share if mix else 0.0,
+        # Strict fair share: the latency-predictable tier — even an
+        # uncontended tail request is clamped to its share, so one
+        # refinement-hungry model can't stretch a level's p95 by 10x.
+        # Requests run whole-span (span_chunks=0): the BaB phase spends
+        # up to its granule budget on hard roots, so splitting a span
+        # into G granules multiplies that burn by G — preemption (which
+        # needs granules) is exercised by chaos_matrix --fleet and
+        # test_serve, not by this latency record.
+        fair_share_idle_exempt=not mix)
+    if args.replicas > 1:
+        # Spill AT the shed bound: a burst spreads over the fleet right
+        # before replicas would start shedding, while a small (shed-free,
+        # sub-max_queue) burst stays on one replica with its full
+        # coalescing occupancy.
+        srv = ServerFleet(FleetConfig(
+            n_replicas=args.replicas, poll_s=0.02,
+            spill_load=max(args.max_queue, 2),
+            replica=scfg))
+    else:
+        srv = VerificationServer(scfg)
     srv.start()
     # Server warmup: one solo request (solo kernels) plus one coalesced
-    # wave (the fixed-width family executable — pad_models means any later
-    # occupancy reuses it).  After this, the measured levels must hit the
-    # warm executable cache only.
+    # wave (the fixed-width family executable — pad_models means any
+    # later occupancy reuses it).  After this, the measured levels must
+    # hit the warm executable cache only.
     w = srv.submit(cfg0.with_(result_dir=os.path.join(args.work_dir, "w0")),
                    _net(0), "w0", partition_span=span)
     srv.wait(w.id, timeout=900.0)
@@ -135,18 +282,40 @@ def main() -> int:
         _net(900 + i), f"wv{i}", partition_span=span) for i in range(2)]
     for req in wave:
         srv.wait(req.id, timeout=900.0)
+    # Warm-until-quiescent: keep feeding fresh warmup models until a whole
+    # round adds zero compiles.  The SERVE_r01 postmortem found the 7
+    # mid-load compiles at 16 clients were FIRST-TOUCH refinement kernels
+    # (sign-BaB, pair-LP, PGD slabs) — paths only UNKNOWN-heavy models
+    # reach, which the old stage-0-decidable warmup never exercised; the
+    # measured levels then paid multi-second compile stalls mid-overload.
+    wseed = 950
+    for _round in range(6):
+        c_before = compile_obs.snapshot_totals()["n_compiles"]
+        wave = [srv.submit(
+            cfg0.with_(result_dir=os.path.join(args.work_dir, f"wq{wseed+i}")),
+            _net(wseed + i), f"wq{wseed + i}", partition_span=span)
+            for i in range(4)]
+        for req in wave:
+            srv.wait(req.id, timeout=900.0)
+        wseed += 4
+        if compile_obs.snapshot_totals()["n_compiles"] == c_before:
+            break
     compiles0 = compile_obs.snapshot_totals()["n_compiles"]
 
+    preempt_ctr = registry.counter("serve_preemptions")
     levels = {}
     coalesced_launches = None
     seed = 1000
     for n_clients in args.clients:
         latencies = []
         misses = 0
+        sheds = 0
+        done_n = 0
         total = 0
         b_sum0, b_cnt0 = batch_hist.sum(), batch_hist.count()
         lvl_l0 = launches.total()
         lvl_c0 = compile_obs.snapshot_totals()["n_compiles"]
+        lvl_p0 = preempt_ctr.total()
         t_lvl = time.perf_counter()
         for rnd in range(args.rounds):
             reqs = []
@@ -154,20 +323,33 @@ def main() -> int:
                 seed += 1
                 rdir = os.path.join(args.work_dir,
                                     f"c{n_clients}-r{rnd}-{c}")
+                if mix and n_clients >= 8:
+                    prio, deadline = _prio_of(c)
+                else:
+                    prio, deadline = 1, args.deadline
                 reqs.append(srv.submit(
                     cfg0.with_(result_dir=rdir), _net(seed),
-                    f"m{seed}", deadline_s=args.deadline,
-                    partition_span=span))
+                    f"m{seed}", deadline_s=deadline,
+                    partition_span=span, priority=prio))
             for req in reqs:
                 done = srv.wait(req.id, timeout=900.0)
                 total += 1
+                if done is not None and done.status == "rejected" \
+                        and done.reason.startswith("shed"):
+                    # Honest triage: the client got an actionable answer
+                    # in milliseconds, before any device time was spent —
+                    # a rejection, not a miss.
+                    sheds += 1
+                    continue
                 if done is None or done.finished_at is None:
                     misses += 1  # never finished: worse than a miss
                     continue
+                done_n += int(done.status == "done")
                 latencies.append(done.finished_at - done.submitted_at)
                 misses += int(done.deadline_missed
                               or done.status != "done")
         wall = time.perf_counter() - t_lvl
+        admitted = total - sheds
         b_cnt = batch_hist.count() - b_cnt0
         occupancy = ((batch_hist.sum() - b_sum0) / b_cnt) if b_cnt else 0.0
         if n_clients == 4:
@@ -175,10 +357,13 @@ def main() -> int:
                                      / args.rounds)
         levels[str(n_clients)] = {
             "requests": total,
+            "admitted": admitted,
             **_percentiles(latencies),
-            "deadline_miss_rate": round(misses / max(total, 1), 4),
+            "deadline_miss_rate": round(misses / max(admitted, 1), 4),
+            "shed_rate": round(sheds / max(total, 1), 4),
+            "preemptions": int(preempt_ctr.total() - lvl_p0),
             "batch_occupancy_mean": round(occupancy, 3),
-            "requests_per_s": round(total / wall, 3),
+            "requests_per_s": round(done_n / wall, 3),
             "xla_compiles": int(compile_obs.snapshot_totals()["n_compiles"]
                                 - lvl_c0),
         }
@@ -200,11 +385,17 @@ def main() -> int:
         "grid_chunk": args.grid_chunk,
         "rounds": args.rounds,
         "deadline_s": args.deadline,
+        "priority_mix": bool(mix),
+        "replicas": args.replicas,
         "clients": levels,
         "warm_xla_compiles": int(warm_compiles),
         "coalesced_device_launches": coalesced_launches,
         "sequential_device_launches": sequential_launches,
     }
+    if not args.no_cold_restart:
+        record["cold_restart"] = _cold_restart(args, exec_dir, in_dim)
+        print(f"serve_bench: cold restart from cache: "
+              f"{record['cold_restart']}", file=sys.stderr)
     with open(args.out, "w") as fp:
         json.dump(record, fp, indent=1)
     print(json.dumps(record))

@@ -1,0 +1,12 @@
+# rel: fairify_tpu/serve/fx_fleet.py
+from fairify_tpu.resilience import faults as faults_mod
+
+
+def health_sweep_and_yield(replicas, running):
+    # Literal anchors for the overload-survival sites: the fleet router's
+    # per-replica health check and the server's span-granule preemption
+    # decision each stay a named chaos-injectable site.
+    for _replica in replicas:
+        faults_mod.check("replica.lost")
+    if running:
+        faults_mod.check("request.preempt")

@@ -1,0 +1,10 @@
+# rel: fairify_tpu/serve/fx_fleet_typos.py
+from fairify_tpu.resilience import faults as faults_mod
+
+
+def health_sweep_typoed(replicas):
+    # Misspelled fleet sites: every --inject-fault spec targeting them is
+    # rejected at the CLI while these paths run unprotected.
+    for _replica in replicas:
+        faults_mod.check("replica.lose")  # EXPECT
+    faults_mod.check("request.preemptt")  # EXPECT

@@ -1,0 +1,209 @@
+"""Persistent executable cache (``obs.compile.enable_exec_cache``, §15).
+
+The zero-cold-start leg of the overload-survival layer: compiled
+executables serialize to disk keyed by the real ``signature_key`` plus
+jax/jaxlib versions, backend, and device kind, and a fresh process warms
+from the cache instead of recompiling.  Four contracts:
+
+* **never trusted** — truncated, corrupted, or wrong-identity entries are
+  quarantined to ``.corrupt`` and recompiled; a bad cache costs time,
+  never correctness;
+* **bit-equal** — a cache hit produces byte-identical outputs (and, at the
+  sweep level, byte-identical verdict maps) to a fresh compile;
+* **race-safe** — replicas racing the same key publish whole entries via
+  atomic rename; readers can never observe a torn file;
+* **opt-in** — with the cache disabled nothing is written or read, so
+  per-process compile accounting elsewhere in the suite is untouched.
+"""
+import hashlib
+import json
+import os
+import pickle
+import subprocess
+import sys
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fairify_tpu import obs
+from fairify_tpu.obs import compile as compile_obs
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def cache_dir(tmp_path):
+    d = str(tmp_path / "exec")
+    compile_obs.enable_exec_cache(d)
+    yield d
+    compile_obs.disable_exec_cache()
+
+
+def _kern(name="t.kern"):
+    def fn(x, k):
+        return x * 2.0 if k else x + 1.0
+
+    return compile_obs.obs_jit(fn, name=name, static_argnames=("k",),
+                               register=False)
+
+
+def _entry_paths(cache_dir):
+    return [os.path.join(cache_dir, n) for n in sorted(os.listdir(cache_dir))
+            if n.endswith(".exec")]
+
+
+def test_fresh_instance_loads_from_cache_bit_equal(cache_dir):
+    k1 = _kern()
+    out1 = np.asarray(k1(jnp.arange(4.0), k=True))
+    assert k1.stats.n_compiles == 1 and k1.stats.cache_stores == 1
+    assert len(_entry_paths(cache_dir)) == 1
+    # A fresh instance (empty in-memory executable cache — the process-
+    # restart analog) must load, not compile, and match byte for byte.
+    k2 = _kern()
+    out2 = np.asarray(k2(jnp.arange(4.0), k=True))
+    assert k2.stats.n_compiles == 0
+    assert k2.stats.cache_hits == 1
+    assert out1.tobytes() == out2.tobytes()
+
+
+def test_truncated_entry_quarantined_and_recompiled(cache_dir):
+    k1 = _kern()
+    out1 = np.asarray(k1(jnp.arange(4.0), k=True))
+    path = _entry_paths(cache_dir)[0]
+    with open(path, "r+b") as fp:
+        fp.truncate(40)
+    errs = obs.registry().counter("exec_cache_errors")
+    e0 = errs.total()
+    k2 = _kern()
+    out2 = np.asarray(k2(jnp.arange(4.0), k=True))
+    assert k2.stats.cache_hits == 0
+    assert k2.stats.n_compiles == 1, "a truncated entry must recompile"
+    assert errs.total() == e0 + 1
+    assert os.path.exists(path + ".corrupt"), "quarantined, never re-parsed"
+    assert out2.tobytes() == out1.tobytes()
+    # The recompile re-published a good entry: next instance hits again.
+    k3 = _kern()
+    k3(jnp.arange(4.0), k=True)
+    assert k3.stats.cache_hits == 1
+
+
+def test_corrupt_payload_quarantined(cache_dir):
+    k1 = _kern()
+    k1(jnp.arange(4.0), k=True)
+    path = _entry_paths(cache_dir)[0]
+    raw = bytearray(open(path, "rb").read())
+    raw[-1] ^= 0xFF  # flip a payload byte: checksum must catch it
+    with open(path, "wb") as fp:
+        fp.write(raw)
+    k2 = _kern()
+    out = np.asarray(k2(jnp.arange(4.0), k=True))
+    assert k2.stats.cache_hits == 0 and k2.stats.n_compiles == 1
+    assert os.path.exists(path + ".corrupt")
+    assert out.tobytes() == np.asarray(k1(jnp.arange(4.0), k=True)).tobytes()
+
+
+def test_wrong_version_entry_rejected_not_loaded(cache_dir):
+    """An entry whose embedded identity disagrees (stale jax version, other
+    backend) must be quarantined even when its checksum is intact."""
+    k1 = _kern()
+    k1(jnp.arange(4.0), k=True)
+    path = _entry_paths(cache_dir)[0]
+    raw = open(path, "rb").read()
+    body = raw[len(compile_obs._EXEC_MAGIC):]
+    _digest, _, payload = body.partition(b"\n")
+    meta = pickle.loads(payload)
+    meta["ident"] = meta["ident"].replace(
+        compile_obs.jax.__version__, "0.0.1-stale", 1)
+    forged = pickle.dumps(meta)
+    with open(path, "wb") as fp:
+        fp.write(compile_obs._EXEC_MAGIC
+                 + hashlib.sha256(forged).hexdigest().encode()
+                 + b"\n" + forged)
+    k2 = _kern()
+    k2(jnp.arange(4.0), k=True)
+    assert k2.stats.cache_hits == 0 and k2.stats.n_compiles == 1
+    assert os.path.exists(path + ".corrupt")
+
+
+def test_concurrent_racers_same_key_never_tear(cache_dir):
+    """N replicas racing one key: every store publishes a complete entry
+    (write-tmp -> fsync -> rename), so the last writer wins a byte-valid
+    file and every racer computes the right answer."""
+    outs = [None] * 8
+    errs = []
+
+    def race(i):
+        try:
+            k = _kern()
+            outs[i] = np.asarray(k(jnp.arange(4.0), k=True)).tobytes()
+        except BaseException as exc:  # noqa: BLE001 - surface in main thread
+            errs.append(exc)
+
+    threads = [threading.Thread(target=race, args=(i,)) for i in range(8)]
+    e0 = obs.registry().counter("exec_cache_errors").total()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert len(set(outs)) == 1
+    assert obs.registry().counter("exec_cache_errors").total() == e0, \
+        "a racer observed a torn entry"
+    # Whatever the interleaving, the surviving entry is loadable.
+    k = _kern()
+    k(jnp.arange(4.0), k=True)
+    assert k.stats.cache_hits == 1 and k.stats.n_compiles == 0
+    assert not [n for n in os.listdir(cache_dir) if n.endswith(".tmp")], \
+        "a racer leaked its tmp file"
+
+
+def test_disabled_cache_writes_and_reads_nothing(tmp_path):
+    assert compile_obs.exec_cache_dir() is None
+    k = _kern()
+    k(jnp.arange(4.0), k=True)
+    assert k.stats.cache_stores == 0 and k.stats.cache_hits == 0
+
+
+def _run_sweep_child(cache_dir, result_dir):
+    code = """
+import json, os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from fairify_tpu.obs import compile as compile_obs
+compile_obs.enable_exec_cache(sys.argv[1])
+from fairify_tpu.models.train import init_mlp
+from fairify_tpu.verify import presets, sweep
+cfg = presets.get("GC").with_(
+    soft_timeout_s=30.0, hard_timeout_s=600.0, sim_size=32,
+    exact_certify_masks=False, grid_chunk=8, launch_backoff_s=1e-4,
+    result_dir=sys.argv[2])
+net = init_mlp((20, 6, 1), seed=7)
+rep = sweep.verify_model(net, cfg, model_name="m", resume=False,
+                         partition_span=(0, 16))
+tot = compile_obs.snapshot_totals()
+hits = sum(k.stats.cache_hits for k in compile_obs.kernels().values())
+print(json.dumps({
+    "map": {str(o.partition_id): o.verdict for o in rep.outcomes},
+    "n_compiles": tot["n_compiles"], "hits": hits}))
+"""
+    out = subprocess.run(
+        [sys.executable, "-c", code, cache_dir, result_dir],
+        cwd=ROOT, capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert out.returncode == 0, out.stderr[-2000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def test_cold_restart_verdicts_bit_equal_and_compile_free(tmp_path):
+    """The full-stack zero-cold-start contract: process 1 compiles and
+    populates the cache; process 2 (a restarted server / fresh replica)
+    compiles NOTHING and produces the identical verdict map."""
+    cache = str(tmp_path / "exec")
+    first = _run_sweep_child(cache, str(tmp_path / "r1"))
+    second = _run_sweep_child(cache, str(tmp_path / "r2"))
+    assert first["n_compiles"] > 0, "first process should have compiled"
+    assert second["n_compiles"] == 0, \
+        f"restart recompiled {second['n_compiles']} kernels"
+    assert second["hits"] > 0
+    assert second["map"] == first["map"], "cache hit changed verdicts"

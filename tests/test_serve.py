@@ -31,7 +31,9 @@ from fairify_tpu.obs import compile as compile_obs
 from fairify_tpu.serve import (
     AdmissionController,
     AdmissionRejected,
+    FleetConfig,
     ServeConfig,
+    ServerFleet,
     VerificationServer,
     span_admissible,
 )
@@ -117,6 +119,198 @@ def test_admission_backlog_frees_on_finish():
     ctl.finished(_Stub("b", 500), partitions=500, elapsed_s=5.0)
     assert ctl.backlog_s() == 0.0
     assert ctl.estimate_s(100) == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# Overload control: priority queue, bounded-queue shedding, preemption
+# ---------------------------------------------------------------------------
+
+
+class _PStub:
+    def __init__(self, rid, partitions, deadline_s=None, priority=1):
+        self.id = rid
+        self.partitions = partitions
+        self.deadline_s = deadline_s
+        self.priority = priority
+
+
+def test_priority_queue_pops_high_first(tmp_path):
+    """Higher tiers pop first; FIFO within a tier."""
+    srv = VerificationServer(ServeConfig())  # never started: queue holds
+    cfg = _cfg(tmp_path, "p")
+    lo = srv.submit(cfg, _net(1), "lo", partition_span=SPAN, priority=0)
+    n1 = srv.submit(cfg, _net(2), "n1", partition_span=SPAN, priority=1)
+    hi = srv.submit(cfg, _net(3), "hi", partition_span=SPAN, priority=2)
+    n2 = srv.submit(cfg, _net(4), "n2", partition_span=SPAN, priority=1)
+    with srv._cv:
+        batch = srv._pop_batch(3)
+    assert [r.id for r in batch] == [hi.id, n1.id, n2.id]
+    with srv._cv:
+        rest = srv._pop_batch(3)
+    assert [r.id for r in rest] == [lo.id]
+
+
+def test_bounded_queue_sheds_with_priority_headroom():
+    """max_queue sheds at depth x PRIORITY_HEADROOM: low sheds earliest,
+    high rides into the safety margin; the reason is machine-readable."""
+    ctl = AdmissionController(max_queue=2)
+    ctl.admit(_PStub("a", 10), queue_depth=1)          # under the bound
+    with pytest.raises(AdmissionRejected) as exc:
+        ctl.admit(_PStub("b", 10), queue_depth=2)      # normal: sheds at 2
+    assert exc.value.kind == "shed"
+    assert str(exc.value).startswith("shed: queue full")
+    with pytest.raises(AdmissionRejected):
+        ctl.admit(_PStub("c", 10, priority=0), queue_depth=2)  # low: earlier
+    ctl.admit(_PStub("d", 10, priority=2), queue_depth=2)  # high: headroom
+    with pytest.raises(AdmissionRejected):
+        ctl.admit(_PStub("e", 10, priority=2), queue_depth=3)
+
+
+def test_feasibility_shed_reason_and_readmit():
+    """Deadline-infeasible submits shed with kind='shed'; the failover
+    readmit path accounts backlog but never sheds."""
+    ctl = AdmissionController()
+    ctl.admit(_PStub("probe", 1000, deadline_s=None))
+    ctl.finished(_PStub("probe", 1000), partitions=1000, elapsed_s=10.0)
+    with pytest.raises(AdmissionRejected) as exc:
+        ctl.admit(_PStub("b", 10_000, deadline_s=2.0))
+    assert exc.value.kind == "shed"
+    assert "deadline-infeasible" in str(exc.value)
+    # readmit: same request would shed, but an already-admitted request
+    # re-homed by failover must land — and still commit backlog.
+    before = ctl.backlog_s()
+    ctl.readmit(_PStub("b", 10_000, deadline_s=2.0))
+    assert ctl.backlog_s() > before
+
+
+def test_shed_is_terminal_and_client_visible(tmp_path):
+    srv = VerificationServer(ServeConfig(max_queue=1))
+    cfg = _cfg(tmp_path, "s0")
+    os.makedirs(cfg.result_dir, exist_ok=True)
+    srv.submit(cfg, _net(1), "a", partition_span=SPAN)
+    cfg2 = _cfg(tmp_path, "s1")
+    os.makedirs(cfg2.result_dir, exist_ok=True)
+    shed = srv.submit(cfg2, _net(2), "b", partition_span=SPAN)
+    assert shed.status == "rejected"
+    assert shed.reason.startswith("shed:")
+    with open(os.path.join(cfg2.result_dir, "status.json")) as fp:
+        rec = json.load(fp)
+    assert rec["status"] == "rejected" and rec["reason"].startswith("shed:")
+
+
+def test_preemption_requeues_and_converges(tmp_path, solo_maps):
+    """A running over-budget low-priority request yields at its next
+    span granule to a queued higher tier, requeues with its partial
+    ledger, and still converges bit-equal to its solo run."""
+    srv = VerificationServer(ServeConfig(
+        batch_window_s=0.05, span_chunks=1, preempt_factor=1.0))
+    # Pre-measure an (optimistic) service rate so the estimate exists and
+    # any real elapsed time reads as over-budget.
+    srv.admission.finished(_PStub("warm", 10_000_000),
+                          partitions=10_000_000, elapsed_s=1.0)
+    low = srv.submit(_cfg(tmp_path, "low"), _net(3), "m3",
+                     partition_span=SPAN, priority=0)
+    srv.start()
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 60.0:
+        if low.status == "running":
+            break
+        time.sleep(0.002)
+    hi = srv.submit(_cfg(tmp_path, "hi"), _net(5), "m5",
+                    partition_span=SPAN, priority=2)
+    f_lo = srv.wait(low.id, timeout=600.0)
+    f_hi = srv.wait(hi.id, timeout=600.0)
+    srv.drain()
+    assert f_hi.status == "done", f_hi.reason
+    assert f_lo.status == "done", f_lo.reason
+    assert f_lo.preemptions >= 1, "the low-priority request never yielded"
+    assert _omap(f_lo.report) == solo_maps[3]
+    assert _omap(f_hi.report) == solo_maps[5]
+
+
+# ---------------------------------------------------------------------------
+# Fleet: warm replicas, routing, failover
+# ---------------------------------------------------------------------------
+
+
+def test_warm_fleet_zero_compiles_and_bit_equal(tmp_path, solo_maps):
+    """ISSUE 11 satellite pin: a warmed fleet serving a same-bucket mix
+    compiles NOTHING (xla_compiles == 0 across the wave) and every
+    request's verdicts stay bit-equal to its solo run."""
+    fl = ServerFleet(FleetConfig(
+        n_replicas=2, poll_s=0.02,
+        replica=ServeConfig(batch_window_s=0.2, max_batch=4)))
+    fl.start()
+    # Warm both buckets (two architectures) until quiescent.
+    for name, net, n in (("w8", _net(99), (20, 8, 1)),
+                         ("w6", init_mlp((20, 6, 1), seed=42), (20, 6, 1))):
+        r = fl.submit(_cfg(tmp_path, name), net, name, partition_span=SPAN)
+        assert fl.wait(r.id, timeout=600.0).status == "done"
+    wave = [fl.submit(_cfg(tmp_path, f"wv{i}"), _net(60 + i), f"wv{i}",
+                      partition_span=SPAN) for i in range(2)]
+    for r in wave:
+        assert fl.wait(r.id, timeout=600.0).status == "done"
+    compiles0 = compile_obs.snapshot_totals()["n_compiles"]
+    reqs = [
+        fl.submit(_cfg(tmp_path, "fa"), _net(3), "m3", partition_span=SPAN),
+        fl.submit(_cfg(tmp_path, "fb"), _net(5), "m5", partition_span=SPAN),
+        fl.submit(_cfg(tmp_path, "fc"), init_mlp((20, 6, 1), seed=9),
+                  "modd", partition_span=SPAN),
+    ]
+    finals = [fl.wait(r.id, timeout=600.0) for r in reqs]
+    fl.drain()
+    assert all(f.status == "done" for f in finals), \
+        [f.reason for f in finals]
+    assert compile_obs.snapshot_totals()["n_compiles"] == compiles0, \
+        "a warm fleet recompiled on same-bucket traffic"
+    assert _omap(finals[0].report) == solo_maps[3]
+    assert _omap(finals[1].report) == solo_maps[5]
+    assert _omap(finals[2].report) == solo_maps["odd"]
+
+
+def test_fleet_failover_mid_request_loses_nothing(tmp_path, solo_maps):
+    """Kill the replica that owns a RUNNING request: the router re-homes
+    it to the survivor, resume=True replays the partial ledger, and the
+    final verdict map is bit-equal to the fault-free solo run."""
+    fl = ServerFleet(FleetConfig(
+        n_replicas=2, poll_s=0.02,
+        replica=ServeConfig(batch_window_s=0.05, span_chunks=1)))
+    fl.start()
+    req = fl.submit(_cfg(tmp_path, "fo"), _net(3), "m3", partition_span=SPAN)
+    owner = fl.owner_of(req.id)
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 60.0:
+        cur = fl.get(req.id)
+        if cur is not None and cur.status == "running":
+            break
+        time.sleep(0.002)
+    fl._replicas[owner].kill()
+    final = fl.wait(req.id, timeout=600.0)
+    assert final is not None and final.status == "done", \
+        (final and final.reason)
+    assert fl.owner_of(req.id) != owner, "request was not re-homed"
+    assert fl.replicas_alive() == 1
+    assert {p: v for p, (v, _) in _omap(final.report).items()} \
+        == {p: v for p, (v, _) in solo_maps[3].items()}
+    fl.drain()
+
+
+def test_fleet_routing_sticky_then_spills(tmp_path):
+    """Same bucket pins to one replica; once that replica's committed
+    load passes spill_load, new requests go to the least-loaded."""
+    fl = ServerFleet(FleetConfig(
+        n_replicas=2, spill_load=2,
+        replica=ServeConfig(batch_window_s=0.2)))
+    cfg = _cfg(tmp_path, "rt")
+    # Not started: requests pile up on the pinned replica's queue.
+    first = [fl.submit(cfg, _net(1), f"m{i}", partition_span=SPAN)
+             for i in range(2)]
+    owners = {fl.owner_of(r.id) for r in first}
+    assert len(owners) == 1, "bucket must pin to one replica"
+    spilled = fl.submit(cfg, _net(1), "spill", partition_span=SPAN)
+    assert fl.owner_of(spilled.id) not in owners, \
+        "saturated bucket must spill to the other replica"
+    fl.drain()
 
 
 # ---------------------------------------------------------------------------
