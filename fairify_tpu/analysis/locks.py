@@ -1,0 +1,1073 @@
+"""Whole-program lock-acquisition analysis (AST-only — no jax import).
+
+The runtime is a thicket of threads: the serve worker loop, the fleet
+router with heartbeat leases, the SMT pool's dispatch lanes, the
+background SMT drainer, and a ``ReplicaKilled(BaseException)`` thrown
+into live threads at yield points.  The per-class ``lock-discipline``
+rule (``lint/rules_locks.py``) checks that guarded attributes are read
+under *a* lock; this module answers the questions that rule cannot see:
+
+* **Which locks exist?**  Every ``threading.Lock`` / ``RLock`` /
+  ``Condition`` construction in scope becomes a node — class attributes
+  (``self._lock = threading.Lock()``), module globals, and function
+  locals.  A Condition *aliases* the lock it wraps (``threading.
+  Condition(self._lock)`` — ``with self._cv:`` acquires ``self._lock``),
+  so the graph's nodes are canonical underlying locks.
+* **In which order are they taken?**  Acquisition edges come from
+  lexically nested ``with``/``acquire`` scopes AND from cross-function
+  call edges inside ``fairify_tpu/``: holding lock A while calling a
+  function that (transitively) acquires lock B is an A → B edge.  Call
+  resolution is type-driven — ``self`` methods, module functions through
+  the import table, attribute/local types from constructor assignments
+  and annotations (``self._replicas: List[Optional[VerificationServer]]``
+  resolves ``self._replicas[i].load()``), and chained calls through
+  return annotations (``obs.registry().gauge(...).set(...)``).
+* **What happens while they are held?**  A reviewed registry of blocking
+  calls (:data:`BLOCKING_DOTTED` / :data:`BLOCKING_ATTRS` + typed
+  ``Thread.join`` / ``Popen.wait`` / ``Future.result`` /
+  ``Condition.wait`` on a *different* lock) is checked at every point a
+  lock is held, including through calls (a call that can *reach* a
+  blocking operation is flagged at the call site, where the lock is
+  actually held).
+* **Is the region kill-safe?**  ``serve.fleet`` kills replicas by
+  raising ``ReplicaKilled`` at yield points and the chaos registry
+  raises at ``faults.check`` sites.  A ``with <lock>`` region that
+  mutates guarded state ≥2 times *around* such a yield point publishes
+  torn state when the kill lands between the mutations — the failover
+  re-homing path then reads a broken invariant.  Manual ``.acquire()``
+  without a ``try/finally`` release is the other kill hazard (the lock
+  leaks on any exception).
+* **Is the Condition used correctly?**  ``Condition.wait`` outside a
+  ``while``-predicate loop (spurious wakeups + ignored ``wait(timeout)``
+  returns), ``notify``/``notify_all`` without holding, and ``wait``
+  without holding are each findings.
+
+The four lint rules in ``lint/rules_concurrency.py`` share ONE instance
+of :class:`ConcurrencyAnalysis` per engine run, so the whole-program walk
+happens once however many rules consume it.  The same graph is the
+ground truth for the dynamic cross-check (:mod:`fairify_tpu.obs.
+lockprof`): observed runtime acquisition edges must be a subset of the
+static edges — an unmodeled edge is a bug in THIS analysis.
+
+Known limits (lexical, documented rather than papered over): calls
+through lambdas/callbacks passed as arguments are invisible (e.g. a
+``Supervisor.run(lambda: ...)`` body); a helper documented as "caller
+holds the lock" contributes its events with an empty held set.  Nested
+``def``s keep the enclosing lexical held set (the closures in this
+codebase are invoked synchronously by their enclosing method).
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: threading factory names that construct a lock-like object.
+LOCK_FACTORIES = frozenset({"Lock", "RLock", "Condition"})
+
+#: Reviewed registry of blocking calls by dotted name ``module.attr``.
+#: Reached under a held lock, each of these stalls every sibling thread
+#: contending for that lock (and, for server/fleet Conditions, the whole
+#: request path).  Grow this ONLY with a genuinely blocking operation —
+#: a false entry turns the rule into noise.
+BLOCKING_DOTTED = frozenset({
+    ("time", "sleep"),
+    ("select", "select"),
+    ("subprocess", "run"), ("subprocess", "call"),
+    ("subprocess", "check_call"), ("subprocess", "check_output"),
+    ("jax", "device_get"),
+    ("np", "asarray"), ("numpy", "asarray"),
+    ("os", "fsync"), ("os", "replace"), ("os", "remove"),
+    ("os", "listdir"), ("os", "makedirs"),
+    ("shutil", "rmtree"),
+})
+
+#: Blocking attribute calls on ANY receiver (unambiguous names).
+BLOCKING_ATTRS = frozenset({"communicate", "block_until_ready"})
+
+#: Blocking methods gated on an inferred receiver type (names too common
+#: to flag untyped: ``str.join``, dict ``.get`` etc. must not match).
+BLOCKING_TYPED = {
+    "threading.Thread": frozenset({"join"}),
+    "subprocess.Popen": frozenset({"wait", "communicate"}),
+    "Future": frozenset({"result"}),
+}
+
+#: Constructor calls whose result type we track for BLOCKING_TYPED.
+_SPECIAL_CTORS = {
+    ("threading", "Thread"): "threading.Thread",
+    ("subprocess", "Popen"): "subprocess.Popen",
+    ("concurrent.futures", "Future"): "Future",
+}
+
+_FAULTS_ALIASES = frozenset({"faults", "faults_mod", "faults_lib"})
+
+_MAX_CHAIN = 4  # witness call-chain depth kept per reachable lock/blocker
+
+
+# ---------------------------------------------------------------------------
+# Model
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class LockInfo:
+    """One lock-like construction site."""
+
+    id: str          # '<rel>::<owner>' e.g. 'fairify_tpu/serve/server.py::VerificationServer._lock'
+    rel: str
+    line: int
+    kind: str        # Lock | RLock | Condition
+    canonical: str   # id of the underlying lock (self for non-aliasing)
+
+
+@dataclass
+class EdgeWitness:
+    """Where one acquisition-order edge was observed statically."""
+
+    rel: str
+    line: int
+    function: str
+    chain: Tuple[str, ...] = ()   # call chain, outermost first
+
+    def render(self) -> str:
+        at = f"{self.rel}:{self.line} in {self.function}()"
+        if self.chain:
+            return f"{at} via {' -> '.join(self.chain)}"
+        return at
+
+
+@dataclass
+class RawFinding:
+    """Engine-agnostic finding; the lint rules wrap these into Findings."""
+
+    rel: str
+    line: int
+    function: str
+    message: str
+
+
+@dataclass
+class _FnSummary:
+    key: Tuple[str, str]                       # (rel, qualname)
+    acquires: List[Tuple[str, int]] = field(default_factory=list)
+    blockers: List[Tuple[str, FrozenSet[str], int]] = field(default_factory=list)
+    calls: List[Tuple[Tuple[Tuple[str, str], ...], FrozenSet[str], int, str]] = \
+        field(default_factory=list)            # (callees, held, line, label)
+
+
+def _short(lock_id: str) -> str:
+    """Human name of a lock id: drop the path, keep the owner."""
+    return lock_id.split("::", 1)[-1]
+
+
+# ---------------------------------------------------------------------------
+# Per-file tables
+# ---------------------------------------------------------------------------
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _lock_factory(call: ast.AST) -> Optional[str]:
+    """'Lock'|'RLock'|'Condition' when ``call`` is ``threading.X(...)``."""
+    if isinstance(call, ast.Call):
+        d = _dotted(call.func)
+        if d is not None and d.startswith("threading."):
+            name = d.split(".", 1)[1]
+            if name in LOCK_FACTORIES:
+                return name
+    return None
+
+
+def _annotation_names(node: ast.AST) -> Set[str]:
+    """All Name ids + dotted names mentioned in a type annotation."""
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        d = _dotted(n)
+        if d:
+            out.add(d)
+        elif isinstance(n, ast.Constant) and isinstance(n.value, str):
+            out.add(n.value)  # string annotations
+    return out
+
+
+class _ClassInfo:
+    def __init__(self, rel: str, node: ast.ClassDef):
+        self.rel = rel
+        self.name = node.name
+        self.node = node
+        self.methods: Dict[str, ast.AST] = {}
+        self.lock_attrs: Dict[str, LockInfo] = {}   # attr -> LockInfo
+        self.attr_types: Dict[str, Set[str]] = {}   # attr -> type names
+        for n in node.body:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[n.name] = n
+
+    def self_name(self, method: ast.AST) -> str:
+        pos = list(method.args.posonlyargs) + list(method.args.args)
+        return pos[0].arg if pos else "self"
+
+
+class _FileInfo:
+    def __init__(self, rel: str, tree: ast.AST):
+        self.rel = rel
+        self.tree = tree
+        self.mod_aliases: Dict[str, str] = {}       # alias -> dotted module
+        self.from_names: Dict[str, Tuple[str, str]] = {}  # name -> (module, orig)
+        self.module_locks: Dict[str, LockInfo] = {}
+        self.module_var_types: Dict[str, Set[str]] = {}
+        self.classes: Dict[str, _ClassInfo] = {}
+        self.functions: Dict[str, ast.AST] = {}     # module-level defs
+
+
+# ---------------------------------------------------------------------------
+# Analysis
+# ---------------------------------------------------------------------------
+
+
+class ConcurrencyAnalysis:
+    """Shared whole-program analysis (see module docstring).
+
+    Feed files via :meth:`add_file` (idempotent per rel), then call
+    :meth:`finalize` once; the findings and the graph are attributes
+    afterwards.  ``lint/rules_concurrency.py`` shares one instance across
+    its four rules so the walk runs once per engine run.
+    """
+
+    def __init__(self):
+        self.files: Dict[str, _FileInfo] = {}
+        self.locks: Dict[str, LockInfo] = {}
+        # (src canonical, dst canonical) -> first witness
+        self.edges: Dict[Tuple[str, str], EdgeWitness] = {}
+        self.blocking: List[RawFinding] = []
+        self.kill: List[RawFinding] = []
+        self.cv: List[RawFinding] = []
+        self._classes_by_name: Dict[str, List[_ClassInfo]] = {}
+        self._summaries: Dict[Tuple[str, str], _FnSummary] = {}
+        self._finalized = False
+
+    # -- ingestion ---------------------------------------------------------
+
+    def add_file(self, rel: str, tree: ast.AST) -> None:
+        if rel in self.files or not rel.endswith(".py"):
+            return
+        info = _FileInfo(rel, tree)
+        self._collect_imports(info)
+        self._collect_module_scope(info)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                ci = _ClassInfo(rel, node)
+                self._collect_class(info, ci)
+                info.classes[ci.name] = ci
+                self._classes_by_name.setdefault(ci.name, []).append(ci)
+        self.files[rel] = info
+
+    def _collect_imports(self, info: _FileInfo) -> None:
+        for node in ast.walk(info.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    info.mod_aliases[a.asname or a.name.split(".", 1)[0]] = \
+                        a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    # `from pkg import submodule` is a module alias when the
+                    # submodule resolves to a file; recorded both ways and
+                    # disambiguated at resolution time.
+                    info.from_names[a.asname or a.name] = (node.module, a.name)
+
+    def _collect_module_scope(self, info: _FileInfo) -> None:
+        for node in info.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info.functions[node.name] = node
+            elif isinstance(node, ast.Assign):
+                fac = _lock_factory(node.value)
+                for t in node.targets:
+                    if not isinstance(t, ast.Name):
+                        continue
+                    if fac:
+                        # Keyed by the threading CALL's line (not the
+                        # assign statement's): the dynamic profiler names
+                        # locks by the call frame's line, and the two must
+                        # agree for multi-line constructions.
+                        lid = f"{info.rel}::{t.id}"
+                        info.module_locks[t.id] = LockInfo(
+                            lid, info.rel, node.value.lineno, fac, lid)
+                    elif isinstance(node.value, ast.Call):
+                        d = _dotted(node.value.func)
+                        if d:
+                            info.module_var_types.setdefault(t.id, set()).add(d)
+            elif isinstance(node, ast.AnnAssign) and \
+                    isinstance(node.target, ast.Name):
+                fac = _lock_factory(node.value) if node.value is not None \
+                    else None
+                if fac:
+                    lid = f"{info.rel}::{node.target.id}"
+                    info.module_locks[node.target.id] = LockInfo(
+                        lid, info.rel, node.value.lineno, fac, lid)
+                else:
+                    info.module_var_types.setdefault(node.target.id, set()) \
+                        .update(_annotation_names(node.annotation))
+
+    def _collect_class(self, info: _FileInfo, ci: _ClassInfo) -> None:
+        # Pass 0: class-BODY locks (`class X: _lock = threading.Lock()`),
+        # in source order so a later Condition(_lock) in the body aliases.
+        for n in ci.node.body:
+            if isinstance(n, ast.Assign):
+                value, names = n.value, [t.id for t in n.targets
+                                         if isinstance(t, ast.Name)]
+            elif isinstance(n, ast.AnnAssign) and n.value is not None \
+                    and isinstance(n.target, ast.Name):
+                value, names = n.value, [n.target.id]
+            else:
+                continue
+            fac = _lock_factory(value)
+            if not fac or not names:
+                continue
+            canonical = None
+            if fac == "Condition" and isinstance(value, ast.Call) \
+                    and value.args and isinstance(value.args[0], ast.Name) \
+                    and value.args[0].id in ci.lock_attrs:
+                canonical = ci.lock_attrs[value.args[0].id].canonical
+            for name in names:
+                lid = f"{info.rel}::{ci.name}.{name}"
+                ci.lock_attrs[name] = LockInfo(
+                    lid, info.rel, value.lineno, fac, canonical or lid)
+        # Pass 1: lock attributes (Condition aliasing resolved in pass 2).
+        pending_cv: List[Tuple[str, ast.Call, int, str]] = []
+        for m in ci.methods.values():
+            sn = ci.self_name(m)
+            for node in ast.walk(m):
+                targets: List[Tuple[ast.AST, ast.AST, int]] = []
+                if isinstance(node, ast.Assign):
+                    targets = [(t, node.value, node.lineno)
+                               for t in node.targets]
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    targets = [(node.target, node.value, node.lineno)]
+                elif isinstance(node, ast.AnnAssign):
+                    # type-only declaration: record the annotation
+                    attr = _self_attr(node.target, sn)
+                    if attr:
+                        ci.attr_types.setdefault(attr, set()).update(
+                            _annotation_names(node.annotation))
+                    continue
+                for t, value, line in targets:
+                    attr = _self_attr(t, sn)
+                    if not attr:
+                        continue
+                    if isinstance(node, ast.AnnAssign):
+                        ci.attr_types.setdefault(attr, set()).update(
+                            _annotation_names(node.annotation))
+                    fac = _lock_factory(value)
+                    if fac == "Condition" and isinstance(value, ast.Call) \
+                            and value.args:
+                        pending_cv.append((attr, value, value.lineno, sn))
+                    elif fac:
+                        # Construction line = the threading CALL's line
+                        # (matches the dynamic profiler's frame line on
+                        # multi-line constructions).
+                        lid = f"{info.rel}::{ci.name}.{attr}"
+                        ci.lock_attrs[attr] = LockInfo(
+                            lid, info.rel, value.lineno, fac, lid)
+                    else:
+                        # Constructor calls anywhere in the value feed the
+                        # attr's candidate types (`A() if flag else B()`,
+                        # list/dict comprehensions of instances, ...).
+                        for n in ast.walk(value):
+                            if isinstance(n, ast.Call):
+                                d = _dotted(n.func)
+                                if d:
+                                    ci.attr_types.setdefault(
+                                        attr, set()).add(d)
+        # Pass 2: Condition(arg) aliasing — wrap of a known lock shares its
+        # canonical node; anything else (incl. Condition(threading.Lock()))
+        # is its own node.
+        for attr, call, line, sn in pending_cv:
+            arg = call.args[0]
+            canonical = f"{info.rel}::{ci.name}.{attr}"
+            wrapped = _self_attr(arg, sn)
+            if wrapped and wrapped in ci.lock_attrs:
+                canonical = ci.lock_attrs[wrapped].canonical
+            elif isinstance(arg, ast.Name) and arg.id in info.module_locks:
+                canonical = info.module_locks[arg.id].canonical
+            ci.lock_attrs[attr] = LockInfo(
+                f"{info.rel}::{ci.name}.{attr}", info.rel, line, "Condition",
+                canonical)
+
+    # -- finalize ----------------------------------------------------------
+
+    def finalize(self) -> None:
+        if self._finalized:
+            return
+        self._finalized = True
+        for lk in self._iter_locks():
+            self.locks[lk.id] = lk
+        for info in self.files.values():
+            for name, fn in info.functions.items():
+                self._walk_function(info, None, fn, name)
+            for ci in info.classes.values():
+                for mname, m in ci.methods.items():
+                    self._walk_function(info, ci, m, f"{ci.name}.{mname}")
+            self._walk_module_body(info)
+        self._propagate()
+
+    def _iter_locks(self) -> Iterable[LockInfo]:
+        for info in self.files.values():
+            yield from info.module_locks.values()
+            for ci in info.classes.values():
+                yield from ci.lock_attrs.values()
+
+    def catalog(self) -> Dict[Tuple[str, int], str]:
+        """(rel, construction line) → canonical lock id.
+
+        The dynamic profiler (:mod:`obs.lockprof`) names locks by caller
+        construction site; this map translates observed sites into the
+        static graph's nodes.  Local (function-scoped) locks are included
+        by the walk below via :attr:`locks` too.
+        """
+        return {(lk.rel, lk.line): lk.canonical for lk in self.locks.values()}
+
+    def cycles(self) -> List[List[Tuple[str, str, EdgeWitness]]]:
+        """Elementary cycles of the canonical lock graph.
+
+        Each cycle is ``[(src, dst, witness), ...]`` closing back on the
+        first src, rotated so the lexically-smallest node leads (stable
+        reporting).  Cycle count in this graph is tiny; a bounded DFS
+        enumeration is plenty.
+        """
+        adj: Dict[str, List[str]] = {}
+        for (a, b) in self.edges:
+            adj.setdefault(a, []).append(b)
+        for v in adj.values():
+            v.sort()
+        cycles: List[Tuple[str, ...]] = []
+        seen: Set[Tuple[str, ...]] = set()
+
+        def dfs(start: str, node: str, path: List[str]) -> None:
+            for nxt in adj.get(node, ()):
+                if nxt == start and len(path) >= 1:
+                    cyc = tuple(path)
+                    lo = cyc.index(min(cyc))
+                    key = cyc[lo:] + cyc[:lo]
+                    if key not in seen:
+                        seen.add(key)
+                        cycles.append(key)
+                elif nxt not in path and nxt > start and len(path) < 8:
+                    # only explore nodes > start: each cycle found once,
+                    # from its smallest node
+                    dfs(start, nxt, path + [nxt])
+
+        for start in sorted(adj):
+            dfs(start, start, [start])
+        out = []
+        for cyc in cycles:
+            steps = []
+            for i, a in enumerate(cyc):
+                b = cyc[(i + 1) % len(cyc)]
+                steps.append((a, b, self.edges[(a, b)]))
+            out.append(steps)
+        return out
+
+    # -- resolution helpers ------------------------------------------------
+
+    def _module_rel(self, dotted: str) -> Optional[str]:
+        cand = dotted.replace(".", "/")
+        for rel in (f"{cand}/__init__.py", f"{cand}.py"):
+            if rel in self.files:
+                return rel
+        return None
+
+    def _resolve_func(self, mod_rel: str, name: str, depth: int = 0
+                      ) -> Optional[Tuple[str, str, ast.AST]]:
+        """(rel, qualname, node) of a module-level function, following
+        re-export chains (``from x import f``) up to 3 hops."""
+        info = self.files.get(mod_rel)
+        if info is None or depth > 3:
+            return None
+        fn = info.functions.get(name)
+        if fn is not None:
+            return (mod_rel, name, fn)
+        chain = info.from_names.get(name)
+        if chain is not None:
+            target = self._module_rel(chain[0])
+            if target is not None:
+                return self._resolve_func(target, chain[1], depth + 1)
+        return None
+
+    def _class_named(self, name: str) -> List[_ClassInfo]:
+        return self._classes_by_name.get(name.rsplit(".", 1)[-1], [])
+
+    def _return_types(self, fn_node: ast.AST) -> Set[str]:
+        ret = getattr(fn_node, "returns", None)
+        return _annotation_names(ret) if ret is not None else set()
+
+    # -- the walk ----------------------------------------------------------
+
+    def _walk_module_body(self, info: _FileInfo) -> None:
+        stmts = [n for n in info.tree.body
+                 if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                       ast.ClassDef))]
+        if stmts:
+            _FunctionWalker(self, info, None, "<module>", None).walk_body(stmts)
+
+    def _walk_function(self, info: _FileInfo, ci: Optional[_ClassInfo],
+                       fn: ast.AST, qualname: str) -> None:
+        _FunctionWalker(self, info, ci, qualname, fn).walk()
+
+    # -- propagation (call-site lifting) -----------------------------------
+
+    def _propagate(self) -> None:
+        """Lift callee acquisitions/blockers to lock-holding call sites.
+
+        ``reach_acquire[fn]`` / ``reach_block[fn]`` are the locks /
+        blocking operations a call to ``fn`` can transitively reach
+        (fixed point over the call graph, chains capped for witnesses).
+        A call made while holding H then yields edges H → each reachable
+        lock and a blocking finding per reachable blocker, attributed at
+        the call site — the place the lock is actually held.
+        """
+        reach_acquire: Dict[Tuple[str, str], Dict[str, Tuple[str, ...]]] = {}
+        reach_block: Dict[Tuple[str, str], Dict[str, Tuple[str, ...]]] = {}
+        for key, s in self._summaries.items():
+            reach_acquire[key] = {lk: () for lk, _ in s.acquires}
+            reach_block[key] = {desc: () for desc, _, _ in s.blockers}
+        changed = True
+        while changed:
+            changed = False
+            for key, s in self._summaries.items():
+                ra, rb = reach_acquire[key], reach_block[key]
+                for callees, _held, line, label in s.calls:
+                    step = f"{label} ({key[0].rsplit('/', 1)[-1]}:{line})"
+                    for callee in callees:
+                        # Reachability always propagates; _MAX_CHAIN only
+                        # truncates the STORED witness chain (an edge deep
+                        # down a call chain is still an edge).
+                        for lk, chain in reach_acquire.get(callee, {}).items():
+                            if lk not in ra:
+                                ra[lk] = ((step,) + chain)[:_MAX_CHAIN]
+                                changed = True
+                        for desc, chain in reach_block.get(callee, {}).items():
+                            if desc not in rb:
+                                rb[desc] = ((step,) + chain)[:_MAX_CHAIN]
+                                changed = True
+        for key, s in self._summaries.items():
+            rel, qual = key
+            for callees, held, line, label in s.calls:
+                if not held:
+                    continue
+                # Edges lift from EVERY candidate callee (an ambiguous
+                # receiver must not hide an edge the runtime can take)...
+                for callee in callees:
+                    for lk, chain in reach_acquire.get(callee, {}).items():
+                        for h in held:
+                            if h != lk and (h, lk) not in self.edges:
+                                self.edges[(h, lk)] = EdgeWitness(
+                                    rel, line, qual,
+                                    (f"{label}()",) + chain)
+                # ...while blocking reports at most ONE finding per call
+                # site (a single fix resolves it, whatever the callee).
+                for callee in callees:
+                    blocked = reach_block.get(callee, {})
+                    if blocked:
+                        desc, chain = sorted(blocked.items())[0]
+                        via = " -> ".join((f"{label}()",) + chain)
+                        self.blocking.append(RawFinding(
+                            rel, line, qual.rsplit(".", 1)[-1],
+                            f"call under lock "
+                            f"{'/'.join(sorted(_short(h) for h in held))} "
+                            f"reaches blocking {desc} (via {via}) — move "
+                            f"the call outside the `with` block, or "
+                            f"allowlist with a reason if the lock exists "
+                            f"to serialize exactly this operation"))
+                        break
+
+
+def _self_attr(node: ast.AST, self_name: str) -> str:
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == self_name:
+        return node.attr
+    return ""
+
+
+class _FunctionWalker:
+    """Single lexical pass over one function: held-set tracking, local
+    type inference, event collection, and the purely-local findings
+    (kill-safety regions, cv-discipline)."""
+
+    def __init__(self, an: ConcurrencyAnalysis, info: _FileInfo,
+                 ci: Optional[_ClassInfo], qualname: str,
+                 fn: Optional[ast.AST]):
+        self.an = an
+        self.info = info
+        self.ci = ci
+        self.qualname = qualname
+        self.fn = fn
+        self.fname = qualname.rsplit(".", 1)[-1]
+        self.self_name = ci.self_name(fn) if ci is not None and fn is not None \
+            else "self"
+        self.summary = _FnSummary((info.rel, qualname))
+        an._summaries[(info.rel, qualname)] = self.summary
+        self.local_types: Dict[str, Set[str]] = {}
+        self.local_locks: Dict[str, LockInfo] = {}
+        self.cv_names: Set[str] = set()  # lock ids that are Conditions
+
+    # -- entry -------------------------------------------------------------
+
+    def walk(self) -> None:
+        self.walk_body(self.fn.body)
+
+    def walk_body(self, stmts: Sequence[ast.AST]) -> None:
+        nodes: List[ast.AST] = []
+        for s in stmts:
+            nodes.extend(ast.walk(s))
+        # Two passes: local types feed each other (`v = self.x; w = v.m()`),
+        # and source order does not always match data order.
+        self._pre_pass(nodes)
+        self._pre_pass(nodes)
+        self._stmts(list(stmts), frozenset(), in_while=False)
+
+    # -- local type / local lock pre-pass ----------------------------------
+
+    def _pre_pass(self, nodes: List[ast.AST]) -> None:
+        for node in nodes:
+            if isinstance(node, ast.Assign):
+                self._note_assign(node.targets, node.value, node.lineno)
+            elif isinstance(node, ast.AnnAssign) and \
+                    isinstance(node.target, ast.Name):
+                self.local_types.setdefault(node.target.id, set()).update(
+                    _annotation_names(node.annotation))
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                types = self._expr_types(node.iter)
+                for t in ast.walk(node.target):
+                    if isinstance(t, ast.Name):
+                        self.local_types.setdefault(t.id, set()).update(types)
+
+    def _note_assign(self, targets, value, line) -> None:
+        fac = _lock_factory(value)
+        names = [t.id for t in targets if isinstance(t, ast.Name)
+                 and t.id not in self.info.module_locks]
+        if fac and names:
+            for name in names:
+                lid = f"{self.info.rel}::{self.qualname}.{name}"
+                lk = LockInfo(lid, self.info.rel, value.lineno, fac, lid)
+                self.local_locks[name] = lk
+                self.an.locks[lid] = lk
+                if fac == "Condition":
+                    self.cv_names.add(lid)
+            return
+        types = self._expr_types(value)
+        if types:
+            for name in names:
+                self.local_types.setdefault(name, set()).update(types)
+
+    def _expr_types(self, expr: ast.AST) -> Set[str]:
+        """Candidate type names of an expression (union over sub-exprs)."""
+        out: Set[str] = set()
+        inner_selfs: Set[int] = set()  # Name nodes that are the `self` of a
+        #                                matched self-attr (not receivers)
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call):
+                d = _dotted(n.func)
+                if d is not None:
+                    if "." in d:
+                        mod, base = d.rsplit(".", 1)
+                        if (mod, base) in _SPECIAL_CTORS:
+                            out.add(_SPECIAL_CTORS[(mod, base)])
+                            continue
+                    else:
+                        base = d
+                    if base == "Future":
+                        out.add("Future")
+                    if self.an._class_named(base):
+                        out.add(base)
+                for _rel, _qual, fnode in self._resolve_call_target(n):
+                    out.update(self.an._return_types(fnode))
+            else:
+                attr = _self_attr(n, self.self_name)
+                if attr and self.ci is not None:
+                    out.update(self.ci.attr_types.get(attr, ()))
+                    base_node = n.value if isinstance(n, ast.Subscript) else n
+                    if isinstance(base_node, ast.Attribute):
+                        inner_selfs.add(id(base_node.value))
+                elif isinstance(n, ast.Name) and id(n) not in inner_selfs:
+                    if self.ci is not None and n.id == self.self_name \
+                            and expr is n:
+                        out.add(self.ci.name)  # a bare `self` receiver only
+                    out.update(self.local_types.get(n.id, ()))
+                    out.update(self.info.module_var_types.get(n.id, ()))
+        return {t for t in out if t not in ("None", "Optional", "List",
+                                            "Dict", "Tuple", "Set", "str",
+                                            "int", "float", "bool", "deque")}
+
+    # -- lock / cv resolution ----------------------------------------------
+
+    def _resolve_lock(self, expr: ast.AST) -> Optional[LockInfo]:
+        attr = _self_attr(expr, self.self_name)
+        if attr and self.ci is not None and attr in self.ci.lock_attrs:
+            return self.ci.lock_attrs[attr]
+        if isinstance(expr, ast.Name):
+            if expr.id in self.local_locks:
+                return self.local_locks[expr.id]
+            if expr.id in self.info.module_locks:
+                return self.info.module_locks[expr.id]
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name):
+            # Class-level lock accessed as `ClassName._lock`.
+            cls = self.info.classes.get(expr.value.id)
+            if cls is not None:
+                return cls.lock_attrs.get(expr.attr)
+        return None
+
+    def _is_condition(self, lk: LockInfo) -> bool:
+        return lk.kind == "Condition"
+
+    # -- statement walk ----------------------------------------------------
+
+    def _stmts(self, stmts: List[ast.AST], held: FrozenSet[str],
+               in_while: bool) -> None:
+        i = 0
+        while i < len(stmts):
+            st = stmts[i]
+            lk = self._manual_acquire(st)
+            if lk is not None:
+                nxt = stmts[i + 1] if i + 1 < len(stmts) else None
+                self._note_acquire(lk, held, st.lineno)
+                inner = held | {lk.canonical}
+                if isinstance(nxt, ast.Try) and \
+                        self._releases_in_finally(nxt, lk):
+                    # Handlers and else run BEFORE finally: the lock is
+                    # still held there.
+                    self._stmts(list(nxt.body), inner, in_while)
+                    for h in nxt.handlers:
+                        self._stmts(list(h.body), inner, in_while)
+                    self._stmts(list(nxt.orelse), inner, in_while)
+                    self._stmts(list(nxt.finalbody), held, in_while)
+                    i += 2
+                    continue
+                self.an.kill.append(RawFinding(
+                    self.info.rel, st.lineno, self.fname,
+                    f"manual {_short(lk.id)}.acquire() without an immediate "
+                    f"try/finally release — a ReplicaKilled/fault raised "
+                    f"before the release leaks the lock forever; use `with` "
+                    f"or wrap the guarded region in try/finally"))
+                self._stmts(stmts[i + 1:], inner, in_while)
+                return
+            rel_lk = self._manual_release(st)
+            if rel_lk is not None and rel_lk.canonical in held:
+                # An explicit .release() ends the held region for the
+                # rest of this statement list.
+                held = held - {rel_lk.canonical}
+                i += 1
+                continue
+            self._stmt(st, held, in_while)
+            i += 1
+
+    def _stmt(self, st: ast.AST, held: FrozenSet[str], in_while: bool) -> None:
+        cls = st.__class__
+        if cls in (ast.With, ast.AsyncWith):
+            inner = held
+            acquired: List[LockInfo] = []
+            for item in st.items:
+                self._exprs(item.context_expr, inner, in_while)
+                lk = self._resolve_lock(item.context_expr)
+                if lk is not None:
+                    self._note_acquire(lk, inner, item.context_expr.lineno)
+                    inner = inner | {lk.canonical}
+                    acquired.append(lk)
+            if acquired:
+                self._kill_scan(st, acquired)
+            self._stmts(list(st.body), inner, in_while)
+        elif cls is ast.While:
+            self._exprs(st.test, held, True)
+            self._stmts(list(st.body), held, True)
+            self._stmts(list(st.orelse), held, in_while)
+        elif cls in (ast.For, ast.AsyncFor):
+            self._exprs(st.iter, held, in_while)
+            self._stmts(list(st.body), held, in_while)
+            self._stmts(list(st.orelse), held, in_while)
+        elif cls is ast.If:
+            self._exprs(st.test, held, in_while)
+            self._stmts(list(st.body), held, in_while)
+            self._stmts(list(st.orelse), held, in_while)
+        elif cls is ast.Try:
+            self._stmts(list(st.body), held, in_while)
+            for h in st.handlers:
+                self._stmts(list(h.body), held, in_while)
+            self._stmts(list(st.orelse), held, in_while)
+            self._stmts(list(st.finalbody), held, in_while)
+        elif cls in (ast.FunctionDef, ast.AsyncFunctionDef):
+            # Nested def: keep the lexical held set (closures here are
+            # invoked synchronously by the enclosing method).
+            self._stmts(list(st.body), held, False)
+        elif cls is ast.ClassDef:
+            pass
+        else:
+            self._exprs(st, held, in_while)
+
+    def _manual_acquire(self, st: ast.AST) -> Optional[LockInfo]:
+        return self._lock_method_stmt(st, "acquire")
+
+    def _manual_release(self, st: ast.AST) -> Optional[LockInfo]:
+        return self._lock_method_stmt(st, "release")
+
+    def _lock_method_stmt(self, st: ast.AST, method: str
+                          ) -> Optional[LockInfo]:
+        if isinstance(st, ast.Expr) and isinstance(st.value, ast.Call):
+            f = st.value.func
+            if isinstance(f, ast.Attribute) and f.attr == method:
+                return self._resolve_lock(f.value)
+        return None
+
+    def _releases_in_finally(self, tr: ast.Try, lk: LockInfo) -> bool:
+        """The finally must release THE acquired lock — releasing some
+        other lock would mask the leak."""
+        for n in tr.finalbody:
+            if isinstance(n, ast.Expr) and isinstance(n.value, ast.Call):
+                f = n.value.func
+                if isinstance(f, ast.Attribute) and f.attr == "release":
+                    got = self._resolve_lock(f.value)
+                    if got is not None and got.canonical == lk.canonical:
+                        return True
+        return False
+
+    # -- expression walk ----------------------------------------------------
+
+    def _exprs(self, node: ast.AST, held: FrozenSet[str],
+               in_while: bool) -> None:
+        # Lambda bodies inside the expression keep the lexical held set
+        # (same policy as nested defs — invoked synchronously here).
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                self._call(n, held, in_while)
+
+    def _call(self, call: ast.Call, held: FrozenSet[str],
+              in_while: bool) -> None:
+        f = call.func
+        d = _dotted(f)
+        # Condition discipline -------------------------------------------------
+        if isinstance(f, ast.Attribute) and f.attr in ("wait", "notify",
+                                                       "notify_all"):
+            lk = self._resolve_lock(f.value)
+            if lk is not None and self._is_condition(lk):
+                self._cv_op(f.attr, lk, held, in_while, call.lineno)
+                return
+        # Blocking registry ----------------------------------------------------
+        desc = self._blocking_desc(call, d)
+        if desc is not None:
+            self.summary.blockers.append((desc, held, call.lineno))
+            if held:
+                self.an.blocking.append(RawFinding(
+                    self.info.rel, call.lineno, self.fname,
+                    f"blocking {desc} while holding "
+                    f"{'/'.join(sorted(_short(h) for h in held))} — every "
+                    f"thread contending for the lock stalls behind it; "
+                    f"move it outside the `with` block"))
+            return
+        # Call-graph edge ------------------------------------------------------
+        callees = self._resolve_call_target(call)
+        if callees:
+            keys = tuple((rel, qual) for rel, qual, _ in callees)
+            label = d or (f.attr if isinstance(f, ast.Attribute) else "?")
+            self.summary.calls.append((keys, held, call.lineno, label))
+
+    def _blocking_desc(self, call: ast.Call, d: Optional[str]
+                       ) -> Optional[str]:
+        if d == "open" or (d is not None and d.endswith(".open")):
+            return "open()"
+        if d is not None and "." in d:
+            mod, attr = d.rsplit(".", 1)
+            if (mod, attr) in BLOCKING_DOTTED:
+                return f"{d}()"
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in BLOCKING_ATTRS:
+                return f".{f.attr}()"
+            rtypes = self._expr_types(f.value)
+            for tname, methods in BLOCKING_TYPED.items():
+                if tname in rtypes and f.attr in methods:
+                    return f"{tname}.{f.attr}()"
+        return None
+
+    def _resolve_call_target(self, call: ast.Call
+                             ) -> List[Tuple[str, str, ast.AST]]:
+        f = call.func
+        out: List[Tuple[str, str, ast.AST]] = []
+        if isinstance(f, ast.Name):
+            # bare call: same-module function, from-import, or class ctor
+            fn = self.info.functions.get(f.id)
+            if fn is not None:
+                return [(self.info.rel, f.id, fn)]
+            chain = self.info.from_names.get(f.id)
+            if chain is not None:
+                mod = self.an._module_rel(chain[0])
+                if mod is not None:
+                    got = self.an._resolve_func(mod, chain[1])
+                    if got is not None:
+                        return [got]
+            for ci in self.an._class_named(f.id):
+                init = ci.methods.get("__init__")
+                if init is not None:
+                    out.append((ci.rel, f"{ci.name}.__init__", init))
+            if self.ci is not None:
+                # same-class ctor/class reference
+                pass
+            return out
+        if not isinstance(f, ast.Attribute):
+            return out
+        recv = f.value
+        # module-attribute call: alias.func(...)
+        rd = _dotted(recv)
+        if rd is not None:
+            mod_dotted = self.info.mod_aliases.get(rd)
+            if mod_dotted is None and rd in self.info.from_names:
+                base, name = self.info.from_names[rd]
+                mod_dotted = f"{base}.{name}"
+            if mod_dotted is not None:
+                mod = self.an._module_rel(mod_dotted)
+                if mod is not None:
+                    got = self.an._resolve_func(mod, f.attr)
+                    if got is not None:
+                        return [got]
+                    # class method through a module alias: mod.Class? rare
+        # typed method call
+        rtypes = self._expr_types(recv)
+        for tname in sorted(rtypes):
+            for ci in self.an._class_named(tname):
+                m = ci.methods.get(f.attr)
+                if m is not None:
+                    out.append((ci.rel, f"{ci.name}.{f.attr}", m))
+        return out
+
+    # -- events ------------------------------------------------------------
+
+    def _note_acquire(self, lk: LockInfo, held: FrozenSet[str],
+                      line: int) -> None:
+        self.summary.acquires.append((lk.canonical, line))
+        for h in held:
+            if h != lk.canonical and (h, lk.canonical) not in self.an.edges:
+                self.an.edges[(h, lk.canonical)] = EdgeWitness(
+                    self.info.rel, line, self.qualname)
+
+    def _cv_op(self, op: str, lk: LockInfo, held: FrozenSet[str],
+               in_while: bool, line: int) -> None:
+        name = _short(lk.id)
+        if lk.canonical not in held:
+            self.an.cv.append(RawFinding(
+                self.info.rel, line, self.fname,
+                f"{name}.{op}() without holding the condition — "
+                f"{'wait' if op == 'wait' else 'notify'} requires the lock "
+                f"(RuntimeError at runtime); take `with {name}:` first"))
+            return
+        others = held - {lk.canonical}
+        if op == "wait" and others:
+            self.an.blocking.append(RawFinding(
+                self.info.rel, line, self.fname,
+                f"{name}.wait() releases only its own lock — "
+                f"{'/'.join(sorted(_short(h) for h in others))} stays held "
+                f"for the whole sleep (classic nested-cv deadlock shape); "
+                f"restructure so the wait holds one lock"))
+        if op == "wait" and not in_while:
+            self.an.cv.append(RawFinding(
+                self.info.rel, line, self.fname,
+                f"{name}.wait() outside a while-predicate loop — spurious "
+                f"wakeups and an ignored wait(timeout) return value make "
+                f"the guarded predicate unchecked; use `while not <pred>: "
+                f"{name}.wait(...)`"))
+
+    # -- kill-safety region scan -------------------------------------------
+
+    def _kill_scan(self, with_node: ast.AST, acquired: List[LockInfo]) -> None:
+        """Torn-state hazard inside one `with <lock>` region: ≥2 guarded
+        mutations with a yield point (faults.check / raise ReplicaKilled)
+        between them — the kill releases the lock (with = try/finally)
+        with the invariant half-published."""
+        events: List[Tuple[int, str]] = []  # (line, 'mut'|'yield')
+        # Manual stack walk so nested def/lambda bodies are PRUNED (their
+        # mutations run at call time, not inside this locked region).
+        stack: List[ast.AST] = [with_node]
+        region: List[ast.AST] = []
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not with_node:
+                continue
+            region.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+        for node in region:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if _self_attr(t, self.self_name):
+                        events.append((node.lineno, "mut"))
+                        break
+            elif isinstance(node, ast.AugAssign):
+                if _self_attr(node.target, self.self_name):
+                    events.append((node.lineno, "mut"))
+            elif isinstance(node, ast.Raise):
+                d = _dotted(node.exc.func) if isinstance(node.exc, ast.Call) \
+                    else (_dotted(node.exc) if node.exc is not None else None)
+                if d is not None and d.rsplit(".", 1)[-1] == "ReplicaKilled":
+                    events.append((node.lineno, "yield"))
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr == "check" \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id in _FAULTS_ALIASES:
+                    events.append((node.lineno, "yield"))
+        events.sort()
+        muts = [ln for ln, k in events if k == "mut"]
+        if len(muts) < 2:
+            return
+        for ln, k in events:
+            if k != "yield":
+                continue
+            before = sum(1 for m in muts if m < ln)
+            after = sum(1 for m in muts if m > ln)
+            if before >= 1 and after >= 1:
+                names = "/".join(sorted(_short(lk.id) for lk in acquired))
+                self.an.kill.append(RawFinding(
+                    self.info.rel, ln, self.fname,
+                    f"kill/yield point between {before + after} mutations "
+                    f"of state guarded by {names} — a ReplicaKilled or "
+                    f"injected fault here releases the lock with the "
+                    f"invariant half-published (torn state read by "
+                    f"failover); make the region one mutation or move the "
+                    f"yield point out"))
+                return
+
+
+# ---------------------------------------------------------------------------
+# Standalone builders (lockprof checker, chaos harness, tests)
+# ---------------------------------------------------------------------------
+
+
+def build_analysis(files: Iterable[Tuple[str, str]]) -> ConcurrencyAnalysis:
+    """Analysis over explicit ``(abs_path, repo_relative)`` pairs."""
+    an = ConcurrencyAnalysis()
+    for path, rel in files:
+        with open(path) as fp:
+            src = fp.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue
+        an.add_file(rel, tree)
+    an.finalize()
+    return an
+
+
+def build_repo_analysis(root: Optional[str] = None) -> ConcurrencyAnalysis:
+    """Whole-repo analysis over ``fairify_tpu/`` (the lockprof ground truth)."""
+    from fairify_tpu.lint.core import iter_py_files, repo_root
+
+    return build_analysis(iter_py_files(root or repo_root()))

@@ -11,8 +11,8 @@ Public surface::
     result = lint.run_lint()          # LintResult over the whole repo
     rc = lint.main(["--format", "json"])   # the CLI entry
 
-``scripts/lint_obs.py`` remains as a deprecated compatibility shim over
-the five legacy rules.
+(The PR 6 migration shim ``scripts/lint_obs.py`` is gone; this engine is
+the only lint entry point.)
 """
 from fairify_tpu.lint.core import (  # noqa: F401
     BASELINE_REL,

@@ -344,6 +344,19 @@ def iter_py_files(root: str, subdir: str = "fairify_tpu"
                 yield path, os.path.relpath(path, root).replace(os.sep, "/")
 
 
+def default_files(root: str) -> List[Tuple[str, str]]:
+    """The default whole-repo file set: ``fairify_tpu/`` plus ``scripts/``.
+
+    Scripts are walked so cross-file rules can see the harness side of a
+    contract (``chaos-coverage`` reads scripts/chaos_matrix.py); rules
+    scoped to ``fairify_tpu/`` simply skip them via :meth:`Rule.applies`.
+    """
+    files = list(iter_py_files(root))
+    if os.path.isdir(os.path.join(root, "scripts")):
+        files += list(iter_py_files(root, "scripts"))
+    return files
+
+
 def run_lint(root: Optional[str] = None,
              rules: Optional[Sequence[Rule]] = None,
              files: Optional[Sequence[Tuple[str, str]]] = None,
@@ -363,7 +376,7 @@ def run_lint(root: Optional[str] = None,
     if root is None:
         root = repo_root()
     if files is None:
-        files = list(iter_py_files(root))
+        files = default_files(root)
 
     result = LintResult(rules=[r.id for r in rules])
     contexts: Dict[str, FileContext] = {}

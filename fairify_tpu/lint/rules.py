@@ -1,4 +1,4 @@
-"""The shipped rule set: nine rules, five migrated + four new.
+"""The shipped rule set: fourteen rules.
 
 Rule ids are stable API — inline suppressions, allowlists, and the
 committed baseline all key on them:
@@ -9,26 +9,40 @@ id                          guards
 ``obs-time-time``           wall-clock timing outside PhaseTimer/obs spans
 ``obs-print``               progress/diagnostics bypassing the heartbeat
 ``obs-raw-jit``             device kernels not registered through obs_jit
-``obs-broad-except``        swallowed faults the resilience layer never saw
+``obs-broad-except``        swallowed faults the resilience layer never
+                            saw; BaseException handlers that could eat a
+                            kill/interrupt
 ``obs-loop-fetch``          sync device fetches stalling the launch queue
 ``jit-purity``              trace-time side effects inside jitted bodies
 ``recompile-hazard``        static-arg/signature churn → silent recompiles
 ``lock-discipline``         lock-protected attrs accessed without the lock
 ``fault-site-coverage``     chaos sites drifting from their call sites
+``chaos-coverage``          registered sites drifting from the chaos
+                            matrix (scripts/chaos_matrix.py cells)
+``lock-order``              cycles in the whole-program lock graph
+``blocking-under-lock``     blocking calls reached while a lock is held
+``kill-safety``             torn-state hazards around kill/yield points
+``cv-discipline``           Condition wait/notify misuse
 ==========================  ================================================
+
+The last four share one whole-program analysis per run
+(:mod:`fairify_tpu.analysis.locks` via ``rules_concurrency``), which is
+also the static ground truth the dynamic lockprof cross-check
+(:mod:`fairify_tpu.obs.lockprof`) verifies observed edges against.
 
 To add a rule: subclass :class:`fairify_tpu.lint.core.Rule` in a
 ``rules_*`` module, give it a stable id/scope/description, add it to
 :func:`all_rules`, and ship ≥1 positive and ≥1 negative fixture under
 ``tests/lint_fixtures/<rule-id>/`` — ``tests/test_lint.py``'s meta-test
-fails otherwise.  See DESIGN.md §11.
+fails otherwise.  See DESIGN.md §11 and §16.
 """
 from __future__ import annotations
 
 from typing import List
 
 from fairify_tpu.lint.core import Rule
-from fairify_tpu.lint.rules_faults import FaultSiteRule
+from fairify_tpu.lint.rules_concurrency import concurrency_rules
+from fairify_tpu.lint.rules_faults import ChaosCoverageRule, FaultSiteRule
 from fairify_tpu.lint.rules_jit import JitPurityRule, RecompileHazardRule
 from fairify_tpu.lint.rules_locks import LockDisciplineRule
 from fairify_tpu.lint.rules_obs import (
@@ -44,7 +58,8 @@ LEGACY_RULE_IDS = ("obs-time-time", "obs-print", "obs-raw-jit",
 
 
 def legacy_rules() -> List[Rule]:
-    """The five rules ``scripts/lint_obs.py`` shipped (shim surface)."""
+    """The five original observability rules (PR 1–4 era), kept as a
+    named subset for targeted runs."""
     return [TimeTimeRule(), PrintRule(), RawJitRule(), BroadExceptRule(),
             LoopFetchRule()]
 
@@ -53,4 +68,5 @@ def all_rules() -> List[Rule]:
     """Fresh instances of every shipped rule (engine runs are stateful —
     cross-file rules accumulate during check and report in finalize)."""
     return legacy_rules() + [JitPurityRule(), RecompileHazardRule(),
-                             LockDisciplineRule(), FaultSiteRule()]
+                             LockDisciplineRule(), FaultSiteRule(),
+                             ChaosCoverageRule()] + concurrency_rules()

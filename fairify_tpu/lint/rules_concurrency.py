@@ -1,0 +1,150 @@
+"""The four concurrency rules over the whole-program lock graph.
+
+All four ride ONE shared :class:`fairify_tpu.analysis.locks.
+ConcurrencyAnalysis` instance per engine run (``concurrency_rules()``
+wires the sharing): every rule's ``check()`` feeds the file into the
+analysis once, and ``finalize()`` triggers the single global pass —
+graph construction, call-site propagation, cycle detection — then each
+rule reports its own finding kind.  The same graph is the ground truth
+for the dynamic cross-check (:mod:`fairify_tpu.obs.lockprof`).
+
+==========================  ================================================
+id                          guards
+==========================  ================================================
+``lock-order``              a cycle in the global acquisition graph —
+                            two threads taking the locks in opposite
+                            order deadlock; the finding message carries
+                            the full witness path
+``blocking-under-lock``     a reviewed registry of blocking calls
+                            (sleep/subprocess/device fetch/file I/O/
+                            ``Thread.join``/``Future.result``/…) reached
+                            while a lock is held, including through
+                            call chains — flagged at the call site where
+                            the lock is actually held
+``kill-safety``             a ``with <lock>`` region with ≥2 guarded
+                            mutations around a kill/yield point
+                            (``faults.check`` / ``raise ReplicaKilled``)
+                            — the kill releases the lock with the
+                            invariant half-published; plus manual
+                            ``.acquire()`` without try/finally
+``cv-discipline``           ``Condition.wait`` outside a while-predicate
+                            loop, wait/notify without holding
+==========================  ================================================
+
+Allowlist policy is the §11 workflow (fix > suppress > allowlist >
+baseline).  The entries below are the reviewed cases where a lock exists
+*precisely to serialize* the flagged blocking operation — removing the
+lock or moving the operation would break the contract the lock
+implements, so the finding is by-design.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List
+
+from fairify_tpu.analysis.locks import ConcurrencyAnalysis, RawFinding, _short
+from fairify_tpu.lint.core import FileContext, Finding, Rule
+
+#: Reviewed ``file::function`` sites where the held lock's purpose IS to
+#: serialize the flagged blocking operation.  Shrink, don't grow.
+ALLOW_BLOCKING_UNDER_LOCK = frozenset({
+    # Crash-safe JSONL appends: the writer lock exists to serialize
+    # append+fsync so records never interleave — fsync under the lock is
+    # the contract, not an accident (DESIGN.md §10).
+    "fairify_tpu/resilience/journal.py::_append_once",
+    # Obs event log: same single-writer append discipline; runs with
+    # fsync=False (flush only), invisible to a lexical analysis.
+    "fairify_tpu/obs/trace.py::_write",
+    # One-time double-checked native-library build: the module lock
+    # exists to serialize the g++ build + dlopen across threads; after
+    # `_tried` flips the lock is held for a dict read only.
+    "fairify_tpu/ops/exact_native.py::_load",
+})
+
+ALLOW_LOCK_ORDER: frozenset = frozenset()
+ALLOW_KILL_SAFETY: frozenset = frozenset()
+ALLOW_CV_DISCIPLINE: frozenset = frozenset()
+
+
+class _ConcurrencyRule(Rule):
+    """Base: feed files into the shared analysis, report one finding kind."""
+
+    def __init__(self, shared: ConcurrencyAnalysis):
+        self._shared = shared
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        self._shared.add_file(ctx.rel, ctx.tree)
+        return ()
+
+    def finalize(self, files: Dict[str, FileContext]) -> Iterable[Finding]:
+        self._shared.finalize()
+        for raw in self._raw_findings():
+            if self.allowed(raw.rel, raw.function):
+                continue
+            yield Finding(rule=self.id, path=raw.rel, line=raw.line,
+                          function=raw.function, message=raw.message,
+                          severity=self.severity)
+
+    def _raw_findings(self) -> List[RawFinding]:
+        return []
+
+
+class LockOrderRule(_ConcurrencyRule):
+    id = "lock-order"
+    description = ("cycle in the whole-program lock-acquisition graph — "
+                   "threads taking the locks in opposite order deadlock; "
+                   "establish one global order (DESIGN.md §16)")
+    allowlist = ALLOW_LOCK_ORDER
+
+    def _raw_findings(self) -> List[RawFinding]:
+        out: List[RawFinding] = []
+        for cycle in self._shared.cycles():
+            path = " -> ".join(
+                f"{_short(dst)} ({w.render()})" for _src, dst, w in cycle)
+            src0, _dst0, w0 = cycle[0]
+            out.append(RawFinding(
+                w0.rel, w0.line, w0.function.rsplit(".", 1)[-1],
+                f"lock-order cycle: {_short(src0)} -> {path} — potential "
+                f"deadlock; acquire these locks in one global order "
+                f"everywhere (lock catalog: DESIGN.md §16)"))
+        return out
+
+
+class BlockingUnderLockRule(_ConcurrencyRule):
+    id = "blocking-under-lock"
+    description = ("registry-listed blocking call (sleep/subprocess/device "
+                   "fetch/file I/O/join/result) reached while a lock is "
+                   "held, directly or through calls")
+    allowlist = ALLOW_BLOCKING_UNDER_LOCK
+
+    def _raw_findings(self) -> List[RawFinding]:
+        return self._shared.blocking
+
+
+class KillSafetyRule(_ConcurrencyRule):
+    id = "kill-safety"
+    description = ("lock-guarded region unsafe under ReplicaKilled/fault "
+                   "injection: >=2 guarded mutations around a yield point "
+                   "(torn state), or manual acquire without try/finally")
+    allowlist = ALLOW_KILL_SAFETY
+
+    def _raw_findings(self) -> List[RawFinding]:
+        return self._shared.kill
+
+
+class CvDisciplineRule(_ConcurrencyRule):
+    id = "cv-discipline"
+    description = ("Condition misuse: wait outside a while-predicate loop "
+                   "(spurious wakeups, ignored wait(timeout) return), or "
+                   "wait/notify without holding the condition")
+    allowlist = ALLOW_CV_DISCIPLINE
+
+    def _raw_findings(self) -> List[RawFinding]:
+        return self._shared.cv
+
+
+def concurrency_rules() -> List[Rule]:
+    """Fresh instances of the four rules sharing ONE analysis, so the
+    whole-program walk runs once per engine run."""
+    shared = ConcurrencyAnalysis()
+    return [LockOrderRule(shared), BlockingUnderLockRule(shared),
+            KillSafetyRule(shared), CvDisciplineRule(shared)]

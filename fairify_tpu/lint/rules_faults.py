@@ -23,12 +23,17 @@ metadata, not injection sites, and are ignored.
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterable, List, Optional, Tuple
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from fairify_tpu.lint.core import FileContext, Finding, Rule
 
 #: The registry module, repo-relative (where FAULT_SITES is declared).
 FAULTS_REL = "fairify_tpu/resilience/faults.py"
+
+#: The chaos driver, repo-relative (the default lint walk includes
+#: ``scripts/`` precisely so the coverage rule can see it).
+CHAOS_REL = "scripts/chaos_matrix.py"
 
 _CHECK_ALIASES = frozenset({"faults", "faults_mod"})
 
@@ -124,3 +129,118 @@ class FaultSiteRule(Rule):
                          f"it is silently disabled; call faults.check"
                          f"({site!r}) at the site or retire the entry"),
                 severity=self.severity)
+
+
+# ---------------------------------------------------------------------------
+# Chaos-matrix coverage: the registry and the chaos driver never drift
+# ---------------------------------------------------------------------------
+
+#: Sites reviewed as covered OUTSIDE scripts/chaos_matrix.py.  Every entry
+#: needs the test/driver that actually exercises it; a stale entry (site
+#: retired, or a chaos cell later added) is itself a finding.
+CHAOS_EXEMPT = {
+    # decide_box_smt needs z3-solver, absent from the chaos image; the
+    # z3-gated tests in tests/test_resilience.py cover the site.
+    "smt.query": "z3-gated tests in tests/test_resilience.py",
+    # Sharded-runtime dispatch/gather faults are exercised by the sharded
+    # chaos tests in tests/test_resilience.py (sharded-vs-plain
+    # bit-equality, interleaved shard journals); the matrix covers the
+    # user-visible shard fault surface via its device.lost cells.
+    "shard.dispatch": "sharded chaos tests in tests/test_resilience.py",
+    "shard.gather": "sharded chaos tests in tests/test_resilience.py",
+}
+
+#: A full injection spec literal: site:kind:nth (kind vocabulary pinned so
+#: arbitrary colon-bearing strings never match).
+_SPEC_RE = re.compile(r"^([a-z][a-z._]*):(transient|fatal|crash)\b")
+#: An f-string site fragment: the literal head of f"{site}:..." style specs.
+_FRAG_RE = re.compile(r"^([a-z][a-z._]*):")
+
+
+def _chaos_sites(tree: ast.AST, known: frozenset
+                 ) -> Tuple[Set[str], List[Tuple[str, int]]]:
+    """(covered sites, [(unknown spec site, line)]) from the chaos driver.
+
+    Coverage counts (a) full ``site:kind:nth`` string literals, (b) the
+    literal head fragment of an f-string spec (``f"device.lost:{kind}:…"``),
+    and (c) bare site-name literals (the site lists the SMT section loops
+    over).  A full spec naming an unregistered site is reported — the
+    driver would crash or silently no-op on it.
+    """
+    covered: Set[str] = set()
+    unknown: List[Tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Constant) and isinstance(node.value, str)):
+            continue
+        text = node.value
+        if text in known:
+            covered.add(text)
+            continue
+        m = _SPEC_RE.match(text)
+        if m:
+            if m.group(1) in known:
+                covered.add(m.group(1))
+            else:
+                unknown.append((m.group(1), node.lineno))
+            continue
+        m = _FRAG_RE.match(text)
+        if m and m.group(1) in known:
+            covered.add(m.group(1))
+    return covered, unknown
+
+
+class ChaosCoverageRule(Rule):
+    id = "chaos-coverage"
+    description = ("every registered fault site needs >=1 chaos-matrix "
+                   "cell (a literal spec in scripts/chaos_matrix.py) or a "
+                   "documented CHAOS_EXEMPT entry")
+    scope = (FAULTS_REL, CHAOS_REL)
+
+    def finalize(self, files: Dict[str, FileContext]) -> Iterable[Finding]:
+        reg = files.get(FAULTS_REL)
+        chaos = files.get(CHAOS_REL)
+        decl = _fault_sites_decl(reg.tree) if reg is not None else None
+        if decl is None or chaos is None:
+            # Partial runs/fixture sets without both halves: nothing to
+            # validate against.
+            return
+        sites, decl_line = decl
+        covered, unknown = _chaos_sites(chaos.tree, sites)
+        for site, line in unknown:
+            yield Finding(
+                rule=self.id, path=CHAOS_REL, line=line,
+                function="<module>",
+                message=(f"chaos cell references unknown fault site "
+                         f"{site!r} — not in resilience.faults.FAULT_SITES; "
+                         f"the spec is rejected at arm time and the cell "
+                         f"can never fire"), severity=self.severity)
+        for site in sorted(sites):
+            if site in covered:
+                continue
+            if site in CHAOS_EXEMPT:
+                continue
+            yield Finding(
+                rule=self.id, path=FAULTS_REL, line=decl_line,
+                function="<module>",
+                message=(f"registered fault site {site!r} has no "
+                         f"scripts/chaos_matrix.py cell and no CHAOS_EXEMPT "
+                         f"entry — the registry and the chaos matrix have "
+                         f"drifted; add a cell or document the exemption "
+                         f"with the test that covers it"),
+                severity=self.severity)
+        for site, why in sorted(CHAOS_EXEMPT.items()):
+            if site not in sites:
+                yield Finding(
+                    rule=self.id, path=FAULTS_REL, line=decl_line,
+                    function="<module>",
+                    message=(f"stale CHAOS_EXEMPT entry {site!r} ({why}) — "
+                             f"the site is no longer registered; drop the "
+                             f"exemption"), severity=self.severity)
+            elif site in covered:
+                yield Finding(
+                    rule=self.id, path=FAULTS_REL, line=decl_line,
+                    function="<module>",
+                    message=(f"stale CHAOS_EXEMPT entry {site!r} ({why}) — "
+                             f"scripts/chaos_matrix.py now has a cell for "
+                             f"it; drop the exemption"),
+                    severity=self.severity)

@@ -1,7 +1,7 @@
-"""The five observability rules migrated from ``scripts/lint_obs.py``.
+"""The five core observability rules (migrated from the pre-PR-6
+``scripts/lint_obs.py`` script, which has since been removed).
 
-Semantics are unchanged from the script (same scopes, same allowlists,
-same hints) with two attribution bugs fixed during migration:
+Two attribution bugs were fixed during the migration:
 
 * the hot-loop fetch rule no longer flags fetches in a ``for``/``while``
   **``else:``** clause or in a ``for``'s iterable expression — both run
@@ -12,8 +12,11 @@ same hints) with two attribution bugs fixed during migration:
   the class name instead of silently inheriting the enclosing
   ``<module>``/function allowlist key.
 
-``scripts/lint_obs.py`` remains as a thin compatibility shim over these
-rule objects (deprecated — new call sites should run ``fairify_tpu lint``).
+The broad-except rule has since grown a stricter tier: handlers catching
+``BaseException`` (or bare ``except:``) must guarantee that
+propagate-class errors — ``KeyboardInterrupt``/``SystemExit``/
+``ReplicaKilled`` — escape, via an unconditional re-raise or the
+``classify(exc) == "propagate"`` guard (DESIGN.md §16).
 """
 from __future__ import annotations
 
@@ -89,9 +92,6 @@ ALLOW_LOOP_FETCH = frozenset({
 })
 
 ALLOW_BROAD_EXCEPT = frozenset({
-    # Import gate: jax.api_util.shaped_abstractify rename degrades to
-    # conservative fallback cache keys, never an import error.
-    "fairify_tpu/obs/compile.py::<module>",
     # Compile fallbacks: an unusable AOT path serves the kernel via plain
     # jax.jit (counted in xla_compile_fallbacks) — observability must
     # never change results or availability.  (_compile's handler re-raises
@@ -131,6 +131,13 @@ _BROAD_HINT = (
     "classify via fairify_tpu.resilience.supervisor.classify and degrade "
     "with a recorded reason, or extend ALLOW_BROAD_EXCEPT with a reviewed "
     "reason")
+
+_BASE_HINT = (
+    "BaseException handler without the propagate re-raise pattern — "
+    "KeyboardInterrupt/SystemExit/ReplicaKilled must escape: re-raise "
+    "unconditionally, or guard with `if classify(exc) == \"propagate\": "
+    "raise` (resilience.supervisor.classify), or extend "
+    "ALLOW_BROAD_EXCEPT with a reviewed reason")
 
 
 # ---------------------------------------------------------------------------
@@ -177,6 +184,58 @@ def _is_broad_type(node) -> bool:
         return any(_is_broad_type(el) for el in node.elts)
     return isinstance(node, ast.Name) and node.id in ("Exception",
                                                       "BaseException")
+
+
+def _is_base_type(node) -> bool:
+    """Catches BaseException (or is bare) — the handlers that can eat a
+    KeyboardInterrupt/SystemExit/ReplicaKilled."""
+    if node is None:
+        return True
+    if isinstance(node, ast.Tuple):
+        return any(_is_base_type(el) for el in node.elts)
+    return isinstance(node, ast.Name) and node.id == "BaseException"
+
+
+def _guard_mentions_propagate(test: ast.AST) -> bool:
+    """Does a guard POSITIVELY test the propagate class — ``classify(...)
+    == 'propagate'`` (Eq, not NotEq) or ``isinstance(exc,
+    KeyboardInterrupt/SystemExit/ReplicaKilled/PROPAGATE)`` not under a
+    ``not``?  Polarity matters: ``!= "propagate"`` / ``not isinstance``
+    guards select the NON-propagate class, so a raise in their body says
+    nothing about kills escaping."""
+    negated = {id(sub) for n in ast.walk(test)
+               if isinstance(n, ast.UnaryOp) and isinstance(n.op, ast.Not)
+               for sub in ast.walk(n.operand)}
+    for n in ast.walk(test):
+        if id(n) in negated:
+            continue
+        if isinstance(n, ast.Compare) \
+                and all(isinstance(op, ast.Eq) for op in n.ops) \
+                and any(isinstance(c, ast.Constant) and c.value == "propagate"
+                        for c in ast.walk(n)):
+            return True
+        name = n.id if isinstance(n, ast.Name) else \
+            (n.attr if isinstance(n, ast.Attribute) else None)
+        if name in ("KeyboardInterrupt", "SystemExit", "ReplicaKilled",
+                    "PROPAGATE"):
+            return True
+    return False
+
+
+def _reraises_propagate(handler: ast.ExceptHandler) -> bool:
+    """Does this handler guarantee propagate-class errors escape
+    UNCHANGED?  Either a bare ``raise`` directly in its body, or a
+    positively propagate-guarded ``if`` whose body bare-raises — a
+    ``raise Other(...) from exc`` converts the kill and does not count."""
+    for st in handler.body:
+        if isinstance(st, ast.Raise) and st.exc is None:
+            return True
+    for node in ast.walk(handler):
+        if isinstance(node, ast.If) and _guard_mentions_propagate(node.test) \
+                and any(isinstance(n, ast.Raise) and n.exc is None
+                        for st in node.body for n in ast.walk(st)):
+            return True
+    return False
 
 
 # ---------------------------------------------------------------------------
@@ -246,18 +305,25 @@ class RawJitRule(Rule):
 class BroadExceptRule(Rule):
     id = "obs-broad-except"
     description = ("broad except that never re-raises banned in "
-                   "fairify_tpu/ — faults must be classified and degraded "
-                   "with a recorded reason")
+                   "fairify_tpu/; BaseException handlers must use the "
+                   "propagate re-raise pattern (interrupts and kills "
+                   "always escape)")
     allowlist = ALLOW_BROAD_EXCEPT
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
         for node, fn, _loop, _t in ctx.attributed():
-            if isinstance(node, ast.ExceptHandler) \
-                    and _is_broad_type(node.type) \
+            if not isinstance(node, ast.ExceptHandler) \
+                    or self.allowed(ctx.rel, fn):
+                continue
+            if _is_broad_type(node.type) \
                     and not any(isinstance(n, ast.Raise)
-                                for n in ast.walk(node)) \
-                    and not self.allowed(ctx.rel, fn):
+                                for n in ast.walk(node)):
                 yield self.finding(ctx, node.lineno, _BROAD_HINT, function=fn)
+            elif _is_base_type(node.type) and not _reraises_propagate(node):
+                # Stricter bar for handlers that can eat an interrupt or
+                # a replica kill: a raise somewhere is not enough — the
+                # propagate class specifically must escape.
+                yield self.finding(ctx, node.lineno, _BASE_HINT, function=fn)
 
 
 class LoopFetchRule(Rule):

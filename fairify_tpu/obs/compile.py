@@ -87,7 +87,7 @@ from fairify_tpu.obs import trace as trace_mod
 
 try:  # public since jax 0.4.x; guarded so a rename degrades to fallback keys
     from jax.api_util import shaped_abstractify as _abstractify
-except Exception:  # pragma: no cover - version drift
+except (ImportError, AttributeError):  # pragma: no cover - version drift
     _abstractify = None
 
 # Sentinel: this signature's AOT path failed — serve it via plain jax.jit.

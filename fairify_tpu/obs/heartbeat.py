@@ -18,7 +18,7 @@ partitions — the whole-run mean lies by design on budgeted sweeps, where
 the stage-0 burst (thousands of partitions per launch) is followed by the
 BaB tail (seconds per partition): a mean-based ETA then promises minutes
 while hours remain.  This module is the obs layer's sanctioned progress
-``print`` (see ``scripts/lint_obs.py``).
+``print`` (the ``obs-print`` lint rule allowlists it).
 """
 from __future__ import annotations
 

@@ -1,0 +1,351 @@
+"""Opt-in dynamic lock-acquisition profiler — the static auditor's
+cross-check.
+
+:mod:`fairify_tpu.analysis.locks` claims a whole-program lock graph; a
+static claim is only as good as its blind spots.  This module records the
+acquisition-order edges that *actually happen* at runtime and checks them
+against the static graph:
+
+* **observed ⊆ static** — every runtime edge between locks constructed
+  in ``fairify_tpu/`` must exist in the static graph.  An unmodeled edge
+  is a bug in the ANALYSIS (a resolution the lexical pass missed), gated
+  in CI (``tests/test_lockprof.py``) and in the chaos matrix's
+  ``--lockprof`` cell.
+* **cycle escalation** — a static ``lock-order`` cycle whose every edge
+  manifests dynamically is not a might-be: :func:`check_against_static`
+  reports it as ``confirmed_cycles`` and the callers treat that as a
+  hard failure.
+
+Mechanics: :func:`install` replaces ``threading.Lock`` / ``RLock`` /
+``Condition`` with recording wrappers.  Each wrapped lock is named by its
+*construction site* — the first stack frame outside this module and the
+``threading`` module — which maps onto the static analysis'
+``catalog()`` keyed by ``(repo-relative file, line)``.  A Condition
+wrapping an already-profiled lock records through that lock's site, so
+``self._cv = threading.Condition(self._lock)`` aliases exactly as the
+static graph's canonical nodes do.  Per-thread held stacks turn each
+successful acquire into edges from every lock the thread already holds;
+``Condition.wait`` releases and re-acquires through the tracking, so the
+held stack stays truthful across waits.
+
+Strictly opt-in: nothing here runs unless :func:`install` is called (the
+chaos matrix's ``--lockprof`` flag, the lockprof tests).  Locks created
+*before* install (module-level registries) are simply not profiled —
+the subset check covers whatever was.  Recording is in-memory;
+:func:`flush_events` writes the accumulated edges to the obs event log
+(``lock_edge`` events, rendered by ``fairify_tpu report``) — deferred so
+the profiler never performs I/O while user code holds a lock.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+Site = Tuple[str, int]  # (repo-relative path or abs path, line)
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_CONDITION = threading.Condition
+
+_THREADING_FILE = getattr(threading, "__file__", "<threading>")
+
+
+class _State:
+    def __init__(self):
+        self.reg_lock = _REAL_LOCK()
+        self.edges: Dict[Tuple[Site, Site], int] = {}
+        self.acquisitions = 0
+        self.tls = threading.local()
+        self.flushed: Dict[Tuple[Site, Site], int] = {}  # counts emitted
+
+
+_state: Optional[_State] = None
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _caller_site() -> Site:
+    """(file, line) of the frame that constructed the lock: first frame
+    outside this module and threading.py, repo-relativized when inside
+    the checkout so sites line up with the static catalog."""
+    here = os.path.abspath(__file__)
+    f = sys._getframe(1)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if os.path.abspath(fn) != here and fn != _THREADING_FILE:
+            break
+        f = f.f_back
+    if f is None:  # pragma: no cover - interpreter internals
+        return ("<unknown>", 0)
+    path = f.f_code.co_filename
+    root = _repo_root()
+    ap = os.path.abspath(path)
+    if ap.startswith(root + os.sep):
+        path = os.path.relpath(ap, root).replace(os.sep, "/")
+    return (path, f.f_lineno)
+
+
+def _held() -> List[Site]:
+    st = _state
+    if st is None:  # uninstalled mid-flight: keep a per-call stack
+        return []
+    h = getattr(st.tls, "held", None)
+    if h is None:
+        h = st.tls.held = []
+    return h
+
+
+def _note_acquire(site: Site) -> None:
+    st = _state
+    if st is None:
+        return
+    held = _held()
+    new_edges = []
+    for h in held:
+        if h != site:
+            new_edges.append((h, site))
+    held.append(site)
+    with st.reg_lock:
+        st.acquisitions += 1
+        for e in new_edges:
+            st.edges[e] = st.edges.get(e, 0) + 1
+
+
+def _note_release(site: Site) -> None:
+    st = _state
+    if st is None:
+        return
+    held = _held()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] == site:
+            del held[i]
+            return
+
+
+class _ProfiledLock:
+    """Recording proxy over a raw Lock/RLock.  Implements the private
+    Condition interop hooks (``_release_save``/``_acquire_restore``/
+    ``_is_owned``) so ``threading.Condition(profiled_lock)`` waits keep
+    the held stack truthful — and ``_is_owned`` probes never record."""
+
+    __slots__ = ("_inner", "site")
+
+    def __init__(self, inner, site: Site):
+        self._inner = inner
+        self.site = site
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            _note_acquire(self.site)
+        return ok
+
+    def release(self) -> None:
+        _note_release(self.site)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        locked = getattr(self._inner, "locked", None)
+        return bool(locked()) if locked is not None else False
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    # -- Condition interop -------------------------------------------------
+
+    def _release_save(self):
+        _note_release(self.site)
+        inner_save = getattr(self._inner, "_release_save", None)
+        if inner_save is not None:
+            return inner_save()
+        self._inner.release()
+        return None
+
+    def _acquire_restore(self, state) -> None:
+        inner_restore = getattr(self._inner, "_acquire_restore", None)
+        if inner_restore is not None:
+            inner_restore(state)
+        else:
+            self._inner.acquire()
+        _note_acquire(self.site)
+
+    def _is_owned(self) -> bool:
+        inner_owned = getattr(self._inner, "_is_owned", None)
+        if inner_owned is not None:
+            return bool(inner_owned())
+        # Probe without recording (the default Condition probe would
+        # otherwise log a spurious acquire on an unheld lock).
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<ProfiledLock {self.site[0]}:{self.site[1]}>"
+
+
+def _profiled_lock():
+    return _ProfiledLock(_REAL_LOCK(), _caller_site())
+
+
+def _profiled_rlock():
+    return _ProfiledLock(_REAL_RLOCK(), _caller_site())
+
+
+class _ProfiledCondition(_REAL_CONDITION):
+    """Condition whose default (internal) lock is profiled too.
+
+    With an explicit profiled lock the base class already routes
+    acquire/release/wait through the proxy's hooks — recording happens at
+    the LOCK's construction site, which is exactly the static graph's
+    canonical node for an aliasing ``Condition(self._lock)``."""
+
+    def __init__(self, lock=None):
+        if lock is None:
+            lock = _ProfiledLock(_REAL_RLOCK(), _caller_site())
+        super().__init__(lock)
+
+
+def install() -> None:
+    """Start profiling (idempotent).  Locks constructed AFTER this call
+    record; pre-existing locks are invisible (and excluded from checks)."""
+    global _state
+    if _state is not None:
+        return
+    _state = _State()
+    threading.Lock = _profiled_lock
+    threading.RLock = _profiled_rlock
+    threading.Condition = _ProfiledCondition
+
+
+def uninstall() -> None:
+    """Stop profiling and restore threading's factories.  Already-created
+    proxies keep working (recording stops — ``_state`` is gone)."""
+    global _state
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+    threading.Condition = _REAL_CONDITION
+    _state = None
+
+
+def installed() -> bool:
+    return _state is not None
+
+
+def reset() -> None:
+    st = _state
+    if st is not None:
+        with st.reg_lock:
+            st.edges.clear()
+            st.flushed.clear()
+            st.acquisitions = 0
+
+
+def observed_edges() -> Dict[Tuple[Site, Site], int]:
+    st = _state
+    if st is None:
+        return {}
+    with st.reg_lock:
+        return dict(st.edges)
+
+
+def flush_events() -> int:
+    """Emit one ``lock_edge`` obs event per edge whose count GREW since
+    the last flush (incremental: events carry the delta, so a periodic
+    flusher's report sums stay exact).  Deferred from acquire time so
+    profiling never does I/O under a user lock."""
+    from fairify_tpu.obs import trace as trace_mod
+
+    st = _state
+    if st is None:
+        return 0
+    with st.reg_lock:
+        pending = []
+        for e, n in sorted(st.edges.items()):
+            delta = n - st.flushed.get(e, 0)
+            if delta > 0:
+                pending.append((e, delta))
+                st.flushed[e] = n
+    for (src, dst), delta in pending:
+        trace_mod.event("lock_edge", src=f"{src[0]}:{src[1]}",
+                        dst=f"{dst[0]}:{dst[1]}", count=delta)
+    return len(pending)
+
+
+# ---------------------------------------------------------------------------
+# The cross-check
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class LockprofReport:
+    """Outcome of one observed-vs-static comparison."""
+
+    observed: int = 0             # edges recorded (all)
+    in_scope: int = 0             # edges with both ends in the catalog
+    external: int = 0             # edges with an end outside fairify_tpu/
+    unmodeled: List[str] = field(default_factory=list)   # NOT in the graph
+    confirmed_cycles: List[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.unmodeled and not self.confirmed_cycles
+
+    def as_dict(self) -> dict:
+        return {"observed": self.observed, "in_scope": self.in_scope,
+                "external": self.external, "unmodeled": list(self.unmodeled),
+                "confirmed_cycles": list(self.confirmed_cycles),
+                "ok": self.ok}
+
+
+def check_against_static(analysis=None, root: Optional[str] = None,
+                         edges: Optional[Dict[Tuple[Site, Site], int]] = None
+                         ) -> LockprofReport:
+    """Observed edges vs the static graph (see module docstring).
+
+    ``analysis`` overrides the repo-wide build (tests check toy modules);
+    ``edges`` overrides the live profiler state.  ``unmodeled`` entries
+    are the CI-failing condition: a runtime edge between two catalogued
+    fairify locks that the static graph lacks.  ``confirmed_cycles`` are
+    static lock-order cycles whose every edge manifested — escalated to
+    a hard failure by every caller.
+    """
+    from fairify_tpu.analysis.locks import build_repo_analysis
+
+    if analysis is None:
+        analysis = build_repo_analysis(root)
+    catalog = analysis.catalog()
+    static = set(analysis.edges)
+    got = observed_edges() if edges is None else edges
+    rep = LockprofReport(observed=len(got))
+    seen_canonical = set()
+    for (src, dst), _n in sorted(got.items()):
+        a, b = catalog.get(src), catalog.get(dst)
+        if a is None or b is None:
+            rep.external += 1
+            continue
+        rep.in_scope += 1
+        if a == b:
+            continue  # aliased cv/lock pair or re-entrant acquire
+        seen_canonical.add((a, b))
+        if (a, b) not in static:
+            rep.unmodeled.append(
+                f"{a} -> {b} (observed {src[0]}:{src[1]} -> "
+                f"{dst[0]}:{dst[1]})")
+    for cycle in analysis.cycles():
+        if all((s, d) in seen_canonical for s, d, _w in cycle):
+            rep.confirmed_cycles.append(
+                " -> ".join([s for s, _d, _w in cycle]
+                            + [cycle[0][0]]))
+    return rep

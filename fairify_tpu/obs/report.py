@@ -91,6 +91,7 @@ def aggregate(paths: Iterable[str]) -> dict:
     requests: Dict[str, dict] = {}  # request id -> lifecycle attrs, last wins
     compiles: Dict[str, dict] = {}  # kernel -> compile-table row
     smt_outcomes: Dict[str, int] = {}  # decided / per-reason query counts
+    lock_edges: Dict[tuple, int] = {}  # (src site, dst site) -> count
     for path in paths:
         files += 1
         records, skipped = trace_mod.load_events(path, count_skipped=True)
@@ -142,6 +143,13 @@ def aggregate(paths: Iterable[str]) -> dict:
                 rid = attrs.get("request")
                 if rid is not None:
                     requests[rid] = attrs
+            elif rtype == "event" and rec.get("name") == "lock_edge":
+                # Dynamic lock-order edges (obs.lockprof flush): summed
+                # across logs, keyed by src -> dst construction sites.
+                attrs = rec.get("attrs", {})
+                key = (attrs.get("src", "?"), attrs.get("dst", "?"))
+                lock_edges[key] = lock_edges.get(key, 0) \
+                    + int(attrs.get("count", 1))
             elif rtype == "event" and rec.get("name") == "verdict":
                 attrs = rec.get("attrs", {})
                 if attrs.get("verdict") not in ("sat", "unsat", "unknown"):
@@ -274,6 +282,8 @@ def aggregate(paths: Iterable[str]) -> dict:
         "smt": dict(sorted(smt_outcomes.items(), key=lambda kv: -kv[1])),
         "shards": {k: shards[k] for k in sorted(shards)},
         "requests": request_table,
+        "lock_edges": [{"src": s, "dst": d, "count": n}
+                       for (s, d), n in sorted(lock_edges.items())],
         "models": models,
         "device_launches": int(launches),
         "launches_in_flight_max": int(inflight_max),
@@ -352,6 +362,19 @@ def render(agg: dict) -> str:
                          f"{row['run_s']:>8.3f}  {decided:>7}  {sla:>6}")
         lines.append(f"requests: {len(agg['requests'])}   "
                      f"deadline misses: {misses}")
+    if agg.get("lock_edges"):
+        rows = agg["lock_edges"]
+        w = max(max(len(r["src"]) for r in rows),
+                max(len(r["dst"]) for r in rows),
+                len("held lock (site)"))
+        lines.append("")
+        lines.append(f"{'held lock (site)':<{w}}  {'then acquired':<{w}}  "
+                     f"{'count':>6}")
+        for r in rows:
+            lines.append(f"{r['src']:<{w}}  {r['dst']:<{w}}  "
+                         f"{r['count']:>6}")
+        lines.append(f"observed lock-order edges: {len(rows)} "
+                     f"(obs.lockprof; static graph: fairify_tpu lint)")
     if agg.get("compiles"):
         w = max(max(len(k) for k in agg["compiles"]), len("kernel"))
         lines.append("")

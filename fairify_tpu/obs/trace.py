@@ -31,7 +31,7 @@ like the verdict ledgers; truncated trailing lines are tolerated on read):
 
 This module is the obs layer's clock shim: it is the one place allowed to
 call ``time.time()`` (wall-clock span timestamps) — everything else goes
-through spans (see ``scripts/lint_obs.py``).
+through spans (the ``obs-time-time`` lint rule enforces it).
 """
 from __future__ import annotations
 

@@ -23,7 +23,7 @@ that into a disciplined structure instead of an accident:
 * The **only** host↔device sync point is the dequeue-time
   :func:`jax.device_get` inside the drain — call sites never
   ``np.asarray`` device arrays in their chunk loops (enforced by the
-  hot-loop fetch lint in ``scripts/lint_obs.py``).
+  ``obs-loop-fetch`` lint rule).
 * :class:`FlightStats` — max and time-weighted mean launches in flight,
   recorded per pipeline and mirrored into the obs ``launches_in_flight``
   gauge (labels ``stat="max"`` / ``stat="mean"``) so every sweep's

@@ -7,8 +7,11 @@ error at a supervised site is classified:
 
 * **propagate** — control-flow and resource exhaustion
   (``KeyboardInterrupt``, ``SystemExit``, ``MemoryError``,
-  ``GeneratorExit``) plus injected ``crash`` faults: never handled, the
-  process is supposed to die (crash-resume is the ledger's job).
+  ``GeneratorExit``), the fleet's cooperative kill signal
+  (``serve.server.ReplicaKilled`` — the thread analog of SIGKILL, matched
+  by name to keep this module import-light), and injected ``crash``
+  faults: never handled, the thread/process is supposed to die
+  (crash-resume is the ledger's job, failover is the fleet router's).
 * **transient** — plausibly succeeds on re-attempt: XLA/JAX runtime
   errors (a dropped tunnelled launch), ``OSError``/``TimeoutError``
   (filesystem/network hiccups), injected ``transient`` faults.  Retried
@@ -42,6 +45,13 @@ from fairify_tpu.resilience.faults import InjectedFault
 #: Exceptions no supervisor may convert into a degradation.
 PROPAGATE = (KeyboardInterrupt, SystemExit, MemoryError, GeneratorExit)
 
+#: Propagate-class exception type names matched without importing their
+#: modules (ReplicaKilled lives in serve.server; resilience must not
+#: import the serve stack).  A killed replica abandons everything with no
+#: cleanup — converting the kill into a retry or a degradation would turn
+#: loss-free failover into partial work.
+_PROPAGATE_NAMES = frozenset({"ReplicaKilled"})
+
 #: Exception type names classified transient without importing their
 #: modules (jaxlib's XlaRuntimeError moves between modules across
 #: versions; matching by name keeps the classifier import-light).
@@ -56,7 +66,11 @@ def classify(exc: BaseException) -> str:
     if isinstance(exc, InjectedFault):
         return {"transient": "transient", "fatal": "fatal"}.get(
             exc.kind, "propagate")
-    if isinstance(exc, PROPAGATE):
+    if isinstance(exc, PROPAGATE) \
+            or any(c.__name__ in _PROPAGATE_NAMES
+                   for c in type(exc).__mro__):
+        # MRO scan, not just the leaf name: a ReplicaKilled SUBCLASS is
+        # still a kill (isinstance semantics, kept import-light).
         return "propagate"
     if isinstance(exc, OSError):
         # Covers ConnectionError/TimeoutError too (both OSError subclasses).

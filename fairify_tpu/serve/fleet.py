@@ -355,6 +355,11 @@ class ServerFleet:
                 try:
                     self._scan_inbox()
                 except BaseException as exc:
+                    # Propagate-class (KeyboardInterrupt/SystemExit/
+                    # ReplicaKilled/crash faults) must kill the router —
+                    # a swallowed interrupt here would leave a zombie
+                    # fleet scanning nothing; everything else degrades
+                    # with a recorded reason and the router lives.
                     if classify(exc) == "propagate":
                         raise
                     obs.event("degraded", site="fleet.inbox",
@@ -443,6 +448,9 @@ class ServerFleet:
                     priority=parse_priority(payload.get("priority",
                                                         PRIORITY_NORMAL)))
             except BaseException as exc:
+                # Same contract as the router loop: kills/interrupts
+                # re-raise; only genuinely per-payload failures become a
+                # terminal rejection the waiting client can see.
                 if classify(exc) == "propagate":
                     raise
                 rec = {"request": req_id, "status": REJECTED,
@@ -465,6 +473,9 @@ class ServerFleet:
             try:
                 faults_mod.check("replica.lost")
             except BaseException as exc:
+                # crash-kind faults and real interrupts re-raise (the
+                # router is supposed to die with the process on those);
+                # transient/fatal drive the absorb-vs-kill split below.
                 kind = classify(exc)
                 if kind == "propagate":
                     raise

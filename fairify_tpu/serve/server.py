@@ -730,9 +730,9 @@ class VerificationServer:
                     # correctness: every request falls back to its solo
                     # stage 0.  (Chunk-level faults inside the shared
                     # launches are already degraded per chunk by the
-                    # pipeline's supervisor and never raise to here.)
-                    if isinstance(exc, ReplicaKilled) \
-                            or classify(exc) == "propagate":
+                    # pipeline's supervisor and never raise to here.
+                    # ReplicaKilled is propagate-class by taxonomy.)
+                    if classify(exc) == "propagate":
                         raise
                     obs.event("degraded", site="serve.batch",
                               error=type(exc).__name__,
@@ -778,8 +778,9 @@ class VerificationServer:
                     sp.set(fair_share_s=round(share, 3))
                 report = self._execute(req, stage0, left)
             except BaseException as exc:
-                if isinstance(exc, ReplicaKilled) \
-                        or classify(exc) == "propagate":
+                # Kills (ReplicaKilled) and interrupts are propagate-class:
+                # the worker abandons, fleet failover owns recovery.
+                if classify(exc) == "propagate":
                     raise
                 req.status = FAILED
                 req.reason = req.reason or \

@@ -129,7 +129,20 @@ def main() -> int:
                          "mid-batch,mid-SMT-drain} + request.preempt")
     ap.add_argument("--no-smt", action="store_true",
                     help="skip the smt.worker.* pool cells")
+    ap.add_argument("--lockprof", action="store_true",
+                    help="run the whole matrix under the dynamic lock "
+                         "profiler (obs.lockprof) and add a final cell "
+                         "asserting every observed acquisition-order edge "
+                         "exists in the static lock graph (fairify_tpu "
+                         "lint's lock-order analysis)")
     args = ap.parse_args()
+
+    if args.lockprof:
+        # Install BEFORE any server/pool/plan construction so their locks
+        # are profiled; module-level locks predate this and are exempt.
+        from fairify_tpu.obs import lockprof
+
+        lockprof.install()
 
     from fairify_tpu.models.train import init_mlp
     from fairify_tpu.verify import presets, sweep
@@ -608,6 +621,15 @@ def main() -> int:
                          for site in ("smt.worker.crash", "smt.worker.hang",
                                       "smt.worker.memout")
                          for label in ("transient", "exhausted")]
+            # spawn cells use nth 1/1+ — the pool spawns lazily at first
+            # checkout, so unlike dispatch sites the arrival count stays
+            # at one per spawn attempt (idle workers are reused).
+            SMT_CELLS += [
+                ("smt.worker.spawn", "transient",
+                 "smt.worker.spawn:transient:1", True),
+                ("smt.worker.spawn", "exhausted",
+                 "smt.worker.spawn:transient:1+", False),
+            ]
             for site, label, spec, absorbed in SMT_CELLS:
                 rdir = os.path.join(
                     args.out, f"{site}-{label}".replace(".", "_"))
@@ -785,6 +807,20 @@ def main() -> int:
         finally:
             (sweep_mod._stage0_block_decode, engine_mod.decide_many,
              engine_mod.decide_box) = saved
+
+    if args.lockprof:
+        # The dynamic cross-check cell: every acquisition-order edge the
+        # matrix actually exercised must be modeled by the static graph
+        # (an unmodeled edge is a bug in analysis/locks.py), and no
+        # static lock-order cycle may have fully manifested.
+        from fairify_tpu.obs import lockprof
+
+        lockprof.flush_events()
+        rep = lockprof.check_against_static()
+        row = {"cell": "lockprof", **rep.as_dict()}
+        failures += 0 if rep.ok else 1
+        print(json.dumps(row), flush=True)
+        lockprof.uninstall()
 
     print(json.dumps({"cells_failed": failures}), flush=True)
     return 1 if failures else 0

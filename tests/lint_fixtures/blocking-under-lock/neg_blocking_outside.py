@@ -1,0 +1,24 @@
+# rel: fairify_tpu/serve/fx_nonblocking.py
+import threading
+import time
+
+
+class Worker:
+    """Blocking work staged outside the `with` block is the fix the rule
+    asks for: snapshot under the lock, block after releasing."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+
+    def drain(self):
+        with self._lock:
+            batch = list(self.items)
+            self.items = []
+        time.sleep(0.1)
+        return batch
+
+    def pure_bookkeeping(self):
+        with self._lock:
+            self.items.append(1)
+            return len(self.items)

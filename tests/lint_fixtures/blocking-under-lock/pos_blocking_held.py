@@ -1,0 +1,35 @@
+# rel: fairify_tpu/serve/fx_blocking.py
+import subprocess
+import threading
+import time
+
+
+class Worker:
+    """Blocking operations while holding the queue lock: direct sleep,
+    a subprocess wait, and one reached through a call chain."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._flush)
+        self.items = []
+
+    def direct_sleep(self):
+        with self._lock:
+            time.sleep(0.1)  # EXPECT
+
+    def run_tool(self):
+        with self._lock:
+            subprocess.run(["true"], check=True)  # EXPECT
+
+    def join_worker(self):
+        with self._lock:
+            self._thread.join()  # EXPECT
+
+    def via_call(self):
+        with self._lock:
+            self._flush()  # EXPECT
+
+    def _flush(self):
+        # No lock held HERE — the finding belongs at the call site above,
+        # where the lock is actually held.
+        time.sleep(0.05)

@@ -1,0 +1,6 @@
+# rel: scripts/other_tool.py
+"""A spec literal OUTSIDE scripts/chaos_matrix.py does not count as
+chaos coverage (and is not itself a finding) — only the driver's cells
+keep a site honest."""
+
+REPRO = "demo.lost:transient:1"

@@ -1,0 +1,12 @@
+# rel: scripts/chaos_matrix.py
+"""Fixture chaos driver: covers demo.used, references an unknown site.
+
+(`demo.lost` is registered but has no cell here and no exemption — that
+finding lands on the registry's FAULT_SITES line; smt.query and the
+shard.* sites are CHAOS_EXEMPT, so their absence is fine.)
+"""
+
+SCHEDULES = [
+    ("demo.used", "transient", "demo.used:transient:2"),
+    ("nope.site", "transient", "nope.site:transient:1"),  # EXPECT
+]

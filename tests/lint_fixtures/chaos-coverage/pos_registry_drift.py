@@ -1,0 +1,4 @@
+# rel: fairify_tpu/resilience/faults.py
+FAULT_SITES = frozenset({"demo.used", "demo.lost", "smt.query",  # EXPECT
+                         "shard.dispatch", "shard.gather"})
+FAULT_KINDS = frozenset({"transient", "fatal", "crash"})

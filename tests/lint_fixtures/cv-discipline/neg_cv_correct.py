@@ -1,0 +1,21 @@
+# rel: fairify_tpu/serve/fx_cv_good.py
+import threading
+
+
+class Box:
+    """The correct shapes: while-predicate wait, notify under the cv."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._items = []
+
+    def take(self):
+        with self._cv:
+            while not self._items:
+                self._cv.wait(0.5)
+            return self._items.pop()
+
+    def put(self, item):
+        with self._cv:
+            self._items.append(item)
+            self._cv.notify_all()

@@ -1,0 +1,22 @@
+# rel: fairify_tpu/serve/fx_cv_bad.py
+import threading
+
+
+class Box:
+    """Condition misuse: wait guarded by `if` (spurious wakeup / ignored
+    wait(timeout) return runs the pop on an empty box), and notify
+    without holding (RuntimeError at runtime)."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._items = []
+
+    def take_bad(self):
+        with self._cv:
+            if not self._items:
+                self._cv.wait(1.0)  # EXPECT
+            return self._items.pop()
+
+    def wake_bad(self, item):
+        self._items.append(item)
+        self._cv.notify_all()  # EXPECT

@@ -1,0 +1,26 @@
+# rel: fairify_tpu/serve/fx_killsafe.py
+import threading
+
+from fairify_tpu.resilience import faults as faults_mod
+
+
+class Router:
+    """Kill-safe shapes: a single mutation next to the yield point (no
+    torn pair), and manual acquire wrapped in try/finally."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._owner = None
+        self._x = 0
+
+    def rehome(self, req):
+        with self._lock:
+            faults_mod.check("replica.lost")
+            self._owner = req.id
+
+    def manual(self):
+        self._lock.acquire()
+        try:
+            self._x = 1
+        finally:
+            self._lock.release()

@@ -1,0 +1,27 @@
+# rel: fairify_tpu/serve/fx_torn.py
+import threading
+
+from fairify_tpu.resilience import faults as faults_mod
+
+
+class Router:
+    """Kill hazards: a chaos yield point between two guarded mutations
+    (the `with` releases on ReplicaKilled with the invariant torn), and
+    a manual acquire that leaks the lock on any exception."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._owner = None
+        self._count = 0
+        self._x = 0
+
+    def rehome(self, req):
+        with self._lock:
+            self._owner = req.id
+            faults_mod.check("replica.lost")  # EXPECT
+            self._count = self._count + 1
+
+    def manual(self):
+        self._lock.acquire()  # EXPECT
+        self._x = 1
+        self._lock.release()

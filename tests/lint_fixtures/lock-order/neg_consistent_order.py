@@ -1,0 +1,23 @@
+# rel: fairify_tpu/serve/fx_ordered.py
+import threading
+
+
+class Ordered:
+    """Both paths take _a before _b — nesting is fine when the global
+    order is consistent."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.n = 0
+
+    def fast(self):
+        with self._a:
+            with self._b:
+                self.n = 1
+
+    def slow(self):
+        with self._a:
+            self.n = 2
+            with self._b:
+                self.n = 3

@@ -1,0 +1,22 @@
+# rel: fairify_tpu/serve/fx_cycle.py
+import threading
+
+
+class Pair:
+    """Two methods acquire the same two locks in opposite order: thread 1
+    in ab() holding _a while thread 2 in ba() holds _b deadlocks."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.n = 0
+
+    def ab(self):
+        with self._a:
+            with self._b:  # EXPECT
+                self.n = 1
+
+    def ba(self):
+        with self._b:
+            with self._a:
+                self.n = 2

@@ -1,0 +1,394 @@
+"""Tier-1 surface of the whole-program concurrency auditor
+(``fairify_tpu/analysis/locks.py`` + ``lint/rules_concurrency.py``).
+
+Three layers:
+
+* **repo facts** — the lock catalog covers EVERY ``threading.Lock`` /
+  ``RLock`` / ``Condition`` construction in ``fairify_tpu/`` (the
+  acceptance bar of the auditor: a lock the graph cannot see is a lock
+  the deadlock analysis silently ignores), the canonical aliasing of
+  Conditions onto their wrapped locks holds, the cross-object edges the
+  runtime actually exercises are modeled, and the graph is acyclic.
+* **machinery** — cycle detection with witnesses on a toy two-way
+  nesting, call-site lifting of blocking operations, Condition aliasing.
+* **rule wiring** — the four rules share one analysis per ``all_rules()``
+  invocation and their findings ride the engine (suppressions work).
+
+No jax import: the analysis layer is plain-AST like the rest of lint.
+"""
+import ast
+import pathlib
+
+from fairify_tpu.analysis import locks as locks_mod
+from fairify_tpu.lint import core as lint_core
+
+REPO_ROOT = pathlib.Path(lint_core.repo_root())
+
+
+def _repo_analysis():
+    return locks_mod.build_repo_analysis(str(REPO_ROOT))
+
+
+def _all_constructions():
+    """(rel, line) of every threading.Lock/RLock/Condition call in
+    fairify_tpu/ — found independently of the analysis, by raw AST scan."""
+    out = set()
+    for path, rel in lint_core.iter_py_files(str(REPO_ROOT)):
+        tree = ast.parse(pathlib.Path(path).read_text(), filename=path)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("Lock", "RLock", "Condition") \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "threading":
+                out.add((rel, node.lineno))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Repo facts
+# ---------------------------------------------------------------------------
+
+
+def test_catalog_covers_every_lock_construction():
+    an = _repo_analysis()
+    catalog = an.catalog()
+    missing = _all_constructions() - set(catalog)
+    assert not missing, (
+        f"lock constructions invisible to the concurrency analysis: "
+        f"{sorted(missing)} — extend analysis/locks.py discovery")
+
+
+def test_condition_aliases_wrapped_lock():
+    """server._cv wraps server._lock: both catalog entries share one
+    canonical node (with self._cv acquires self._lock)."""
+    an = _repo_analysis()
+    rel = "fairify_tpu/serve/server.py"
+    cv = an.locks[f"{rel}::VerificationServer._cv"]
+    lk = an.locks[f"{rel}::VerificationServer._lock"]
+    assert cv.canonical == lk.canonical == lk.id
+
+
+def test_repo_graph_models_cross_object_edges():
+    """The edges the fleet/server runtime actually exercises must be in
+    the static graph (the dynamic lockprof subset check depends on it):
+    router-holds-fleet-lock -> replica load(), and metrics instruments
+    bumped under the server condition."""
+    an = _repo_analysis()
+    short = {(a.split("::")[-1], b.split("::")[-1]) for a, b in an.edges}
+    assert ("ServerFleet._lock", "VerificationServer._lock") in short
+    assert ("VerificationServer._lock", "MetricsRegistry._lock") in short
+    assert ("VerificationServer._lock", "Gauge._lock") in short
+
+
+def test_repo_graph_is_acyclic():
+    an = _repo_analysis()
+    assert an.cycles() == [], [
+        [(s.split("::")[-1], d.split("::")[-1]) for s, d, _ in c]
+        for c in an.cycles()]
+
+
+def test_repo_has_no_unallowlisted_findings():
+    """Raw findings minus the reviewed allowlist == 0 (the lint gate
+    enforces the same; this pins it at the analysis layer with names)."""
+    from fairify_tpu.lint.rules_concurrency import ALLOW_BLOCKING_UNDER_LOCK
+
+    an = _repo_analysis()
+    live = [f for f in an.blocking
+            if f"{f.rel}::{f.function}" not in ALLOW_BLOCKING_UNDER_LOCK]
+    assert not live, [(f.rel, f.line, f.message) for f in live]
+    assert not an.kill, [(f.rel, f.line) for f in an.kill]
+    assert not an.cv, [(f.rel, f.line) for f in an.cv]
+
+
+# ---------------------------------------------------------------------------
+# Machinery on toy trees
+# ---------------------------------------------------------------------------
+
+
+def _analyze_src(tmp_path, named_srcs):
+    an = locks_mod.ConcurrencyAnalysis()
+    for rel, src in named_srcs.items():
+        an.add_file(rel, ast.parse(src))
+    an.finalize()
+    return an
+
+
+def test_cycle_detection_with_witnesses(tmp_path):
+    an = _analyze_src(tmp_path, {"fairify_tpu/x.py": (
+        "import threading\n"
+        "class P:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def ab(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+        "    def ba(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n")})
+    cycles = an.cycles()
+    assert len(cycles) == 1
+    steps = cycles[0]
+    assert {s.split("::")[-1] for s, _d, _w in steps} == {"P._a", "P._b"}
+    # Witnesses carry real locations.
+    assert all(w.rel == "fairify_tpu/x.py" and w.line for _s, _d, w in steps)
+
+
+def test_cross_function_edge_and_blocking_lift(tmp_path):
+    """Holding a lock while calling a method that acquires another lock
+    (edge) or that reaches a blocking op (finding at the call site)."""
+    an = _analyze_src(tmp_path, {"fairify_tpu/y.py": (
+        "import threading\n"
+        "import time\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._la = threading.Lock()\n"
+        "        self.b = B()\n"
+        "    def outer(self):\n"
+        "        with self._la:\n"
+        "            self.b.inner()\n"
+        "            self._slow()\n"
+        "    def _slow(self):\n"
+        "        time.sleep(1)\n"
+        "class B:\n"
+        "    def __init__(self):\n"
+        "        self._lb = threading.Lock()\n"
+        "    def inner(self):\n"
+        "        with self._lb:\n"
+        "            pass\n")})
+    short = {(a.split("::")[-1], b.split("::")[-1]) for a, b in an.edges}
+    assert ("A._la", "B._lb") in short
+    # One blocking finding, attributed at the _slow() CALL site (line 10),
+    # not inside _slow (where no lock is held).
+    assert [(f.line, f.function) for f in an.blocking] == [(10, "outer")]
+
+
+def test_condition_wait_while_holding_second_lock_is_blocking(tmp_path):
+    an = _analyze_src(tmp_path, {"fairify_tpu/z.py": (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._m = threading.Lock()\n"
+        "        self._cv = threading.Condition()\n"
+        "    def bad(self):\n"
+        "        with self._m:\n"
+        "            with self._cv:\n"
+        "                while True:\n"
+        "                    self._cv.wait(0.1)\n")})
+    assert any("releases only its own lock" in f.message
+               for f in an.blocking)
+
+
+def test_rules_share_one_analysis_per_run():
+    from fairify_tpu.lint.rules_concurrency import concurrency_rules
+
+    rules = concurrency_rules()
+    assert len({id(r._shared) for r in rules}) == 1
+    # And a fresh batch gets a fresh analysis (engine runs are stateful).
+    assert id(concurrency_rules()[0]._shared) != id(rules[0]._shared)
+
+
+def test_findings_ride_engine_suppressions(tmp_path):
+    from fairify_tpu.lint.rules_concurrency import concurrency_rules
+
+    p = tmp_path / "fx.py"
+    p.write_text(
+        "import threading\n"
+        "import time\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def f(self):\n"
+        "        with self._lock:\n"
+        "            time.sleep(1)  # lint: disable=blocking-under-lock\n")
+    result = lint_core.run_lint(rules=concurrency_rules(),
+                                files=[(str(p), "fairify_tpu/serve/fx.py")])
+    assert not result.findings
+    assert result.suppressed_by_rule == {"blocking-under-lock": 1}
+
+
+# ---------------------------------------------------------------------------
+# Review hardening regressions
+# ---------------------------------------------------------------------------
+
+
+def test_manual_acquire_finally_must_release_same_lock(tmp_path):
+    """A finally releasing a DIFFERENT lock must not mask the leak, and
+    blocking ops inside the try's except handlers are still under the
+    manually-held lock."""
+    an = _analyze_src(tmp_path, {"fairify_tpu/m.py": (
+        "import threading\n"
+        "import time\n"
+        "class M:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def bad(self):\n"
+        "        self._a.acquire()\n"
+        "        try:\n"
+        "            pass\n"
+        "        except Exception:\n"
+        "            time.sleep(5)\n"
+        "        finally:\n"
+        "            self._b.release()\n"
+        "    def good(self):\n"
+        "        self._a.acquire()\n"
+        "        try:\n"
+        "            pass\n"
+        "        except Exception:\n"
+        "            time.sleep(5)\n"
+        "        finally:\n"
+        "            self._a.release()\n")})
+    # bad(): wrong-lock finally -> kill-safety finding at the acquire.
+    assert [(f.line, f.function) for f in an.kill] == [(8, "bad")]
+    # Both handler sleeps run with _a held -> blocking findings in each.
+    assert sorted((f.line, f.function) for f in an.blocking) == \
+        [(12, "bad"), (20, "good")]
+
+
+def test_kill_scan_prunes_nested_defs(tmp_path):
+    """Mutations inside callbacks defined under the lock run at CALL
+    time, not inside the region — they must not trip the torn-state scan."""
+    an = _analyze_src(tmp_path, {"fairify_tpu/n.py": (
+        "import threading\n"
+        "from fairify_tpu.resilience import faults as faults_mod\n"
+        "class N:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def safe(self):\n"
+        "        with self._lock:\n"
+        "            self._x = 1\n"
+        "            faults_mod.check('replica.lost')\n"
+        "            def cb():\n"
+        "                self._b = 2\n"
+        "                self._c = 3\n"
+        "            self._callback = cb\n")})
+    # direct events: mutation(_x), yield, mutation(_callback) — wait,
+    # _callback IS a second direct mutation after the yield: that torn
+    # pair is real.  Only the nested-def mutations must be invisible.
+    assert len(an.kill) == 1  # _x / _callback straddle, cb's body doesn't
+    assert "2 mutations" in an.kill[0].message
+
+
+def test_lock_construction_line_is_the_call_line(tmp_path):
+    """Multi-line constructions: the catalog keys on the threading CALL's
+    line, which is what the dynamic profiler's frame reports."""
+    an = _analyze_src(tmp_path, {"fairify_tpu/w.py": (
+        "import threading\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._lock = (\n"
+        "            threading.Lock())\n")})
+    assert ("fairify_tpu/w.py", 5) in an.catalog()
+
+
+def test_deep_call_chain_edges_still_propagate(tmp_path):
+    """Reachability is not capped by the witness-chain length: a lock
+    acquired 6 call frames below a lock-holding site is still an edge
+    (only the stored witness chain is truncated)."""
+    hops = "".join(
+        f"    def g{i}(self):\n        self.g{i + 1}()\n" for i in range(6))
+    an = _analyze_src(tmp_path, {"fairify_tpu/deep.py": (
+        "import threading\n"
+        "class D:\n"
+        "    def __init__(self):\n"
+        "        self._top = threading.Lock()\n"
+        "        self._deep = threading.Lock()\n"
+        "    def outer(self):\n"
+        "        with self._top:\n"
+        "            self.g0()\n"
+        + hops +
+        "    def g6(self):\n"
+        "        with self._deep:\n"
+        "            pass\n")})
+    short = {(a.split("::")[-1], b.split("::")[-1]) for a, b in an.edges}
+    assert ("D._top", "D._deep") in short
+
+
+def test_manual_release_ends_the_held_region(tmp_path):
+    """An explicit .release() stops the held-set: statements after it
+    are not lock-held (no cascading false blocking findings); the
+    kill-safety finding at the unprotected acquire remains."""
+    an = _analyze_src(tmp_path, {"fairify_tpu/r.py": (
+        "import threading\n"
+        "import time\n"
+        "class R:\n"
+        "    def __init__(self):\n"
+        "        self._l = threading.Lock()\n"
+        "    def f(self):\n"
+        "        self._l.acquire()\n"
+        "        self._x = 1\n"
+        "        self._l.release()\n"
+        "        time.sleep(1)\n")})
+    assert [(f.line) for f in an.kill] == [7]  # acquire without try/finally
+    assert not an.blocking  # the sleep runs after the release
+
+
+def test_class_body_and_annassign_locks_discovered(tmp_path):
+    """Class-body locks and annotated module locks are nodes: nesting
+    through them produces edges, and the catalog covers them."""
+    an = _analyze_src(tmp_path, {"fairify_tpu/cb.py": (
+        "import threading\n"
+        "_GLOBAL: threading.Lock = threading.Lock()\n"
+        "class C:\n"
+        "    _lock = threading.Lock()\n"
+        "    _cv = threading.Condition(_lock)\n"
+        "    def f(self):\n"
+        "        with C._lock:\n"
+        "            with _GLOBAL:\n"
+        "                pass\n"
+        "    def g(self):\n"
+        "        with self._cv:\n"
+        "            pass\n")})
+    cat = an.catalog()
+    assert ("fairify_tpu/cb.py", 2) in cat   # AnnAssign module lock
+    assert ("fairify_tpu/cb.py", 4) in cat   # class-body lock
+    # The class-body Condition aliases the class-body lock.
+    assert an.locks["fairify_tpu/cb.py::C._cv"].canonical == \
+        "fairify_tpu/cb.py::C._lock"
+    short = {(a.split("::")[-1], b.split("::")[-1]) for a, b in an.edges}
+    assert ("C._lock", "_GLOBAL") in short
+
+
+def test_ambiguous_callee_blocking_does_not_hide_edges(tmp_path):
+    """A call site whose receiver is ambiguous between a blocking callee
+    and a lock-acquiring callee yields BOTH the blocking finding and the
+    edge — one must not suppress the other."""
+    an = _analyze_src(tmp_path, {"fairify_tpu/amb.py": (
+        "import threading\n"
+        "import time\n"
+        "class A:\n"
+        "    def run(self):\n"
+        "        time.sleep(1)\n"
+        "class B:\n"
+        "    def __init__(self):\n"
+        "        self._inner = threading.Lock()\n"
+        "    def run(self):\n"
+        "        with self._inner:\n"
+        "            pass\n"
+        "class H:\n"
+        "    def __init__(self, flag):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.w = A() if flag else B()\n"
+        "    def go(self):\n"
+        "        with self._lock:\n"
+        "            self.w.run()\n")})
+    short = {(a.split("::")[-1], b.split("::")[-1]) for a, b in an.edges}
+    assert ("H._lock", "B._inner") in short
+    assert len([f for f in an.blocking if f.function == "go"]) == 1
+
+
+def test_condition_alias_respects_custom_self_name(tmp_path):
+    """The aliasing pass uses the method's actual instance-parameter
+    name, not a hardcoded 'self'."""
+    an = _analyze_src(tmp_path, {"fairify_tpu/sn.py": (
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(this):\n"
+        "        this._lock = threading.Lock()\n"
+        "        this._cv = threading.Condition(this._lock)\n")})
+    assert an.locks["fairify_tpu/sn.py::S._cv"].canonical == \
+        "fairify_tpu/sn.py::S._lock"

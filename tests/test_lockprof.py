@@ -1,0 +1,209 @@
+"""Tier-1 surface of the dynamic lock profiler (``obs/lockprof.py``).
+
+Pins the three contracts the chaos matrix's ``--lockprof`` cell relies
+on: the recorder captures real multi-thread acquisition interleaves, the
+event-log schema round-trips through ``fairify_tpu report``'s reader,
+and observed edges are a subset of the static graph — on a toy module
+via an explicit analysis, and on the REAL serve/fleet stack against the
+whole-repo graph (the CI gate: an unmodeled edge here is a bug in
+``analysis/locks.py``, not in the runtime).
+"""
+import ast
+import threading
+
+import pytest
+
+from fairify_tpu.analysis import locks as locks_mod
+from fairify_tpu.obs import lockprof
+
+
+@pytest.fixture
+def profiler():
+    """Installed lockprof for the test body; ALWAYS restored (the patch
+    is process-global)."""
+    lockprof.install()
+    lockprof.reset()
+    try:
+        yield lockprof
+    finally:
+        lockprof.uninstall()
+
+
+def test_multithread_interleave_records_edges(profiler, tmp_path):
+    """Two threads nesting a -> b concurrently: the edge is recorded
+    once per acquisition, never inverted, and the held stack survives a
+    Condition wait/notify handoff between the threads."""
+    a = threading.Lock(); a_site = a.site          # noqa: E702
+    b = threading.Lock(); b_site = b.site          # noqa: E702
+    cv = threading.Condition()
+    state = {"ready": 0}
+
+    def worker():
+        with a:
+            with b:
+                with cv:
+                    state["ready"] += 1
+                    cv.notify_all()
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    with cv:
+        while state["ready"] < 4:
+            cv.wait(1.0)
+    for t in threads:
+        t.join()
+    edges = lockprof.observed_edges()
+    assert edges.get((a_site, b_site), 0) >= 4
+    assert (b_site, a_site) not in edges
+
+
+def test_observed_subset_of_static_on_toy_module(profiler, tmp_path):
+    """Exercise a toy class dynamically AND analyze the same source
+    statically: observed ⊆ static holds, and an artificial extra edge
+    (not in the source) is reported as unmodeled."""
+    src = (
+        "import threading\n"
+        "class P:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def ab(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n")
+    mod_path = tmp_path / "toy_locks.py"
+    mod_path.write_text(src)
+    rel = str(mod_path)  # dynamic sites use the abs path; rel must match
+    an = locks_mod.ConcurrencyAnalysis()
+    an.add_file(rel, ast.parse(src))
+    an.finalize()
+
+    ns: dict = {}
+    exec(compile(src, str(mod_path), "exec"), ns)
+    p = ns["P"]()
+    p.ab()
+    rep = lockprof.check_against_static(analysis=an)
+    assert rep.in_scope >= 1 and not rep.unmodeled and rep.ok
+
+    # An edge the source never takes (b held, then a) must be flagged.
+    bad = dict(lockprof.observed_edges())
+    bad[(p._b.site, p._a.site)] = 1
+    rep2 = lockprof.check_against_static(analysis=an, edges=bad)
+    assert len(rep2.unmodeled) == 1 and not rep2.ok
+    assert "P._b" in rep2.unmodeled[0] and "P._a" in rep2.unmodeled[0]
+
+
+def test_confirmed_static_cycle_escalates(profiler, tmp_path):
+    """A static lock-order cycle whose every edge manifests dynamically
+    is reported as confirmed (the callers fail hard on it)."""
+    src = (
+        "import threading\n"
+        "class P:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def ab(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+        "    def ba(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n")
+    mod_path = tmp_path / "toy_cycle.py"
+    mod_path.write_text(src)
+    an = locks_mod.ConcurrencyAnalysis()
+    an.add_file(str(mod_path), ast.parse(src))
+    an.finalize()
+    assert len(an.cycles()) == 1
+
+    ns: dict = {}
+    exec(compile(src, str(mod_path), "exec"), ns)
+    p = ns["P"]()
+    p.ab()
+    rep = lockprof.check_against_static(analysis=an)
+    assert not rep.confirmed_cycles  # only one arm manifested
+    p.ba()  # deadlock-shaped in a single thread is safe; both edges now real
+    rep = lockprof.check_against_static(analysis=an)
+    assert len(rep.confirmed_cycles) == 1 and not rep.ok
+
+
+def test_flush_emits_event_log_schema(profiler, tmp_path):
+    """lock_edge events land in the obs event log with src/dst/count and
+    aggregate into the report's lock-edge table."""
+    from fairify_tpu import obs
+    from fairify_tpu.obs import report as report_mod
+
+    log = tmp_path / "events.jsonl"
+    with obs.tracing(str(log), run_id="lockprof-test"):
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                pass
+        n = lockprof.flush_events()
+        assert n >= 1
+        assert lockprof.flush_events() == 0  # flush is incremental
+    records = obs.load_events(str(log))
+    edges = [r for r in records
+             if r.get("type") == "event" and r.get("name") == "lock_edge"]
+    assert edges and all(
+        {"src", "dst", "count"} <= set(e["attrs"]) for e in edges)
+    agg = report_mod.aggregate([str(log)])
+    assert agg["lock_edges"] and agg["lock_edges"][0]["count"] >= 1
+    text = report_mod.render(agg)
+    assert "observed lock-order edges" in text
+
+
+def test_real_serve_fleet_edges_modeled(profiler):
+    """Drive the REAL fleet router + server submit path under lockprof
+    and check observed ⊆ the whole-repo static graph.  This is the CI
+    gate for analysis drift: new runtime lock nesting must be modeled."""
+    from fairify_tpu.models.train import init_mlp
+    from fairify_tpu.serve import FleetConfig, ServeConfig, ServerFleet
+    from fairify_tpu.verify import presets
+
+    cfg = presets.get("GC").with_(sim_size=16, grid_chunk=8)
+    net = init_mlp((len(cfg.query().columns), 4, 1), seed=0)
+    fl = ServerFleet(FleetConfig(n_replicas=2,
+                                 replica=ServeConfig(batch_window_s=0.01)))
+    # Never started: _route pins a bucket (fleet lock -> replica load()),
+    # submit queues (server cv -> admission/metrics locks) — the lock
+    # nesting runs without any device work.
+    req = fl.submit(cfg, net, "m", partition_span=(0, 8))
+    assert req.status == "queued"
+    fl.drain()
+    edges = lockprof.observed_edges()
+    fleet_edges = [(s, d) for (s, d) in edges
+                   if s[0].endswith("serve/fleet.py")]
+    assert fleet_edges, "fleet router recorded no edges — probe broken?"
+    rep = lockprof.check_against_static()
+    assert rep.in_scope >= 2
+    assert not rep.unmodeled, rep.unmodeled
+    assert not rep.confirmed_cycles, rep.confirmed_cycles
+
+
+def test_flush_is_incremental_by_count(profiler, tmp_path):
+    """Periodic flushers get delta events, so report sums stay exact
+    across flushes instead of freezing at the first count."""
+    from fairify_tpu import obs
+    from fairify_tpu.obs import report as report_mod
+
+    log = tmp_path / "events.jsonl"
+    a = threading.Lock()
+    b = threading.Lock()
+    with obs.tracing(str(log), run_id="lockprof-delta"):
+        with a:
+            with b:
+                pass
+        assert lockprof.flush_events() == 1
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert lockprof.flush_events() == 1  # same edge, new delta
+        assert lockprof.flush_events() == 0  # nothing new
+    agg = report_mod.aggregate([str(log)])
+    (row,) = [r for r in agg["lock_edges"]]
+    assert row["count"] == 4

@@ -421,25 +421,22 @@ def test_snapshot_delta_histograms_and_gauges():
 # ---------------------------------------------------------------------------
 
 
-def _lint_obs():
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    sys.path.insert(0, os.path.join(root, "scripts"))
-    try:
-        import lint_obs
-    finally:
-        sys.path.pop(0)
-    return lint_obs
+def test_obs_rules_clean_on_tree():
+    """The five obs rules (tier-1-wired) pass on the current tree —
+    through the rule engine; the old ``scripts/lint_obs.py`` shim is gone."""
+    from fairify_tpu.lint import core as lint_core
+    from fairify_tpu.lint.rules import legacy_rules
 
-
-def test_lint_obs_clean():
-    """The obs lint (tier-1-wired) passes on the current tree."""
-    assert _lint_obs().main([]) == 0
+    result = lint_core.run_lint(rules=legacy_rules())
+    assert not result.findings and not result.parse_errors
 
 
 def test_lint_bans_raw_jit_in_verify_and_ops(tmp_path):
     """Every spelling of a bare jax.jit in verify/ or ops/ is flagged;
     obs_jit passes; files outside the scope are untouched."""
-    lint_obs = _lint_obs()
+    from fairify_tpu.lint import core as lint_core
+    from fairify_tpu.lint.rules_obs import RawJitRule
+
     bad = tmp_path / "bad.py"
     bad.write_text(
         "import jax\n"
@@ -450,18 +447,23 @@ def test_lint_bans_raw_jit_in_verify_and_ops(tmp_path):
         "@partial(jax.jit, static_argnames=('k',))\n"
         "def c(x, k):\n    return x\n")
     for scope_rel in ("fairify_tpu/verify/bad.py", "fairify_tpu/ops/bad.py"):
-        errors = lint_obs.check_file(str(bad), scope_rel)
-        assert len([e for e in errors if "bare jax.jit" in e]) == 3, scope_rel
+        result = lint_core.run_lint(rules=[RawJitRule()],
+                                    files=[(str(bad), scope_rel)])
+        assert len(result.findings) == 3, scope_rel
     # Out of scope (models/ trains ad-hoc nets; the rule protects the
     # verification core): no raw-jit errors.
-    errors = lint_obs.check_file(str(bad), "fairify_tpu/models/bad.py")
-    assert not any("bare jax.jit" in e for e in errors)
+    result = lint_core.run_lint(rules=[RawJitRule()],
+                                files=[(str(bad), "fairify_tpu/models/bad.py")])
+    assert not result.findings
     good = tmp_path / "good.py"
     good.write_text(
         "from fairify_tpu.obs import obs_jit\n"
         "@obs_jit(static_argnames=('k',))\n"
         "def a(x, k):\n    return x\n")
-    assert lint_obs.check_file(str(good), "fairify_tpu/verify/good.py") == []
+    result = lint_core.run_lint(rules=[RawJitRule()],
+                                files=[(str(good),
+                                        "fairify_tpu/verify/good.py")])
+    assert not result.findings
 
 
 def test_traced_sweep_matches_report(tmp_path, monkeypatch):

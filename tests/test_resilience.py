@@ -887,22 +887,19 @@ def test_report_renders_per_shard_table(tmp_path, capsys):
 # ---------------------------------------------------------------------------
 
 
-def _lint_obs():
-    import importlib
-    import sys as _sys
+def _broad_except_findings(tmp_path, src):
+    from fairify_tpu.lint import core as lint_core
+    from fairify_tpu.lint.rules_obs import BroadExceptRule
 
-    _sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "scripts"))
-    import lint_obs
-
-    importlib.reload(lint_obs)
-    return lint_obs
+    p = tmp_path / "bad.py"
+    p.write_text(src)
+    result = lint_core.run_lint(rules=[BroadExceptRule()],
+                                files=[(str(p), "fairify_tpu/bad.py")])
+    return result.findings
 
 
 def test_lint_flags_silent_broad_excepts(tmp_path):
-    lint_obs = _lint_obs()
-    bad = tmp_path / "bad.py"
-    bad.write_text(
+    findings = _broad_except_findings(tmp_path, (
         "def a():\n"
         "    try:\n"
         "        pass\n"
@@ -922,12 +919,105 @@ def test_lint_flags_silent_broad_excepts(tmp_path):
         "    try:\n"
         "        pass\n"
         "    except ValueError:\n"     # narrow: fine
-        "        pass\n")
-    errors = lint_obs.check_file(str(bad), "fairify_tpu/bad.py")
-    lines = sorted(int(e.split(":")[1]) for e in errors)
-    assert lines == [4, 9]
-    assert all("broad except" in e for e in errors)
+        "        pass\n"))
+    assert sorted(f.line for f in findings) == [4, 9]
+    assert all("except" in f.message for f in findings)
+
+
+def test_lint_base_exception_needs_propagate_reraise(tmp_path):
+    """The strict tier: a BaseException handler with SOME raise still
+    fails unless the propagate class specifically escapes — either an
+    unconditional re-raise or the `classify(exc) == "propagate"` guard
+    (KeyboardInterrupt/SystemExit/ReplicaKilled must never be converted
+    into a degradation)."""
+    findings = _broad_except_findings(tmp_path, (
+        "from fairify_tpu.resilience.supervisor import classify\n"
+        "def bad_converts_everything():\n"
+        "    try:\n"
+        "        pass\n"
+        "    except BaseException as exc:\n"      # line 5: flagged
+        "        raise RuntimeError('wrapped') from exc\n"
+        "def good_guard():\n"
+        "    try:\n"
+        "        pass\n"
+        "    except BaseException as exc:\n"
+        "        if classify(exc) == 'propagate':\n"
+        "            raise\n"
+        "        x = 1\n"
+        "def good_guard_via_variable():\n"
+        "    try:\n"
+        "        pass\n"
+        "    except BaseException as exc:\n"
+        "        cls = classify(exc)\n"
+        "        if cls == 'propagate':\n"
+        "            raise\n"
+        "def good_isinstance():\n"
+        "    try:\n"
+        "        pass\n"
+        "    except BaseException as exc:\n"
+        "        if isinstance(exc, (KeyboardInterrupt, SystemExit)):\n"
+        "            raise\n"
+        "def good_unconditional():\n"
+        "    try:\n"
+        "        pass\n"
+        "    except BaseException:\n"
+        "        x = 2\n"
+        "        raise\n"))
+    assert [f.line for f in findings] == [5]
+    assert "propagate" in findings[0].message
+
+
+def test_classify_replica_killed_is_propagate():
+    """The fleet's cooperative kill is the thread analog of SIGKILL: no
+    supervisor/handler may convert it into a retry or degradation."""
+    from fairify_tpu.resilience.supervisor import classify
+    from fairify_tpu.serve.server import ReplicaKilled
+
+    assert classify(ReplicaKilled()) == "propagate"
 
 
 def test_lint_clean_on_current_tree():
-    assert _lint_obs().main([]) == 0
+    from fairify_tpu.lint import core as lint_core
+    from fairify_tpu.lint.rules_obs import BroadExceptRule
+
+    result = lint_core.run_lint(rules=[BroadExceptRule()])
+    assert not result.findings and not result.parse_errors
+
+
+def test_lint_base_exception_guard_polarity_and_bare_raise(tmp_path):
+    """Review hardening: the guard must be POSITIVE and the raise BARE —
+    an inverted guard falls through on kills, and `raise Other(...) from
+    exc` converts them."""
+    findings = _broad_except_findings(tmp_path, (
+        "from fairify_tpu.resilience.supervisor import classify\n"
+        "def bad_inverted_guard():\n"
+        "    try:\n"
+        "        pass\n"
+        "    except BaseException as exc:\n"      # line 5: flagged
+        "        if classify(exc) != 'propagate':\n"
+        "            raise RuntimeError('x') from exc\n"
+        "def bad_converted_reraise():\n"
+        "    try:\n"
+        "        pass\n"
+        "    except BaseException as exc:\n"      # line 11: flagged
+        "        if classify(exc) == 'propagate':\n"
+        "            raise RuntimeError('x') from exc\n"
+        "def bad_not_isinstance():\n"
+        "    try:\n"
+        "        pass\n"
+        "    except BaseException as exc:\n"      # line 17: flagged
+        "        if not isinstance(exc, KeyboardInterrupt):\n"
+        "            raise ValueError('x')\n"))
+    assert [f.line for f in findings] == [5, 11, 17]
+
+
+def test_classify_replica_killed_subclass_is_propagate():
+    """isinstance semantics survive the import-light name matching: a
+    ReplicaKilled SUBCLASS raised at a yield point is still a kill."""
+    from fairify_tpu.resilience.supervisor import classify
+    from fairify_tpu.serve.server import ReplicaKilled
+
+    class ReplicaPreempted(ReplicaKilled):
+        pass
+
+    assert classify(ReplicaPreempted()) == "propagate"
