@@ -156,6 +156,7 @@ def main(trace_out=None, heartbeat_s: float = 0.0) -> None:
                                    f"{cfg.name}-GC-1.throughput.json")) as fp:
                 thr = json.load(fp)
             run_rec["device_launches"] = thr.get("device_launches")
+            run_rec["launches_per_model"] = thr.get("launches_per_model")
             run_rec["phases_s"] = thr.get("phases_s")
             run_rec["pipeline_depth"] = thr.get("pipeline_depth")
             run_rec["launches_in_flight_max"] = thr.get("launches_in_flight_max")
@@ -180,6 +181,9 @@ def main(trace_out=None, heartbeat_s: float = 0.0) -> None:
         "max": hi_v,
         "runs": runs,
         "device_launches": median_run.get("device_launches"),
+        # Launch economy (perfdiff-gated, lower is better): launches per
+        # model — O(segments) under the stage-0 mega-loop.
+        "launches_per_model": median_run.get("launches_per_model"),
         "phases_s": median_run.get("phases_s"),
         "pipeline_depth": median_run.get("pipeline_depth"),
         "launches_in_flight_max": median_run.get("launches_in_flight_max"),
@@ -223,8 +227,20 @@ def _ladder_configs() -> None:
     # AC 12-model vmap suite (stacked per architecture group, the same
     # grouping run_sweep uses — the zoo's AC nets span several depths).
     cfg = presets.get("AC").with_(result_dir="/tmp/fairify_tpu_bench_ac")
-    nets, _ = zoo.load_matching("adult", len(cfg.query().columns))
+    try:
+        nets, _ = zoo.load_matching("adult", len(cfg.query().columns))
+    except OSError:
+        nets = {}
     names = sorted(nets)
+    if not names:
+        # Reference zoo assets absent (bare container): emitting a zero
+        # metric would gate future rounds against a meaningless baseline —
+        # skip the ladder loudly instead (the headline uses the synthetic
+        # flagship twin and still records).
+        print(json.dumps({"metric": "ladder_skipped",
+                          "error": "no adult zoo models on this host"}),
+              file=sys.stderr)
+        return
     enc = encode(cfg.query())
     _, lo, hi = sweep.build_partitions(cfg)
     from collections import defaultdict
@@ -242,17 +258,24 @@ def _ladder_configs() -> None:
     from fairify_tpu import obs
     from fairify_tpu.parallel.pipeline import LaunchPipeline
 
+    from fairify_tpu.utils import profiling
+
     ac_runs = []
     decided = 0
     for _ in range(BENCH_REPEATS):
         obs.registry().reset()
         pipe = LaunchPipeline(cfg.pipeline_depth)
+        launch0 = profiling.launch_count()
         t0 = time.perf_counter()
         fams = sweep.stage0_families(stacks, enc, lo, hi, cfg, pipe=pipe)
         dt = time.perf_counter() - t0
+        launches = profiling.launch_count() - launch0
         decided = int(sum((u | s).sum() for fam in fams for u, s, _ in fam))
         ac_runs.append({"value": round(decided / dt, 1),
                         "elapsed_s": round(dt, 3),
+                        "device_launches": launches,
+                        "launches_per_model": round(
+                            launches / max(len(names), 1), 2),
                         "launches_in_flight_max": pipe.stats.max,
                         "launches_in_flight_mean": round(pipe.stats.mean(), 3)})
     pps, lo_v, hi_v = _median_band(ac_runs)
@@ -268,6 +291,8 @@ def _ladder_configs() -> None:
         "max": hi_v,
         "runs": ac_runs,
         "pipeline_depth": cfg.pipeline_depth,
+        "device_launches": ac_runs[-1]["device_launches"],
+        "launches_per_model": ac_runs[-1]["launches_per_model"],
         "launches_in_flight_max": max(r["launches_in_flight_max"]
                                       for r in ac_runs),
     }), flush=True)

@@ -6,7 +6,7 @@ tiny grid) before any pass runs.  The world is chosen so each kernel traces
 the same code paths production does — a PA dim with two assignments, an RA
 dim with ε = 1 (so the RA-widening and RA-lattice branches are live), one
 hidden layer (so sign-BaB and CROWN relaxations are live), and a stacked
-two-model family — while staying small enough that tracing all 19 kernels
+two-model family — while staying small enough that tracing all 23 kernels
 plus the buffer pass's compiles finishes well inside the 30 s CPU budget
 (``tests/test_analysis.py`` pins it).
 
@@ -149,6 +149,33 @@ class AnalysisWorld:
         self.valid_mask = np.array([True, True])
         self.jnp = jnp
 
+        # Mega-segment stacks (DESIGN.md §17): TWO chunks of the B-box
+        # world along the leading scan axis — the smallest segment that
+        # exercises the mega kernels' scan-shaped avals.  The second chunk
+        # shifts the shared dims and draws its own attack RNG, the way a
+        # real segment stacks per-chunk streams keyed to global starts.
+        lo2, hi2 = self.lo.copy(), self.hi.copy()
+        lo2[:, 2:] += 1
+        hi2[:, 2:] += 1
+
+        def _chunk(lo_c, hi_c, seed):
+            flo, fhi = lo_c.astype(np.float32), hi_c.astype(np.float32)
+            x_lo, x_hi, xp_lo, xp_hi, valid = prop.role_boxes(
+                self.enc, flo, fhi)
+            r = np.random.default_rng(seed)
+            xr, pr = engine.build_attack_candidates(self.enc, r, lo_c,
+                                                    hi_c, S)
+            return (x_lo, x_hi, xp_lo, xp_hi, flo, fhi, valid, xr, pr)
+
+        self.mega_seg = tuple(np.stack(a) for a in zip(
+            _chunk(self.lo, self.hi, 0), _chunk(lo2, hi2, 1)))
+        # Reversed chunk order: same shapes, different content — a later
+        # segment of the same sweep, which must reuse the executable.
+        self.mega_seg2 = tuple(np.stack(a) for a in zip(
+            _chunk(lo2, hi2, 1), _chunk(self.lo, self.hi, 0)))
+        self.mkeys = jnp.stack([grid_keys(0, 0, B), grid_keys(0, B, B)])
+        self.malive = (np.ones((2, B, 8), np.float32),)
+
 
 #: Flattened-leaf keystrs of the MLP final-layer mask (all-ones by the
 #: model contract — ``utils/prune.py:235-236`` never prunes the output
@@ -183,6 +210,13 @@ def _certify_args(w: AnalysisWorld, lo, hi, alpha_iters: int):
 def _certify_attack_args(w: AnalysisWorld, lo, hi, alpha_iters: int):
     args, kw = _certify_args(w, lo, hi, alpha_iters)
     return args + (w.xr, w.pr), kw
+
+
+def _mega_stage0_args(w: AnalysisWorld, seg, first, alpha_iters: int):
+    x_lo, x_hi, xp_lo, xp_hi, plo, phi, valid, xr, pr = seg
+    return ((first, x_lo, x_hi, xp_lo, xp_hi, plo, phi, w.assign_vals,
+             w.pa_mask, w.ra_mask, w.eps, valid, w.vp, xr, pr),
+            {"alpha_iters": alpha_iters})
 
 
 def _family_certify_args(w: AnalysisWorld, alpha_iters: int):
@@ -306,6 +340,36 @@ def kernel_specs() -> Dict[str, KernelSpec]:
             "sweep.family_logits_kernel",
             lambda w: ((w.stacked, w.xr, w.pr), {}),
             dead_ok=(_NET_FINAL_MASK,)),
+        KernelSpec(
+            "sweep.mega_stage0_kernel",
+            lambda w: _mega_stage0_args(w, w.mega_seg, w.net, 0),
+            sound=True, dead_ok=(_NET_FINAL_MASK,),
+            variants=(Variant(
+                "later segment, same shapes",
+                lambda w: _mega_stage0_args(w, w.mega_seg2, w.net, 0),
+                same_exec=True),),
+            expected_signatures=1),
+        KernelSpec(
+            "sweep.mega_family_stage0_kernel",
+            lambda w: _mega_stage0_args(w, w.mega_seg, w.stacked, 0),
+            sound=True, dead_ok=(_NET_FINAL_MASK,),
+            variants=(Variant(
+                "later segment, same shapes",
+                lambda w: _mega_stage0_args(w, w.mega_seg2, w.stacked, 0),
+                same_exec=True),),
+            expected_signatures=1),
+        KernelSpec(
+            "sweep.mega_parity_kernel",
+            lambda w: ((w.net, w.mkeys, w.mega_seg[4], w.mega_seg[5],
+                        w.malive), {"sim_size": w.sim_size}),
+            dead_ok=(_NET_FINAL_MASK,),
+            expected_signatures=1),
+        KernelSpec(
+            "pruning.mega_sim_and_bounds",
+            lambda w: ((w.net, w.mkeys, w.mega_seg[4], w.mega_seg[5]),
+                       {"sim_size": w.sim_size}),
+            dead_ok=(_NET_FINAL_MASK,),
+            expected_signatures=1),
         KernelSpec(
             "sweep.parity_grid_from_keys",
             lambda w: ((w.net, w.keys, w.flo, w.fhi, w.alive_hidden),

@@ -77,6 +77,8 @@ def _overridden_cfg(args):
         overrides["heartbeat_s"] = float(args.heartbeat_interval)
     if getattr(args, "pipeline_depth", None) is not None:
         overrides["pipeline_depth"] = int(args.pipeline_depth)
+    if getattr(args, "mega_chunks", None) is not None:
+        overrides["mega_chunks"] = int(args.mega_chunks)
     if getattr(args, "max_launch_retries", None) is not None:
         overrides["max_launch_retries"] = int(args.max_launch_retries)
     if getattr(args, "launch_backoff", None) is not None:
@@ -445,6 +447,12 @@ def main(argv=None) -> int:
     run.add_argument("--pipeline-depth", type=int, default=None,
                      help="async launch pipeline depth (chunk launches kept "
                           "in flight; 1 = synchronous, default 2)")
+    run.add_argument("--mega-chunks", type=int, default=None,
+                     help="grid chunks per device-resident mega launch: one "
+                          "lax.scan launch certifies this many chunks "
+                          "(segment = the fault blast radius and the "
+                          "supervisor's retry unit; default 4, 0 = "
+                          "per-chunk launches)")
     run.add_argument("--heartbeat-interval", type=float, default=None,
                      help="stderr progress line every N seconds (0 = off)")
     run.add_argument("--max-launch-retries", type=int, default=None,

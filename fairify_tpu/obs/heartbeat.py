@@ -77,6 +77,7 @@ class Heartbeat:
         self._retries0 = reg.counter("launch_retries").total()
         self._degraded0 = reg.counter("chunks_degraded").total()
         self._last_attempted: Optional[int] = None
+        self._last_segment: Optional[float] = None
         self._rate_ema: Optional[float] = None
         if self.interval_s > 0:
             global _ACTIVE
@@ -108,6 +109,39 @@ class Heartbeat:
             # A leaked/stale registration over a closed stream must never
             # fail the kernel call that triggered the flag.
             self.close()
+
+    def segment(self, phase: str, done: int, total: int,
+                in_flight: int = 0, force: bool = False) -> bool:
+        """Segment-granular progress for device-resident mega launches.
+
+        A mega segment is ONE device launch covering many chunks:
+        partitions decided inside it are invisible to the host until the
+        launch drains, so the per-partition ``beat`` stalls for the whole
+        launch and a long single launch would look hung.  This line
+        surfaces segments-done/total instead::
+
+            [hb GC-1] stage0_decide segments 3/8 (2 in flight) | +3 launches
+
+        Same interval throttle as ``beat`` but on its own clock (the two
+        progress streams must not starve each other); the final segment of
+        a phase always prints.
+        """
+        if self.interval_s <= 0 and not force:
+            return False
+        now = self._clock()
+        if not force and done < total and self._last_segment is not None \
+                and now - self._last_segment < self.interval_s:
+            return False
+        launches = self._launches()
+        d_launch = int(launches - self._last_launches)
+        label = f" {self.label}" if self.label else ""
+        flight = f" ({in_flight} in flight)" if in_flight else ""
+        print(f"[hb{label}] {phase} segments {done}/{total}{flight} "
+              f"| +{d_launch} launches",
+              file=self.stream or sys.stderr, flush=True)
+        self._last_segment = now
+        self._last_launches = launches
+        return True
 
     def beat(self, decided: int, attempted: int, unknown: int = 0,
              force: bool = False) -> bool:

@@ -92,6 +92,7 @@ def aggregate(paths: Iterable[str]) -> dict:
     compiles: Dict[str, dict] = {}  # kernel -> compile-table row
     smt_outcomes: Dict[str, int] = {}  # decided / per-reason query counts
     lock_edges: Dict[tuple, int] = {}  # (src site, dst site) -> count
+    segments: Dict[str, dict] = {}  # mega-loop phase -> done/total row
     for path in paths:
         files += 1
         records, skipped = trace_mod.load_events(path, count_skipped=True)
@@ -150,6 +151,18 @@ def aggregate(paths: Iterable[str]) -> dict:
                 key = (attrs.get("src", "?"), attrs.get("dst", "?"))
                 lock_edges[key] = lock_edges.get(key, 0) \
                     + int(attrs.get("count", 1))
+            elif rtype == "event" and rec.get("name") == "segment":
+                # Mega-loop segment progress (DESIGN.md §17): per phase,
+                # the latest done/total plus how many partitions drained
+                # through segment launches — the host-visible progress
+                # grain while a device-resident launch is in flight.
+                attrs = rec.get("attrs", {})
+                row = segments.setdefault(
+                    str(attrs.get("phase", "?")),
+                    {"done": 0, "total": 0, "partitions": 0})
+                row["done"] = int(attrs.get("done", row["done"]))
+                row["total"] = int(attrs.get("total", row["total"]))
+                row["partitions"] += int(attrs.get("partitions", 0))
             elif rtype == "event" and rec.get("name") == "verdict":
                 attrs = rec.get("attrs", {})
                 if attrs.get("verdict") not in ("sat", "unsat", "unknown"):
@@ -284,6 +297,7 @@ def aggregate(paths: Iterable[str]) -> dict:
         "requests": request_table,
         "lock_edges": [{"src": s, "dst": d, "count": n}
                        for (s, d), n in sorted(lock_edges.items())],
+        "segments": {k: segments[k] for k in sorted(segments)},
         "models": models,
         "device_launches": int(launches),
         "launches_in_flight_max": int(inflight_max),
@@ -323,6 +337,14 @@ def render(agg: dict) -> str:
         v = agg["verdicts"]
         lines.append(f"{'TOTAL':<{w}}  {v['sat']:>6}  {v['unsat']:>6}  "
                      f"{v['unknown']:>7}  {agg['decided']:>7}")
+    if agg.get("segments"):
+        w = max(max(len(k) for k in agg["segments"]), len("mega segments"))
+        lines.append("")
+        lines.append(f"{'mega segments':<{w}}  {'done':>5}  {'total':>5}  "
+                     f"{'partitions':>10}")
+        for phase, row in agg["segments"].items():
+            lines.append(f"{phase:<{w}}  {row['done']:>5}  {row['total']:>5}  "
+                         f"{row['partitions']:>10}")
     if agg.get("via"):
         lines.append("")
         lines.append("decided via: " + ", ".join(

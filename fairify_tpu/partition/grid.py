@@ -192,6 +192,42 @@ def pad_rows(arr: np.ndarray, step: int) -> np.ndarray:
         [arr, np.repeat(arr[-1:], step - arr.shape[0], axis=0)], axis=0)
 
 
+def segment_spans(n: int, chunk: int, mega_chunks: int):
+    """``(step, [(seg_start, seg_stop, [(s, e), ...]), ...])``.
+
+    A *segment* is the mega-loop's launch unit: ``mega_chunks`` consecutive
+    grid chunks certified by ONE device-resident ``lax.scan`` launch
+    (DESIGN.md §17).  Chunk boundaries — and therefore every chunk-keyed
+    RNG stream — are identical to :func:`chunk_spans` (this only groups
+    them), so the segment grouping changes launch COUNT, never kernel
+    inputs.  Shared by the stage-0/parity loops (verify/sweep.py) and the
+    prune pass (verify/pruning.py) so their launch signatures cannot
+    desync.
+    """
+    step, spans = chunk_spans(n, chunk)
+    m = max(1, int(mega_chunks))
+    segs = [(spans[i][0], spans[min(i + m, len(spans)) - 1][1],
+             spans[i:i + m]) for i in range(0, len(spans), m)]
+    return step, segs
+
+
+def pad_chunk_axis(chunks, pad_chunks: int):
+    """Segment chunk list padded to the segment bucket (last chunk repeated).
+
+    A ragged FINAL segment (``len(spans) % mega_chunks != 0``) would
+    otherwise scan a shorter chunk axis — a second XLA signature per mega
+    kernel per model, exactly the shape churn the ragged-ROW pad
+    (:func:`pad_rows`) already prevents.  Callers request padding only
+    when the grid spans more than one segment (a single-segment run has
+    one signature either way and padding it would multiply device work);
+    decodes iterate the REAL chunk list, so padded iterations' outputs
+    are never read.
+    """
+    if pad_chunks and len(chunks) < pad_chunks:
+        return list(chunks) + [chunks[-1]] * (pad_chunks - len(chunks))
+    return list(chunks)
+
+
 class BoxList:
     """Lazy sequence view over a (P, d) box tensor as per-partition dicts.
 

@@ -12,9 +12,13 @@ does it *across concurrent service requests*:
   **architecture** (``(in_dim,) + layer_sizes``, the family-stack key);
 * every arch bucket with ≥2 members stacks its requests' nets into ONE
   vmapped family (:func:`parallel.mesh.stack_models`) and all buckets'
-  (family, chunk) blocks ride ONE shared :class:`LaunchPipeline` through
-  :func:`verify.sweep.stage0_families` — one fused launch per chunk per
-  family, instead of one per chunk per *request*;
+  (family, segment) blocks ride ONE shared :class:`LaunchPipeline` through
+  :func:`verify.sweep.stage0_families` — under the device-resident
+  mega-loop (DESIGN.md §17) that is one ``lax.scan`` launch per
+  ``mega_chunks``-chunk segment per family, instead of one launch per
+  chunk per *request*; the stage-0 signature deliberately excludes
+  ``mega_chunks`` (it shapes launch structure, never results, so requests
+  with different knob values still coalesce);
 * the **model axis is a compiled-shape bucket** exactly like the chunk
   axis: ``pad_models`` (the server passes its ``max_batch``) pads every
   stack to one fixed width by repeating the last member, so a bucket of

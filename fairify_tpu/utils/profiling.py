@@ -98,6 +98,13 @@ class ThroughputCounter:
             "partitions_per_sec": round(pps, 4),
             "partitions_per_sec_per_chip": round(pps / max(self.n_devices, 1), 4),
             "device_launches": self.launches,
+            # Launch economy per model (lower is better, perfdiff-gated):
+            # O(segments) under the stage-0 mega-loop, O(chunks) before it.
+            # A ThroughputCounter always covers ONE verify_model run, so
+            # the per-model number IS the launch delta; multi-model
+            # harnesses (bench.py's AC suite line) divide their own launch
+            # delta by their stack width instead of dumping this counter.
+            "launches_per_model": self.launches,
         }
 
     def dump(self, path: str, phases: Optional[Dict[str, float]] = None,

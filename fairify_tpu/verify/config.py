@@ -43,6 +43,19 @@ class SweepConfig:
     # synchronous order.  Verdict maps are depth-invariant (chunk RNG
     # streams are keyed to global chunk starts, not fetch order).
     pipeline_depth: int = 2
+    # Device-resident stage-0 mega-loop (DESIGN.md §17): how many grid
+    # chunks one `lax.scan` launch certifies before the host sees results.
+    # Each segment is ONE obs_jit launch for the fused certify+attack pass
+    # (and the prune/parity passes), so a model's stage-0 launch count is
+    # O(ceil(chunks / mega_chunks)) instead of O(chunks); it is also the
+    # supervisor's retry/degrade unit (a fault degrades one segment) and
+    # bounds the stacked per-segment host+device buffers (attack candidates
+    # are drawn host-side per chunk and stacked on the scan axis).
+    # 0 = per-chunk launches (the pre-mega loop; also the forced path on
+    # mesh-sharded and non-CROWN runs, which have no fused body to scan).
+    # Verdict maps, counterexamples, and ledgers are bit-equal at every
+    # setting (tests/test_mega.py).
+    mega_chunks: int = 4
     engine: EngineConfig = field(default_factory=EngineConfig)
     result_dir: str = "res"
     profile_dir: Optional[str] = None  # XLA trace output (TensorBoard/XProf)

@@ -69,6 +69,23 @@ from fairify_tpu.utils.prng import grid_keys  # canonical key derivation
 
 
 @obs_jit(static_argnames=("sim_size",))
+def _mega_sim_and_bounds(net: MLP, keys, lo, hi, sim_size: int):
+    """Whole-segment prune pass: ``lax.scan`` over the chunk axis of the
+    transfer-light (``with_sim=False``) :func:`_sim_and_bounds` body — one
+    launch per segment (DESIGN.md §17).  Keys keep the global per-partition
+    derivation, so masks are bit-equal to the chunk loop's."""
+    def chunk_step(cursor, inp):
+        k, l, h = inp
+        stats, _, bounds = _sim_and_bounds.__wrapped__(
+            net, k, l, h, sim_size, False)
+        return cursor + 1, (stats, bounds)
+
+    _, (stats, bounds) = jax.lax.scan(
+        chunk_step, jnp.int32(0), (keys, lo, hi))
+    return stats, bounds
+
+
+@obs_jit(static_argnames=("sim_size",))
 def _sim_stats(net: MLP, keys, lo, hi, sim_size: int):
     """Simulation statistics only — no IBP bounds (harsh prune needs none)."""
     stats, _ = jax.vmap(
@@ -88,6 +105,7 @@ def sound_prune_grid(
     index_offset: int = 0,
     keep_sim: bool = True,
     pipeline_depth: int = 2,
+    mega_chunks: int = 0,
 ) -> PruneResult:
     """Sound pruning for a (P, d) box grid in batched device passes.
 
@@ -107,9 +125,17 @@ def sound_prune_grid(
     The pipeline changes only *when* results are fetched — launch order,
     kernel arguments, and per-partition keys are depth-invariant, so masks
     and samples are bit-equal at every depth (``tests/test_chunking.py``).
+
+    ``mega_chunks`` > 0 routes the transfer-light path (``keep_sim=False``)
+    through the device-resident mega-loop (DESIGN.md §17): segments of that
+    many chunks run as ONE ``lax.scan`` launch each (keys keep the global
+    per-partition derivation, masks bit-equal to the chunk loop).  The
+    sample-keeping path stays chunk-looped — stacking (P, S, d) sample
+    tensors across a segment would defeat the transfer bound.
     """
     from fairify_tpu.parallel.pipeline import LaunchPipeline
-    from fairify_tpu.partition.grid import chunk_spans, pad_rows
+    from fairify_tpu.partition.grid import (chunk_spans, pad_chunk_axis,
+                                            pad_rows, segment_spans)
 
     P = lo.shape[0]
     step, spans = chunk_spans(P, chunk)
@@ -140,6 +166,35 @@ def sound_prune_grid(
         if keep_sim:
             sim_c.append(sim[:n])
 
+    def _mega_submit(chunks, pad_chunks=0):
+        """One segment's prune launch: stacked chunk keys/boxes, one scan.
+
+        ``pad_chunks`` pads the scan's chunk axis to the segment bucket
+        (last chunk repeated) so a ragged FINAL segment reuses the
+        full-segment executable; the decode iterates the real ``chunks``
+        list, so padded iterations are never read.
+        """
+        blk = pad_chunk_axis(chunks, pad_chunks)
+        keys_c = [grid_keys(seed, index_offset + s, step) for s, _e in blk]
+        lo_c = [pad_rows(lo_np[s:e], step).astype(np.float32)
+                for s, e in blk]
+        hi_c = [pad_rows(hi_np[s:e], step).astype(np.float32)
+                for s, e in blk]
+        profiling.bump_launch()
+        payload = _mega_sim_and_bounds(
+            net, jnp.stack(keys_c), jnp.asarray(np.stack(lo_c)),
+            jnp.asarray(np.stack(hi_c)), sim_size)
+        return payload, chunks
+
+    def _mega_decode(chunks, host) -> None:
+        stats, bounds = host
+        for ci, (s, e) in enumerate(chunks):
+            n = e - s
+            cand_c.append([c[ci, :n] for c in stats.candidates])
+            pos_c.append([p[ci, :n] for p in stats.positive_prob])
+            lb_c.append([b[ci, :n] for b in bounds.ws_lb])
+            ub_c.append([b[ci, :n] for b in bounds.ws_ub])
+
     with span_obs:
         # gauge=False: a prune-phase micro-pipeline must not overwrite the
         # run pipeline's launches_in_flight overlap record.  fault_sites=
@@ -149,12 +204,26 @@ def sound_prune_grid(
         # the stage-0 chaos schedules count on.
         pipe = LaunchPipeline(depth=pipeline_depth, gauge=False,
                               fault_sites=False)
-        for s, e in spans:
-            for _meta, n, host in pipe.submit(
-                    lambda s=s, e=e: _chunk_submit(s, e)):
+        if mega_chunks > 0 and not keep_sim:
+            # Same segment grouping + ragged-tail bucket rule as the
+            # stage-0/parity loops (partition.grid.segment_spans), so the
+            # prune pass's launch signatures can never desync from theirs.
+            _, segs = segment_spans(P, chunk, mega_chunks)
+            bucket = mega_chunks if len(segs) > 1 else 0
+            for _seg_s, _seg_e, blk in segs:
+                for _meta, chunks, host in pipe.submit(
+                        lambda blk=blk: _mega_submit(blk,
+                                                     pad_chunks=bucket)):
+                    _mega_decode(chunks, host)
+            for _meta, chunks, host in pipe.drain():
+                _mega_decode(chunks, host)
+        else:
+            for s, e in spans:
+                for _meta, n, host in pipe.submit(
+                        lambda s=s, e=e: _chunk_submit(s, e)):
+                    _chunk_decode(n, host)
+            for _meta, n, host in pipe.drain():
                 _chunk_decode(n, host)
-        for _meta, n, host in pipe.drain():
-            _chunk_decode(n, host)
 
     L = len(cand_c[0])
     _cat = lambda parts: [np.concatenate([p[l] for p in parts]) for l in range(L)]

@@ -131,6 +131,41 @@ _chunk_spans = grid_mod.chunk_spans
 _pad_rows = grid_mod.pad_rows
 
 
+_segment_spans = grid_mod.segment_spans
+_pad_chunk_axis = grid_mod.pad_chunk_axis
+
+
+def _use_mega(cfg: SweepConfig, mesh) -> bool:
+    """Whether this run's stage-0 rides the device-resident mega-loop.
+
+    The mega kernels scan the FUSED certify+attack body, so they exist only
+    where that body does: CROWN certificates on an unsharded device
+    (``mesh`` runs shard per-chunk arrays and keep the chunk loop; the IBP
+    path never had a fused kernel to scan).  ``mega_chunks=0`` opts back
+    into the per-chunk launch loop everywhere.
+    """
+    return cfg.mega_chunks > 0 and cfg.engine.use_crown and mesh is None
+
+
+def _segment_tick(phase: str, done: int, total: int, partitions: int,
+                  in_flight: int = 0) -> None:
+    """Segment-granular progress: event-log record + throttled heartbeat.
+
+    Partitions decided INSIDE an in-flight mega launch are invisible to the
+    host until the segment drains, so per-partition progress stalls for the
+    whole launch; these ticks are what keeps a long single launch from
+    looking hung (``fairify_tpu report`` renders the events, the live
+    heartbeat prints the done/total line).
+    """
+    from fairify_tpu.obs import heartbeat as hb_mod
+
+    obs.event("segment", phase=phase, done=done, total=total,
+              partitions=partitions)
+    hb = hb_mod.active()
+    if hb is not None:
+        hb.segment(phase, done, total, in_flight=in_flight)
+
+
 def _supervisor(cfg: SweepConfig) -> Supervisor:
     """The run's launch supervisor, configured from the sweep knobs."""
     return Supervisor(max_retries=cfg.max_launch_retries,
@@ -349,6 +384,12 @@ def _stage0_certify_and_attack(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
     decode (flip extraction, exact ``validate_pair``) of block N overlaps
     the in-flight device work.  Submission order — hence every RNG stream,
     keyed to global block starts — is identical at every depth.
+
+    Under the mega-loop (:func:`_use_mega`, DESIGN.md §17) the pipeline
+    entry is a SEGMENT of ``cfg.mega_chunks`` chunks — one ``lax.scan``
+    launch, one packed decode, one supervisor retry/degrade unit — and
+    the chunk-granular loop below is the mesh/IBP fallback.  Verdict maps
+    are bit-equal between the two paths (tests/test_mega.py).
     """
     P = lo.shape[0]
     step, spans = _chunk_spans(P, cfg.grid_chunk)
@@ -357,6 +398,50 @@ def _stage0_certify_and_attack(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
     unsat = np.zeros(P, dtype=bool)
     sat = np.zeros(P, dtype=bool)
     witnesses: Dict[int, tuple] = {}
+
+    if _use_mega(cfg, mesh):
+        # Device-resident mega-loop (DESIGN.md §17): one ``lax.scan``
+        # launch certifies + attacks a whole SEGMENT of chunks; the host
+        # decodes its packed verdict/witness buffers once per segment.
+        # The pipeline now pipelines segments, so the supervisor's
+        # ``launch.submit``/``launch.decode`` sites fire — and exhaustion
+        # degrades — per segment (the configured blast radius).
+        _, segs = _segment_spans(P, cfg.grid_chunk, cfg.mega_chunks)
+        # Chunk-axis bucket: a multi-segment grid pads its ragged final
+        # segment up to mega_chunks so every segment hits ONE executable.
+        bucket = cfg.mega_chunks if len(segs) > 1 else 0
+        done = {"n": 0}
+
+        def consume_seg(meta, ctx, host):
+            seg_s, seg_e, chunks = meta
+            done["n"] += 1
+            drained = 0
+            if isinstance(host, ChunkFailure):
+                # A degraded segment still counts toward done/total, but
+                # NONE of its partitions drained (the report's segments
+                # table must agree with the degradation table beside it).
+                if on_failure is not None:
+                    on_failure(seg_s, seg_e, host)
+            else:
+                drained = seg_e - seg_s
+                for (s, e), (u, sa, w) in zip(
+                        chunks, _mega_segment_decode(host, ctx)):
+                    unsat[s:e], sat[s:e] = u[: e - s], sa[: e - s]
+                    witnesses.update(
+                        {s + k: v for k, v in w.items() if k < e - s})
+            _segment_tick("stage0_decide", done["n"], len(segs),
+                          drained, in_flight=len(pipe))
+
+        for seg_s, seg_e, chunks in segs:
+            for item in pipe.submit(
+                    lambda chunks=chunks: _mega_segment_submit(
+                        net, enc, lo, hi, cfg, chunks, step, seed_offset,
+                        pad_chunks=bucket),
+                    meta=(seg_s, seg_e, chunks)):
+                consume_seg(*item)
+        for item in pipe.drain():
+            consume_seg(*item)
+        return unsat, sat, witnesses
 
     def consume(meta, ctx, host):
         s, e = meta
@@ -497,6 +582,206 @@ def _stage0_block(net, enc: PairEncoding, lo, hi, cfg: SweepConfig, mesh, rng_se
     return _stage0_block_decode(jax.device_get(payload), ctx)
 
 
+# ---------------------------------------------------------------------------
+# Device-resident stage-0 mega-loop (DESIGN.md §17)
+# ---------------------------------------------------------------------------
+
+
+@obs_jit(static_argnames=("alpha_iters",))
+def _mega_stage0_kernel(net, x_lo, x_hi, xp_lo, xp_hi, plo, phi, av, pm, rm,
+                        eps, va, vp, xr, pr, alpha_iters):
+    """Stage-0 certify + attack for a whole SEGMENT of chunks, ONE launch.
+
+    ``lax.scan`` over the leading chunk axis (C) of every per-chunk tensor:
+    each step runs the exact fused body the chunk loop launches
+    (:func:`engine._certify_attack_impl`), so C chunks cost one dispatch
+    round-trip instead of C — the α,β-CROWN "rapid massively-parallel
+    incomplete verifier" shape (PAPERS.md: arxiv 2011.13824) with the
+    incomplete pass living entirely on device.  The scan carry is the chunk
+    cursor; the per-chunk attack RNG stays keyed to GLOBAL chunk starts and
+    is drawn host-side at submit (stacked on the scan axis), so the packed
+    results are bit-equal to the chunk loop's by construction.
+
+    Returns ``(cert (C, P), wit (C, P, 3), reason (C, P))``: the packed
+    verdict array, the counterexample index buffer (sample and role-pair
+    indices into the host-kept candidates), and a per-partition int8
+    reason code (0 = undecided, 1 = certified UNSAT, 2 = attack flip,
+    3 = both) the host decodes once per segment — the decode derives the
+    flip mask from the codes (``reason >= 2``), skips witness extraction
+    for flip-free chunks, and resolves flips via exact witness replay.
+    """
+    def chunk_step(cursor, inp):
+        a, b, c, d, l, h, v, xr_c, pr_c = inp
+        cert, _, found, wit = engine._certify_attack_impl(
+            net, a, b, c, d, l, h, av, pm, rm, eps, v, vp, xr_c, pr_c,
+            alpha_iters)
+        reason = cert.astype(jnp.int8) + 2 * found.astype(jnp.int8)
+        return cursor + 1, (cert, wit, reason)
+
+    _, packed = jax.lax.scan(
+        chunk_step, jnp.int32(0),
+        (x_lo, x_hi, xp_lo, xp_hi, plo, phi, va, xr, pr))
+    return packed
+
+
+@obs_jit(static_argnames=("alpha_iters",))
+def _mega_family_stage0_kernel(stacked, x_lo, x_hi, xp_lo, xp_hi, plo, phi,
+                               av, pm, rm, eps, va, vp, xr, pr, alpha_iters):
+    """:func:`_mega_stage0_kernel` for a stacked model family: scan over the
+    chunk axis of a vmapped fused body — the whole (models × chunks) stage-0
+    pass of a family is ONE launch per segment, which is what turns the
+    serve batcher's coalesced buckets into mega-launches."""
+    from fairify_tpu.models.mlp import MLP
+
+    def chunk_step(cursor, inp):
+        a, b, c, d, l, h, v, xr_c, pr_c = inp
+        cert, _, found, wit = jax.vmap(
+            lambda net: engine._certify_attack_impl(
+                net, a, b, c, d, l, h, av, pm, rm, eps, v, vp, xr_c, pr_c,
+                alpha_iters)
+        )(MLP(stacked.weights, stacked.biases, stacked.masks))
+        reason = cert.astype(jnp.int8) + 2 * found.astype(jnp.int8)
+        return cursor + 1, (cert, wit, reason)
+
+    _, packed = jax.lax.scan(
+        chunk_step, jnp.int32(0),
+        (x_lo, x_hi, xp_lo, xp_hi, plo, phi, va, xr, pr))
+    return packed
+
+
+def _mega_chunk_inputs(enc: PairEncoding, lo, hi, cfg: SweepConfig,
+                       chunks, step: int, seed_offset: int,
+                       pad_chunks: int = 0):
+    """Stacked per-chunk device inputs for one segment.
+
+    Each chunk is padded to the chunk bucket and its attack candidates are
+    drawn from the SAME host RNG derivation the chunk loop uses
+    (``engine.seed + seed_offset + chunk_start``, on the padded rows) —
+    the per-chunk key derivation folded into the scan's input stack, so
+    segment grouping can never shift an RNG stream.  ``pad_chunks`` pads
+    the CHUNK axis to the segment bucket (:func:`_pad_chunk_axis`) so a
+    ragged final segment reuses the full-segment executable.
+    """
+    bufs = [[] for _ in range(9)]
+    for s, e in _pad_chunk_axis(chunks, pad_chunks):
+        clo, chi = _pad_rows(lo[s:e], step), _pad_rows(hi[s:e], step)
+        flo, fhi = clo.astype(np.float32), chi.astype(np.float32)
+        x_lo, x_hi, xp_lo, xp_hi, valid = role_boxes(enc, flo, fhi)
+        rng = np.random.default_rng(cfg.engine.seed + seed_offset + s)
+        xr, pr = engine.build_attack_candidates(enc, rng, clo, chi,
+                                                cfg.engine.attack_samples)
+        for buf, arr in zip(bufs, (x_lo, x_hi, xp_lo, xp_hi, flo, fhi,
+                                   valid, xr, pr)):
+            buf.append(arr)
+    return tuple(np.stack(b) for b in bufs)
+
+
+def _mega_segment_submit(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
+                         chunks, step: int, seed_offset: int,
+                         pad_chunks: int = 0):
+    """Dispatch one segment's mega launch; no sync on its results.
+
+    Same ``(payload, ctx)`` contract as :func:`_stage0_block_submit`, one
+    pipeline entry per SEGMENT: the supervisor's retry/degrade unit — and
+    therefore a fault's blast radius — is the segment.
+    """
+    (x_lo, x_hi, xp_lo, xp_hi, plo, phi, valid,
+     xr, pr) = _mega_chunk_inputs(enc, lo, hi, cfg, chunks, step,
+                                  seed_offset, pad_chunks)
+    assign_vals, pa_mask, ra_mask = engine._enc_tensors(enc, lo.shape[1])
+    profiling.bump_launch()
+    cert, wit, reason = _mega_stage0_kernel(
+        net, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
+        jnp.asarray(xp_hi), jnp.asarray(plo), jnp.asarray(phi),
+        jnp.asarray(assign_vals), jnp.asarray(pa_mask),
+        jnp.asarray(ra_mask), float(enc.eps), jnp.asarray(valid),
+        jnp.asarray(enc.valid_pair), jnp.asarray(xr), jnp.asarray(pr),
+        alpha_iters=0,
+    )
+    ctx = {"net": net, "enc": enc, "chunks": chunks, "xr": xr, "pr": pr,
+           "kind": "mega"}
+    return {"cert": cert, "wit": wit, "reason": reason}, ctx
+
+
+def _mega_family_segment_submit(stacked, enc: PairEncoding, lo, hi,
+                                cfg: SweepConfig, chunks, step: int,
+                                seed_offset: int, pad_chunks: int = 0):
+    """Family-stacked :func:`_mega_segment_submit` (one launch per
+    (family, segment) — the AC suite and every coalesced serve bucket)."""
+    (x_lo, x_hi, xp_lo, xp_hi, plo, phi, valid,
+     xr, pr) = _mega_chunk_inputs(enc, lo, hi, cfg, chunks, step,
+                                  seed_offset, pad_chunks)
+    assign_vals, pa_mask, ra_mask = engine._enc_tensors(enc, lo.shape[1])
+    profiling.bump_launch()
+    cert, wit, reason = _mega_family_stage0_kernel(
+        stacked, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
+        jnp.asarray(xp_hi), jnp.asarray(plo), jnp.asarray(phi),
+        jnp.asarray(assign_vals), jnp.asarray(pa_mask),
+        jnp.asarray(ra_mask), float(enc.eps), jnp.asarray(valid),
+        jnp.asarray(enc.valid_pair), jnp.asarray(xr), jnp.asarray(pr),
+        alpha_iters=0,
+    )
+    ctx = {"stacked": stacked, "enc": enc, "chunks": chunks,
+           "M": stacked.weights[0].shape[0], "xr": xr, "pr": pr,
+           "kind": "mega_family"}
+    return {"cert": cert, "wit": wit, "reason": reason}, ctx
+
+
+def _mega_segment_decode(host, ctx):
+    """Host decode of one drained mega segment → per-chunk results.
+
+    ONE decode per segment: the packed reason codes bucket each chunk's
+    partitions (certified / flip found / undecided) — the flip mask is
+    ``reason >= 2`` and flip-free chunks skip witness extraction
+    entirely; flip hits pay the same exact ``validate_pair`` replay as
+    the per-chunk decode.  Returns the chunk loop's ``(unsat, sat,
+    witnesses)`` triple per chunk (padded rows included; the consumer's
+    span slice trims, as everywhere else).  Padded CHUNK-axis entries
+    (``_pad_chunk_axis``) are simply never iterated — ``ctx["chunks"]``
+    is the real list.
+    """
+    net, enc, chunks = ctx["net"], ctx["enc"], ctx["chunks"]
+    cert = np.asarray(host["cert"])
+    wit, reason = np.asarray(host["wit"]), np.asarray(host["reason"])
+    weights = [np.asarray(w) for w in net.weights]
+    biases = [np.asarray(b) for b in net.biases]
+    out = []
+    for ci in range(len(chunks)):
+        found = reason[ci] >= 2
+        witnesses = engine.extract_witnesses(
+            found, wit[ci], ctx["xr"][ci], ctx["pr"][ci],
+            weights, biases) if found.any() else {}
+        sat = np.zeros(cert.shape[1], dtype=bool)
+        sat[list(witnesses)] = True
+        out.append((cert[ci], sat, witnesses))
+    return out
+
+
+def _mega_family_segment_decode(host, ctx):
+    """Family decode: per-chunk LIST of per-model ``(unsat, sat, wits)``."""
+    stacked, enc, M = ctx["stacked"], ctx["enc"], ctx["M"]
+    chunks = ctx["chunks"]
+    cert = np.asarray(host["cert"])  # (C, M, P)
+    wit, reason = np.asarray(host["wit"]), np.asarray(host["reason"])
+    weights_m = [[np.asarray(w[m]) for w in stacked.weights]
+                 for m in range(M)]
+    biases_m = [[np.asarray(b[m]) for b in stacked.biases]
+                for m in range(M)]
+    out = []
+    for ci in range(len(chunks)):
+        per_model = []
+        for m in range(M):
+            found = reason[ci, m] >= 2
+            witnesses = engine.extract_witnesses(
+                found, wit[ci, m], ctx["xr"][ci], ctx["pr"][ci],
+                weights_m[m], biases_m[m]) if found.any() else {}
+            sat = np.zeros(cert.shape[2], dtype=bool)
+            sat[list(witnesses)] = True
+            per_model.append((cert[ci, m], sat, witnesses))
+        out.append(per_model)
+    return out
+
+
 @obs_jit(static_argnames=("alpha_iters",))
 def _family_certify_kernel(stacked, a, b, c, d, plo, phi, av, pm, rm, eps,
                            va, vp, alpha_iters):
@@ -569,13 +854,16 @@ def stage0_families(stacks, enc: PairEncoding, lo, hi, cfg: SweepConfig,
                     mesh=None, pipe=None, seed_offset: int = 0):
     """Stage 0 for SEVERAL stacked families through one shared launch queue.
 
-    Every (family, grid-chunk) block is an independent launch, so they all
-    ride the same async pipeline: the per-model host decode of one family's
-    chunk (witness extraction, exact ``validate_pair``) overlaps the next
-    chunk's — or the next *family's* — in-flight kernel, and the 12-model
-    AC suite never drains the device queue between architecture groups.
-    Returns one result list (per-model ``(unsat, sat, witnesses)``) per
-    entry of ``stacks``.
+    Every (family, segment) block — (family, grid-chunk) on the fallback
+    chunk path — is an independent launch, so they all ride the same async
+    pipeline: the per-model host decode of one family's block (witness
+    extraction, exact ``validate_pair``) overlaps the next block's — or
+    the next *family's* — in-flight kernel, and the 12-model AC suite
+    never drains the device queue between architecture groups.  Under the
+    mega-loop one ``lax.scan`` launch covers a whole family × segment
+    (DESIGN.md §17), which is what turns the serve batcher's coalesced
+    buckets into mega-launches.  Returns one result list (per-model
+    ``(unsat, sat, witnesses)``) per entry of ``stacks``.
 
     ``seed_offset`` ties the attack RNG to the grid's GLOBAL start index
     (same contract as :func:`_stage0_certify_and_attack`): a caller handing
@@ -593,6 +881,50 @@ def stage0_families(stacks, enc: PairEncoding, lo, hi, cfg: SweepConfig,
         accs.append(([np.zeros(P, dtype=bool) for _ in range(M)],
                      [np.zeros(P, dtype=bool) for _ in range(M)],
                      [{} for _ in range(M)]))
+
+    if _use_mega(cfg, mesh):
+        # Mega-loop path (DESIGN.md §17): one scan launch per (family,
+        # segment) — C chunks × M models of fused certify+attack in a
+        # single dispatch; a degraded segment leaves exactly its span
+        # undecided (upward degradation to the per-model PGD/BaB tier,
+        # same contract as the chunk loop).
+        _, segs = _segment_spans(P, cfg.grid_chunk, cfg.mega_chunks)
+        bucket = cfg.mega_chunks if len(segs) > 1 else 0
+        total = len(segs) * len(stacks)
+        done = {"n": 0}
+
+        def consume_seg(meta, ctx, host):
+            gi, seg_s, seg_e, chunks = meta
+            done["n"] += 1
+            drained = 0
+            if isinstance(host, ChunkFailure):
+                obs.registry().counter("chunks_degraded").inc(site=host.site)
+                obs.event("degraded", **host.to_record(),
+                          phase="stage0_family", partitions=seg_e - seg_s)
+            else:
+                drained = seg_e - seg_s
+                unsat, sat, wits = accs[gi]
+                for (s, e), per_model in zip(
+                        chunks, _mega_family_segment_decode(host, ctx)):
+                    for m, (u, sa, w) in enumerate(per_model):
+                        unsat[m][s:e], sat[m][s:e] = u[: e - s], sa[: e - s]
+                        wits[m].update(
+                            {s + k: v for k, v in w.items() if k < e - s})
+            _segment_tick("stage0_family", done["n"], total, drained,
+                          in_flight=len(pipe))
+
+        for gi, stacked in enumerate(stacks):
+            for seg_s, seg_e, chunks in segs:
+                for item in pipe.submit(
+                        lambda stacked=stacked, chunks=chunks:
+                        _mega_family_segment_submit(
+                            stacked, enc, lo, hi, cfg, chunks, step,
+                            seed_offset, pad_chunks=bucket),
+                        meta=(gi, seg_s, seg_e, chunks)):
+                    consume_seg(*item)
+        for item in pipe.drain():
+            consume_seg(*item)
+        return [list(zip(*acc)) for acc in accs]
 
     def consume(meta, ctx, host):
         gi, s, e = meta
@@ -762,6 +1094,23 @@ def _parity_grid_from_keys(net, keys, lo, hi, alive, sim_size: int):
         return jnp.mean((orig == masked).astype(jnp.float32))
 
     return jax.vmap(one)(keys, lo, hi, alive)
+
+
+@obs_jit(static_argnames=("sim_size",))
+def _mega_parity_kernel(net, keys, lo, hi, alive, sim_size: int):
+    """Whole-segment parity pass: ``lax.scan`` over the chunk axis of
+    :func:`_parity_grid_from_keys`'s body — one launch per segment instead
+    of one per chunk, same launch economics as the stage-0 mega kernel.
+    Inputs carry a leading (C) chunk axis; simulation keys stay the global
+    per-partition ``grid_keys`` derivation, so every sample row is
+    bit-identical to the chunk loop's."""
+    def chunk_step(cursor, inp):
+        k, l, h, masks = inp
+        return cursor + 1, _parity_grid_from_keys.__wrapped__(
+            net, k, l, h, masks, sim_size)
+
+    _, parity = jax.lax.scan(chunk_step, jnp.int32(0), (keys, lo, hi, alive))
+    return parity
 
 
 @obs_jit(static_argnames=("sim_size",))
@@ -1049,6 +1398,11 @@ def _verify_model_impl(
                     net, lo, hi, cfg.sim_size, cfg.seed,
                     exact_certify=cfg.exact_certify_masks, chunk=cfg.grid_chunk,
                     index_offset=span_start, keep_sim=False,
+                    # One switch for the whole run's launch structure: the
+                    # prune pass segments its chunks exactly when stage 0
+                    # does (DESIGN.md §17), so launches stay O(segments).
+                    mega_chunks=cfg.mega_chunks if _use_mega(cfg, mesh)
+                    else 0,
                 ), site="prune")
             except ChunkDegraded as exc:
                 # Pruning feeds only mask-derived REPORTING (compression
@@ -1108,12 +1462,60 @@ def _verify_model_impl(
                     return
                 parity[s:e] = np.asarray(host)[: e - s]
 
-            for s, e in (spans if prune is not None else ()):
-                for item in pipe.submit(
-                        lambda s=s, e=e: _parity_submit(s, e), meta=(s, e)):
+            def _mega_parity_submit(chunks, pad_chunks=0):
+                keys_c, lo_c, hi_c = [], [], []
+                alive_c = [[] for _ in prune.st_deads[:-1]]
+                for s, e in _pad_chunk_axis(chunks, pad_chunks):
+                    for buf, d in zip(alive_c, prune.st_deads[:-1]):
+                        buf.append(_pad_rows(1.0 - d[s:e],
+                                             step).astype(np.float32))
+                    keys_c.append(pruning.grid_keys(cfg.seed,
+                                                    span_start + s, step))
+                    lo_c.append(_pad_rows(lo[s:e], step).astype(np.float32))
+                    hi_c.append(_pad_rows(hi[s:e], step).astype(np.float32))
+                profiling.bump_launch()
+                block = _mega_parity_kernel(
+                    net, jnp.stack(keys_c),
+                    jnp.asarray(np.stack(lo_c)), jnp.asarray(np.stack(hi_c)),
+                    tuple(jnp.asarray(np.stack(b)) for b in alive_c),
+                    cfg.sim_size)
+                return block, chunks
+
+            def _mega_parity_consume(meta, ctx, host):
+                seg_s, seg_e, chunks = meta
+                if isinstance(host, ChunkFailure):
+                    _degrade([i for i in range(seg_s, seg_e)
+                              if not sat0[i] and not unsat0[i]],
+                             host, "stage0_parity")
+                    return
+                block = np.asarray(host)
+                for ci, (s, e) in enumerate(chunks):
+                    parity[s:e] = block[ci, : e - s]
+
+            if _use_mega(cfg, mesh) and prune is not None:
+                # Segment-granular parity launches (DESIGN.md §17): the
+                # parity pass shares the stage-0 segment spans so a model's
+                # launch count stays O(segments) end to end, and a fault
+                # here degrades (still-undecided partitions of) exactly one
+                # segment, same as the chunk loop's per-chunk radius.
+                _, psegs = _segment_spans(P, cfg.grid_chunk, cfg.mega_chunks)
+                pbucket = cfg.mega_chunks if len(psegs) > 1 else 0
+                for seg_s, seg_e, chunks in psegs:
+                    for item in pipe.submit(
+                            lambda chunks=chunks: _mega_parity_submit(
+                                chunks, pad_chunks=pbucket),
+                            meta=(seg_s, seg_e, chunks)):
+                        _mega_parity_consume(*item)
+                for item in pipe.drain():
+                    _mega_parity_consume(*item)
+            else:
+                for s, e in (spans if prune is not None else ()):
+                    for item in pipe.submit(
+                            lambda s=s, e=e: _parity_submit(s, e),
+                            meta=(s, e)):
+                        _parity_consume(*item)
+                for item in pipe.drain():
                     _parity_consume(*item)
-            for item in pipe.drain():
-                _parity_consume(*item)
         stage0_per_part = 0.0  # finalized (incl. the PGD phase) below
 
         outcomes: List[PartitionOutcome] = []

@@ -233,6 +233,41 @@ def main() -> int:
         failures += 0 if row["ok"] else 1
         print(json.dumps(row), flush=True)
 
+    # Mega-segment blast-radius cells (ISSUE 14 / DESIGN.md §17): with
+    # one-chunk segments, exhausting exactly one segment's submit (or
+    # decode) attempts — nth 2-4 covers segment 2's attempt plus both
+    # retries at the default max_launch_retries=2 — must degrade that
+    # segment's partitions ONLY, and resume must converge to the
+    # fault-free map.
+    for site in ("launch.submit", "launch.decode"):
+        spec = f"{site}:transient:2-4"
+        rdir = os.path.join(args.out, f"mega_{site.replace('.', '_')}")
+        cfg = cfg0.with_(result_dir=rdir, mega_chunks=1,
+                         inject_faults=(spec,))
+        row = {"cell": f"mega/{site}/exhausted-mid-segment", "spec": spec}
+        try:
+            rep = sweep.verify_model(net, cfg, model_name="m", resume=False,
+                                     partition_span=span)
+        except BaseException as exc:
+            row["crashed"] = f"{type(exc).__name__}: {exc}"
+            row["ok"] = False
+            failures += 1
+            print(json.dumps(row), flush=True)
+            continue
+        got = _vmap(rep)
+        seg = set(range(args.grid_chunk + 1, 2 * args.grid_chunk + 1))
+        blast_exact = rep.degraded == args.grid_chunk and all(
+            got[pid] == "unknown" for pid in seg) and all(
+            got[k] == want[k] for k in got if k not in seg)
+        resumed = sweep.verify_model(
+            net, cfg.with_(inject_faults=()), model_name="m", resume=True,
+            partition_span=span)
+        row.update(degraded=rep.degraded, blast_radius_exact=blast_exact,
+                   resume_converged=_vmap(resumed) == want)
+        row["ok"] = bool(blast_exact and row["resume_converged"])
+        failures += 0 if row["ok"] else 1
+        print(json.dumps(row), flush=True)
+
     # Shard-loss cells: device.lost at each shard index × transient/fatal
     # over the sharded runtime.  The fault-free SHARDED run is the pin —
     # it must itself equal the single-chip map (cross-path invariance).

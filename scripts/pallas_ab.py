@@ -1,22 +1,28 @@
 #!/usr/bin/env python
-"""On-chip A/B: Pallas fused-IBP kernel vs the XLA interval path.
+"""On-chip A/B: device-resident mega-loop vs the per-chunk launch loop.
 
-HISTORICAL RECORD — this harness produced ``audits/pallas_ab_r5.json``
-(GC-1: pallas 0.97x, AC-1: 0.83x isolated / 1.08x e2e, masks identical):
-on the tunnelled single chip every stage-0 call is launch-bound (~100 ms
-relay round-trip), so a fused-VMEM kernel cannot beat the already-fused
-XLA jit.  Per VERDICT r4 weak #4 ("prove it or remove it") the kernel
-was removed right after this run; to re-run the A/B, check out the tree
-at commit 7b248ba (the last with ``ops/pallas_ibp.py``).
+Lineage — this harness was born as the Pallas fused-IBP A/B and produced
+``audits/pallas_ab_r5.json`` (pallas 0.97x on GC-1: on a launch-bound
+tunnelled chip a fused-VMEM kernel cannot beat the already-fused XLA jit;
+the kernel was removed per VERDICT r4 weak #4, last tree with it at commit
+7b248ba).  The round-14 successor A/Bs the NEXT launch-economy lever on
+the same stage-0 call sites: the ``lax.scan`` mega-loop (ISSUE 14,
+DESIGN.md §17) that certifies a whole segment of grid chunks in ONE
+``obs_jit`` launch, against the per-chunk multi-launch loop it replaces.
 
-VERDICT r4 weak #4: the flag-gated ``ops/pallas_ibp.py`` kernel was never
-benchmarked on the real chip — "prove it or remove it".  This harness times
-the exact stage-0 pruning call both paths serve
-(:func:`pruning.sound_prune_grid` via ``_sim_and_bounds``'s ``pallas`` flag,
-plus the isolated bounds kernels) on the GC and AC grids, checks the two
-paths' pruning masks agree, and writes ``audits/pallas_ab_r5.json``.
+Per config (GC-1 and an AC prefix), both arms run the identical fused
+certify+attack pass over the same grid prefix through
+``sweep._stage0_certify_and_attack``:
 
-Usage: python scripts/pallas_ab.py [--iters 5]
+* **chunked** — ``mega_chunks=0``: one launch per grid chunk (the pre-r14
+  loop, kept as the mesh/non-CROWN fallback);
+* **mega** — whole-prefix segments: ONE launch for all chunks.
+
+and the harness records wall time, launch counts, speedup, and checks the
+two arms' (unsat, sat, witness) maps are bit-identical — the invariant
+tests/test_mega.py pins in tier-1.
+
+Usage: python scripts/pallas_ab.py [--iters 5] [--out audits/mega_ab_r14.json]
 """
 from __future__ import annotations
 
@@ -34,89 +40,78 @@ os.chdir(ROOT)
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--iters", type=int, default=5)
-    ap.add_argument("--out", default="audits/pallas_ab_r5.json")
+    ap.add_argument("--chunk", type=int, default=256,
+                    help="grid chunk for the A/B (small enough that the "
+                         "prefix spans several chunks)")
+    ap.add_argument("--prefix", type=int, default=2048,
+                    help="partition-grid prefix per config")
+    ap.add_argument("--out", default="audits/mega_ab_r14.json")
     args = ap.parse_args()
 
-    try:
-        from fairify_tpu.ops import pallas_ibp
-    except ImportError:
-        raise SystemExit(
-            "ops/pallas_ibp.py was removed after this A/B concluded the "
-            "kernel gives no win on the launch-bound tunnelled chip "
-            "(audits/pallas_ab_r5.json holds the recorded numbers).  To "
-            "re-run, check out commit 7b248ba — the last tree with the "
-            "kernel.")
-
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
     from fairify_tpu.models import zoo
-    from fairify_tpu.ops import interval as interval_ops
+    from fairify_tpu.utils import profiling
     from fairify_tpu.utils.cache import enable_persistent_cache
-    from fairify_tpu.utils.prng import grid_keys
-    from fairify_tpu.verify import presets, pruning, sweep
+    from fairify_tpu.verify import presets, sweep
+    from fairify_tpu.verify.property import encode
 
     enable_persistent_cache()
     out = {"platform": jax.devices()[0].platform,
-           "device": str(jax.devices()[0]), "configs": []}
+           "device": str(jax.devices()[0]),
+           "iters": args.iters, "configs": []}
 
     for preset_name, model in (("GC", "GC-1"), ("AC", "AC-1")):
-        cfg = presets.get(preset_name).with_(result_dir="/tmp/pallas_ab")
-        net = zoo.load(cfg.dataset, model)
-        _, lo, hi = sweep.build_partitions(cfg)
-        P = min(lo.shape[0], 2048)
+        cfg0 = presets.get(preset_name).with_(
+            result_dir="/tmp/mega_ab", grid_chunk=args.chunk)
+        try:
+            net = zoo.load(cfg0.dataset, model)
+        except (OSError, KeyError):
+            # Reference zoo assets absent (bare container): synthetic twin
+            # at the domain width — the A/B measures launch economics, not
+            # this particular net's verdicts.
+            from fairify_tpu.models.train import init_mlp
+
+            net = init_mlp((len(cfg0.query().columns), 50, 1), seed=0)
+            model += " (synthetic twin)"
+        enc = encode(cfg0.query())
+        _, lo, hi = sweep.build_partitions(cfg0)
+        P = min(lo.shape[0], args.prefix)
         lo, hi = lo[:P], hi[:P]
-        flo = jnp.asarray(lo, jnp.float32)
-        fhi = jnp.asarray(hi, jnp.float32)
-        if not pallas_ibp.available(net):
-            out["configs"].append({"preset": preset_name, "model": model,
-                                   "skipped": "net wider than LANE pad"})
-            continue
+        n_chunks = (P + args.chunk - 1) // args.chunk
 
-        # (a) isolated bounds kernels — the component the Pallas kernel
-        # replaces (jitted wrappers, block_until_ready timing).
-        xla_fn = jax.jit(lambda l, h: interval_ops.network_bounds(net, l, h))
-        pl_fn = jax.jit(
-            lambda l, h: interval_ops.network_bounds_pallas(net, l, h))
-        rows = {}
-        for name, fn in (("xla", xla_fn), ("pallas", pl_fn)):
-            r = fn(flo, fhi)  # compile
-            jax.block_until_ready(r)
-            t0 = time.perf_counter()
+        arms = {"chunked": cfg0.with_(mega_chunks=0),
+                "mega": cfg0.with_(mega_chunks=n_chunks)}
+        rows, results, launches = {}, {}, {}
+        for name, cfg in arms.items():
+            # One untimed pass per arm compiles its kernels at the exact
+            # shapes, so the timed medians measure launches, not traces.
+            sweep._stage0_certify_and_attack(net, enc, lo, hi, cfg)
+            times = []
             for _ in range(args.iters):
-                jax.block_until_ready(fn(flo, fhi))
-            rows[name] = (time.perf_counter() - t0) / args.iters
-        # Mask agreement: the consumer of these bounds is the dead-neuron
-        # criterion; both paths must prune identically.
-        bx = xla_fn(flo, fhi)
-        bp = pl_fn(flo, fhi)
-        dead_x = [np.asarray(d) for d in interval_ops.dead_from_ws_ub(bx)]
-        dead_p = [np.asarray(d) for d in interval_ops.dead_from_ws_ub(bp)]
-        masks_equal = all(np.array_equal(a, b)
-                          for a, b in zip(dead_x, dead_p))
+                l0 = profiling.launch_count()
+                t0 = time.perf_counter()
+                res = sweep._stage0_certify_and_attack(net, enc, lo, hi, cfg)
+                times.append(time.perf_counter() - t0)
+                launches[name] = profiling.launch_count() - l0
+            results[name] = res
+            rows[name] = sorted(times)[len(times) // 2]
 
-        # (b) end-to-end stage-0 prune (sim + bounds fused in one jit) with
-        # the pallas flag off/on — what the sweep actually pays.
-        e2e = {}
-        for name, flag in (("xla", False), ("pallas", True)):
-            keys = grid_keys(cfg.seed, 0, P)
-            r = pruning._sim_and_bounds(net, keys, flo, fhi, cfg.sim_size,
-                                        pallas=flag, with_sim=False)
-            jax.block_until_ready(r)
-            t0 = time.perf_counter()
-            for _ in range(args.iters):
-                jax.block_until_ready(pruning._sim_and_bounds(
-                    net, keys, flo, fhi, cfg.sim_size, pallas=flag,
-                    with_sim=False))
-            e2e[name] = (time.perf_counter() - t0) / args.iters
+        u_c, s_c, w_c = results["chunked"]
+        u_m, s_m, w_m = results["mega"]
+        equal = (np.array_equal(u_c, u_m) and np.array_equal(s_c, s_m)
+                 and set(w_c) == set(w_m)
+                 and all(np.array_equal(w_c[k][0], w_m[k][0])
+                         and np.array_equal(w_c[k][1], w_m[k][1])
+                         for k in w_c))
         out["configs"].append({
             "preset": preset_name, "model": model, "partitions": int(P),
-            "bounds_ms": {k: round(v * 1e3, 2) for k, v in rows.items()},
-            "bounds_speedup_pallas": round(rows["xla"] / rows["pallas"], 3),
-            "prune_e2e_ms": {k: round(v * 1e3, 2) for k, v in e2e.items()},
-            "prune_speedup_pallas": round(e2e["xla"] / e2e["pallas"], 3),
-            "dead_masks_equal": bool(masks_equal),
+            "grid_chunk": args.chunk, "chunks": int(n_chunks),
+            "stage0_ms": {k: round(v * 1e3, 2) for k, v in rows.items()},
+            "launches": {k: int(v) for k, v in launches.items()},
+            "speedup_mega": round(rows["chunked"] / rows["mega"], 3),
+            "verdicts_bit_equal": bool(equal),
         })
         print(json.dumps(out["configs"][-1]), flush=True)
 

@@ -257,7 +257,10 @@ def test_ragged_chunk_single_compile_and_verdict_invariance(
     from fairify_tpu.verify.oracle import random_net
 
     net = random_net(np.random.default_rng(11), (3, 5, 1))
-    cfg = _tiny_cfg(tmp_path / "ragged", grid_chunk=4)
+    # mega_chunks=0 pins the per-chunk loop's ragged-pad contract; the
+    # mega path's twin (scan kernels, same pad inside the segment stack)
+    # is asserted below.
+    cfg = _tiny_cfg(tmp_path / "ragged", grid_chunk=4, mega_chunks=0)
     c = obs.registry().counter("xla_compiles")
     ragged = sweep.verify_model(net, cfg, model_name="m", resume=False)
     # 7 partitions / chunk 4 → spans of 4,3: the ragged last block must
@@ -271,6 +274,17 @@ def test_ragged_chunk_single_compile_and_verdict_invariance(
     assert thr["n_compiles"] == int(sum(
         s["value"] for s in c.snapshot()))
     assert thr["compile_s"] > 0
+
+    # Mega-loop ragged twin: both chunks (one ragged, padded) ride ONE
+    # scan launch per phase and each mega kernel compiles exactly once.
+    mega = sweep.verify_model(
+        net, _tiny_cfg(tmp_path / "mega", grid_chunk=4),
+        model_name="m", resume=False)
+    for kern in ("sweep.mega_stage0_kernel", "pruning.mega_sim_and_bounds",
+                 "sweep.mega_parity_kernel"):
+        assert c.value(kernel=kern) == 1, kern
+    assert [o.verdict for o in mega.outcomes] == \
+        [o.verdict for o in ragged.outcomes]
 
     whole = sweep.verify_model(
         net, _tiny_cfg(tmp_path / "whole", grid_chunk=0),
@@ -296,14 +310,22 @@ def test_family_ragged_chunk_single_compile(tmp_path, tiny_domain):
     _, lo, hi = sweep.build_partitions(cfg)
     assert lo.shape[0] % 4 != 0  # the point: a ragged last chunk
     c = obs.registry().counter("xla_compiles")
-    chunked = sweep._stage0_family(stacked, enc, lo, hi, cfg)
+    chunked = sweep._stage0_family(stacked, enc, lo, hi,
+                                   cfg.with_(mega_chunks=0))
     assert c.value(kernel="sweep.family_stage0_kernel") == 1
+    # Mega twin: the ragged chunk pads inside the segment stack and the
+    # whole family×segment pass is one compiled scan kernel.
+    mega = sweep._stage0_family(stacked, enc, lo, hi, cfg)
+    assert c.value(kernel="sweep.mega_family_stage0_kernel") == 1
     whole = sweep._stage0_family(stacked, enc, lo, hi,
                                  cfg.with_(grid_chunk=0))
-    for (cu, cs, cw), (wu, ws, ww) in zip(chunked, whole):
+    for (cu, cs, cw), (mu, ms, mw), (wu, ws, ww) in zip(chunked, mega, whole):
         np.testing.assert_array_equal(cu, wu)
         np.testing.assert_array_equal(cs, ws)
         assert set(cw) == set(ww)
+        np.testing.assert_array_equal(mu, cu)
+        np.testing.assert_array_equal(ms, cs)
+        assert set(mw) == set(cw)
 
 
 # ---------------------------------------------------------------------------

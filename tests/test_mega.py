@@ -1,0 +1,274 @@
+"""Device-resident stage-0 mega-loop (DESIGN.md §17).
+
+The contracts ISSUE 14 pins in tier-1:
+
+* verdict maps, counterexamples, and ledgers are BIT-EQUAL between the
+  mega-loop and the per-chunk launch loop across segment sizes
+  {1 chunk, several, whole grid} and pipeline depths {1, 2} — on the real
+  GC-1 zoo net and on a stacked adult (AC) family;
+* launches per model drop from O(chunks) to O(segments), recorded as
+  ``launches_per_model`` in the throughput JSON;
+* a ``launch.submit``/``launch.decode`` fault exhausted mid-segment
+  degrades EXACTLY that segment's partitions and ``resume=True``
+  converges to the fault-free map (a transient is absorbed outright);
+* a crash while a segment is in flight never ledgers undrained work
+  (the mega twin of test_pipeline's chunk-loop crash test);
+* segment progress is observable: ``segment`` events land in the trace
+  log (rendered by ``fairify_tpu report``) and the heartbeat prints a
+  throttled ``segments done/total`` line.
+"""
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+
+from fairify_tpu.models.train import init_mlp
+from fairify_tpu.verify import presets, sweep
+
+
+def _cfg(tmp_path, sub, **kw):
+    return presets.get("GC").with_(
+        result_dir=str(tmp_path / sub), soft_timeout_s=30.0,
+        hard_timeout_s=300.0, sim_size=64, exact_certify_masks=False,
+        grid_chunk=16, **kw)
+
+
+def _outcome_map(report):
+    out = {}
+    for o in report.outcomes:
+        ce = None
+        if o.counterexample is not None:
+            ce = (tuple(int(v) for v in o.counterexample[0]),
+                  tuple(int(v) for v in o.counterexample[1]))
+        out[o.partition_id] = (o.verdict, ce, round(float(o.pruned_acc), 6))
+    return out
+
+
+def _ledger_map(path):
+    """pid → (verdict, ce) from a ledger file (time fields excluded)."""
+    recs, skipped = sweep._read_ledger(str(path))
+    assert skipped == 0
+    out = {}
+    for rec in recs:
+        ce = rec.get("ce")
+        out[rec["partition_id"]] = (
+            rec["verdict"],
+            tuple(tuple(c) for c in ce) if ce else None)
+    return out
+
+
+def test_mega_bit_equal_gc1_across_segments_and_depths(tmp_path):
+    """GC-1 (the headline net): chunk loop vs mega at {1, 2, whole}.
+
+    ``_flagship_net`` is bench.py's GC-1 — the reference zoo h5 when the
+    assets are present, its synthetic architecture twin otherwise.
+    """
+    from __graft_entry__ import _flagship_net
+
+    net = _flagship_net()
+    span = (0, 64)  # 4 chunks of 16
+    maps, ledgers = {}, {}
+    for mc in (0, 1, 2, 8):
+        for depth in (1, 2):
+            cfg = _cfg(tmp_path, f"gc_{mc}_{depth}", mega_chunks=mc,
+                       pipeline_depth=depth)
+            rep = sweep.verify_model(net, cfg, model_name="GC-1",
+                                     resume=False, partition_span=span)
+            maps[(mc, depth)] = _outcome_map(rep)
+            ledgers[(mc, depth)] = _ledger_map(
+                tmp_path / f"gc_{mc}_{depth}" / "GC-GC-1@0-64.ledger.jsonl")
+    ref, led_ref = maps[(0, 1)], ledgers[(0, 1)]
+    assert ref and led_ref
+    for key in maps:
+        assert maps[key] == ref, f"outcome drift at {key}"
+        assert ledgers[key] == led_ref, f"ledger drift at {key}"
+
+
+def test_mega_family_bit_equal_ac(tmp_path):
+    """One adult (AC) architecture family through stage0_families."""
+    from fairify_tpu.parallel.mesh import stack_models
+    from fairify_tpu.verify.property import encode
+
+    cfg = presets.get("AC").with_(grid_chunk=16)
+    d = len(cfg.query().columns)
+    enc = encode(cfg.query())
+    _, lo, hi = sweep.build_partitions(cfg)
+    lo, hi = lo[:48], hi[:48]
+    stacked = stack_models([init_mlp((d, 8, 1), seed=s) for s in (0, 1, 2)])
+    want = sweep.stage0_families([stacked], enc, lo, hi,
+                                 cfg.with_(mega_chunks=0))[0]
+    for mc in (1, 2, 8):
+        got = sweep.stage0_families([stacked], enc, lo, hi,
+                                    cfg.with_(mega_chunks=mc))[0]
+        assert len(got) == len(want)
+        for (u_g, s_g, w_g), (u_w, s_w, w_w) in zip(got, want):
+            np.testing.assert_array_equal(u_g, u_w)
+            np.testing.assert_array_equal(s_g, s_w)
+            assert set(w_g) == set(w_w)
+            for k in w_g:
+                np.testing.assert_array_equal(w_g[k][0], w_w[k][0])
+                np.testing.assert_array_equal(w_g[k][1], w_w[k][1])
+
+
+def test_mega_launch_economy(tmp_path):
+    """Launches per model are O(segments), not O(chunks), and recorded."""
+    net = init_mlp((20, 8, 1), seed=3)
+    span = (0, 48)  # 3 chunks
+    thr = {}
+    for mc in (0, 8):
+        cfg = _cfg(tmp_path, f"econ_{mc}", mega_chunks=mc)
+        sweep.verify_model(net, cfg, model_name="m", resume=False,
+                           partition_span=span)
+        with open(tmp_path / f"econ_{mc}" / "GC-m@0-48.throughput.json") as fp:
+            thr[mc] = json.load(fp)
+    # Chunk loop: one launch per chunk per phase (prune/certify/parity).
+    # Whole-grid segments: one launch per phase.
+    assert thr[8]["device_launches"] < thr[0]["device_launches"]
+    assert thr[8]["launches_per_model"] == thr[8]["device_launches"]
+    assert thr[8]["launches_per_model"] <= 3 + 1  # 3 phases (+ PGD slack)
+
+
+def test_mega_ragged_final_segment_single_compile(tmp_path):
+    """5 chunks at mega_chunks=4 → segments of 4 and 1: the ragged final
+    segment must pad its CHUNK axis to the segment bucket and reuse the
+    full-segment executables — one compile per mega kernel, results
+    bit-equal to the chunk loop."""
+    from fairify_tpu import obs
+
+    net = init_mlp((20, 8, 1), seed=7)  # fresh arch: owns its compiles
+    span = (0, 80)  # 5 chunks of 16
+    c = obs.registry().counter("xla_compiles")
+    kernels = ("sweep.mega_stage0_kernel", "pruning.mega_sim_and_bounds",
+               "sweep.mega_parity_kernel")
+    before = {k: c.value(kernel=k) or 0 for k in kernels}
+    rep = sweep.verify_model(
+        net, _cfg(tmp_path, "ragged", mega_chunks=4), model_name="m",
+        resume=False, partition_span=span)
+    for k in kernels:
+        assert (c.value(kernel=k) or 0) - before[k] == 1, k
+    chunked = sweep.verify_model(
+        net, _cfg(tmp_path, "ragged0", mega_chunks=0), model_name="m",
+        resume=False, partition_span=span)
+    assert _outcome_map(rep) == _outcome_map(chunked)
+
+
+def _fault_cfg(tmp_path, sub, specs):
+    # mega_chunks=1 → 3 one-chunk segments per phase; max_launch_retries=2
+    # means arrivals {2, 3, 4} are segment 2's attempt + both retries.
+    return _cfg(tmp_path, sub, mega_chunks=1, max_launch_retries=2,
+                launch_backoff_s=0.001, inject_faults=specs)
+
+
+@pytest.mark.parametrize("site", ["launch.submit", "launch.decode"])
+def test_mega_fault_exhausted_degrades_one_segment(tmp_path, site):
+    net = init_mlp((20, 8, 1), seed=3)
+    span = (0, 48)
+    clean = sweep.verify_model(
+        net, _cfg(tmp_path, f"{site}-clean"), model_name="m", resume=False,
+        partition_span=span)
+    want = _outcome_map(clean)
+
+    spec = f"{site}:transient:2-4"  # exhaust exactly segment 2's attempts
+    cfg = _fault_cfg(tmp_path, f"{site}-exh", (spec,))
+    rep = sweep.verify_model(net, cfg, model_name="m", resume=False,
+                             partition_span=span)
+    got = _outcome_map(rep)
+    seg2 = set(range(17, 33))  # partitions of the second 16-chunk segment
+    assert rep.degraded == 16
+    for pid, (verdict, ce, _pa) in got.items():
+        if pid in seg2:
+            assert verdict == "unknown", f"pid {pid} should have degraded"
+        else:
+            assert (verdict, ce) == want[pid][:2], f"pid {pid} drifted"
+    # The ledger carries machine-readable failure records for exactly seg2.
+    recs, _ = sweep._read_ledger(
+        str(tmp_path / f"{site}-exh" / "GC-m@0-48.ledger.jsonl"))
+    failed_pids = {r["partition_id"] for r in recs if r.get("failure")}
+    assert failed_pids == seg2
+
+    # resume=True re-attempts only the degraded segment and converges.
+    resumed = sweep.verify_model(net, cfg.with_(inject_faults=()),
+                                 model_name="m", resume=True,
+                                 partition_span=span)
+    res_map = {pid: v[:2] for pid, v in _outcome_map(resumed).items()}
+    assert res_map == {pid: v[:2] for pid, v in want.items()}
+
+
+def test_mega_fault_transient_absorbed(tmp_path):
+    net = init_mlp((20, 8, 1), seed=3)
+    span = (0, 48)
+    clean = sweep.verify_model(
+        net, _cfg(tmp_path, "trans-clean"), model_name="m", resume=False,
+        partition_span=span)
+    cfg = _fault_cfg(tmp_path, "trans", ("launch.submit:transient:2",))
+    rep = sweep.verify_model(net, cfg, model_name="m", resume=False,
+                             partition_span=span)
+    assert rep.degraded == 0
+    assert _outcome_map(rep) == _outcome_map(clean)
+
+
+def test_mega_crash_mid_segment_never_ledgers_undrained(tmp_path, monkeypatch):
+    """The chunk-loop crash-safety pin, on the mega decode path."""
+    cfg = _cfg(tmp_path, "crash", mega_chunks=1, pipeline_depth=2)
+    net = init_mlp((20, 8, 1), seed=3)
+    span = (0, 48)
+
+    real_decode = sweep._mega_segment_decode
+    calls = {"n": 0}
+
+    def dying_decode(host, ctx):
+        calls["n"] += 1
+        if calls["n"] >= 2:  # die at the second drain — one seg in flight
+            raise RuntimeError("simulated crash mid-drain")
+        return real_decode(host, ctx)
+
+    monkeypatch.setattr(sweep, "_mega_segment_decode", dying_decode)
+    with pytest.raises(RuntimeError, match="mid-drain"):
+        sweep.verify_model(net, cfg, model_name="m", resume=False,
+                           partition_span=span)
+    monkeypatch.setattr(sweep, "_mega_segment_decode", real_decode)
+
+    ledger = tmp_path / "crash" / "GC-m@0-48.ledger.jsonl"
+    assert not ledger.exists() or os.path.getsize(ledger) == 0
+
+    crashed = sweep.verify_model(net, cfg, model_name="m", resume=True,
+                                 partition_span=span)
+    clean = sweep.verify_model(
+        net, _cfg(tmp_path, "crash-clean", mega_chunks=1), model_name="m",
+        resume=False, partition_span=span)
+    assert _outcome_map(crashed) == _outcome_map(clean)
+
+
+def test_segment_events_and_report_table(tmp_path):
+    from fairify_tpu.obs import report as report_mod
+
+    trace = tmp_path / "trace.jsonl"
+    cfg = _cfg(tmp_path, "events", mega_chunks=1, trace_out=str(trace))
+    net = init_mlp((20, 8, 1), seed=3)
+    sweep.verify_model(net, cfg, model_name="m", resume=False,
+                       partition_span=(0, 48))
+    agg = report_mod.aggregate([str(trace)])
+    segs = agg["segments"]
+    assert segs["stage0_decide"]["done"] == segs["stage0_decide"]["total"] == 3
+    assert segs["stage0_decide"]["partitions"] == 48
+    assert "mega segments" in report_mod.render(agg)
+
+
+def test_heartbeat_segment_line():
+    from fairify_tpu.obs.heartbeat import Heartbeat
+
+    out = io.StringIO()
+    hb = Heartbeat(0.001, total=48, label="GC-1", stream=out,
+                   clock=iter(np.arange(0.0, 100.0, 1.0)).__next__)
+    try:
+        assert hb.segment("stage0_decide", 1, 3, in_flight=2)
+        # Mid-phase beats throttle on the interval clock; the final
+        # segment always prints.
+        assert hb.segment("stage0_decide", 3, 3)
+    finally:
+        hb.close()
+    text = out.getvalue()
+    assert "stage0_decide segments 1/3 (2 in flight)" in text
+    assert "stage0_decide segments 3/3" in text

@@ -147,10 +147,12 @@ def test_stage0_families_matches_per_family(tmp_path):
 
 
 def test_crash_with_inflight_chunks_never_ledgers_undrained(tmp_path, monkeypatch):
+    # mega_chunks=0 pins the per-chunk launch loop: this test monkeypatches
+    # its decode (the mega path's crash-safety twin lives in test_mega.py).
     cfg = presets.get("GC").with_(
         result_dir=str(tmp_path / "crash"), soft_timeout_s=30.0,
         hard_timeout_s=300.0, sim_size=64, exact_certify_masks=False,
-        grid_chunk=16, pipeline_depth=2)
+        grid_chunk=16, pipeline_depth=2, mega_chunks=0)
     net = init_mlp((20, 8, 1), seed=3)
     span = (0, 48)
 
@@ -192,10 +194,12 @@ def test_crash_with_inflight_chunks_never_ledgers_undrained(tmp_path, monkeypatc
 def test_throughput_json_records_pipeline_gauge(tmp_path):
     import json
 
+    # mega_chunks=0: the overlap pin needs ≥2 per-chunk launches in flight;
+    # under the mega-loop this span is a single segment per phase.
     cfg = presets.get("GC").with_(
         result_dir=str(tmp_path), soft_timeout_s=30.0, hard_timeout_s=300.0,
         sim_size=64, exact_certify_masks=False, grid_chunk=16,
-        pipeline_depth=2)
+        pipeline_depth=2, mega_chunks=0)
     net = init_mlp((20, 8, 1), seed=3)
     sweep.verify_model(net, cfg, model_name="m", resume=False,
                        partition_span=(0, 48))

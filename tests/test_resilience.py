@@ -309,6 +309,12 @@ SPAN = (0, 48)
 
 def _cfg(tmp_path, name, **kw):
     kw.setdefault("grid_chunk", 16)
+    # One-chunk segments: the module's chaos schedules are arrival-count
+    # based (nth launch.submit/launch.decode arrivals), written for one
+    # launch per chunk — mega_chunks=1 keeps segment arrivals identical to
+    # chunk arrivals while still exercising the mega-loop launch path.
+    # (Multi-chunk segment blast radii are pinned in test_mega.py.)
+    kw.setdefault("mega_chunks", 1)
     return presets.get("GC").with_(
         result_dir=str(tmp_path / name), soft_timeout_s=30.0,
         hard_timeout_s=600.0, sim_size=64, exact_certify_masks=False,
@@ -564,7 +570,9 @@ def test_smt_retry_ladder_wired_into_unknown_retry(tmp_path, monkeypatch):
     monkeypatch.setattr(engine_mod, "decide_box",
                         lambda *a, **k: engine_mod.Decision("unknown"))
     monkeypatch.setattr(pool_mod, "submit_box", fake_submit)
+    # mega_chunks=0: the dull-stage-0 stub patches the chunk loop's decode.
     cfg = _cfg(tmp_path, "smt", smt_retry_timeouts_s=(7.0, 21.0),
+               mega_chunks=0,
                engine=engine_mod.EngineConfig(pgd_phase=False))
     rep = sweep.verify_model(_net(), cfg, model_name="m", resume=False,
                              partition_span=span)
