@@ -287,8 +287,24 @@ def _cmd_serve(args) -> int:
 
     signal.signal(signal.SIGTERM, _sig)
     signal.signal(signal.SIGINT, _sig)
+    if args.replica_procs and (args.replicas > 1 or args.shards):
+        print("serve: --replica-procs is mutually exclusive with "
+              "--replicas/--shards", file=sys.stderr)
+        return 2
     with obs.tracing(args.trace_out, run_id="serve"):
-        if args.replicas and args.replicas > 1:
+        if args.replica_procs and args.replica_procs >= 1:
+            from dataclasses import replace
+
+            from fairify_tpu.serve import ProcessFleet, ProcFleetConfig
+
+            srv = ProcessFleet(ProcFleetConfig(
+                n_replicas=args.replica_procs, spool=args.spool,
+                poll_s=args.poll_interval, lease_s=args.lease,
+                memory_cap_mb=args.replica_memory_cap,
+                max_restarts=args.max_restarts,
+                exec_cache=exec_cache,
+                replica=replace(scfg, spool=None, exec_cache=None))).start()
+        elif args.replicas and args.replicas > 1:
             from dataclasses import replace
 
             srv = ServerFleet(FleetConfig(
@@ -299,7 +315,8 @@ def _cmd_serve(args) -> int:
             srv = VerificationServer(scfg).start()
         print(f"fairify_tpu serve: spool={args.spool} "
               f"batch_window={scfg.batch_window_s}s max_batch={scfg.max_batch}"
-              f" replicas={args.replicas or 1}"
+              f" replicas={args.replica_procs or args.replicas or 1}"
+              f"{' (processes)' if args.replica_procs else ''}"
               f" exec_cache={exec_cache or 'off'}"
               f" (SIGTERM drains)", file=sys.stderr)
         worker_died = False
@@ -314,7 +331,8 @@ def _cmd_serve(args) -> int:
                 break
         requeued = srv.drain()
     print(json.dumps({"drained": True, "worker_died": worker_died,
-                      "requeued": [r.id for r in requeued]}))
+                      "requeued": [r if isinstance(r, str) else r.id
+                                   for r in requeued]}))
     return 1 if worker_died else 0
 
 
@@ -571,7 +589,24 @@ def main(argv=None) -> int:
     srv.add_argument("--lease", type=float, default=0.0,
                      help="replica heartbeat lease in seconds (fleet mode): "
                           "a worker silent past the lease is declared lost "
-                          "and failed over (0 = thread-liveness only)")
+                          "and failed over (0 = thread-liveness only; with "
+                          "--replica-procs this is the FILE-lease hang "
+                          "deadline answered by SIGTERM->SIGKILL)")
+    srv.add_argument("--replica-procs", type=int, default=0,
+                     help="run N replicas as real OS processes "
+                          "(serve.procfleet, DESIGN.md §18): hard-kill "
+                          "containment, lease-based hang detection, "
+                          "loss-free cross-process failover; mutually "
+                          "exclusive with --replicas/--shards")
+    srv.add_argument("--replica-memory-cap", type=int, default=0,
+                     metavar="MB",
+                     help="RLIMIT_AS per replica PROCESS in MB "
+                          "(--replica-procs mode; a memory blowup kills "
+                          "one replica, not the fleet; 0 = uncapped)")
+    srv.add_argument("--max-restarts", type=int, default=3,
+                     help="bounded restart budget per replica-process slot "
+                          "(--replica-procs mode; exhausted slots are "
+                          "abandoned and their work re-homed)")
     srv.add_argument("--max-queue", type=int, default=0,
                      help="bounded queue: shed (reject with a machine-"
                           "readable 'shed:' reason) submits past this "

@@ -84,10 +84,11 @@ class LockDisciplineRule(Rule):
         "fairify_tpu/obs/metrics.py",
         "fairify_tpu/parallel/pipeline.py",
         "fairify_tpu/resilience/journal.py",
-        # The whole serve package: server/admission (PR 8) AND the fleet
-        # router (serve/fleet.py) — replica tables, bucket pins, and
-        # owner maps are shared between the router thread, submit
-        # callers, and failover.
+        # The whole serve package: server/admission (PR 8), the thread
+        # fleet router (serve/fleet.py) AND the process-fleet router
+        # (serve/procfleet.py) — replica tables, bucket pins, owner/
+        # payload/status maps are shared between router threads,
+        # control-pipe readers, submit callers, and failover.
         "fairify_tpu/serve/",
         # The SMT worker pool: dispatch lanes, the serve drainer, and
         # client submit threads all share SmtPool's worker/queue state.

@@ -39,6 +39,10 @@ ALLOW_TIME_TIME = frozenset({
     "fairify_tpu/serve/client.py::submit",
     "fairify_tpu/serve/server.py::_journal_record",
     "fairify_tpu/serve/fleet.py::_journal_record",  # same epoch `ts` field
+    "fairify_tpu/serve/procfleet.py::_journal",     # same epoch `ts` field
+    # File-lease age is epoch-now minus file mtime BY DESIGN: mtimes are
+    # wall-clock, and router + replica share one host clock (DESIGN.md §18).
+    "fairify_tpu/serve/procfleet.py::_lease_age",
 })
 
 ALLOW_PRINT = frozenset({

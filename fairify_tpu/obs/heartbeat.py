@@ -51,6 +51,51 @@ def notify_compile(kernel: str) -> None:
         hb.compile_started(kernel)
 
 
+class FleetPulse:
+    """Throttled ``replicas alive k/N`` stderr line for replica fleets.
+
+    The process-fleet router (``serve/procfleet.py``) beats this every
+    tick; a line is printed when the live count CHANGES (a death or a
+    completed restart must be visible immediately) or — while the fleet is
+    degraded or restarting — at most once per ``interval_s``, so a fleet
+    riding out restart backoff never looks hung.  A healthy, unchanged
+    fleet prints nothing (zero-noise, like the retries/degraded suffix).
+    """
+
+    def __init__(self, interval_s: float = 5.0, label: str = "fleet",
+                 stream=None, clock: Callable[[], float] = time.monotonic):
+        self.interval_s = float(interval_s)
+        self.label = label
+        self.stream = stream  # None → sys.stderr at pulse time (testable)
+        self._clock = clock
+        self._last: Optional[float] = None
+        self._last_alive: Optional[int] = None
+
+    def pulse(self, alive: int, total: int, restarting: int = 0,
+              rehomed: int = 0, force: bool = False) -> bool:
+        """Emit one line if warranted (see class docstring); returns
+        whether a line was printed."""
+        if self.interval_s <= 0 and not force:
+            return False
+        now = self._clock()
+        changed = self._last_alive is not None and alive != self._last_alive
+        degraded = alive < total or restarting > 0
+        throttled = self._last is not None \
+            and now - self._last < self.interval_s
+        if not force and not changed and (not degraded or throttled):
+            self._last_alive = alive
+            return False
+        parts = [f"[hb {self.label}] replicas alive {alive}/{total}"]
+        if restarting:
+            parts.append(f"| {restarting} restarting")
+        if rehomed:
+            parts.append(f"| {rehomed} re-homed")
+        print(" ".join(parts), file=self.stream or sys.stderr, flush=True)
+        self._last = now
+        self._last_alive = alive
+        return True
+
+
 class Heartbeat:
     """Throttled progress reporter; ``interval_s <= 0`` disables it."""
 

@@ -89,6 +89,7 @@ def aggregate(paths: Iterable[str]) -> dict:
     keyed: Dict[tuple, dict] = {}  # (model, partition_id) -> attrs, last wins
     anon: List[dict] = []  # verdict events without a partition id
     requests: Dict[str, dict] = {}  # request id -> lifecycle attrs, last wins
+    replicas: Dict[int, dict] = {}  # process-fleet replica rows (`replica`)
     compiles: Dict[str, dict] = {}  # kernel -> compile-table row
     smt_outcomes: Dict[str, int] = {}  # decided / per-reason query counts
     lock_edges: Dict[tuple, int] = {}  # (src site, dst site) -> count
@@ -144,6 +145,32 @@ def aggregate(paths: Iterable[str]) -> dict:
                 rid = attrs.get("request")
                 if rid is not None:
                     requests[rid] = attrs
+            elif rtype == "event" and rec.get("name") == "replica":
+                # Process-fleet lifecycle (serve.procfleet): spawn/hello/
+                # death/restart/rehome events fold into one row per
+                # replica slot — pid is last-wins, counters accumulate.
+                attrs = rec.get("attrs", {})
+                if attrs.get("replica") is None:
+                    continue
+                row = replicas.setdefault(int(attrs["replica"]), {
+                    "pid": None, "restarts": 0, "deaths": {},
+                    "rehomed": 0, "last_lease_age_s": None,
+                    "abandoned": False})
+                ev = attrs.get("event")
+                if attrs.get("pid") is not None:
+                    row["pid"] = int(attrs["pid"])
+                if ev == "restart":
+                    row["restarts"] = max(row["restarts"],
+                                          int(attrs.get("restarts", 0)))
+                elif ev == "death":
+                    kind = str(attrs.get("kind", "?"))
+                    row["deaths"][kind] = row["deaths"].get(kind, 0) + 1
+                elif ev == "rehome":
+                    row["rehomed"] += int(attrs.get("requests", 0))
+                elif ev == "lease_expired":
+                    row["last_lease_age_s"] = attrs.get("lease_age")
+                elif ev == "abandoned":
+                    row["abandoned"] = True
             elif rtype == "event" and rec.get("name") == "lock_edge":
                 # Dynamic lock-order edges (obs.lockprof flush): summed
                 # across logs, keyed by src -> dst construction sites.
@@ -295,6 +322,7 @@ def aggregate(paths: Iterable[str]) -> dict:
         "smt": dict(sorted(smt_outcomes.items(), key=lambda kv: -kv[1])),
         "shards": {k: shards[k] for k in sorted(shards)},
         "requests": request_table,
+        "replicas": {str(k): replicas[k] for k in sorted(replicas)},
         "lock_edges": [{"src": s, "dst": d, "count": n}
                        for (s, d), n in sorted(lock_edges.items())],
         "segments": {k: segments[k] for k in sorted(segments)},
@@ -384,6 +412,21 @@ def render(agg: dict) -> str:
                          f"{row['run_s']:>8.3f}  {decided:>7}  {sla:>6}")
         lines.append(f"requests: {len(agg['requests'])}   "
                      f"deadline misses: {misses}")
+    if agg.get("replicas"):
+        lines.append("")
+        lines.append(f"{'replica':<8}  {'pid':>8}  {'restarts':>8}  "
+                     f"{'deaths':>20}  {'re-homed':>8}  {'lease_age':>9}")
+        for idx, row in agg["replicas"].items():
+            deaths = ",".join(f"{k}={n}" for k, n in
+                              sorted(row["deaths"].items())) or "-"
+            lease = f"{row['last_lease_age_s']:.2f}s" \
+                if row.get("last_lease_age_s") is not None else "-"
+            label = f"{idx}*" if row.get("abandoned") else str(idx)
+            lines.append(f"{label:<8}  {row['pid'] or '-':>8}  "
+                         f"{row['restarts']:>8}  {deaths:>20}  "
+                         f"{row['rehomed']:>8}  {lease:>9}")
+        if any(r.get("abandoned") for r in agg["replicas"].values()):
+            lines.append("(* = slot abandoned after its restart budget)")
     if agg.get("lock_edges"):
         rows = agg["lock_edges"]
         w = max(max(len(r["src"]) for r in rows),

@@ -36,6 +36,15 @@ site                  where :func:`check` is called
                       (:mod:`serve.fleet`) — ``fatal`` kills that replica
                       and exercises failover re-spooling; ``transient``
                       models a heartbeat blip the router absorbs
+``replica.spawn``     :class:`serve.procfleet.ProcessFleet` forking a
+                      replica worker process (an injected fault models a
+                      fork/exec failure; exhaustion abandons the slot and
+                      re-homes its requests to survivors)
+``replica.lease``     the process-fleet router reading a replica's
+                      file-lease heartbeat — ``transient`` is a stat blip
+                      the router absorbs for one tick; ``fatal`` forces
+                      the lease expired, so the REAL escalating
+                      SIGTERM→SIGKILL hang-containment path runs
 ``smt.worker.spawn``  :class:`smt.pool.SmtPool` forking a solver worker
                       subprocess (an injected fault models a fork/exec
                       failure; exhaustion degrades the query)
@@ -80,7 +89,7 @@ FAULT_SITES = frozenset(
     {"launch.submit", "launch.decode", "compile", "smt.query", "ledger.append",
      "shard.dispatch", "shard.gather", "device.lost",
      "request.admit", "request.deadline", "serve.drain",
-     "request.preempt", "replica.lost",
+     "request.preempt", "replica.lost", "replica.spawn", "replica.lease",
      "smt.worker.spawn", "smt.worker.crash", "smt.worker.hang",
      "smt.worker.memout"})
 FAULT_KINDS = frozenset({"transient", "fatal", "crash"})

@@ -13,8 +13,13 @@ fleet) into a service:
   coalescing into shared vmapped family launches;
 * :mod:`fairify_tpu.serve.server` — the queue → admit → batch → stream
   worker loop with graceful SIGTERM drain;
-* :mod:`fairify_tpu.serve.fleet` — N replicas behind one arch-bucket
-  router with heartbeat failover (``fairify_tpu serve --replicas N``);
+* :mod:`fairify_tpu.serve.fleet` — N thread replicas behind one
+  arch-bucket router with heartbeat failover (``fairify_tpu serve
+  --replicas N``);
+* :mod:`fairify_tpu.serve.procfleet` / :mod:`fairify_tpu.serve.replica`
+  — N OS-process replicas with hard-kill containment, file-lease hang
+  detection, and loss-free cross-process failover (``fairify_tpu serve
+  --replica-procs N``, DESIGN.md §18);
 * :mod:`fairify_tpu.serve.client` — the file-spool submit protocol
   (``fairify_tpu submit``).
 """
@@ -24,6 +29,10 @@ from fairify_tpu.serve.admission import (  # noqa: F401
     span_admissible,
 )
 from fairify_tpu.serve.fleet import FleetConfig, ServerFleet  # noqa: F401
+from fairify_tpu.serve.procfleet import (  # noqa: F401
+    ProcessFleet,
+    ProcFleetConfig,
+)
 from fairify_tpu.serve.request import (  # noqa: F401
     PRIORITIES,
     VerifyRequest,

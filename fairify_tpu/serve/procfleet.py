@@ -1,0 +1,815 @@
+"""Out-of-process replica fleet: OS-process workers, hard-kill containment.
+
+The thread fleet (``serve/fleet.py``) bounds *scheduling* blast radius —
+but a thread replica cannot be contained: a wedged XLA launch hangs the
+process, a native crash or memory blowup takes every replica down, and
+``ReplicaKilled`` only simulates a kill at cooperative yield points.
+This router runs N replicas as real OS processes (``python -m
+fairify_tpu.serve.replica``), applying the PR 10 SMT-pool containment
+contract to the device-launch side itself — the last uncontained failure
+domain in the serving stack:
+
+* **control plane** — newline-framed JSON over each replica's pipes
+  (:mod:`fairify_tpu.smt.protocol` framing: a SIGKILL tears at most one
+  line) carries hello/status/drain; **data plane** is the spool — the
+  router owns the fleet inbox and routes payload files into per-replica
+  sub-inboxes (``<spool>/replicas/<i>/inbox``) by atomic rename, while
+  every replica writes request sinks into the SHARED ``<spool>/requests``
+  tree, so a request's result_dir (and its crash-safe verdict ledger)
+  survives any number of owner changes.
+* **death is classified, not guessed** (the PR 4 taxonomy at process
+  granularity): ``crash`` — waitpid returned (any signal or nonzero
+  exit); ``memout`` — the replica's distinct ``EXIT_MEMOUT`` code (its
+  ``RLIMIT_AS`` cap landed); ``hang`` — the file lease
+  (``replicas/<i>/lease``, beaten at batch iterations and span granules)
+  aged past ``lease_s`` while the process lived, answered by escalating
+  SIGTERM → SIGKILL after ``term_grace_s`` — the watchdog a thread fleet
+  can never have, and the only cure for a SIGSTOP/wedged-launch replica;
+  ``spawn`` — no hello within ``spawn_timeout_s`` or a fork/exec
+  failure.  ``replica.spawn`` and ``replica.lease`` are the chaos sites.
+* **restarts are bounded, jittered backoff** — each death schedules a
+  respawn at ``backoff_s * 2^n * jitter`` up to ``max_restarts`` per
+  slot; an exhausted slot is abandoned (its work re-homes) rather than
+  flap-looped.
+* **failover is loss-free** — a dead replica's unpicked sub-inbox
+  payloads move back to the fleet inbox by rename, and every picked but
+  non-terminal request (tracked via the control-pipe status stream,
+  cross-checked against the on-disk terminal ``status.json``) is
+  re-written there from the router's payload table; the next scan routes
+  them to survivors.  The payload carries the original ``submitted_ts``
+  (SLA clock) and ``id`` (result_dir), so the survivor's ``resume=True``
+  run replays the partial ledger — decided verdicts survive a literal
+  ``kill -9`` bit-for-bit, and only undecided work is re-attempted.
+  With no survivors the payloads simply WAIT in the fleet inbox: loss-
+  free by construction, picked up by the next healthy replica or fleet.
+
+Because replicas are processes, they are not GIL-bound: on a multi-core
+host N replicas verify N requests genuinely in parallel (SERVE_r03
+measures this against SERVE_r02's thread fleet).  The shared persistent
+executable cache (``exec_cache``) makes a restarted replica warm from
+disk — cold restart compiles nothing.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from fairify_tpu import obs
+from fairify_tpu.obs.heartbeat import FleetPulse
+from fairify_tpu.resilience import faults as faults_mod
+from fairify_tpu.resilience.supervisor import classify
+from fairify_tpu.serve.client import write_atomic_json
+from fairify_tpu.serve.request import DONE, FAILED, REJECTED
+from fairify_tpu.serve.server import ServeConfig
+from fairify_tpu.smt import protocol
+
+#: Statuses after which a request needs no re-homing (``requeued`` is NOT
+#: terminal here: a replica-drain requeue parks the payload back in a
+#: sub-inbox, and the router must still collect it).
+_TERMINAL = (DONE, FAILED, REJECTED)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+@dataclass(frozen=True)
+class ProcFleetConfig:
+    """Fleet knobs (``fairify_tpu serve --replica-procs N``)."""
+
+    n_replicas: int = 2
+    # Fleet spool root (REQUIRED: processes have no in-process submit
+    # path — the spool protocol is the data plane).
+    spool: str = ""
+    # Router tick: inbox scan + health sweep interval.
+    poll_s: float = 0.05
+    # Hang detection: a replica whose file lease is older than this is
+    # declared wedged and killed (SIGTERM → SIGKILL).  0 disables — a
+    # granule-less request legitimately goes dark for its whole runtime,
+    # so pair a nonzero lease with ``replica.span_chunks > 0``.
+    lease_s: float = 0.0
+    # SIGTERM → SIGKILL escalation window for hang containment (a
+    # SIGSTOPped replica ignores SIGTERM; only the SIGKILL lands).
+    term_grace_s: float = 2.0
+    # Hello deadline: jax import + device init + exec-cache load happen
+    # before the replica says hello.
+    spawn_timeout_s: float = 120.0
+    # Bounded restart policy per replica slot.
+    max_restarts: int = 3
+    backoff_s: float = 0.25          # first respawn backoff (jittered, 2x)
+    # RLIMIT_AS per replica process, MB; 0 = uncapped (no memout
+    # containment).
+    memory_cap_mb: int = 0
+    # Shared persistent executable cache ("auto" = <spool>/exec-cache;
+    # None/"" = off).  What makes a restarted replica warm from disk.
+    exec_cache: Optional[str] = "auto"
+    # Throttled "replicas alive k/N" stderr line interval; 0 disables.
+    pulse_s: float = 5.0
+    # Graceful-drain wait per replica before SIGTERM/SIGKILL escalation.
+    drain_timeout_s: float = 120.0
+    # Per-replica server template (batch window, span granule, SMT pool,
+    # overload knobs).  ``spool``/``requests_dir``/``lease_path``/
+    # ``exec_cache``/``replica_id`` are owned by the fleet and stamped per
+    # replica; whatever they say here is ignored.
+    replica: ServeConfig = field(default_factory=ServeConfig)
+    seed: int = 0
+
+
+class _ReplicaProc:
+    """One live replica subprocess: pipes, lease path, reader thread.
+
+    NOT thread-safe by itself — ownership of mutation is the router's;
+    the reader thread only flips ``hello``/``pid`` (monotonic, write-once)
+    and feeds the fleet's status table through a locked callback.
+    """
+
+    def __init__(self, idx: int, proc: subprocess.Popen, inbox: str,
+                 lease_path: str):
+        self.idx = idx
+        self.proc = proc
+        self.inbox = inbox
+        self.lease_path = lease_path
+        self.spawned_at = time.monotonic()
+        self.hello = threading.Event()
+        self.pid: Optional[int] = None
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def send(self, obj: dict) -> bool:
+        try:
+            self.proc.stdin.write(protocol.dump_msg(obj))
+            self.proc.stdin.flush()
+            return True
+        except (OSError, ValueError):
+            return False  # a dead pipe IS a death; waitpid classifies it
+
+    def kill(self) -> None:
+        if self.proc.poll() is None:
+            try:
+                self.proc.kill()
+            except OSError:
+                pass
+        try:
+            self.proc.wait(timeout=5.0)
+        except subprocess.TimeoutExpired:
+            pass
+        for fp in (self.proc.stdin, self.proc.stdout):
+            try:
+                if fp is not None:
+                    fp.close()
+            except OSError:
+                pass
+
+
+class ProcessFleet:
+    """N OS-process replicas behind one spool router (module docstring).
+
+    API mirrors the operations a spool client or bench needs:
+    ``start`` / ``drain`` / ``alive`` / ``replicas_alive`` / ``wait`` /
+    ``pids``; submission is the spool protocol
+    (:func:`fairify_tpu.serve.client.submit`) — there is no in-process
+    submit across a process boundary.
+    """
+
+    def __init__(self, cfg: ProcFleetConfig):
+        if not cfg.spool:
+            raise ValueError("ProcessFleet requires a spool directory")
+        if cfg.n_replicas < 1:
+            raise ValueError("fleet needs n_replicas >= 1")
+        import numpy as np
+
+        self.cfg = cfg
+        self._cv = threading.Condition(threading.Lock())
+        self._slots: List[Optional[_ReplicaProc]] = [None] * cfg.n_replicas
+        self._restarts: List[int] = [0] * cfg.n_replicas
+        self._respawn_at: Dict[int, float] = {}
+        self._owner: Dict[str, int] = {}      # request id -> replica slot
+        self._payloads: Dict[str, dict] = {}  # request id -> spool payload
+        self._status: Dict[str, str] = {}     # request id -> last status
+        self._drain_stats: Dict[int, dict] = {}  # slot -> last drained msg
+        self._rehomed_total = 0
+        self._draining = False
+        self._thread: Optional[threading.Thread] = None
+        self._rng = np.random.default_rng(cfg.seed)
+        self._pulse = FleetPulse(interval_s=cfg.pulse_s)
+        os.makedirs(os.path.join(cfg.spool, "inbox"), exist_ok=True)
+        os.makedirs(os.path.join(cfg.spool, "requests"), exist_ok=True)
+        from fairify_tpu.resilience.journal import JournalWriter
+        from fairify_tpu.resilience.supervisor import Supervisor
+
+        self._journal_writer = JournalWriter(
+            os.path.join(cfg.spool, "procfleet.journal.jsonl"),
+            supervisor=Supervisor(max_retries=2, backoff_s=0.05))
+
+    # --- plumbing ---------------------------------------------------------
+
+    def _exec_cache_dir(self) -> Optional[str]:
+        if self.cfg.exec_cache == "auto":
+            return os.path.join(self.cfg.spool, "exec-cache")
+        return self.cfg.exec_cache or None
+
+    def _journal(self, rec: dict) -> None:
+        self._journal_writer.append({"ts": round(time.time(), 3), **rec})
+
+    def _lease_age(self, rp: _ReplicaProc) -> float:
+        """Seconds since the replica's worker last beat its file lease
+        (epoch mtime vs epoch now — same host, same clock)."""
+        try:
+            return max(time.time() - os.stat(rp.lease_path).st_mtime, 0.0)
+        except OSError:
+            # Lease not born yet: measure from spawn so a replica wedged
+            # before its first beat still expires.
+            return time.monotonic() - rp.spawned_at
+
+    # --- spawn / restart --------------------------------------------------
+
+    def _replica_cmd(self, idx: int) -> List[str]:
+        r = self.cfg.replica
+        cmd = [sys.executable, "-m", "fairify_tpu.serve.replica",
+               "--spool", self.cfg.spool, "--replica", str(idx),
+               "--batch-window", str(r.batch_window_s),
+               "--max-batch", str(r.max_batch),
+               "--span-chunks", str(r.span_chunks),
+               "--poll-interval", str(r.poll_s),
+               "--smt-workers", str(r.smt_workers),
+               "--smt-memory-cap", str(r.smt_memory_cap_mb),
+               "--smt-portfolio", str(r.smt_portfolio),
+               "--max-queue", str(r.max_queue),
+               "--preempt-factor", str(r.preempt_factor),
+               "--max-preemptions", str(r.max_preemptions),
+               "--fair-share", str(r.fair_share_factor),
+               "--fair-share-min", str(r.fair_share_min_s)]
+        if not r.fair_share_idle_exempt:
+            cmd.append("--fair-share-strict")
+        if r.default_deadline_s is not None:
+            cmd += ["--default-deadline", str(r.default_deadline_s)]
+        cache = self._exec_cache_dir()
+        if cache:
+            cmd += ["--exec-cache", cache]
+        if self.cfg.memory_cap_mb > 0:
+            cmd += ["--memory-cap-mb", str(self.cfg.memory_cap_mb)]
+        return cmd
+
+    def _spawn(self, idx: int) -> Optional[_ReplicaProc]:
+        """Fork one replica (the ``replica.spawn`` chaos site).  Returns
+        None on a spawn failure — already recorded and rescheduled."""
+        try:
+            faults_mod.check("replica.spawn")
+            rdir = os.path.join(self.cfg.spool, "replicas", str(idx))
+            os.makedirs(os.path.join(rdir, "inbox"), exist_ok=True)
+            proc = subprocess.Popen(
+                self._replica_cmd(idx), stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE, text=True, bufsize=1, cwd=_ROOT)
+        except BaseException as exc:
+            if classify(exc) == "propagate":
+                raise
+            self._on_spawn_fail(idx, exc)
+            return None
+        rp = _ReplicaProc(idx, proc,
+                          inbox=os.path.join(self.cfg.spool, "replicas",
+                                             str(idx), "inbox"),
+                          lease_path=os.path.join(self.cfg.spool, "replicas",
+                                                  str(idx), "lease"))
+        threading.Thread(target=self._reader, args=(rp,),
+                         name=f"procfleet-r{idx}", daemon=True).start()
+        obs.event("replica", replica=idx, event="spawn", pid=proc.pid)
+        self._journal({"event": "spawn", "replica": idx, "pid": proc.pid})
+        return rp
+
+    def _on_spawn_fail(self, idx: int, exc: BaseException) -> None:
+        obs.registry().counter("replica_deaths").inc(kind="spawn")
+        obs.event("replica", replica=idx, event="death", kind="spawn",
+                  error=type(exc).__name__, detail=str(exc)[:200])
+        self._journal({"event": "death", "replica": idx, "kind": "spawn",
+                       "error": type(exc).__name__})
+        self._schedule_restart(idx)
+
+    def _schedule_restart(self, idx: int) -> None:
+        """Bounded jittered-backoff respawn; exhaustion abandons the slot."""
+        with self._cv:
+            if self._draining:
+                return
+            n = self._restarts[idx]
+            if n >= self.cfg.max_restarts:
+                abandoned = True
+            else:
+                abandoned = False
+                self._restarts[idx] = n + 1
+                delay = self.cfg.backoff_s * (2.0 ** n) \
+                    * (1.0 + float(self._rng.random()))
+                self._respawn_at[idx] = time.monotonic() + delay
+        if abandoned:
+            obs.event("replica", replica=idx, event="abandoned",
+                      restarts=n)
+            self._journal({"event": "abandoned", "replica": idx,
+                           "restarts": n})
+
+    def _respawn_due(self) -> None:
+        due: List[int] = []
+        with self._cv:
+            now = time.monotonic()
+            for idx, at in list(self._respawn_at.items()):
+                if at <= now and self._slots[idx] is None \
+                        and not self._draining:
+                    del self._respawn_at[idx]
+                    due.append(idx)
+        for idx in due:
+            rp = self._spawn(idx)
+            if rp is None:
+                continue
+            with self._cv:
+                self._slots[idx] = rp
+                n = self._restarts[idx]
+            obs.registry().counter("replica_restarts").inc(replica=idx)
+            obs.event("replica", replica=idx, event="restart", pid=rp.proc.pid,
+                      restarts=n)
+            self._journal({"event": "restart", "replica": idx,
+                           "pid": rp.proc.pid, "restarts": n})
+
+    # --- control-pipe reader ----------------------------------------------
+
+    def _reader(self, rp: _ReplicaProc) -> None:
+        """Drain one replica's stdout: hello + lifecycle status stream.
+
+        Exits on EOF (the replica died; waitpid classifies it).  Torn or
+        garbage lines are ignored — a SIGKILL tears at most one."""
+        for line in rp.proc.stdout:
+            msg = protocol.parse_msg(line)
+            if msg is None:
+                continue
+            if msg.get("hello"):
+                rp.pid = int(msg.get("pid") or rp.proc.pid)
+                rp.hello.set()
+                obs.event("replica", replica=rp.idx, event="hello",
+                          pid=rp.pid)
+                continue
+            if msg.get("op") == "status":
+                rid = msg.get("request")
+                status = msg.get("status")
+                if rid is None or status is None:
+                    continue
+                with self._cv:
+                    if status in _TERMINAL:
+                        # Terminal: evict the whole tracking entry, not
+                        # just the payload — _owner/_status otherwise
+                        # grow one record per request ever served, and
+                        # _route_target scans _owner per routed payload.
+                        # status.json on disk stays the durable answer.
+                        self._payloads.pop(str(rid), None)
+                        self._owner.pop(str(rid), None)
+                        self._status.pop(str(rid), None)
+                    else:
+                        self._status[str(rid)] = str(status)
+                attrs = {k: v for k, v in msg.items() if k != "op"}
+                obs.event("request", **attrs)
+                continue
+            if msg.get("op") == "drained":
+                # Process-lifetime compile accounting (exec-cache health):
+                # kept per slot so tests and the report can assert that a
+                # restarted replica warmed from disk compiled nothing.
+                with self._cv:
+                    self._drain_stats[rp.idx] = dict(msg)
+
+    # --- lifecycle --------------------------------------------------------
+
+    def start(self) -> "ProcessFleet":
+        # Recover a previous fleet's orphans: payloads parked in replica
+        # sub-inboxes (an orphaned replica's EOF drain, or a crash between
+        # routing and pickup) rejoin the fleet inbox before anyone routes.
+        self._collect_sub_inboxes()
+        for idx in range(self.cfg.n_replicas):
+            rp = self._spawn(idx)
+            if rp is None:
+                continue
+            with self._cv:
+                self._slots[idx] = rp
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._router,
+                                            name="fairify-procfleet",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def __enter__(self) -> "ProcessFleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.drain()
+        return False
+
+    def wait_ready(self, timeout: float = 180.0) -> int:
+        """Block until every CURRENT replica said hello (or the deadline);
+        returns how many are ready.  Spawning includes a jax import, so
+        benches/tests should wait before measuring."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._cv:
+                slots = [s for s in self._slots if s is not None]
+            ready = sum(1 for s in slots if s.hello.is_set())
+            if ready == len(slots) and slots:
+                return ready
+            if time.monotonic() >= deadline:
+                return ready
+            time.sleep(0.05)
+
+    def alive(self) -> bool:
+        """True while the router runs and the fleet can still take work.
+
+        A slot is *viable* when it is occupied (live, or dead but not yet
+        swept — the sweep turns it into a restart or an abandonment) or
+        when its respawn is pending in the backoff window.  An operator
+        loop that drained on ``not alive()`` during either window would
+        turn every recoverable crash into a fleet shutdown, defeating the
+        bounded-restart policy; only a fleet whose every slot is
+        abandoned (or drained) reads dead."""
+        with self._cv:
+            router = self._thread is not None and self._thread.is_alive()
+            viable = any(s is not None for s in self._slots) \
+                or bool(self._respawn_at)
+        return router and viable
+
+    def replicas_alive(self) -> int:
+        with self._cv:
+            slots = [s for s in self._slots if s is not None]
+        return sum(1 for s in slots if s.alive())
+
+    def pids(self) -> Dict[int, int]:
+        """Live replica pids by slot (chaos harnesses SIGKILL/SIGSTOP
+        these — the whole point of process replicas)."""
+        with self._cv:
+            slots = list(self._slots)
+        return {i: s.proc.pid for i, s in enumerate(slots)
+                if s is not None and s.alive()}
+
+    def restarts(self) -> List[int]:
+        with self._cv:
+            return list(self._restarts)
+
+    def drain_stats(self) -> Dict[int, dict]:
+        """Per-slot ``drained`` control messages (compile accounting of
+        the replica's whole process lifetime) — populated by drain()."""
+        with self._cv:
+            return {i: dict(v) for i, v in self._drain_stats.items()}
+
+    def status_of(self, request_id: str) -> Optional[str]:
+        with self._cv:
+            return self._status.get(request_id)
+
+    def owner_of(self, request_id: str) -> Optional[int]:
+        """Replica slot currently routed this request (None after a
+        re-home put it back in the fleet inbox)."""
+        with self._cv:
+            return self._owner.get(request_id)
+
+    def inject_memout(self, idx: int) -> bool:
+        """Chaos: tell replica ``idx`` to allocate past its RSS cap (the
+        process-level analog of the SMT worker's memout directive)."""
+        with self._cv:
+            rp = self._slots[idx]
+        return rp is not None and rp.send({"op": "memout"})
+
+    def wait(self, request_id: str, timeout: Optional[float] = None
+             ) -> Optional[dict]:
+        """Terminal status record via the shared spool (status.json is the
+        cross-process source of truth), or None on timeout."""
+        from fairify_tpu.serve import client
+
+        return client.wait(self.cfg.spool, request_id, timeout=timeout,
+                           poll_s=0.05)
+
+    def drain(self) -> List[str]:
+        """Graceful shutdown: drain every replica, collect requeues back
+        into the fleet inbox; returns the requeued request ids."""
+        with self._cv:
+            if self._draining:
+                return []  # idempotent: a second drain is a no-op
+            self._draining = True
+            self._respawn_at.clear()
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        with self._cv:
+            slots = [(i, s) for i, s in enumerate(self._slots)
+                     if s is not None]
+            self._slots = [None] * self.cfg.n_replicas
+        for _idx, rp in slots:
+            rp.send({"op": "drain"})
+        for idx, rp in slots:
+            try:
+                rp.proc.wait(timeout=self.cfg.drain_timeout_s)
+            except subprocess.TimeoutExpired:
+                rp.kill()
+            self._journal({"event": "drained", "replica": idx,
+                           "rc": rp.proc.poll()})
+        # Give the reader threads a beat to deliver the final ``drained``
+        # control messages (compile accounting) of cleanly-exited replicas.
+        want = {idx for idx, rp in slots if rp.proc.poll() == 0}
+        deadline = time.monotonic() + 2.0
+        while want and time.monotonic() < deadline:
+            with self._cv:
+                if want <= set(self._drain_stats):
+                    break
+            time.sleep(0.02)
+        requeued = self._collect_sub_inboxes()
+        self._journal({"event": "fleet_drained", "requeued": requeued})
+        self._journal_writer.close()
+        return requeued
+
+    def _collect_sub_inboxes(self) -> List[str]:
+        """Move every payload parked in a replica sub-inbox back to the
+        fleet inbox (rename-atomic); returns the request ids moved."""
+        root = os.path.join(self.cfg.spool, "replicas")
+        inbox = os.path.join(self.cfg.spool, "inbox")
+        moved: List[str] = []
+        try:
+            replicas = sorted(os.listdir(root))
+        except OSError:
+            return moved
+        for sub in replicas:
+            sub_inbox = os.path.join(root, sub, "inbox")
+            try:
+                names = sorted(os.listdir(sub_inbox))
+            except OSError:
+                continue
+            for name in names:
+                if not name.endswith(".json"):
+                    continue
+                try:
+                    os.replace(os.path.join(sub_inbox, name),
+                               os.path.join(inbox, name))
+                except OSError:
+                    continue  # racing consumer; the payload still exists
+                moved.append(name[:-len(".json")])
+        return moved
+
+    # --- router loop ------------------------------------------------------
+
+    def _router(self) -> None:
+        while True:
+            with self._cv:
+                if self._draining:
+                    return
+            try:
+                self._scan_inbox()
+                self._health_sweep()
+                self._respawn_due()
+            except BaseException as exc:
+                # Propagate-class (interrupt/crash faults) must kill the
+                # router — a zombie fleet scanning nothing is worse than a
+                # dead one; anything else degrades with a recorded reason.
+                if classify(exc) == "propagate":
+                    raise
+                obs.event("degraded", site="procfleet.router",
+                          error=type(exc).__name__, detail=str(exc)[:200])
+            with self._cv:
+                alive = sum(1 for s in self._slots
+                            if s is not None and s.alive())
+                restarting = len(self._respawn_at)
+                rehomed = self._rehomed_total
+                if self._draining:
+                    return
+            self._pulse.pulse(alive, self.cfg.n_replicas,
+                              restarting=restarting, rehomed=rehomed)
+            obs.registry().gauge("procfleet_replicas_alive").set(alive)
+            with self._cv:
+                if self._draining:
+                    return
+                self._cv.wait(timeout=self.cfg.poll_s)
+
+    # --- routing ----------------------------------------------------------
+
+    def _route_target(self) -> Optional[_ReplicaProc]:
+        """Least-loaded live replica (fewest owned non-terminal requests,
+        hello'd replicas preferred), or None — in which case payloads WAIT
+        in the fleet inbox (loss-free when the whole fleet is down)."""
+        with self._cv:
+            live = [(i, s) for i, s in enumerate(self._slots)
+                    if s is not None and s.alive()]
+            if not live:
+                return None
+            owned = {i: 0 for i, _s in live}
+            for rid, idx in self._owner.items():
+                if idx in owned \
+                        and self._status.get(rid) not in _TERMINAL:
+                    owned[idx] += 1
+            return min(live, key=lambda kv: (not kv[1].hello.is_set(),
+                                             owned[kv[0]], kv[0]))[1]
+
+    def _scan_inbox(self) -> None:
+        """Route fleet-inbox payloads into replica sub-inboxes.
+
+        Mirrors the thread fleet's scan where it matters: corruption is
+        quarantined with a terminal, client-visible rejection; routing is
+        write-then-remove of JSON (both halves atomic), so a crash between
+        the two at worst duplicates a payload — which ``resume=True``
+        replay makes idempotent."""
+        from fairify_tpu.serve.request import new_request_id
+
+        inbox = os.path.join(self.cfg.spool, "inbox")
+        try:
+            names = sorted(os.listdir(inbox))
+        except OSError:
+            return
+        for name in names:
+            with self._cv:
+                if self._draining:
+                    return
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(inbox, name)
+            try:
+                with open(path) as fp:
+                    payload = json.load(fp)
+            except OSError:
+                continue  # consumed by a racing router, or an fs flake
+            except json.JSONDecodeError as exc:
+                self._quarantine(path, name, exc)
+                continue
+            target = self._route_target()
+            if target is None:
+                return  # no live replicas: payloads wait, loss-free
+            req_id = str(payload.get("id") or new_request_id())
+            payload = dict(payload, id=req_id)
+            try:
+                write_atomic_json(
+                    os.path.join(target.inbox, f"{req_id}.json"), payload)
+                os.remove(path)
+            except OSError:
+                continue
+            with self._cv:
+                self._owner[req_id] = target.idx
+                self._payloads[req_id] = payload
+                self._status[req_id] = "routed"
+            self._journal({"event": "route", "request": req_id,
+                           "replica": target.idx,
+                           "model": payload.get("model",
+                                                payload.get("init", "?"))})
+
+    def _quarantine(self, path: str, name: str, exc: Exception) -> None:
+        try:
+            os.replace(path, f"{path}.corrupt")
+        except OSError:
+            return
+        rid = name[:-len(".json")]
+        rec = {"request": rid, "status": REJECTED, "model": "?",
+               "preset": "?",
+               "reason": f"corrupt payload (quarantined to {name}.corrupt): "
+                         f"{str(exc)[:200]}"}
+        obs.registry().counter("serve_requests").inc(status=REJECTED)
+        obs.event("request", **rec)
+        self._journal(rec)
+        rdir = os.path.join(self.cfg.spool, "requests", rid)
+        os.makedirs(rdir, exist_ok=True)
+        write_atomic_json(os.path.join(rdir, "status.json"), rec)
+
+    # --- health + failover ------------------------------------------------
+
+    def _health_sweep(self) -> None:
+        """One pass: waitpid + spawn deadline + file-lease check per
+        replica, each death classified and failed over."""
+        # Imported lazily: a module-scope import would pre-load serve.replica
+        # in every `python -m fairify_tpu.serve.replica` subprocess (runpy's
+        # found-in-sys.modules double-execution warning).
+        from fairify_tpu.serve.replica import EXIT_MEMOUT
+
+        with self._cv:
+            slots = [(i, s) for i, s in enumerate(self._slots)
+                     if s is not None]
+        for idx, rp in slots:
+            rc = rp.proc.poll()
+            if rc is not None:
+                kind = "memout" if rc == EXIT_MEMOUT else "crash"
+                self._fail_over(idx, rp, kind, rc=rc)
+                continue
+            if not rp.hello.is_set():
+                if time.monotonic() - rp.spawned_at \
+                        > self.cfg.spawn_timeout_s:
+                    rp.kill()
+                    self._fail_over(idx, rp, "spawn")
+                continue
+            if self.cfg.lease_s <= 0:
+                continue
+            forced = False
+            try:
+                faults_mod.check("replica.lease")
+            except BaseException as exc:
+                cls = classify(exc)
+                if cls == "propagate":
+                    raise
+                if cls == "transient":
+                    # A stat blip: skip this tick's lease verdict; the
+                    # next sweep re-reads the real mtime.
+                    obs.event("degraded", site="replica.lease", replica=idx,
+                              error=type(exc).__name__)
+                    continue
+                # fatal: force the lease expired so the REAL escalating
+                # hang-containment path runs against the live process.
+                forced = True
+            age = self._lease_age(rp)
+            obs.registry().gauge("replica_lease_age_s").set(age, replica=idx)
+            if forced or age > self.cfg.lease_s:
+                self._contain_hang(idx, rp, age)
+
+    def _contain_hang(self, idx: int, rp: _ReplicaProc, age: float) -> None:
+        """Escalating SIGTERM → SIGKILL for a lease-expired replica.
+
+        SIGTERM first (a merely-slow replica may still die cleanly and
+        flush its pipes); a process that ignores it — SIGSTOPped, wedged
+        in native code — takes the SIGKILL after ``term_grace_s``.  Only
+        then does failover run: the kill precedes re-homing, so two
+        processes never write one request's ledger concurrently."""
+        obs.event("replica", replica=idx, event="lease_expired",
+                  lease_age=round(age, 3), pid=rp.proc.pid)
+        try:
+            rp.proc.terminate()
+        except OSError:
+            pass
+        try:
+            rp.proc.wait(timeout=self.cfg.term_grace_s)
+        except subprocess.TimeoutExpired:
+            rp.kill()
+        self._fail_over(idx, rp, "hang", rc=rp.proc.poll())
+
+    def _fail_over(self, idx: int, rp: _ReplicaProc, kind: str,
+                   rc: Optional[int] = None) -> None:
+        """Quarantine a dead replica's slot, re-home its work, schedule
+        the bounded-backoff restart."""
+        with self._cv:
+            if self._slots[idx] is not rp:
+                return  # already failed over
+            self._slots[idx] = None
+        rp.kill()  # reap + close pipes (no-op on an already-dead proc)
+        obs.registry().counter("replica_deaths").inc(kind=kind)
+        obs.event("replica", replica=idx, event="death", kind=kind,
+                  pid=rp.proc.pid, rc=rc)
+        self._journal({"event": "death", "replica": idx, "kind": kind,
+                       "pid": rp.proc.pid, "rc": rc})
+        rehomed = self._rehome(idx, rp)
+        if rehomed:
+            obs.registry().counter("replica_rehomed").inc(rehomed)
+            obs.event("replica", replica=idx, event="rehome",
+                      requests=rehomed)
+        self._schedule_restart(idx)
+
+    def _rehome(self, idx: int, rp: _ReplicaProc) -> int:
+        """Every non-terminal request the dead replica owned goes back to
+        the fleet inbox: unpicked sub-inbox payloads by rename, picked
+        ones re-written from the router's payload table (cross-checked
+        against the on-disk terminal status.json — the control-pipe
+        stream may be missing its torn last line).  The next scan routes
+        them to survivors; ``submitted_ts`` in the payload keeps the SLA
+        clock, the stable id keeps the result_dir, and ``resume=True``
+        replays the decided rows."""
+        from fairify_tpu.serve import client
+
+        inbox = os.path.join(self.cfg.spool, "inbox")
+        moved: set = set()
+        try:
+            names = sorted(os.listdir(rp.inbox))
+        except OSError:
+            names = []
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            try:
+                os.replace(os.path.join(rp.inbox, name),
+                           os.path.join(inbox, name))
+            except OSError:
+                continue
+            moved.add(name[:-len(".json")])
+        with self._cv:
+            owned = [(rid, dict(self._payloads[rid]))
+                     for rid, o in self._owner.items()
+                     if o == idx and rid not in moved
+                     and self._status.get(rid) not in _TERMINAL
+                     and rid in self._payloads]
+        for rid, payload in owned:
+            rec = client.status(self.cfg.spool, rid)
+            if rec is not None and rec.get("status") in _TERMINAL:
+                with self._cv:  # pipe stream missed the terminal: catch up
+                    self._payloads.pop(rid, None)
+                    self._owner.pop(rid, None)
+                    self._status.pop(rid, None)
+                continue
+            try:
+                write_atomic_json(os.path.join(inbox, f"{rid}.json"), payload)
+            except OSError:
+                continue
+            moved.add(rid)
+        with self._cv:
+            for rid in moved:
+                if self._owner.get(rid) == idx:
+                    del self._owner[rid]
+                self._status[rid] = "rehomed"
+            self._rehomed_total += len(moved)
+        for rid in sorted(moved):
+            self._journal({"event": "rehome", "request": rid,
+                           "replica": idx})
+        return len(moved)

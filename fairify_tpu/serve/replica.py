@@ -1,0 +1,237 @@
+"""Replica worker process: ``python -m fairify_tpu.serve.replica``.
+
+One OS process owning one full :class:`~fairify_tpu.serve.server.
+VerificationServer` — its own device client, worker loop, SMT pool and
+launch pipeline — managed by :class:`~fairify_tpu.serve.procfleet.
+ProcessFleet`.  Unlike the thread replicas of ``serve/fleet.py``, this
+process is a real containment domain: a wedged XLA launch, a native
+crash, or a memory blowup dies HERE, and the router's recovery runs
+against a true corpse (``kill -9`` works), not a cooperative simulation.
+
+Contract with the router (DESIGN.md §18):
+
+* **control plane** — newline-framed JSON on stdin/stdout (the
+  :mod:`fairify_tpu.smt.protocol` framing: a SIGKILL tears at most one
+  line, and any undecodable read is treated as a death, not a protocol
+  error).  The replica sends ``{"hello": true, pid, replica}`` once its
+  server is live (jax import + device init happen before this, so the
+  router's spawn deadline covers them), forwards every request lifecycle
+  transition as ``{"op": "status", ...}``, and answers ``ping`` with
+  ``pong``.  The router sends ``{"op": "drain"}`` for graceful shutdown;
+  EOF on stdin (the router died) also drains — an orphan must park its
+  queued payloads back in its sub-inbox, never strand them.
+* **file lease** — the server touches ``<spool>/replicas/<i>/lease`` at
+  every worker yield point (batch-loop iterations and span granules, via
+  ``ServeConfig.lease_path``); the router reads its mtime.  A wedged
+  worker — SIGSTOP, a hung launch — stops beating while the process
+  stays alive, which is exactly the failure ``waitpid`` cannot see.
+* **spool layout** — the replica scans its OWN sub-inbox
+  (``<spool>/replicas/<i>/inbox``) but writes request sinks into the
+  fleet's shared ``<spool>/requests`` (``ServeConfig.requests_dir``):
+  stable result_dirs are what make a cross-process failover's
+  ``resume=True`` ledger replay loss-free.
+* **death taxonomy** — exit 0 only after a completed drain; a worker
+  thread killed by a propagate-class error exits ``EXIT_CRASH``; a
+  ``MemoryError`` anywhere (the ``RLIMIT_AS`` cap landing) exits
+  ``EXIT_MEMOUT`` via ``os._exit`` — a heap that just failed allocation
+  is not trustworthy for cleanup, and the distinct code lets the router
+  classify the death without a word from the corpse.
+
+The module imports only stdlib + :mod:`fairify_tpu.smt.protocol` at the
+top so ``--memory-cap-mb`` (``RLIMIT_AS``) is applied BEFORE the jax
+stack allocates its arenas.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+
+from fairify_tpu.smt import protocol
+
+#: Replica exit codes (the router's waitpid-side death taxonomy).
+EXIT_DRAINED = 0
+EXIT_CRASH = 3
+EXIT_MEMOUT = 86
+
+
+def _apply_memory_cap(cap_mb: int) -> None:
+    if cap_mb <= 0:
+        return
+    import resource
+
+    cap = int(cap_mb) * 1024 * 1024
+    resource.setrlimit(resource.RLIMIT_AS, (cap, cap))
+
+
+def _hijack_stdout():
+    """Reserve fd 1 for the control channel.
+
+    The verify stack legitimately writes progress to stderr, but any
+    stray stdout write (a library banner, a debug print) would corrupt
+    the framed control stream — so the ORIGINAL fd 1 is dup'd for the
+    channel and fd 1 itself is pointed at stderr.  ``parse_msg`` on the
+    router side ignores garbage lines anyway; this makes them not happen.
+    """
+    chan = os.fdopen(os.dup(1), "w", buffering=1)
+    os.dup2(2, 1)
+    sys.stdout = sys.stderr
+    return chan
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--spool", required=True,
+                    help="the FLEET spool root (this replica uses "
+                         "replicas/<i>/ under it)")
+    ap.add_argument("--replica", type=int, required=True)
+    ap.add_argument("--batch-window", type=float, default=0.05)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--span-chunks", type=int, default=0)
+    ap.add_argument("--poll-interval", type=float, default=0.05)
+    ap.add_argument("--default-deadline", type=float, default=None)
+    ap.add_argument("--smt-workers", type=int, default=1)
+    ap.add_argument("--smt-memory-cap", type=int, default=0)
+    ap.add_argument("--smt-portfolio", type=int, default=0)
+    ap.add_argument("--max-queue", type=int, default=0)
+    ap.add_argument("--preempt-factor", type=float, default=0.0)
+    ap.add_argument("--max-preemptions", type=int, default=2)
+    ap.add_argument("--fair-share", type=float, default=0.0)
+    ap.add_argument("--fair-share-min", type=float, default=2.0)
+    ap.add_argument("--fair-share-strict", action="store_true")
+    ap.add_argument("--exec-cache", default=None,
+                    help="shared persistent executable cache directory "
+                         "(a restarted replica warms from disk)")
+    ap.add_argument("--memory-cap-mb", type=int, default=0,
+                    help="RLIMIT_AS for THIS replica process (0 = off)")
+    ap.add_argument("--trace-out", default=None,
+                    help="optional obs event log for this replica")
+    args = ap.parse_args(argv)
+
+    chan = _hijack_stdout()
+    send_lock = threading.Lock()
+
+    def send(obj: dict) -> None:
+        try:
+            with send_lock:
+                chan.write(protocol.dump_msg(obj))
+                chan.flush()
+        except (OSError, ValueError):
+            pass  # router gone mid-write: the reader's EOF drain handles it
+
+    # A MemoryError ANYWHERE (the RLIMIT_AS cap landing in the worker, the
+    # SMT drainer, a decode) means this heap is done: exit immediately with
+    # the distinct memout code — cleanup on a failed heap is how a memout
+    # becomes a hang.
+    prev_hook = threading.excepthook
+
+    def _thread_hook(hook_args):
+        if issubclass(hook_args.exc_type, MemoryError):
+            os._exit(EXIT_MEMOUT)
+        prev_hook(hook_args)
+
+    threading.excepthook = _thread_hook
+
+    _apply_memory_cap(args.memory_cap_mb)
+
+    rdir = os.path.join(args.spool, "replicas", str(args.replica))
+    os.makedirs(os.path.join(rdir, "inbox"), exist_ok=True)
+
+    try:
+        from fairify_tpu import obs
+        from fairify_tpu.serve.server import ServeConfig, VerificationServer
+
+        scfg = ServeConfig(
+            spool=rdir,
+            requests_dir=os.path.join(args.spool, "requests"),
+            lease_path=os.path.join(rdir, "lease"),
+            batch_window_s=args.batch_window, max_batch=args.max_batch,
+            span_chunks=args.span_chunks, poll_s=args.poll_interval,
+            default_deadline_s=args.default_deadline,
+            smt_workers=args.smt_workers,
+            smt_memory_cap_mb=args.smt_memory_cap,
+            smt_portfolio=args.smt_portfolio, max_queue=args.max_queue,
+            preempt_factor=args.preempt_factor,
+            max_preemptions=args.max_preemptions,
+            fair_share_factor=args.fair_share,
+            fair_share_min_s=args.fair_share_min,
+            fair_share_idle_exempt=not args.fair_share_strict,
+            exec_cache=args.exec_cache, replica_id=args.replica)
+
+        def forward(rec: dict) -> None:
+            send({"op": "status", "replica": args.replica, **rec})
+
+        stop = threading.Event()
+
+        def _chaos_memout() -> None:
+            # Allocate past the RSS cap so the REAL containment path runs
+            # (mirrors the SMT worker's memout directive).
+            blocks = []
+            try:
+                while True:
+                    blocks.append(bytearray(16 * 1024 * 1024))
+            except MemoryError:
+                del blocks
+                os._exit(EXIT_MEMOUT)
+
+        def _reader() -> None:
+            for line in sys.stdin:
+                msg = protocol.parse_msg(line)
+                if msg is None:
+                    continue
+                op = msg.get("op")
+                if op == "drain":
+                    stop.set()
+                    return
+                if op == "ping":
+                    send({"op": "pong", "replica": args.replica})
+                elif op == "memout":
+                    _chaos_memout()
+            # EOF: the router died.  Drain so queued payloads park in the
+            # sub-inbox for the next fleet instead of stranding here.
+            stop.set()
+
+        with obs.tracing(args.trace_out, run_id=f"replica-{args.replica}"):
+            srv = VerificationServer(scfg, transition_fn=forward).start()
+            threading.Thread(target=_reader, name="replica-ctl",
+                             daemon=True).start()
+            send({"hello": True, "replica": args.replica,
+                  "pid": os.getpid(), "lease": scfg.lease_path})
+            crashed = False
+            while not stop.is_set():
+                if not srv.alive():
+                    # A propagate-class error killed the worker thread
+                    # (MemoryError already _exit'd via the hook): die
+                    # loudly so waitpid classifies a crash and the router
+                    # re-homes this replica's requests.
+                    crashed = True
+                    break
+                stop.wait(0.2)
+            if crashed:
+                send({"op": "dead", "replica": args.replica})
+                return EXIT_CRASH
+            requeued = srv.drain()
+            # Process-lifetime compile accounting rides the drained
+            # message: it is how the router (and the exec-cache tests)
+            # see that a restarted replica warmed from disk compiled
+            # NOTHING — per-request records only carry per-run deltas.
+            try:
+                from fairify_tpu.obs import compile as compile_obs
+
+                tot = compile_obs.snapshot_totals()
+                stats = {"n_compiles": int(tot["n_compiles"]),
+                         "compile_s": round(float(tot["compile_s"]), 3),
+                         "exec_cache_hits": int(obs.registry().counter(
+                             "exec_cache_hits").total())}
+            except (ImportError, KeyError):
+                stats = {}
+            send({"op": "drained", "replica": args.replica,
+                  "requeued": [r.id for r in requeued], **stats})
+        return EXIT_DRAINED
+    except MemoryError:
+        os._exit(EXIT_MEMOUT)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

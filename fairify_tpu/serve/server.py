@@ -181,6 +181,17 @@ class ServeConfig:
     # serve.fleet's replicas (labels journal records and metrics; enables
     # nothing by itself).
     replica_id: Optional[int] = None
+    # Request-sink root override (serve.procfleet): a process-fleet
+    # replica spools from its OWN sub-inbox (<fleet spool>/replicas/<i>)
+    # but must write request dirs into the FLEET's shared requests/ — the
+    # stable result_dir is what makes a cross-process failover's
+    # ``resume=True`` ledger replay loss-free.  None = <spool>/requests.
+    requests_dir: Optional[str] = None
+    # File-lease heartbeat (serve.procfleet): when set, the worker touches
+    # this file at every yield point it already beats (batch-loop
+    # iterations, span granules) — the cross-process analog of
+    # ``lease_age()``, readable by a router in another process via mtime.
+    lease_path: Optional[str] = None
 
 
 class VerificationServer:
@@ -193,10 +204,14 @@ class VerificationServer:
             srv.wait(req.id, timeout=120.0)
     """
 
-    def __init__(self, cfg: ServeConfig = ServeConfig(), journal=None):
+    def __init__(self, cfg: ServeConfig = ServeConfig(), journal=None,
+                 transition_fn=None):
         """``journal`` injects a shared lifecycle JournalWriter (the fleet
-        passes its fleet-wide one to every replica; the owner closes it)."""
+        passes its fleet-wide one to every replica; the owner closes it).
+        ``transition_fn`` observes every lifecycle journal record (the
+        process-fleet replica forwards them over its control pipe)."""
         self.cfg = cfg
+        self._transition_fn = transition_fn
         self.admission = AdmissionController(smt_backlog=self._smt_backlog_s,
                                              max_queue=cfg.max_queue)
         self._lock = threading.Lock()
@@ -218,15 +233,46 @@ class VerificationServer:
         self._smt_draining_id: Optional[str] = None  # popped, in drain()
         if cfg.spool:
             os.makedirs(os.path.join(cfg.spool, "inbox"), exist_ok=True)
-            os.makedirs(os.path.join(cfg.spool, "requests"), exist_ok=True)
+            os.makedirs(self._requests_root(), exist_ok=True)
             if self._journal_writer is None:
                 self._journal_writer = JournalWriter(
                     os.path.join(cfg.spool, "serve.journal.jsonl"),
                     supervisor=self._sup)
+        if cfg.lease_path:
+            # Born fresh: the router's lease clock starts at spawn, not at
+            # the first batch iteration (a replica that wedges before its
+            # first beat must still expire).
+            with open(cfg.lease_path, "a"):
+                pass
+            os.utime(cfg.lease_path, None)
         if cfg.exec_cache:
             from fairify_tpu.obs import compile as compile_obs
 
             compile_obs.enable_exec_cache(cfg.exec_cache)
+
+    def _requests_root(self) -> str:
+        """Root of the per-request sink dirs (``requests_dir`` override or
+        ``<spool>/requests``) — a process-fleet replica points this at the
+        fleet's shared tree so failover keeps every result_dir stable."""
+        return self.cfg.requests_dir or os.path.join(self.cfg.spool,
+                                                     "requests")
+
+    def _touch_lease(self) -> None:
+        """Beat the cross-process file lease (no-op without one).
+
+        Called at the worker's yield points OUTSIDE ``_cv`` — file I/O
+        under a lock is a blocking-under-lock violation, and the lease
+        needs no serialization (any beat sets mtime = now)."""
+        if not self.cfg.lease_path:
+            return
+        try:
+            os.utime(self.cfg.lease_path, None)
+        except OSError:
+            try:
+                with open(self.cfg.lease_path, "a"):
+                    pass
+            except OSError:
+                pass  # a missing/readonly lease must never kill the worker
 
     # --- lifecycle --------------------------------------------------------
 
@@ -679,6 +725,7 @@ class VerificationServer:
                     obs.event("degraded", site="serve.inbox",
                               error=type(exc).__name__,
                               detail=str(exc)[:200])
+            self._touch_lease()
             with self._cv:
                 now = time.monotonic()
                 self._last_beat = now
@@ -887,6 +934,7 @@ class VerificationServer:
         reports = []
         attempted = 0
         for s in range(start, stop, granule):
+            self._touch_lease()
             with self._cv:
                 draining = self._draining
                 self._last_beat = time.monotonic()
@@ -1042,6 +1090,11 @@ class VerificationServer:
         if self._journal_writer is not None:
             self._journal_writer.append({"ts": round(time.time(), 3), **rec})
         obs.event("request", **rec)
+        if self._transition_fn is not None:
+            # Cross-process visibility (serve.procfleet): the replica
+            # forwards every lifecycle transition over its control pipe so
+            # the router's request table tracks pickups and terminals.
+            self._transition_fn(rec)
 
     def _finish(self, req: VerifyRequest) -> None:
         """Terminal bookkeeping: journal + client-visible status.json."""
@@ -1109,7 +1162,7 @@ class VerificationServer:
                          f"{str(exc)[:200]}"}
         obs.registry().counter("serve_requests").inc(status=REJECTED)
         self._journal_record(rec)
-        rdir = os.path.join(self.cfg.spool, "requests", rid)
+        rdir = os.path.join(self._requests_root(), rid)
         os.makedirs(rdir, exist_ok=True)
         _atomic_json(os.path.join(rdir, "status.json"), rec)
 
@@ -1118,7 +1171,7 @@ class VerificationServer:
 
         req_id = payload.get("id") or new_request_id()
         payload = dict(payload, id=req_id)
-        rdir = os.path.join(self.cfg.spool, "requests", req_id)
+        rdir = os.path.join(self._requests_root(), req_id)
         os.makedirs(rdir, exist_ok=True)
         _atomic_json(os.path.join(rdir, "request.json"), payload)
         try:

@@ -127,6 +127,12 @@ def main() -> int:
                     help="also run the replicated-serving cells: "
                          "replica.lost x {transient,fatal} x {idle,"
                          "mid-batch,mid-SMT-drain} + request.preempt")
+    ap.add_argument("--procfleet", action="store_true",
+                    help="also run the OS-process replica-fleet cells "
+                         "(serve/procfleet.py, real subprocesses): literal "
+                         "SIGKILL mid-batch, SIGSTOP lease-wedge, "
+                         "replica.lease fatal, replica.spawn x {transient,"
+                         "exhausted}, memout x {transient,exhausted}")
     ap.add_argument("--no-smt", action="store_true",
                     help="skip the smt.worker.* pool cells")
     ap.add_argument("--lockprof", action="store_true",
@@ -595,6 +601,286 @@ def main() -> int:
                 row = _finish(row, fl, reqs, want_alive=2)
             row["preemptions"] = pre.total() - p0
             row["ok"] = bool(row["ok"] and row["preemptions"] >= 1)
+        except BaseException as exc:
+            row["crashed"] = f"{type(exc).__name__}: {exc}"
+            row["ok"] = False
+        failures += 0 if row["ok"] else 1
+        print(json.dumps(row), flush=True)
+
+    # Process-fleet cells (--procfleet, DESIGN.md §18): REAL subprocess
+    # replicas under literal kill -9 / SIGSTOP / RLIMIT_AS blowups plus
+    # the injected replica.spawn / replica.lease faults.  Contract: the
+    # router never crashes, every submitted request reaches `done`, its
+    # ledger verdict map (incl. counterexample bytes) is bit-equal to the
+    # fault-free solo run, and each death is classified under the right
+    # taxonomy kind (crash/hang/memout/spawn).
+    if args.procfleet:
+        import time as time_mod
+
+        from fairify_tpu.obs import metrics as metrics_mod
+        from fairify_tpu.resilience import faults as faults_lib
+        from fairify_tpu.serve import ProcessFleet, ProcFleetConfig, \
+            ServeConfig
+        from fairify_tpu.serve import client as client_lib
+
+        deaths_ctr = metrics_mod.registry().counter("replica_deaths")
+        pf_over = {
+            "soft_timeout_s": 30.0, "hard_timeout_s": 600.0, "sim_size": 64,
+            "exact_certify_masks": False, "grid_chunk": args.grid_chunk,
+            "launch_backoff_s": 1e-4}
+        pf_sizes = [len(cfg0.query().columns), 8, 1]
+
+        def _pf_base(seed):
+            rep = sweep.verify_model(
+                init_mlp(tuple(pf_sizes), seed=seed),
+                cfg0.with_(result_dir=os.path.join(args.out,
+                                                   f"pf_base{seed}")),
+                model_name="m", resume=False, partition_span=span)
+            out = {}
+            for o in rep.outcomes:
+                ce = None if o.counterexample is None else \
+                    json.dumps([[int(v) for v in x]
+                                for x in o.counterexample])
+                out[o.partition_id] = (o.verdict, ce)
+            return out
+
+        pf_want = {3: _pf_base(3), 5: _pf_base(5)}
+
+        def _pf_fleet(tag, **kw):
+            kw.setdefault("poll_s", 0.03)
+            kw.setdefault("pulse_s", 0.0)
+            kw.setdefault("backoff_s", 0.05)
+            kw.setdefault("replica", ServeConfig(
+                batch_window_s=0.1, max_batch=4, poll_s=0.05, span_chunks=1))
+            return ProcessFleet(ProcFleetConfig(
+                n_replicas=2, spool=os.path.join(args.out, tag), **kw))
+
+        def _pf_submit(fl, seed):
+            return client_lib.submit(fl.cfg.spool, client_lib.build_payload(
+                args.preset, init={"sizes": pf_sizes, "seed": seed},
+                overrides=dict(pf_over), span=span))
+
+        def _pf_map(fl, rid):
+            out = {}
+            for path in client_lib.ledger_paths(fl.cfg.spool, rid):
+                for pid, rec in sweep._load_ledger(path).items():
+                    ce = rec.get("ce")
+                    out[pid] = (rec["verdict"],
+                                None if ce is None else json.dumps(ce))
+            return out
+
+        def _pf_wait_running(fl, rid, timeout=90.0):
+            t0 = time_mod.monotonic()
+            while time_mod.monotonic() - t0 < timeout:
+                if fl.status_of(rid) == "running":
+                    owner = fl.owner_of(rid)
+                    if owner is not None:
+                        return owner
+                time_mod.sleep(0.01)
+            return None
+
+        def _pf_finish(row, fl, rids, want_kind=None, d0=None):
+            ok = True
+            for seed, rid in rids.items():
+                rec = fl.wait(rid, timeout=600)
+                done = rec is not None and rec.get("status") == "done"
+                bit_equal = done and _pf_map(fl, rid) == pf_want[seed]
+                row[f"status_{seed}"] = None if rec is None \
+                    else rec.get("status")
+                row[f"bit_equal_{seed}"] = bit_equal
+                ok = ok and done and bit_equal
+            if want_kind is not None:
+                fired = deaths_ctr.value(kind=want_kind) - (d0 or 0)
+                row["deaths_" + want_kind] = fired
+                ok = ok and fired >= 1
+            row["replicas_alive"] = fl.replicas_alive()
+            fl.drain()
+            row["ok"] = bool(ok)
+            return row
+
+        # Literal kill -9 MID-BATCH: the owning replica dies with no
+        # cleanup; failover re-homes, resume replays, bit-equal.
+        import signal as signal_mod
+
+        row = {"cell": "procfleet/sigkill-mid-batch"}
+        try:
+            d0 = deaths_ctr.value(kind="crash")
+            fl = _pf_fleet("pf_kill").start()
+            fl.wait_ready(timeout=180)
+            rids = {3: _pf_submit(fl, 3), 5: _pf_submit(fl, 5)}
+            owner = _pf_wait_running(fl, rids[3])
+            os.kill(fl.pids()[owner], signal_mod.SIGKILL)
+            row = _pf_finish(row, fl, rids, want_kind="crash", d0=d0)
+        except BaseException as exc:
+            row["crashed"] = f"{type(exc).__name__}: {exc}"
+            row["ok"] = False
+        failures += 0 if row["ok"] else 1
+        print(json.dumps(row), flush=True)
+
+        # SIGSTOP wedge: alive to waitpid, dead to the lease — the router
+        # must escalate SIGTERM -> SIGKILL (only the SIGKILL lands on a
+        # stopped process) and fail over.  The lease must clear the
+        # worst-case healthy inter-beat gap (a whole granule on a loaded
+        # single-core host), or the router kills the SURVIVOR too and
+        # flaps the fleet dead — 5 s is the reviewed margin here.
+        row = {"cell": "procfleet/sigstop-lease-wedge"}
+        try:
+            d0 = deaths_ctr.value(kind="hang")
+            fl = _pf_fleet("pf_stop", lease_s=5.0, term_grace_s=0.5).start()
+            fl.wait_ready(timeout=180)
+            rids = {3: _pf_submit(fl, 3), 5: _pf_submit(fl, 5)}
+            owner = _pf_wait_running(fl, rids[3])
+            os.kill(fl.pids()[owner], signal_mod.SIGSTOP)
+            row = _pf_finish(row, fl, rids, want_kind="hang", d0=d0)
+        except BaseException as exc:
+            row["crashed"] = f"{type(exc).__name__}: {exc}"
+            row["ok"] = False
+        failures += 0 if row["ok"] else 1
+        print(json.dumps(row), flush=True)
+
+        # replica.lease:fatal — the injected fault FORCES a healthy
+        # replica's lease expired, so the real hang-containment (and the
+        # failover behind it) runs without a wedge to wait for.
+        row = {"cell": "procfleet/replica.lease/fatal",
+               "spec": "replica.lease:fatal:1"}
+        try:
+            d0 = deaths_ctr.value(kind="hang")
+            fl = _pf_fleet("pf_lease", lease_s=30.0, term_grace_s=0.5)
+            with faults_lib.armed(("replica.lease:fatal:1",),
+                                  seed=cfg0.seed):
+                fl.start()
+                fl.wait_ready(timeout=180)
+                rids = {3: _pf_submit(fl, 3)}
+                t0 = time_mod.monotonic()
+                while deaths_ctr.value(kind="hang") == d0 \
+                        and time_mod.monotonic() - t0 < 60:
+                    time_mod.sleep(0.02)
+                row = _pf_finish(row, fl, rids, want_kind="hang", d0=d0)
+        except BaseException as exc:
+            row["crashed"] = f"{type(exc).__name__}: {exc}"
+            row["ok"] = False
+        failures += 0 if row["ok"] else 1
+        print(json.dumps(row), flush=True)
+
+        # replica.lease:transient — a stat blip: absorbed for one tick,
+        # nothing dies, nothing restarts.
+        row = {"cell": "procfleet/replica.lease/transient",
+               "spec": "replica.lease:transient:1"}
+        try:
+            d0 = deaths_ctr.total()
+            fl = _pf_fleet("pf_lease_t", lease_s=30.0)
+            with faults_lib.armed(("replica.lease:transient:1",),
+                                  seed=cfg0.seed):
+                fl.start()
+                fl.wait_ready(timeout=180)
+                rids = {3: _pf_submit(fl, 3)}
+                row = _pf_finish(row, fl, rids)
+                row["deaths_total"] = deaths_ctr.total() - d0
+                row["ok"] = bool(row["ok"] and row["deaths_total"] == 0
+                                 and row["replicas_alive"] == 2)
+        except BaseException as exc:
+            row["crashed"] = f"{type(exc).__name__}: {exc}"
+            row["ok"] = False
+        failures += 0 if row["ok"] else 1
+        print(json.dumps(row), flush=True)
+
+        # replica.spawn:transient — slot 0's FIRST fork fails; the
+        # bounded-backoff respawn brings the fleet to full strength.
+        row = {"cell": "procfleet/replica.spawn/transient",
+               "spec": "replica.spawn:transient:1"}
+        try:
+            fl = _pf_fleet("pf_spawn_t")
+            with faults_lib.armed(("replica.spawn:transient:1",),
+                                  seed=cfg0.seed):
+                fl.start()
+                t0 = time_mod.monotonic()
+                while fl.replicas_alive() < 2 \
+                        and time_mod.monotonic() - t0 < 120:
+                    time_mod.sleep(0.05)
+            fl.wait_ready(timeout=180)
+            rids = {3: _pf_submit(fl, 3)}
+            row = _pf_finish(row, fl, rids, want_kind=None)
+            row["recovered"] = row["replicas_alive"] == 2
+            row["ok"] = bool(row["ok"] and row["recovered"])
+        except BaseException as exc:
+            row["crashed"] = f"{type(exc).__name__}: {exc}"
+            row["ok"] = False
+        failures += 0 if row["ok"] else 1
+        print(json.dumps(row), flush=True)
+
+        # replica.spawn exhausted — slot 0's fork AND both respawn
+        # attempts fail (arrivals 1, 3, 4; arrival 2 is slot 1): the slot
+        # is abandoned, the survivor serves everything.
+        row = {"cell": "procfleet/replica.spawn/exhausted",
+               "spec": "replica.spawn:transient:1 + 3-4"}
+        try:
+            fl = _pf_fleet("pf_spawn_x", max_restarts=2)
+            with faults_lib.armed(("replica.spawn:transient:1",
+                                   "replica.spawn:transient:3-4"),
+                                  seed=cfg0.seed):
+                fl.start()
+                fl.wait_ready(timeout=180)
+                rids = {3: _pf_submit(fl, 3)}
+                t0 = time_mod.monotonic()
+                while fl.restarts()[0] < 2 \
+                        and time_mod.monotonic() - t0 < 120:
+                    time_mod.sleep(0.05)
+                row = _pf_finish(row, fl, rids)
+                row["slot0_restarts"] = fl.restarts()[0]
+                row["ok"] = bool(row["ok"] and row["slot0_restarts"] == 2)
+        except BaseException as exc:
+            row["crashed"] = f"{type(exc).__name__}: {exc}"
+            row["ok"] = False
+        failures += 0 if row["ok"] else 1
+        print(json.dumps(row), flush=True)
+
+        # memout transient — one allocation past RLIMIT_AS kills exactly
+        # that replica with the distinct exit code; the restart absorbs
+        # it and requests stay bit-equal.
+        row = {"cell": "procfleet/memout/transient"}
+        try:
+            d0 = deaths_ctr.value(kind="memout")
+            fl = _pf_fleet("pf_mem_t", memory_cap_mb=2048).start()
+            fl.wait_ready(timeout=240)
+            assert fl.inject_memout(0)
+            t0 = time_mod.monotonic()
+            while deaths_ctr.value(kind="memout") == d0 \
+                    and time_mod.monotonic() - t0 < 60:
+                time_mod.sleep(0.02)
+            rids = {3: _pf_submit(fl, 3)}
+            row = _pf_finish(row, fl, rids, want_kind="memout", d0=d0)
+        except BaseException as exc:
+            row["crashed"] = f"{type(exc).__name__}: {exc}"
+            row["ok"] = False
+        failures += 0 if row["ok"] else 1
+        print(json.dumps(row), flush=True)
+
+        # memout exhausted — every comeback of slot 0 memouts again until
+        # its restart budget is spent; the slot is abandoned and the
+        # survivor still serves.
+        row = {"cell": "procfleet/memout/exhausted"}
+        try:
+            d0 = deaths_ctr.value(kind="memout")
+            fl = _pf_fleet("pf_mem_x", memory_cap_mb=2048,
+                           max_restarts=1).start()
+            fl.wait_ready(timeout=240)
+            killed = 0
+            t0 = time_mod.monotonic()
+            while killed < 2 and time_mod.monotonic() - t0 < 240:
+                if 0 in fl.pids() and fl.inject_memout(0):
+                    before = deaths_ctr.value(kind="memout")
+                    while deaths_ctr.value(kind="memout") == before \
+                            and time_mod.monotonic() - t0 < 240:
+                        time_mod.sleep(0.02)
+                    killed += 1
+                else:
+                    time_mod.sleep(0.05)
+            rids = {3: _pf_submit(fl, 3)}
+            row = _pf_finish(row, fl, rids)
+            row["memouts"] = deaths_ctr.value(kind="memout") - d0
+            row["slot0_restarts"] = fl.restarts()[0]
+            row["ok"] = bool(row["ok"] and row["memouts"] >= 2
+                             and row["slot0_restarts"] == 1)
         except BaseException as exc:
             row["crashed"] = f"{type(exc).__name__}: {exc}"
             row["ok"] = False

@@ -58,6 +58,11 @@ regression) and ``serve.preemptions@<n>c`` lower-is-better with a
 ``serve.cold_restart_compile_s`` gate the zero-cold-start contract — a
 restarted process recompiling anything it should have loaded from the
 executable cache fails (0.5 floors match ``n_compiles``/``compile_s``).
+A ``procfleet`` block (``--replica-procs`` runs, ISSUE 15) adds
+``serve.replica_deaths`` / ``serve.replica_restarts`` /
+``serve.replica_rehomed`` — lower-is-better with a 2-count floor: a fleet
+that starts dying or flapping at equal load is a containment regression
+even when failover keeps the latency columns green.
 ``serve.batch_occupancy@<n>c`` is emitted only for shed-free levels: under
 admission shedding it measures admitted workload shape, not batcher
 packing, so a shedding candidate simply drops the metric (a ``missing``
@@ -188,6 +193,18 @@ def _serve_records(obj: dict) -> Dict[str, dict]:
         if cold.get("compile_s") is not None:
             out["serve.cold_restart_compile_s"] = _flat_lower(
                 cold["compile_s"], floor=0.5)
+    pf = obj.get("procfleet")
+    if isinstance(pf, dict):
+        # Process-fleet health (ISSUE 15): kill/restart/re-home counters
+        # gate lower-is-better with a 2-count floor — a replica fleet
+        # that starts dying or flapping at equal load is a containment
+        # regression even when the latency columns survive it (that is
+        # the point of failover).
+        for key, metric in (("replica_deaths", "serve.replica_deaths"),
+                            ("replica_restarts", "serve.replica_restarts"),
+                            ("rehomed", "serve.replica_rehomed")):
+            if pf.get(key) is not None:
+                out[metric] = _flat_lower(pf[key], floor=2.0)
     return out
 
 
@@ -476,6 +493,19 @@ def self_test() -> int:
                                    "shed_rate": 0.31, "preemptions": 3}}})
     svo_coldly = _serve_records(
         {**svo, "cold_restart": {"n_compiles": 9, "compile_s": 21.0}})
+    svp = {"kind": "SERVE", "replica_procs": 2,
+           "clients": {"4": {"p95_ms": 900.0, "deadline_miss_rate": 0.0,
+                             "requests_per_s": 4.0}},
+           "procfleet": {"replica_deaths": 0, "replica_restarts": 0,
+                         "rehomed": 0, "fleet_n_compiles": 9}}
+    svp_base = _serve_records(svp)
+    svp_same = _serve_records(json.loads(json.dumps(svp)))
+    svp_flappy = _serve_records(
+        {**svp, "procfleet": {**svp["procfleet"], "replica_deaths": 6,
+                              "replica_restarts": 6, "rehomed": 5}})
+    svp_blip = _serve_records(
+        {**svp, "procfleet": {**svp["procfleet"], "replica_deaths": 1,
+                              "replica_restarts": 1, "rehomed": 1}})
     sv16_melt = _serve_records(       # the r01 shape: no shedding, melted
         {"kind": "SERVE",
          "clients": {"16": {"p95_ms": 126226.2, "deadline_miss_rate": 0.625,
@@ -577,6 +607,11 @@ def self_test() -> int:
          compare(svo_base, svo_coldly), 2),
         ("shedding candidate's occupancy not gated vs melted baseline",
          compare(sv16_melt, sv16_shedding), 0),
+        ("identical procfleet records pass", compare(svp_base, svp_same), 0),
+        ("replica fleet flapping flagged (deaths+restarts+rehomes)",
+         compare(svp_base, svp_flappy), 3),
+        ("single replica blip within count floor passes",
+         compare(svp_base, svp_blip), 0),
         ("identical smt records pass", compare(sm_base, sm_same), 0),
         ("lost smt scaling flagged (qps@4w + speedup_x)",
          compare(sm_base, sm_serial), 2),

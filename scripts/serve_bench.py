@@ -161,6 +161,15 @@ def main() -> int:
     ap.add_argument("--replicas", type=int, default=1,
                     help="route levels through a ServerFleet of N replicas "
                          "(clients spread over N span groups)")
+    ap.add_argument("--replica-procs", type=int, default=0,
+                    help="route levels through a ProcessFleet of N "
+                         "OS-process replicas (serve.procfleet): submits "
+                         "go through the real spool protocol, latencies "
+                         "are the server-side queue_wait+run from each "
+                         "request's terminal record, and the record gains "
+                         "a `procfleet` block (deaths/restarts/re-homes + "
+                         "fleet compile totals) perfdiff gates "
+                         "lower-is-better")
     ap.add_argument("--max-queue", type=int, default=8,
                     help="bounded-queue shed depth in --priority-mix mode")
     ap.add_argument("--preempt-factor", type=float, default=2.0,
@@ -230,18 +239,26 @@ def main() -> int:
     def _net(seed):
         return init_mlp((in_dim, 8, 1), seed=seed)
 
+    procs = args.replica_procs > 0
     # Sequential baseline: 4 solo runs, counted warm (after one throwaway
     # cold run that pays the compiles the server's warmup also pays).
-    sweep.verify_model(
-        _net(0), cfg0.with_(result_dir=os.path.join(args.work_dir, "warm")),
-        model_name="warm", resume=False, partition_span=span)
-    seq0 = launches.total()
-    for i in range(4):
+    # Skipped in --replica-procs mode: launches happen in replica
+    # processes, so the coalesced side of the comparison is unobservable
+    # here (the procfleet block carries the fleet-level health instead).
+    sequential_launches = None
+    if not procs:
         sweep.verify_model(
-            _net(100 + i),
-            cfg0.with_(result_dir=os.path.join(args.work_dir, f"solo-{i}")),
-            model_name=f"solo-{i}", resume=False, partition_span=span)
-    sequential_launches = int(launches.total() - seq0)
+            _net(0),
+            cfg0.with_(result_dir=os.path.join(args.work_dir, "warm")),
+            model_name="warm", resume=False, partition_span=span)
+        seq0 = launches.total()
+        for i in range(4):
+            sweep.verify_model(
+                _net(100 + i),
+                cfg0.with_(result_dir=os.path.join(args.work_dir,
+                                                   f"solo-{i}")),
+                model_name=f"solo-{i}", resume=False, partition_span=span)
+        sequential_launches = int(launches.total() - seq0)
 
     mix = args.priority_mix
     scfg = ServeConfig(
@@ -258,7 +275,15 @@ def main() -> int:
         # needs granules) is exercised by chaos_matrix --fleet and
         # test_serve, not by this latency record.
         fair_share_idle_exempt=not mix)
-    if args.replicas > 1:
+    spool = os.path.join(os.path.abspath(args.work_dir), "spool")
+    if procs:
+        from fairify_tpu.serve import ProcessFleet, ProcFleetConfig
+
+        srv = ProcessFleet(ProcFleetConfig(
+            n_replicas=args.replica_procs, spool=spool, poll_s=0.02,
+            pulse_s=5.0, exec_cache=exec_dir,
+            replica=scfg))
+    elif args.replicas > 1:
         # Spill AT the shed bound: a burst spreads over the fleet right
         # before replicas would start shedding, while a small (shed-free,
         # sub-max_queue) burst stays on one replica with its full
@@ -270,37 +295,70 @@ def main() -> int:
     else:
         srv = VerificationServer(scfg)
     srv.start()
+    if procs:
+        from fairify_tpu.serve import client as spool_client
+
+        ready = srv.wait_ready(timeout=300)
+        print(f"serve_bench: {ready}/{args.replica_procs} process replicas "
+              f"ready", file=sys.stderr)
+
+        cfg_overrides = {
+            "soft_timeout_s": 10.0, "hard_timeout_s": 600.0, "sim_size": 64,
+            "exact_certify_masks": False, "grid_chunk": args.grid_chunk,
+            "launch_backoff_s": 1e-4}
+
+        def spool_submit(seed, deadline=None, prio=None):
+            return spool_client.submit(spool, spool_client.build_payload(
+                args.preset, init={"sizes": [in_dim, 8, 1], "seed": seed},
+                overrides=dict(cfg_overrides), deadline_s=deadline,
+                span=span, priority=prio))
+
+        def spool_wait(rid, timeout=900.0):
+            return spool_client.wait(spool, rid, timeout=timeout,
+                                     poll_s=0.02)
     # Server warmup: one solo request (solo kernels) plus one coalesced
     # wave (the fixed-width family executable — pad_models means any
     # later occupancy reuses it).  After this, the measured levels must
-    # hit the warm executable cache only.
-    w = srv.submit(cfg0.with_(result_dir=os.path.join(args.work_dir, "w0")),
-                   _net(0), "w0", partition_span=span)
-    srv.wait(w.id, timeout=900.0)
-    wave = [srv.submit(
-        cfg0.with_(result_dir=os.path.join(args.work_dir, f"wv{i}")),
-        _net(900 + i), f"wv{i}", partition_span=span) for i in range(2)]
-    for req in wave:
-        srv.wait(req.id, timeout=900.0)
-    # Warm-until-quiescent: keep feeding fresh warmup models until a whole
-    # round adds zero compiles.  The SERVE_r01 postmortem found the 7
-    # mid-load compiles at 16 clients were FIRST-TOUCH refinement kernels
-    # (sign-BaB, pair-LP, PGD slabs) — paths only UNKNOWN-heavy models
-    # reach, which the old stage-0-decidable warmup never exercised; the
-    # measured levels then paid multi-second compile stalls mid-overload.
-    wseed = 950
-    for _round in range(6):
-        c_before = compile_obs.snapshot_totals()["n_compiles"]
+    # hit the warm executable cache only.  In --replica-procs mode the
+    # warmup spreads one request per replica (least-loaded routing), so
+    # every process compiles-or-loads its kernels before measurement.
+    if procs:
+        warm_ids = [spool_submit(900 + i)
+                    for i in range(max(args.replica_procs, 2))]
+        for rid in warm_ids:
+            spool_wait(rid)
+        compiles0 = 0
+    if not procs:
+        w = srv.submit(
+            cfg0.with_(result_dir=os.path.join(args.work_dir, "w0")),
+            _net(0), "w0", partition_span=span)
+        srv.wait(w.id, timeout=900.0)
         wave = [srv.submit(
-            cfg0.with_(result_dir=os.path.join(args.work_dir, f"wq{wseed+i}")),
-            _net(wseed + i), f"wq{wseed + i}", partition_span=span)
-            for i in range(4)]
+            cfg0.with_(result_dir=os.path.join(args.work_dir, f"wv{i}")),
+            _net(900 + i), f"wv{i}", partition_span=span) for i in range(2)]
         for req in wave:
             srv.wait(req.id, timeout=900.0)
-        wseed += 4
-        if compile_obs.snapshot_totals()["n_compiles"] == c_before:
-            break
-    compiles0 = compile_obs.snapshot_totals()["n_compiles"]
+        # Warm-until-quiescent: keep feeding fresh warmup models until a
+        # whole round adds zero compiles.  The SERVE_r01 postmortem found
+        # the 7 mid-load compiles at 16 clients were FIRST-TOUCH
+        # refinement kernels (sign-BaB, pair-LP, PGD slabs) — paths only
+        # UNKNOWN-heavy models reach, which the old stage-0-decidable
+        # warmup never exercised; the measured levels then paid
+        # multi-second compile stalls mid-overload.
+        wseed = 950
+        for _round in range(6):
+            c_before = compile_obs.snapshot_totals()["n_compiles"]
+            wave = [srv.submit(
+                cfg0.with_(result_dir=os.path.join(args.work_dir,
+                                                   f"wq{wseed + i}")),
+                _net(wseed + i), f"wq{wseed + i}", partition_span=span)
+                for i in range(4)]
+            for req in wave:
+                srv.wait(req.id, timeout=900.0)
+            wseed += 4
+            if compile_obs.snapshot_totals()["n_compiles"] == c_before:
+                break
+        compiles0 = compile_obs.snapshot_totals()["n_compiles"]
 
     preempt_ctr = registry.counter("serve_preemptions")
     levels = {}
@@ -318,6 +376,36 @@ def main() -> int:
         lvl_p0 = preempt_ctr.total()
         t_lvl = time.perf_counter()
         for rnd in range(args.rounds):
+            if procs:
+                # Spool protocol end-to-end: latency is the server-side
+                # queue_wait + run from each terminal record (the r01/r02
+                # finished_at - submitted_at quantity, measured where the
+                # clocks live).
+                rids = []
+                for c in range(n_clients):
+                    seed += 1
+                    if mix and n_clients >= 8:
+                        prio, deadline = _prio_of(c)
+                    else:
+                        prio, deadline = 1, args.deadline
+                    rids.append(spool_submit(seed, deadline=deadline,
+                                             prio=prio))
+                for rid in rids:
+                    rec = spool_wait(rid)
+                    total += 1
+                    if rec is None:
+                        misses += 1  # never terminal: worse than a miss
+                        continue
+                    if rec.get("status") == "rejected" and str(
+                            rec.get("reason", "")).startswith("shed"):
+                        sheds += 1
+                        continue
+                    done_n += int(rec.get("status") == "done")
+                    latencies.append(float(rec.get("queue_wait_s", 0.0))
+                                     + float(rec.get("run_s", 0.0)))
+                    misses += int(bool(rec.get("deadline_missed"))
+                                  or rec.get("status") != "done")
+                continue
             reqs = []
             for c in range(n_clients):
                 seed += 1
@@ -352,31 +440,60 @@ def main() -> int:
         admitted = total - sheds
         b_cnt = batch_hist.count() - b_cnt0
         occupancy = ((batch_hist.sum() - b_sum0) / b_cnt) if b_cnt else 0.0
-        if n_clients == 4:
+        if n_clients == 4 and not procs:
+            # Launches land in replica processes in --replica-procs mode;
+            # this process's counter would read a misleading 0.
             coalesced_launches = int((launches.total() - lvl_l0)
                                      / args.rounds)
-        levels[str(n_clients)] = {
+        row = {
             "requests": total,
             "admitted": admitted,
             **_percentiles(latencies),
             "deadline_miss_rate": round(misses / max(admitted, 1), 4),
             "shed_rate": round(sheds / max(total, 1), 4),
-            "preemptions": int(preempt_ctr.total() - lvl_p0),
-            "batch_occupancy_mean": round(occupancy, 3),
             "requests_per_s": round(done_n / wall, 3),
-            "xla_compiles": int(compile_obs.snapshot_totals()["n_compiles"]
-                                - lvl_c0),
         }
+        if not procs:
+            # Compile/occupancy/preemption instruments live in THIS
+            # process only for thread-mode servers; replica processes
+            # report their compile totals in the procfleet block instead.
+            row["preemptions"] = int(preempt_ctr.total() - lvl_p0)
+            row["batch_occupancy_mean"] = round(occupancy, 3)
+            row["xla_compiles"] = int(
+                compile_obs.snapshot_totals()["n_compiles"] - lvl_c0)
+        levels[str(n_clients)] = row
         print(f"serve_bench: {n_clients:>2} client(s): "
               f"{levels[str(n_clients)]}", file=sys.stderr)
     # The warm gate is the acceptance cell: 4 concurrent requests on a
     # warmed server compile nothing (falls back to the total across levels
     # when 4 wasn't measured).
-    if "4" in levels:
+    if procs:
+        warm_compiles = None
+    elif "4" in levels:
         warm_compiles = levels["4"]["xla_compiles"]
     else:
         warm_compiles = compile_obs.snapshot_totals()["n_compiles"] - compiles0
-    srv.drain()
+    procfleet_block = None
+    if procs:
+        drain_stats = {}
+        srv.drain()
+        drain_stats = srv.drain_stats()
+        reg = registry
+        procfleet_block = {
+            "replicas": args.replica_procs,
+            "replica_deaths": int(reg.counter("replica_deaths").total()),
+            "replica_restarts": int(
+                reg.counter("replica_restarts").total()),
+            "rehomed": int(reg.counter("replica_rehomed").total()),
+            "fleet_n_compiles": sum(
+                int(s.get("n_compiles", 0)) for s in drain_stats.values()),
+            "fleet_exec_cache_hits": sum(
+                int(s.get("exec_cache_hits", 0))
+                for s in drain_stats.values()),
+        }
+        print(f"serve_bench: procfleet {procfleet_block}", file=sys.stderr)
+    else:
+        srv.drain()
 
     record = {
         "kind": "SERVE",
@@ -387,11 +504,15 @@ def main() -> int:
         "deadline_s": args.deadline,
         "priority_mix": bool(mix),
         "replicas": args.replicas,
+        "replica_procs": args.replica_procs,
         "clients": levels,
-        "warm_xla_compiles": int(warm_compiles),
+        "warm_xla_compiles": None if warm_compiles is None
+        else int(warm_compiles),
         "coalesced_device_launches": coalesced_launches,
         "sequential_device_launches": sequential_launches,
     }
+    if procfleet_block is not None:
+        record["procfleet"] = procfleet_block
     if not args.no_cold_restart:
         record["cold_restart"] = _cold_restart(args, exec_dir, in_dim)
         print(f"serve_bench: cold restart from cache: "
@@ -399,6 +520,17 @@ def main() -> int:
     with open(args.out, "w") as fp:
         json.dump(record, fp, indent=1)
     print(json.dumps(record))
+    if procs:
+        # Process-mode health: every client level completed, and the
+        # fleet neither crashed nor flapped (deaths gate lives in
+        # perfdiff; here a restart is only fatal if requests were lost).
+        ok = all(lvl.get("requests", 0) > 0 for lvl in levels.values())
+        print(f"serve_bench: procfleet levels "
+              f"{'OK' if ok else 'INCOMPLETE'} "
+              f"(deaths={procfleet_block['replica_deaths']} "
+              f"restarts={procfleet_block['replica_restarts']})",
+              file=sys.stderr)
+        return 0 if ok else 1
     ok = warm_compiles == 0 and (
         coalesced_launches is None or coalesced_launches < sequential_launches)
     print(f"serve_bench: warm compiles {warm_compiles} "

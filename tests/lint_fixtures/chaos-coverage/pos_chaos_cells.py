@@ -10,3 +10,11 @@ SCHEDULES = [
     ("demo.used", "transient", "demo.used:transient:2"),
     ("nope.site", "transient", "nope.site:transient:1"),  # EXPECT
 ]
+
+# Process-fleet style cells (full spec literals, the shape the real
+# --procfleet section uses): these keep replica.spawn / replica.lease
+# covered in the fixture registry.
+PROCFLEET_SPECS = [
+    "replica.spawn:transient:1",
+    "replica.lease:fatal:1",
+]

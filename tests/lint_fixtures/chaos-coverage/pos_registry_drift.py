@@ -1,4 +1,5 @@
 # rel: fairify_tpu/resilience/faults.py
 FAULT_SITES = frozenset({"demo.used", "demo.lost", "smt.query",  # EXPECT
-                         "shard.dispatch", "shard.gather"})
+                         "shard.dispatch", "shard.gather",
+                         "replica.spawn", "replica.lease"})
 FAULT_KINDS = frozenset({"transient", "fatal", "crash"})

@@ -1,0 +1,12 @@
+# rel: fairify_tpu/serve/fx_procfleet.py
+from fairify_tpu.resilience import faults as faults_mod
+
+
+def spawn_and_sweep(slots, lease_s):
+    # Literal anchors for the process-fleet sites: the router's replica
+    # fork and its file-lease heartbeat check each stay a named
+    # chaos-injectable site (DESIGN.md §18).
+    for _slot in slots:
+        faults_mod.check("replica.spawn")
+    if lease_s > 0:
+        faults_mod.check("replica.lease")

@@ -1,0 +1,10 @@
+# rel: fairify_tpu/serve/fx_procfleet_typos.py
+from fairify_tpu.resilience import faults as faults_mod
+
+
+def spawn_and_sweep_typoed(slots):
+    # Misspelled process-fleet sites: every --inject-fault spec targeting
+    # them is rejected at the CLI while these paths run unprotected.
+    for _slot in slots:
+        faults_mod.check("replica.spwan")  # EXPECT
+    faults_mod.check("replica.leese")  # EXPECT

@@ -3,6 +3,7 @@ FAULT_SITES = frozenset({"demo.used", "demo.orphan", "shard.dispatch",  # EXPECT
                          "shard.gather", "device.lost", "request.admit",
                          "request.deadline", "serve.drain",
                          "request.preempt", "replica.lost",
+                         "replica.spawn", "replica.lease",
                          "smt.worker.spawn", "smt.worker.crash",
                          "smt.worker.hang", "smt.worker.memout"})
 FAULT_KINDS = frozenset({"transient", "fatal", "crash"})

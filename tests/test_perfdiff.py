@@ -228,5 +228,43 @@ def test_serve_record_gating(tmp_path):
     assert perfdiff.main([str(a), str(b)]) == 0
 
 
+def test_procfleet_block_gating(tmp_path):
+    """A SERVE record's `procfleet` block (serve_bench --replica-procs)
+    gates replica deaths/restarts/re-homes lower-is-better with a 2-count
+    floor: a flapping fleet fails even when the latency columns survive
+    failover; a single blip within the floor passes."""
+    base = {"kind": "SERVE", "replica_procs": 2,
+            "clients": {"4": {"p95_ms": 900.0, "deadline_miss_rate": 0.0,
+                              "requests_per_s": 4.0}},
+            "procfleet": {"replica_deaths": 0, "replica_restarts": 0,
+                          "rehomed": 0, "fleet_n_compiles": 9,
+                          "fleet_exec_cache_hits": 30}}
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(base))
+    assert {"serve.replica_deaths", "serve.replica_restarts",
+            "serve.replica_rehomed"} <= set(perfdiff.load_records(str(a)))
+    b.write_text(json.dumps(base))
+    assert perfdiff.main([str(a), str(b)]) == 0
+    # Flapping fleet: kills, restarts, and re-homes all step up.
+    cand = json.loads(json.dumps(base))
+    cand["procfleet"].update(replica_deaths=6, replica_restarts=6,
+                             rehomed=5)
+    b.write_text(json.dumps(cand))
+    assert perfdiff.main([str(a), str(b)]) == 1
+    # One death + restart over a clean baseline is inside the 2-count
+    # floor (a single chaos-style blip, not a flap loop).
+    cand = json.loads(json.dumps(base))
+    cand["procfleet"].update(replica_deaths=1, replica_restarts=1,
+                             rehomed=1)
+    b.write_text(json.dumps(cand))
+    assert perfdiff.main([str(a), str(b)]) == 0
+    # Fleet compile totals are informational (warmup compiles are
+    # legitimate on a cold cache), never gated.
+    cand = json.loads(json.dumps(base))
+    cand["procfleet"]["fleet_n_compiles"] = 40
+    b.write_text(json.dumps(cand))
+    assert perfdiff.main([str(a), str(b)]) == 0
+
+
 def test_self_test_cli_flag():
     assert perfdiff.main(["--self-test"]) == 0

@@ -1,0 +1,424 @@
+"""Out-of-process replica fleet (``serve/procfleet.py``, DESIGN.md §18).
+
+Every test here drives REAL subprocesses — no mocks: replicas are
+``python -m fairify_tpu.serve.replica`` workers, deaths are literal
+``kill -9`` / ``SIGSTOP`` / allocation past ``RLIMIT_AS``, and recovery
+is the router's actual waitpid/lease/failover machinery.  The contracts:
+
+* **loss-free hard-kill failover** — a replica SIGKILLed mid-batch loses
+  nothing: its requests re-home to a survivor, the survivor's
+  ``resume=True`` run replays the crash-safe ledger, and the final
+  verdict map (verdict AND counterexample bytes per partition) is
+  bit-equal to an undisturbed run;
+* **lease-based hang detection** — a SIGSTOPped replica stops beating
+  its file lease while staying alive to ``waitpid``; the router must
+  declare it wedged, escalate SIGTERM → SIGKILL, and fail over;
+* **bounded restart backoff** — repeated deaths restart the slot at most
+  ``max_restarts`` times, then abandon it (no flap loop);
+* **cross-process exec-cache sharing** — a replica restarted against the
+  shared persistent executable cache compiles nothing;
+* **client exit codes survive a replica death** — ``fairify_tpu submit
+  --wait`` returns 0 (done) across a mid-request kill.
+"""
+import json
+import os
+import signal
+import sys
+import time
+
+import pytest
+
+from fairify_tpu import obs
+from fairify_tpu.serve import ProcessFleet, ProcFleetConfig, ServeConfig
+from fairify_tpu.serve import client as client_mod
+from fairify_tpu.verify import presets, sweep
+
+SPAN = (0, 48)
+SIZES = [20, 8, 1]
+
+OVERRIDES = {
+    "soft_timeout_s": 30.0, "hard_timeout_s": 600.0, "sim_size": 64,
+    "exact_certify_masks": False, "grid_chunk": 16,
+    "launch_backoff_s": 1e-4,
+}
+
+
+def _fleet(spool, n=2, **kw):
+    kw.setdefault("poll_s", 0.03)
+    kw.setdefault("pulse_s", 0.0)
+    kw.setdefault("backoff_s", 0.05)
+    kw.setdefault("replica", ServeConfig(batch_window_s=0.1, max_batch=4,
+                                         poll_s=0.05, span_chunks=1))
+    return ProcessFleet(ProcFleetConfig(n_replicas=n, spool=str(spool), **kw))
+
+
+def _payload(seed=3, span=SPAN, **extra):
+    return client_mod.build_payload(
+        "GC", init={"sizes": SIZES, "seed": seed},
+        overrides=dict(OVERRIDES), span=span, **extra)
+
+
+def _ledger_map(spool, rid):
+    """partition -> (verdict, ce-bytes) from the request's ledger: the
+    bit-equality key (counterexamples included)."""
+    paths = client_mod.ledger_paths(str(spool), rid)
+    assert paths, f"no ledger for {rid}"
+    out = {}
+    for path in paths:
+        for pid, rec in sweep._load_ledger(path).items():
+            ce = rec.get("ce")
+            out[pid] = (rec["verdict"],
+                        None if ce is None else json.dumps(ce))
+    return out
+
+
+def _solo_map(tmp_path, seed=3, span=SPAN):
+    """The undisturbed reference: a plain in-process run of the same net."""
+    from fairify_tpu.models.train import init_mlp
+
+    cfg = presets.get("GC").with_(result_dir=str(tmp_path / f"solo{seed}"),
+                                  **OVERRIDES)
+    rep = sweep.verify_model(init_mlp(tuple(SIZES), seed=seed), cfg,
+                             model_name="solo", resume=False,
+                             partition_span=span)
+    out = {}
+    for o in rep.outcomes:
+        ce = None
+        if o.counterexample is not None:
+            ce = json.dumps([[int(v) for v in x]
+                             for x in o.counterexample])
+        out[o.partition_id] = (o.verdict, ce)
+    return out
+
+
+def _wait_running(fl, rid, timeout=90.0):
+    """Block until the replica reports the request RUNNING; returns the
+    owning slot index."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if fl.status_of(rid) == "running":
+            owner = fl.owner_of(rid)
+            if owner is not None:
+                return owner
+        time.sleep(0.01)
+    raise AssertionError(
+        f"request {rid} never reached running (status="
+        f"{fl.status_of(rid)!r})")
+
+
+# ---------------------------------------------------------------------------
+# kill -9 mid-batch: loss-free failover, bit-equal verdicts
+# ---------------------------------------------------------------------------
+
+
+def test_sigkill_mid_batch_failover_bit_equal(tmp_path):
+    """A literal ``kill -9`` of the owning replica mid-request loses no
+    decided verdict: the survivor's resume replay converges to a verdict
+    map (incl. counterexample bytes) bit-equal to the undisturbed run."""
+    want = _solo_map(tmp_path)
+    spool = tmp_path / "spool"
+    with _fleet(spool) as fl:
+        assert fl.wait_ready(timeout=180) == 2
+        rid = client_mod.submit(str(spool), _payload())
+        owner = _wait_running(fl, rid)
+        pid = fl.pids()[owner]
+        os.kill(pid, signal.SIGKILL)
+        rec = fl.wait(rid, timeout=300)
+        assert rec is not None and rec["status"] == "done", rec
+        got = _ledger_map(spool, rid)
+        assert got == want
+        # The death was classified and the work re-homed — real failover,
+        # not a lucky completion before the kill landed.
+        assert fl.restarts()[owner] >= 1
+        # Terminal requests are EVICTED from the router's tracking tables
+        # (status.json stays the durable answer): a long-lived router must
+        # not grow one entry per request ever served.
+        t0 = time.monotonic()
+        while fl.status_of(rid) is not None and time.monotonic() - t0 < 15:
+            time.sleep(0.02)
+        assert fl.status_of(rid) is None and fl.owner_of(rid) is None
+    deaths = obs.registry().counter("replica_deaths")
+    assert deaths.value(kind="crash") >= 1
+
+
+def test_submit_wait_exit_codes_across_replica_death(tmp_path):
+    """``fairify_tpu submit --wait`` exit semantics are pinned across a
+    replica kill: 0 for a request that failed over to done, 2 for a
+    client-side payload error, 1 for a terminal non-done state."""
+    from fairify_tpu import cli
+
+    spool = tmp_path / "spool"
+    with _fleet(spool) as fl:
+        assert fl.wait_ready(timeout=180) == 2
+        # Corrupt payload -> terminal rejected -> --wait exits 1.
+        bad = os.path.join(str(spool), "inbox", "badjson.json")
+        with open(bad, "w") as fp:
+            fp.write("{nope")
+        t0 = time.monotonic()
+        while client_mod.status(str(spool), "badjson") is None \
+                and time.monotonic() - t0 < 30:
+            time.sleep(0.02)
+        st = client_mod.status(str(spool), "badjson")
+        assert st is not None and st["status"] == "rejected"
+        # Payload-level validation error -> exit 2 before any submit.
+        rc = cli.main(["submit", "GC", "--spool", str(spool), "--wait", "5"])
+        assert rc == 2  # neither --model nor --init-sizes
+        # A healthy request killed mid-run still exits 0 once failover
+        # finishes it (same spool CLI a real client uses).
+        rid = client_mod.submit(str(spool), _payload(seed=5))
+        owner = _wait_running(fl, rid)
+        os.kill(fl.pids()[owner], signal.SIGKILL)
+        rec = fl.wait(rid, timeout=300)
+        assert rec is not None and rec["status"] == "done"
+        # client.wait + the CLI's status mapping: done -> 0.
+        assert client_mod.status(str(spool), rid)["status"] == "done"
+
+
+# ---------------------------------------------------------------------------
+# SIGSTOP wedge: lease expiry -> SIGTERM/SIGKILL escalation -> failover
+# ---------------------------------------------------------------------------
+
+
+def test_sigstop_wedge_lease_hang_failover_bit_equal(tmp_path):
+    """A SIGSTOPped replica is alive to waitpid but beats no lease: the
+    router must detect the hang, hard-kill it (SIGTERM is ignored by a
+    stopped process — only the SIGKILL escalation lands), and fail over
+    with the verdict map still bit-equal to the undisturbed run."""
+    want = _solo_map(tmp_path, seed=7)
+    spool = tmp_path / "spool"
+    # The lease must clear the worst-case HEALTHY inter-beat gap (one
+    # whole granule on a loaded single-core host) or the router would
+    # kill the survivor too; 5 s is comfortable, and the SIGSTOPped
+    # replica's frozen mtime blows past it just the same.
+    with _fleet(spool, lease_s=5.0, term_grace_s=0.5) as fl:
+        assert fl.wait_ready(timeout=180) == 2
+        rid = client_mod.submit(str(spool), _payload(seed=7))
+        owner = _wait_running(fl, rid)
+        pid = fl.pids()[owner]
+        os.kill(pid, signal.SIGSTOP)
+        rec = fl.wait(rid, timeout=300)
+        assert rec is not None and rec["status"] == "done", rec
+        assert _ledger_map(spool, rid) == want
+    deaths = obs.registry().counter("replica_deaths")
+    assert deaths.value(kind="hang") >= 1
+
+
+# ---------------------------------------------------------------------------
+# bounded restart backoff
+# ---------------------------------------------------------------------------
+
+
+def test_bounded_restart_backoff(tmp_path):
+    """Each death restarts the slot at most ``max_restarts`` times with
+    growing jittered backoff; exhaustion abandons the slot instead of
+    flap-looping, and the other slot keeps serving."""
+    spool = tmp_path / "spool"
+    fl = _fleet(spool, n=2, max_restarts=2, backoff_s=0.05)
+    with fl:
+        assert fl.wait_ready(timeout=180) == 2
+        victim_pids = []
+        for _round in range(3):  # max_restarts=2 -> third kill is final
+            pids = fl.pids()
+            if 0 not in pids:
+                break
+            victim_pids.append(pids[0])
+            os.kill(pids[0], signal.SIGKILL)
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 120:
+                cur = fl.pids().get(0)
+                if cur is not None and cur not in victim_pids:
+                    break  # restarted under a fresh pid
+                if fl.restarts()[0] >= 2 and 0 not in fl.pids():
+                    break  # budget spent, slot down
+                time.sleep(0.02)
+        assert fl.restarts()[0] == 2  # bounded: never more than the cap
+        # The slot is abandoned (no live replica 0), slot 1 still serves.
+        t0 = time.monotonic()
+        while 0 in fl.pids() and time.monotonic() - t0 < 120:
+            time.sleep(0.05)
+        assert 0 not in fl.pids()
+        assert 1 in fl.pids()
+        rid = client_mod.submit(str(spool), _payload(seed=9, span=(0, 16)))
+        rec = fl.wait(rid, timeout=300)
+        assert rec is not None and rec["status"] == "done"
+
+
+# ---------------------------------------------------------------------------
+# shared persistent exec cache: cold replica restart compiles nothing
+# ---------------------------------------------------------------------------
+
+
+def test_exec_cache_shared_across_replica_processes(tmp_path):
+    """A replica process restarted against the shared on-disk executable
+    cache compiles nothing: the first replica's compiles populated it,
+    and the fresh process (empty in-memory caches) loads every kernel."""
+    spool = tmp_path / "spool"
+    with _fleet(spool, n=1) as fl:
+        assert fl.wait_ready(timeout=180) == 1
+        rid = client_mod.submit(str(spool), _payload(seed=11, span=(0, 32)))
+        rec = fl.wait(rid, timeout=300)
+        assert rec is not None and rec["status"] == "done"
+        # Kill the only replica: the restart is a genuinely fresh process.
+        os.kill(fl.pids()[0], signal.SIGKILL)
+        # Restart-backoff window: zero replicas live, respawn pending —
+        # the fleet must still report alive() (an operator loop draining
+        # here would turn every recoverable crash into a shutdown).
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 60:
+            if fl.replicas_alive() == 0:
+                assert fl.alive()
+                break
+            time.sleep(0.005)
+        rid2 = client_mod.submit(str(spool), _payload(seed=11, span=(0, 32),
+                                                     request_id="cold-run"))
+        rec2 = fl.wait(rid2, timeout=300)
+        assert rec2 is not None and rec2["status"] == "done"
+        assert fl.restarts()[0] >= 1
+        stats = {}
+        fl.drain()
+        stats = fl.drain_stats()
+    cache_dir = os.path.join(str(spool), "exec-cache")
+    assert os.path.isdir(cache_dir) and os.listdir(cache_dir)
+    # The restarted replica reports its PROCESS-lifetime compile
+    # accounting in its drained control message: warmed from the shared
+    # on-disk cache, the fresh process compiled nothing and loaded every
+    # kernel from disk.
+    assert 0 in stats, stats
+    assert stats[0].get("n_compiles") == 0, stats
+    assert stats[0].get("exec_cache_hits", 0) > 0, stats
+
+
+# ---------------------------------------------------------------------------
+# memout containment: RLIMIT_AS kills one replica, not the fleet
+# ---------------------------------------------------------------------------
+
+
+def test_memout_is_classified_and_contained(tmp_path):
+    """A replica allocating past its RSS cap dies with the distinct
+    memout exit code; the router classifies it (not ``crash``), restarts
+    the slot, and the fleet keeps serving."""
+    spool = tmp_path / "spool"
+    deaths = obs.registry().counter("replica_deaths")
+    m0 = deaths.value(kind="memout")
+    # The cap must clear a sweep's ~1.4 GB VA peak (jax CPU arenas) while
+    # still bounding the chaos allocation — 2 GB does both.
+    with _fleet(spool, n=2, memory_cap_mb=2048) as fl:
+        assert fl.wait_ready(timeout=240) == 2
+        assert fl.inject_memout(0)
+        t0 = time.monotonic()
+        while deaths.value(kind="memout") == m0 \
+                and time.monotonic() - t0 < 60:
+            time.sleep(0.02)
+        assert deaths.value(kind="memout") == m0 + 1
+        rid = client_mod.submit(str(spool), _payload(seed=13, span=(0, 16)))
+        rec = fl.wait(rid, timeout=300)
+        assert rec is not None and rec["status"] == "done"
+
+
+# ---------------------------------------------------------------------------
+# machinery units (no subprocesses)
+# ---------------------------------------------------------------------------
+
+
+def test_config_validation(tmp_path):
+    with pytest.raises(ValueError):
+        ProcessFleet(ProcFleetConfig(n_replicas=2, spool=""))
+    with pytest.raises(ValueError):
+        ProcessFleet(ProcFleetConfig(n_replicas=0, spool=str(tmp_path)))
+
+
+def test_fleet_pulse_throttles_and_reports_changes():
+    from fairify_tpu.obs.heartbeat import FleetPulse
+
+    class _Clock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clock = _Clock()
+    out = []
+
+    class _Stream:
+        @staticmethod
+        def write(s):
+            out.append(s)
+
+        @staticmethod
+        def flush():
+            pass
+
+    p = FleetPulse(interval_s=5.0, stream=_Stream(), clock=clock)
+    assert not p.pulse(2, 2)            # healthy, unchanged: silent
+    assert p.pulse(1, 2)                # a death prints immediately
+    clock.t += 1.0
+    assert not p.pulse(1, 2)            # degraded but throttled
+    clock.t += 5.0
+    assert p.pulse(1, 2, restarting=1)  # degraded + interval elapsed
+    assert p.pulse(2, 2)                # recovery (change) prints
+    clock.t += 10.0
+    assert not p.pulse(2, 2)            # healthy again: silent
+    text = "".join(out)
+    assert "replicas alive 1/2" in text and "1 restarting" in text
+    assert "replicas alive 2/2" in text
+
+
+def test_report_renders_replica_table(tmp_path):
+    """`fairify_tpu report` folds the router's `replica` events into one
+    row per slot: last pid, restart count, deaths by kind, re-homed
+    requests, last lease age, abandoned marker."""
+    from fairify_tpu.obs import report as report_mod
+
+    recs = [
+        {"type": "event", "name": "replica",
+         "attrs": {"replica": 0, "event": "spawn", "pid": 100}},
+        {"type": "event", "name": "replica",
+         "attrs": {"replica": 0, "event": "death", "kind": "crash",
+                   "pid": 100}},
+        {"type": "event", "name": "replica",
+         "attrs": {"replica": 0, "event": "rehome", "requests": 2}},
+        {"type": "event", "name": "replica",
+         "attrs": {"replica": 0, "event": "restart", "pid": 101,
+                   "restarts": 1}},
+        {"type": "event", "name": "replica",
+         "attrs": {"replica": 1, "event": "lease_expired",
+                   "lease_age": 3.25, "pid": 102}},
+        {"type": "event", "name": "replica",
+         "attrs": {"replica": 1, "event": "death", "kind": "hang",
+                   "pid": 102}},
+        {"type": "event", "name": "replica",
+         "attrs": {"replica": 1, "event": "abandoned", "restarts": 3}},
+    ]
+    log = tmp_path / "events.jsonl"
+    log.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    agg = report_mod.aggregate([str(log)])
+    assert agg["replicas"]["0"] == {
+        "pid": 101, "restarts": 1, "deaths": {"crash": 1}, "rehomed": 2,
+        "last_lease_age_s": None, "abandoned": False}
+    assert agg["replicas"]["1"]["deaths"] == {"hang": 1}
+    assert agg["replicas"]["1"]["last_lease_age_s"] == 3.25
+    assert agg["replicas"]["1"]["abandoned"] is True
+    text = report_mod.render(agg)
+    assert "replica" in text and "hang=1" in text and "1*" in text
+
+
+def test_replica_cmd_carries_template_knobs(tmp_path):
+    fl = _fleet(tmp_path / "s", n=1, memory_cap_mb=256,
+                replica=ServeConfig(batch_window_s=0.1, max_batch=4,
+                                    poll_s=0.05, span_chunks=1,
+                                    preempt_factor=2.0, max_preemptions=0,
+                                    fair_share_factor=4.0,
+                                    fair_share_min_s=10.0))
+    cmd = fl._replica_cmd(0)
+    joined = " ".join(cmd)
+    assert "-m fairify_tpu.serve.replica" in joined
+    assert "--span-chunks 1" in joined
+    assert "--memory-cap-mb 256" in joined
+    assert "--exec-cache" in joined  # auto -> <spool>/exec-cache
+    # EVERY overload knob of the template crosses the process boundary —
+    # a dropped flag silently reverts the replica to defaults.
+    assert "--preempt-factor 2.0" in joined
+    assert "--max-preemptions 0" in joined
+    assert "--fair-share 4.0" in joined
+    assert "--fair-share-min 10.0" in joined
+    fl._journal_writer.close()
