@@ -193,7 +193,24 @@ def _cmd_report(args) -> int:
     """Aggregate ``--trace-out`` event logs into phase/verdict/launch tables."""
     from fairify_tpu.obs import report
 
-    return report.main(args.logs, json_out=args.json_out, as_json=args.json)
+    logs = list(args.logs)
+    if args.trace_dir:
+        # A fleet's per-process shards ARE event logs: with no explicit
+        # logs, the report aggregates every shard in the directory.
+        from fairify_tpu.obs import trace as trace_mod
+
+        shards = trace_mod.shard_paths(args.trace_dir)
+        if not shards:
+            print(f"report: no trace.<pid>.jsonl shards under "
+                  f"{args.trace_dir}", file=sys.stderr)
+            return 2
+        if not logs:
+            logs = shards
+    elif not logs:
+        print("report: give event logs or --trace-dir", file=sys.stderr)
+        return 2
+    return report.main(logs, json_out=args.json_out, as_json=args.json,
+                       trace_dir=args.trace_dir)
 
 
 def _cmd_experiment(args) -> int:
@@ -279,7 +296,7 @@ def _cmd_serve(args) -> int:
         max_queue=args.max_queue, preempt_factor=args.preempt_factor,
         fair_share_factor=args.fair_share,
         fair_share_idle_exempt=not args.fair_share_strict,
-        exec_cache=exec_cache)
+        exec_cache=exec_cache, trace_dir=args.trace_dir)
     stop = threading.Event()
 
     def _sig(_signum, _frame):
@@ -291,7 +308,15 @@ def _cmd_serve(args) -> int:
         print("serve: --replica-procs is mutually exclusive with "
               "--replicas/--shards", file=sys.stderr)
         return 2
-    with obs.tracing(args.trace_out, run_id="serve"):
+    # --trace-dir puts THIS process's spans in its own pid-named shard
+    # next to the replicas' and SMT workers' shards; --trace-out keeps the
+    # single-file behavior.  The shard wins when both are given.
+    trace_out = args.trace_out
+    if args.trace_dir:
+        from fairify_tpu.obs import trace as trace_mod
+
+        trace_out = trace_mod.shard_path(args.trace_dir)
+    with obs.tracing(trace_out, run_id="serve"):
         if args.replica_procs and args.replica_procs >= 1:
             from dataclasses import replace
 
@@ -302,8 +327,9 @@ def _cmd_serve(args) -> int:
                 poll_s=args.poll_interval, lease_s=args.lease,
                 memory_cap_mb=args.replica_memory_cap,
                 max_restarts=args.max_restarts,
-                exec_cache=exec_cache,
-                replica=replace(scfg, spool=None, exec_cache=None))).start()
+                exec_cache=exec_cache, trace_dir=args.trace_dir,
+                replica=replace(scfg, spool=None, exec_cache=None,
+                                trace_dir=None))).start()
         elif args.replicas and args.replicas > 1:
             from dataclasses import replace
 
@@ -516,11 +542,16 @@ def main(argv=None) -> int:
     rpt = sub.add_parser(
         "report", help="aggregate --trace-out event logs into phase/verdict/"
                        "launch breakdown tables")
-    rpt.add_argument("logs", nargs="+", help="one or more JSONL event logs")
+    rpt.add_argument("logs", nargs="*", help="one or more JSONL event logs")
     rpt.add_argument("--json", action="store_true",
                      help="print the aggregate as one JSON line instead of tables")
     rpt.add_argument("--json-out", default=None,
                      help="also write the aggregate JSON to this file")
+    rpt.add_argument("--trace-dir", default=None,
+                     help="fleet trace-shard directory (serve --trace-dir): "
+                          "merges every trace.<pid>.jsonl into one Perfetto "
+                          "export (<dir>/merged.chrome.json) and prints the "
+                          "per-request critical-path table")
 
     exp = sub.add_parser(
         "experiment", help="verify + localize + repair + hybrid-route + audit")
@@ -636,6 +667,13 @@ def main(argv=None) -> int:
     srv.add_argument("--trace-out", default=None,
                      help="JSONL span/event log (request lifecycle events "
                           "feed the `fairify_tpu report` request table)")
+    srv.add_argument("--trace-dir", default=None, metavar="DIR",
+                     help="fleet-wide trace shards (DESIGN.md §19): every "
+                          "process — router, replicas, SMT workers — "
+                          "appends spans to its own trace.<pid>.jsonl "
+                          "here; `fairify_tpu report --trace-dir DIR` "
+                          "merges them into one Perfetto timeline with "
+                          "per-request critical paths")
     srv.add_argument("--smt-workers", type=int, default=1,
                      help="server-wide SMT worker pool size shared by every "
                           "SMT-enabled request (default 1)")

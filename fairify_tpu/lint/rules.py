@@ -1,4 +1,4 @@
-"""The shipped rule set: fourteen rules.
+"""The shipped rule set: fifteen rules.
 
 Rule ids are stable API — inline suppressions, allowlists, and the
 committed baseline all key on them:
@@ -23,6 +23,8 @@ id                          guards
 ``blocking-under-lock``     blocking calls reached while a lock is held
 ``kill-safety``             torn-state hazards around kill/yield points
 ``cv-discipline``           Condition wait/notify misuse
+``trace-context``           cross-process JSON frames dropping the
+                            distributed-trace context (DESIGN.md §19)
 ==========================  ================================================
 
 The last four share one whole-program analysis per run
@@ -52,6 +54,7 @@ from fairify_tpu.lint.rules_obs import (
     RawJitRule,
     TimeTimeRule,
 )
+from fairify_tpu.lint.rules_trace import TraceContextRule
 
 LEGACY_RULE_IDS = ("obs-time-time", "obs-print", "obs-raw-jit",
                    "obs-broad-except", "obs-loop-fetch")
@@ -69,4 +72,5 @@ def all_rules() -> List[Rule]:
     cross-file rules accumulate during check and report in finalize)."""
     return legacy_rules() + [JitPurityRule(), RecompileHazardRule(),
                              LockDisciplineRule(), FaultSiteRule(),
-                             ChaosCoverageRule()] + concurrency_rules()
+                             ChaosCoverageRule()] + concurrency_rules() \
+        + [TraceContextRule()]

@@ -1,0 +1,171 @@
+"""The distributed-tracing propagation rule (DESIGN.md §19).
+
+Every request-scoped JSON frame that crosses a process boundary — a
+newline-framed pipe message (``protocol.dump_msg`` / hand-rolled
+``json.dumps(...) + "\\n"``) or an atomic spool payload
+(``write_atomic_json``) — must carry the trace fields (``trace`` /
+``trace_id``) that join the per-process shards into one trace tree.  A
+frame writer that drops them silently severs the tree: the request still
+completes, but ``fairify_tpu report --trace-dir`` can no longer attribute
+its critical path, which is exactly the failure mode a lint (not a test)
+has to guard — nothing crashes.
+
+The rule is deliberately *provable-absence only*: it flags a frame
+expression **only when it is a dict literal** that demonstrably lacks
+trace fields and is not a control frame.  Everything it cannot decide is
+skipped, so the rule has no false positives by construction:
+
+* a bare-``Name`` frame that is a **parameter** of the enclosing function
+  is a pass-through writer (``def send(obj): pipe.write(dump_msg(obj))``)
+  — the frame *constructor* is the responsible party, and the rule fires
+  there instead;
+* any other non-literal frame (a payload loaded from disk and forwarded
+  verbatim, a locally assembled record) is opaque to the AST and skipped;
+* a literal with a ``**spread`` may carry trace through the spread.
+
+Control frames are exempt by a reviewed vocabulary, not per-site
+allowlist entries: frames whose ``op`` is in :data:`CONTROL_OPS`
+(ping/pong/drain/metrics/… — fleet plumbing with no request identity) or
+that carry a :data:`CONTROL_KEYS` discriminator (``hello``/``pong``/
+``fatal``/``error`` responses).  Growing either set is the review point,
+same contract as the allowlists in ``rules_obs``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from fairify_tpu.lint.core import FileContext, Finding, Rule
+
+#: Request-identity fields that join a frame to its trace tree.
+TRACE_KEYS = frozenset({"trace", "trace_id"})
+
+#: Reviewed control-frame vocabulary: ``op`` values with no request
+#: identity to propagate (fleet/worker lifecycle plumbing).  A new op
+#: added here is a review decision — per-request ops (``solve``) must
+#: NOT appear.
+CONTROL_OPS = frozenset({
+    "ping", "pong", "hello", "exit", "drain", "drained", "dead",
+    "ready", "status", "metrics", "hang", "memout",
+})
+
+#: Frame discriminators that mark a control/diagnostic response on their
+#: own (the worker's response channel has no ``op`` field): handshake,
+#: liveness, and fatal/error frames emitted outside any request context.
+CONTROL_KEYS = frozenset({"hello", "pong", "ping", "fatal", "error"})
+
+#: ``file`` / ``file::function`` reviewed exceptions (empty: the whole
+#: tree is compliant; a new entry needs a reason in review).
+ALLOW_TRACE_CONTEXT: frozenset = frozenset()
+
+#: Callables whose argument IS a cross-boundary frame.
+_FRAME_FNS = frozenset({"dump_msg"})           # frame = arg 0
+_SPOOL_FNS = frozenset({"write_atomic_json", "_atomic_json"})  # frame = arg 1
+#: Send-helper names: judged only when handed a dict literal directly
+#: (a Name argument is the pass-through idiom, handled at its source).
+_SEND_FNS = frozenset({"send", "_send", "respond", "_respond"})
+
+_HINT = (
+    "cross-process JSON frame without trace fields — request-scoped "
+    "frames must carry the submit-stamped trace context ({'trace': "
+    "obs.trace.context_fields()['trace']} or a 'trace_id') so the "
+    "per-process shards join into one tree (DESIGN.md §19); control "
+    "frames belong in rules_trace.CONTROL_OPS/CONTROL_KEYS, reviewed "
+    "exceptions in ALLOW_TRACE_CONTEXT")
+
+
+def _callee_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _is_json_dumps(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "dumps"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "json")
+
+
+def _newline_framed_dumps(node: ast.BinOp) -> Optional[ast.Call]:
+    """``json.dumps(x) + "\\n"`` (either operand order) → the dumps call."""
+    if not isinstance(node.op, ast.Add):
+        return None
+    for a, b in ((node.left, node.right), (node.right, node.left)):
+        if _is_json_dumps(a) and isinstance(b, ast.Constant) \
+                and isinstance(b.value, str) and "\n" in b.value:
+            return a
+    return None
+
+
+def _dict_lacks_trace(d: ast.Dict) -> bool:
+    """True only when the literal PROVABLY lacks trace fields and is not
+    a control frame — ``**spread`` keys make it undecidable (pass)."""
+    keys = []
+    for k, v in zip(d.keys, d.values):
+        if k is None:
+            return False  # **spread: may carry trace
+        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+            keys.append((k.value, v))
+    names = {k for k, _ in keys}
+    if names & TRACE_KEYS or names & CONTROL_KEYS:
+        return False
+    for k, v in keys:
+        if k == "op" and isinstance(v, ast.Constant) \
+                and v.value in CONTROL_OPS:
+            return False
+    return True
+
+
+class TraceContextRule(Rule):
+    """Flag cross-process frame writes whose payload provably drops the
+    distributed-trace context."""
+
+    id = "trace-context"
+    description = ("cross-process JSON frames (pipe messages, spool "
+                   "payloads) must carry trace fields or be reviewed "
+                   "control frames — a dropped context severs the merged "
+                   "trace tree (DESIGN.md §19)")
+    allowlist = ALLOW_TRACE_CONTEXT
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if self.allowed(ctx.rel):
+            return []
+        out: List[Finding] = []
+        self._scan(ctx, ctx.tree, "<module>", out)
+        return out
+
+    def _scan(self, ctx: FileContext, node: ast.AST, fn_name: str,
+              out: List[Finding]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            fn_name = node.name
+        frame: Optional[ast.AST] = None
+        if isinstance(node, ast.Call):
+            name = _callee_name(node.func)
+            args = node.args
+            if name in _FRAME_FNS and args:
+                frame = args[0]
+            elif name in _SPOOL_FNS and len(args) >= 2:
+                frame = args[1]
+            elif name in _SEND_FNS and len(args) == 1 \
+                    and isinstance(args[0], ast.Dict):
+                frame = args[0]
+        elif isinstance(node, ast.BinOp):
+            dumps = _newline_framed_dumps(node)
+            if dumps is not None and dumps.args:
+                frame = dumps.args[0]
+        if frame is not None and not self.allowed(ctx.rel, fn_name):
+            # Only a dict literal is judged: a bare-Name frame is either
+            # the pass-through-writer idiom (a parameter, responsibility
+            # at the frame constructor) or an opaque local — absence is
+            # unprovable either way, so no finding (module docstring).
+            if isinstance(frame, ast.Dict) and _dict_lacks_trace(frame):
+                out.append(self.finding(
+                    ctx, getattr(frame, "lineno", node.lineno), _HINT,
+                    function=fn_name))
+        for child in ast.iter_child_nodes(node):
+            self._scan(ctx, child, fn_name, out)

@@ -34,15 +34,23 @@ import contextlib
 from fairify_tpu.obs.heartbeat import Heartbeat  # noqa: F401
 from fairify_tpu.obs.metrics import MetricsRegistry, registry  # noqa: F401
 from fairify_tpu.obs.trace import (  # noqa: F401
+    TraceContext,
     Tracer,
     chrome_trace_path,
+    context,
+    context_fields,
     current,
+    current_context,
     event,
     load_events,
     maybe_tracing,
+    new_trace_id,
+    shard_path,
+    shard_paths,
     span,
     tracing,
     write_chrome_trace,
+    write_chrome_trace_merged,
 )
 
 

@@ -34,7 +34,13 @@ results directory's worth), produce
   server journals every lifecycle transition as a ``request`` event):
   per request, final status, queue wait, run seconds and whether its SLA
   was missed, last-transition-wins per request id (the event stream
-  replays a request's whole lifecycle; the terminal record is truth).
+  replays a request's whole lifecycle; the terminal record is truth);
+* with ``--trace-dir``, a **per-request critical-path table** joined
+  across the fleet's per-process trace shards on ``trace_id`` (DESIGN.md
+  §19): queue wait, admission, batch-coalesce, compile, device, SMT and
+  drain seconds per request, plus which replica (and which SMT worker
+  pids) served it — and one merged Perfetto export
+  (``<dir>/merged.chrome.json``) with pid-namespaced process tracks.
 
 Torn/partially-written lines (crash mid-sweep) are skipped with a counted
 warning, never raised on.
@@ -155,11 +161,18 @@ def aggregate(paths: Iterable[str]) -> dict:
                 row = replicas.setdefault(int(attrs["replica"]), {
                     "pid": None, "restarts": 0, "deaths": {},
                     "rehomed": 0, "last_lease_age_s": None,
-                    "abandoned": False})
+                    "abandoned": False, "exec_cache_hit_rate": None,
+                    "launches_per_model": None})
                 ev = attrs.get("event")
                 if attrs.get("pid") is not None:
                     row["pid"] = int(attrs["pid"])
-                if ev == "restart":
+                if ev == "metrics":
+                    # Live fleet telemetry (procfleet metrics beats): the
+                    # router-derived gauges, last-beat-wins per slot.
+                    for k in ("exec_cache_hit_rate", "launches_per_model"):
+                        if attrs.get(k) is not None:
+                            row[k] = attrs[k]
+                elif ev == "restart":
                     row["restarts"] = max(row["restarts"],
                                           int(attrs.get("restarts", 0)))
                 elif ev == "death":
@@ -335,6 +348,145 @@ def aggregate(paths: Iterable[str]) -> dict:
     }
 
 
+#: Span name → critical-path stage column.  ``serve.smt_drain`` is the
+#: server-side wall clock of the SMT leg; ``smt.pool_query`` (same
+#: process, nested) and ``smt.worker_solve`` (the worker shard) are
+#: fallbacks when the outer span is absent, never added on top — the
+#: three nest, and summing nested spans double-counts.
+_SMT_TIERS = ("serve.smt_drain", "smt.pool_query", "smt.worker_solve")
+
+
+def _stage_of(name: str):
+    if name == "serve.admit":
+        return "admission_s"
+    if name == "serve.batch_stage0":
+        # ONLY the coalesced stage-0 wave: ``serve.batch`` wraps the whole
+        # batch execution (refinement included), so charging it here would
+        # show a coalesce column bigger than the request's own latency.
+        return "coalesce_s"
+    if name.startswith("compile."):
+        return "compile_s"
+    if name == "pipeline.drain":
+        return "drain_s"
+    return None
+
+
+def critical_paths(paths: Iterable[str]) -> Dict[str, dict]:
+    """Per-request critical-path rows joined across per-process shards.
+
+    The join key is ``trace_id`` — span ids are per-process counters and
+    never joined on (DESIGN.md §19).  Batch spans (``serve.batch*``)
+    serve several requests at once and carry a ``trace_ids`` list; their
+    duration is charged to every listed request as the coalesce stage.
+    ``device_s`` is the residual of the request's measured run seconds
+    after the instrumented stages — the un-spanned dispatch/execute time
+    — so each row's stages sum exactly to its measured latency
+    (``queue_wait_s + run_s``); ``complete`` marks rows whose request
+    reached a terminal status AND had spans recorded under its trace.
+    """
+    spans: Dict[str, list] = {}      # trace_id -> [(span rec, shard meta)]
+    req_events: Dict[str, dict] = {}  # trace_id -> merged request attrs
+    for i, path in enumerate(paths):
+        records, _skipped = trace_mod.load_events(path, count_skipped=True)
+        meta = trace_mod._shard_meta(records, fallback_pid=-(i + 1))
+        for rec in records:
+            rtype = rec.get("type")
+            if rtype == "span":
+                tid = rec.get("trace_id")
+                listed = rec.get("attrs", {}).get("trace_ids")
+                for t in ([tid] if tid else []) + list(listed or []):
+                    spans.setdefault(t, []).append((rec, meta))
+            elif rtype == "event" and rec.get("name") == "request":
+                attrs = rec.get("attrs", {})
+                t = rec.get("trace_id") or attrs.get("trace_id")
+                if t:
+                    req_events.setdefault(t, {}).update(attrs)
+    rows: Dict[str, dict] = {}
+    for t in sorted(set(spans) | set(req_events)):
+        attrs = req_events.get(t, {})
+        row = {"request": attrs.get("request"),
+               "status": attrs.get("status"),
+               "replica": attrs.get("replica"),
+               "worker_pids": [],
+               "queue_wait_s": round(float(attrs.get("queue_wait_s", 0.0)), 4),
+               "run_s": round(float(attrs.get("run_s", 0.0)), 4),
+               "admission_s": 0.0, "coalesce_s": 0.0, "compile_s": 0.0,
+               "smt_s": 0.0, "drain_s": 0.0, "device_s": 0.0}
+        # A failed-over request has spans from BOTH the killed owner's
+        # torn attempt and the survivor's resume replay.  The critical
+        # path is the attempt that finished: stages are charged from the
+        # process whose ``serve.request`` span is latest (the terminal
+        # status record's run seconds describe exactly that attempt);
+        # worker solve spans join from whatever SMT worker pids served it.
+        serve_pid = None
+        serve_ts = None
+        for rec, meta in spans.get(t, []):
+            if rec.get("name") == "serve.request":
+                ts = float(rec.get("ts", 0.0))
+                if serve_ts is None or ts >= serve_ts:
+                    serve_ts, serve_pid = ts, meta["pid"]
+                    if not row["run_s"]:
+                        row["run_s"] = round(float(rec.get("dur_s", 0.0)), 4)
+        smt = {name: 0.0 for name in _SMT_TIERS}
+        worker_pids = set()
+        for rec, meta in spans.get(t, []):
+            name = rec.get("name", "")
+            dur = float(rec.get("dur_s", 0.0))
+            if name == "smt.worker_solve":
+                smt[name] += dur
+                worker_pids.add(meta["pid"])
+                continue
+            if serve_pid is not None and meta["pid"] != serve_pid:
+                continue  # the torn attempt's stages are not the path
+            stage = _stage_of(name)
+            if stage is not None:
+                row[stage] += dur
+            elif name in smt:
+                smt[name] += dur
+        # Outermost-present SMT tier only (they nest across processes).
+        row["smt_s"] = next((smt[n] for n in _SMT_TIERS if smt[n] > 0), 0.0)
+        row["worker_pids"] = sorted(worker_pids)
+        if serve_pid is not None:
+            row["replica_pid"] = serve_pid
+        instrumented = row["compile_s"] + row["smt_s"] + row["drain_s"]
+        row["device_s"] = round(max(row["run_s"] - instrumented, 0.0), 4)
+        row["total_s"] = round(row["queue_wait_s"] + row["run_s"], 4)
+        for k in ("admission_s", "coalesce_s", "compile_s", "smt_s",
+                  "drain_s"):
+            row[k] = round(row[k], 4)
+        row["complete"] = bool(spans.get(t)) and row["status"] in \
+            ("done", "failed", "rejected")
+        rows[t] = row
+    return rows
+
+
+def render_critical_paths(rows: Dict[str, dict]) -> str:
+    """Monospace critical-path table (one row per traced request)."""
+    lines: List[str] = []
+    if not rows:
+        return ""
+    w = max(max(len(str(r["request"] or t)[:18]) for t, r in rows.items()),
+            len("request"))
+    lines.append(f"{'request':<{w}}  {'replica':>7}  {'wait_s':>7}  "
+                 f"{'admit':>6}  {'coalesce':>8}  {'compile':>7}  "
+                 f"{'device':>7}  {'smt':>6}  {'drain':>6}  {'total':>7}")
+    complete = 0
+    for t, r in sorted(rows.items(), key=lambda kv: -kv[1]["total_s"]):
+        complete += int(r["complete"])
+        rep = r["replica"] if r["replica"] is not None else "-"
+        label = str(r["request"] or t)[:18]
+        mark = "" if r["complete"] else " (partial)"
+        lines.append(
+            f"{label:<{w}}  {rep!s:>7}  {r['queue_wait_s']:>7.3f}  "
+            f"{r['admission_s']:>6.3f}  {r['coalesce_s']:>8.3f}  "
+            f"{r['compile_s']:>7.3f}  {r['device_s']:>7.3f}  "
+            f"{r['smt_s']:>6.3f}  {r['drain_s']:>6.3f}  "
+            f"{r['total_s']:>7.3f}{mark}")
+    lines.append(f"traced requests: {len(rows)}   "
+                 f"complete critical paths: {complete}")
+    return "\n".join(lines)
+
+
 def render(agg: dict) -> str:
     """Human-readable tables for one aggregate (monospace, stdout-ready)."""
     lines: List[str] = []
@@ -415,16 +567,22 @@ def render(agg: dict) -> str:
     if agg.get("replicas"):
         lines.append("")
         lines.append(f"{'replica':<8}  {'pid':>8}  {'restarts':>8}  "
-                     f"{'deaths':>20}  {'re-homed':>8}  {'lease_age':>9}")
+                     f"{'deaths':>20}  {'re-homed':>8}  {'lease_age':>9}  "
+                     f"{'cache_hit':>9}  {'launch/m':>8}")
         for idx, row in agg["replicas"].items():
             deaths = ",".join(f"{k}={n}" for k, n in
                               sorted(row["deaths"].items())) or "-"
             lease = f"{row['last_lease_age_s']:.2f}s" \
                 if row.get("last_lease_age_s") is not None else "-"
+            hit = f"{row['exec_cache_hit_rate']:.0%}" \
+                if row.get("exec_cache_hit_rate") is not None else "-"
+            lpm = f"{row['launches_per_model']:.1f}" \
+                if row.get("launches_per_model") is not None else "-"
             label = f"{idx}*" if row.get("abandoned") else str(idx)
             lines.append(f"{label:<8}  {row['pid'] or '-':>8}  "
                          f"{row['restarts']:>8}  {deaths:>20}  "
-                         f"{row['rehomed']:>8}  {lease:>9}")
+                         f"{row['rehomed']:>8}  {lease:>9}  "
+                         f"{hit:>9}  {lpm:>8}")
         if any(r.get("abandoned") for r in agg["replicas"].values()):
             lines.append("(* = slot abandoned after its restart budget)")
     if agg.get("lock_edges"):
@@ -455,7 +613,8 @@ def render(agg: dict) -> str:
     return "\n".join(lines)
 
 
-def main(paths: List[str], json_out: str = None, as_json: bool = False) -> int:
+def main(paths: List[str], json_out: str = None, as_json: bool = False,
+         trace_dir: str = None) -> int:
     """CLI body for ``fairify_tpu report`` (returns an exit code)."""
     import os
     import sys
@@ -465,10 +624,26 @@ def main(paths: List[str], json_out: str = None, as_json: bool = False) -> int:
         print(f"no such event log: {missing}", file=sys.stderr)
         return 2
     agg = aggregate(paths)
+    if trace_dir:
+        shards = trace_mod.shard_paths(trace_dir)
+        merged = os.path.join(trace_dir, "merged.chrome.json")
+        n_events = trace_mod.write_chrome_trace_merged(shards, merged)
+        agg["critical_paths"] = critical_paths(shards)
+        agg["merged_chrome"] = {"path": merged, "shards": len(shards),
+                                "events": n_events}
+        print(f"report: merged {len(shards)} shard(s), {n_events} events "
+              f"-> {merged} (load in Perfetto / chrome://tracing)",
+              file=sys.stderr)
     if agg.get("skipped_lines"):
         print(f"report: skipped {agg['skipped_lines']} torn/truncated "
               f"line(s) across {agg['files']} log(s)", file=sys.stderr)
-    print(json.dumps(agg) if as_json else render(agg))
+    if as_json:
+        print(json.dumps(agg))
+    else:
+        print(render(agg))
+        if agg.get("critical_paths"):
+            print()
+            print(render_critical_paths(agg["critical_paths"]))
     if json_out:
         with open(json_out, "w") as fp:
             json.dump(agg, fp, indent=2)

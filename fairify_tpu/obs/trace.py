@@ -37,9 +37,10 @@ from __future__ import annotations
 
 import itertools
 import json
+import os
 import threading
 import time
-from typing import Optional
+from typing import List, Optional
 
 from fairify_tpu.obs import metrics as metrics_mod
 
@@ -70,11 +71,117 @@ class _NullSpan:
 NULL_SPAN = _NullSpan()
 
 
+# ---------------------------------------------------------------------------
+# Trace context: the cross-boundary identity of one request
+# ---------------------------------------------------------------------------
+
+
+class TraceContext:
+    """The per-request identity that crosses process boundaries.
+
+    ``trace_id`` is stamped once, at submit time (``serve/client.py``);
+    ``parent_span`` is the sender-side span id the receiving process
+    should treat as its logical parent.  Span ids are per-process
+    counters, so ``parent_span`` is only meaningful together with the
+    sender's shard — the merged view namespaces tracks by ``(pid, tid)``
+    and joins shards on ``trace_id``, never on raw span ids.
+    """
+
+    __slots__ = ("trace_id", "parent_span")
+
+    def __init__(self, trace_id: str, parent_span: Optional[int] = None):
+        self.trace_id = str(trace_id)
+        self.parent_span = parent_span
+
+    def fields(self) -> dict:
+        """The wire form: ``{"trace": {"id": ..., "span": ...}}`` —
+        mergeable into any JSON frame (spool payload, pipe frame, SMT
+        query frame) without schema changes on the reader side."""
+        t: dict = {"id": self.trace_id}
+        if self.parent_span is not None:
+            t["span"] = int(self.parent_span)
+        return {"trace": t}
+
+    @staticmethod
+    def from_fields(obj: Optional[dict]) -> Optional["TraceContext"]:
+        """Recover a context from a frame's ``trace`` field (None when
+        the frame predates tracing or came from a trace-off sender)."""
+        t = (obj or {}).get("trace")
+        if not isinstance(t, dict) or not t.get("id"):
+            return None
+        span_id = t.get("span")
+        return TraceContext(str(t["id"]),
+                            int(span_id) if span_id is not None else None)
+
+
+def new_trace_id() -> str:
+    """A fresh 64-bit hex trace id (collision-safe across processes)."""
+    return os.urandom(8).hex()
+
+
+_ctx_tls = threading.local()
+
+
+def current_context() -> Optional[TraceContext]:
+    """The trace context bound to this thread (None outside any request)."""
+    return getattr(_ctx_tls, "ctx", None)
+
+
+class _ContextScope:
+    """Bind a context for a scope; restores the previous binding on exit.
+
+    A ``None`` context is a no-op scope (the caller can bind
+    ``TraceContext.from_fields(frame)`` unconditionally)."""
+
+    __slots__ = ("_ctx", "_prev")
+
+    def __init__(self, ctx: Optional[TraceContext]):
+        self._ctx = ctx
+
+    def __enter__(self) -> Optional[TraceContext]:
+        self._prev = getattr(_ctx_tls, "ctx", None)
+        if self._ctx is not None:
+            _ctx_tls.ctx = self._ctx
+        return self._ctx
+
+    def __exit__(self, *exc):
+        _ctx_tls.ctx = self._prev
+        return False
+
+
+def context(ctx: Optional[TraceContext]) -> _ContextScope:
+    """``with context(ctx): ...`` — every span/event/outgoing frame in the
+    scope records/carries ``ctx``.  Nested scopes shadow; threads never
+    inherit (a handoff must capture :func:`current_context` explicitly
+    and re-bind on the far side — the queues in serve/ and parallel/ do
+    exactly that)."""
+    return _ContextScope(ctx)
+
+
+def context_fields() -> dict:
+    """Trace fields for an outgoing cross-boundary frame.
+
+    ``{}`` when no context is bound (control frames — ping/drain/hello —
+    legitimately carry none).  The ``span`` field is the innermost open
+    span on this thread when tracing is active, so the receiver's shard
+    records which sender-side stage handed the work over."""
+    ctx = current_context()
+    if ctx is None:
+        return {}
+    parent = ctx.parent_span
+    tr = _active
+    if tr is not None:
+        stack = tr._stack()
+        if stack:
+            parent = stack[-1].span_id
+    return TraceContext(ctx.trace_id, parent).fields()
+
+
 class Span:
     """One open span; created by :meth:`Tracer.span`, closed on ``__exit__``."""
 
     __slots__ = ("name", "attrs", "span_id", "parent_id", "tid",
-                 "_tracer", "_t0", "_ts", "_launch0")
+                 "_tracer", "_t0", "_ts", "_launch0", "_trace")
 
     def __init__(self, tracer: "Tracer", name: str, attrs: dict):
         self.name = name
@@ -93,6 +200,7 @@ class Span:
         stack = tr._stack()
         self.parent_id = stack[-1].span_id if stack else None
         self.tid = tr._tid()
+        self._trace = current_context()
         self._ts = time.time()
         self._launch0 = tr._launches()
         self._t0 = time.perf_counter()
@@ -110,12 +218,20 @@ class Span:
             self.attrs.setdefault("launches", int(launches))
         if exc_type is not None:
             self.attrs.setdefault("error", exc_type.__name__)
-        tr._write({
+        rec = {
             "type": "span", "name": self.name, "span_id": self.span_id,
             "parent_id": self.parent_id, "tid": self.tid,
             "ts": _round(self._ts), "dur_s": _round(dur),
             "attrs": self.attrs,
-        })
+        }
+        if self._trace is not None:
+            rec["trace_id"] = self._trace.trace_id
+            if self.parent_id is None \
+                    and self._trace.parent_span is not None:
+                # Cross-process parent: the sender-side span id that handed
+                # this work over (meaningful only with the sender's shard).
+                rec["remote_parent"] = int(self._trace.parent_span)
+        tr._write(rec)
         return False
 
 
@@ -123,8 +239,6 @@ class Tracer:
     """Appends span/event records to a JSONL file, one line per record."""
 
     def __init__(self, path: str, run_id: Optional[str] = None):
-        import os
-
         self.path = path
         self.run_id = run_id
         parent = os.path.dirname(path)
@@ -141,8 +255,12 @@ class Tracer:
         # registry is cumulative (a warm-up sweep or a previous run in the
         # same process has already bumped it).
         self._metrics0 = metrics_mod.registry().snapshot()
+        # ``pid`` namespaces this shard's tracks in merged views: thread
+        # ids are only unique per process, so two replicas' worker threads
+        # would otherwise interleave on one Perfetto track.
         self._write({"type": "meta", "version": EVENT_VERSION,
-                     "run_id": run_id, "wall_time": _round(time.time())})
+                     "run_id": run_id, "pid": os.getpid(),
+                     "wall_time": _round(time.time())})
 
     # -- internals ---------------------------------------------------------
     def _stack(self) -> list:
@@ -181,8 +299,12 @@ class Tracer:
         return Span(self, name, attrs)
 
     def event(self, name: str, **attrs) -> None:
-        self._write({"type": "event", "name": name, "ts": _round(time.time()),
-                     "tid": self._tid(), "attrs": attrs})
+        rec = {"type": "event", "name": name, "ts": _round(time.time()),
+               "tid": self._tid(), "attrs": attrs}
+        ctx = current_context()
+        if ctx is not None:
+            rec["trace_id"] = ctx.trace_id
+        self._write(rec)
 
     def close(self, snapshot_metrics: bool = True) -> None:
         if self._closed:
@@ -314,32 +436,107 @@ def chrome_trace_path(jsonl_path: str) -> str:
     return base + ".chrome.json"
 
 
+def shard_path(trace_dir: str) -> str:
+    """This process's trace shard inside a shared ``--trace-dir``.
+
+    Per-process shards are how the fleet traces without cross-process
+    file locking: each process appends to its own ``trace.<pid>.jsonl``
+    (crash-safe single-write appends), and the merge joins them on
+    ``trace_id`` after the fact."""
+    os.makedirs(trace_dir, exist_ok=True)
+    return os.path.join(trace_dir, f"trace.{os.getpid()}.jsonl")
+
+
+def shard_paths(trace_dir: str) -> List[str]:
+    """Every trace shard under ``trace_dir`` (sorted; [] when none)."""
+    try:
+        names = sorted(os.listdir(trace_dir))
+    except OSError:
+        return []
+    return [os.path.join(trace_dir, n) for n in names
+            if n.startswith("trace.") and n.endswith(".jsonl")]
+
+
+def _chrome_events(records: list, pid: int, ts0: float,
+                   include_instants: bool) -> list:
+    out = []
+    for r in records:
+        args = dict(r.get("attrs") or {})
+        if r.get("trace_id"):
+            args["trace_id"] = r["trace_id"]
+        if r.get("type") == "span":
+            out.append({
+                "name": r["name"], "ph": "X", "pid": pid,
+                "tid": r.get("tid", 0),
+                "ts": _round((r["ts"] - ts0) * 1e6, 3),
+                "dur": _round(r["dur_s"] * 1e6, 3),
+                "args": args,
+            })
+        elif r.get("type") == "event" and include_instants:
+            out.append({
+                "name": r["name"], "ph": "i", "s": "t", "pid": pid,
+                "tid": r.get("tid", 0),
+                "ts": _round((r["ts"] - ts0) * 1e6, 3),
+                "args": args,
+            })
+    return out
+
+
+def _shard_meta(records: list, fallback_pid: int) -> dict:
+    meta = next((r for r in records if r.get("type") == "meta"), {})
+    pid = meta.get("pid")
+    return {"pid": int(pid) if pid else fallback_pid,
+            "run_id": meta.get("run_id")}
+
+
 def write_chrome_trace(jsonl_path: str, out_path: str,
                        include_instants: bool = True) -> int:
-    """Convert an event log to Chrome ``traceEvents`` JSON (Perfetto-ready).
+    """Convert one event log to Chrome ``traceEvents`` JSON (Perfetto-ready).
 
     Timestamps are rebased to the log's earliest record so the viewer opens
     at t=0.  Returns the number of trace events written.
     """
     records = load_events(jsonl_path)
     ts0 = min((r["ts"] for r in records if "ts" in r), default=0.0)
-    trace = [{"name": "process_name", "ph": "M", "pid": 0,
-              "args": {"name": "fairify_tpu"}}]
-    for r in records:
-        if r.get("type") == "span":
-            trace.append({
-                "name": r["name"], "ph": "X", "pid": 0, "tid": r.get("tid", 0),
-                "ts": _round((r["ts"] - ts0) * 1e6, 3),
-                "dur": _round(r["dur_s"] * 1e6, 3),
-                "args": r.get("attrs", {}),
-            })
-        elif r.get("type") == "event" and include_instants:
-            trace.append({
-                "name": r["name"], "ph": "i", "s": "t", "pid": 0,
-                "tid": r.get("tid", 0),
-                "ts": _round((r["ts"] - ts0) * 1e6, 3),
-                "args": r.get("attrs", {}),
-            })
+    meta = _shard_meta(records, fallback_pid=0)
+    trace = [{"name": "process_name", "ph": "M", "pid": meta["pid"],
+              "args": {"name": meta["run_id"] or "fairify_tpu"}}]
+    trace += _chrome_events(records, meta["pid"], ts0, include_instants)
     with open(out_path, "w") as fp:
         json.dump({"traceEvents": trace, "displayTimeUnit": "ms"}, fp)
     return len(trace) - 1
+
+
+def write_chrome_trace_merged(shard_jsonl_paths: List[str], out_path: str,
+                              include_instants: bool = True) -> int:
+    """Merge per-process trace shards into one Perfetto export.
+
+    Each shard becomes its own process track, named from the shard's meta
+    record (``run_id [pid N]``) and pid-namespaced so thread ids from
+    different processes never collide on one track.  Timestamps are
+    rebased to the earliest record across ALL shards (same-host wall
+    clock), so cross-process handoffs line up visually.  Returns the
+    number of (non-metadata) trace events written.
+    """
+    shards = []
+    for i, path in enumerate(shard_jsonl_paths):
+        try:
+            records = load_events(path)
+        except OSError:
+            continue
+        meta = _shard_meta(records, fallback_pid=-(i + 1))
+        shards.append((path, meta, records))
+    ts0 = min((r["ts"] for _p, _m, records in shards
+               for r in records if "ts" in r), default=0.0)
+    trace = []
+    n_events = 0
+    for path, meta, records in shards:
+        run = meta["run_id"] or os.path.basename(path)
+        trace.append({"name": "process_name", "ph": "M", "pid": meta["pid"],
+                      "args": {"name": f"{run} [pid {meta['pid']}]"}})
+        events = _chrome_events(records, meta["pid"], ts0, include_instants)
+        n_events += len(events)
+        trace += events
+    with open(out_path, "w") as fp:
+        json.dump({"traceEvents": trace, "displayTimeUnit": "ms"}, fp)
+    return n_events

@@ -139,15 +139,23 @@ class LaunchPipeline:
         self.stats.update(len(self._q))
         return ready
 
-    def _dispatch(self, fn, meta) -> Tuple[Any, Any, Any, Any]:
-        """One supervised dispatch → queue entry ``(meta, ctx, payload, fn)``.
+    def _dispatch(self, fn, meta) -> Tuple[Any, Any, Any, Any, Any]:
+        """One supervised dispatch → queue entry
+        ``(meta, ctx, payload, fn, trace_ctx)``.
 
         A degraded dispatch enqueues the :class:`ChunkFailure` as the
         payload so FIFO order (and the consumer's span bookkeeping) is
         preserved — the failure surfaces at this chunk's drain slot.
+        ``trace_ctx`` is the submit-time trace context (obs.trace),
+        re-bound at drain so the sync-point span attributes to the request
+        whose launch it waits on, not whichever request happens to be
+        running when the queue finally drains.
         """
+        from fairify_tpu.obs import trace as trace_mod
         from fairify_tpu.resilience import faults
         from fairify_tpu.resilience.supervisor import ChunkDegraded
+
+        tctx = trace_mod.current_context()
 
         def attempt():
             if self._fault_sites:
@@ -156,12 +164,12 @@ class LaunchPipeline:
 
         if self.supervisor is None:
             payload, ctx = attempt()
-            return meta, ctx, payload, fn
+            return meta, ctx, payload, fn, tctx
         try:
             payload, ctx = self.supervisor.run(attempt, site="launch.submit")
         except ChunkDegraded as exc:
-            return meta, None, exc.failure, None
-        return meta, ctx, payload, fn
+            return meta, None, exc.failure, None, tctx
+        return meta, ctx, payload, fn, tctx
 
     def drain(self) -> Iterator[Tuple[Any, Any, Any]]:
         while self._q:
@@ -171,10 +179,11 @@ class LaunchPipeline:
         import jax
 
         from fairify_tpu import obs
+        from fairify_tpu.obs import trace as trace_mod
         from fairify_tpu.resilience import faults
         from fairify_tpu.resilience.supervisor import ChunkDegraded, ChunkFailure
 
-        meta, ctx, payload, fn = self._q.popleft()
+        meta, ctx, payload, fn, tctx = self._q.popleft()
         if isinstance(payload, ChunkFailure):  # degraded at dispatch
             self.stats.update(len(self._q))
             self._record_gauge()
@@ -198,8 +207,9 @@ class LaunchPipeline:
         # The pipeline's single sanctioned sync point: visible as its own
         # span so Perfetto traces show the drain-wait lane against the
         # in-flight device lanes (short waits = real overlap).
-        with obs.span("pipeline.drain", in_flight=len(self._q) + 1,
-                      depth=self.depth):
+        with trace_mod.context(tctx), \
+                obs.span("pipeline.drain", in_flight=len(self._q) + 1,
+                         depth=self.depth):
             if self.supervisor is None:
                 host = fetch()
             else:

@@ -114,8 +114,14 @@ def batched_stage0(requests: Sequence, pipe=None,
     if not buckets:
         return out
     occupancy = sum(len(b) for b in buckets)
+    # One coalesced launch serves many requests, so this span belongs to
+    # several traces at once: it lists every member's trace id, and the
+    # critical-path extractor charges its duration to each listed request
+    # as the batch-coalesce stage.
+    trace_ids = sorted({req.trace.trace_id for b in buckets for req in b
+                        if getattr(req, "trace", None) is not None})
     with obs.span("serve.batch_stage0", buckets=len(buckets),
-                  requests=occupancy):
+                  requests=occupancy, trace_ids=trace_ids):
         # Buckets may differ in signature (different grids), so each
         # signature group gets its own stage0_families call — but they all
         # submit into the SAME pipe, which is what keeps the device fed.

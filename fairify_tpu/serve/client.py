@@ -30,6 +30,11 @@ A **payload** is JSON with:
 ``submitted_ts`` epoch submit time, stamped by :func:`submit`; the SLA
                  clock is measured from here so it survives drain/requeue
                  handoffs between servers
+``trace``        ``{"id": <hex>, "span": <sender span id>}`` — the
+                 distributed-trace context, stamped by :func:`submit` and
+                 preserved verbatim across routing/requeue/re-home hops
+                 (DESIGN.md §19); every span the request's life produces,
+                 in any process, records this id
 """
 from __future__ import annotations
 
@@ -130,12 +135,18 @@ def submit(spool: str, payload: dict) -> str:
 
     Stamps the epoch submit time (``submitted_ts``) so the request's SLA
     clock survives a drain/requeue handoff — the next server restores it
-    instead of restarting the deadline from pickup."""
+    instead of restarting the deadline from pickup, and the trace context
+    (``trace``) that every downstream process binds its spans to.  Both
+    use ``setdefault``: a requeued payload keeps its original identity."""
+    from fairify_tpu.obs import trace as trace_mod
     from fairify_tpu.serve.request import new_request_id
 
     req_id = payload.get("id") or new_request_id()
     payload = dict(payload, id=req_id)
     payload.setdefault("submitted_ts", time.time())
+    ctx_fields = trace_mod.context_fields()
+    payload.setdefault(
+        "trace", ctx_fields.get("trace") or {"id": trace_mod.new_trace_id()})
     inbox = os.path.join(spool, "inbox")
     os.makedirs(inbox, exist_ok=True)
     write_atomic_json(os.path.join(inbox, f"{req_id}.json"), payload)

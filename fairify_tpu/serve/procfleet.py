@@ -110,6 +110,12 @@ class ProcFleetConfig:
     exec_cache: Optional[str] = "auto"
     # Throttled "replicas alive k/N" stderr line interval; 0 disables.
     pulse_s: float = 5.0
+    # Shared trace-shard directory (DESIGN.md §19).  When set, every
+    # replica appends spans to ``trace.<pid>.jsonl`` there (and hands the
+    # directory on to its SMT workers); ``fairify_tpu report --trace-dir``
+    # merges the shards into one fleet-wide Perfetto timeline.  None = no
+    # per-replica shards (replicas trace only if the template says so).
+    trace_dir: Optional[str] = None
     # Graceful-drain wait per replica before SIGTERM/SIGKILL escalation.
     drain_timeout_s: float = 120.0
     # Per-replica server template (batch window, span granule, SMT pool,
@@ -193,6 +199,8 @@ class ProcessFleet:
         self._payloads: Dict[str, dict] = {}  # request id -> spool payload
         self._status: Dict[str, str] = {}     # request id -> last status
         self._drain_stats: Dict[int, dict] = {}  # slot -> last drained msg
+        self._replica_metrics: Dict[int, dict] = {}  # slot -> last beat
+        self._fleet_metrics_at = 0.0          # last fleet_metrics.json dump
         self._rehomed_total = 0
         self._draining = False
         self._thread: Optional[threading.Thread] = None
@@ -254,6 +262,8 @@ class ProcessFleet:
             cmd += ["--exec-cache", cache]
         if self.cfg.memory_cap_mb > 0:
             cmd += ["--memory-cap-mb", str(self.cfg.memory_cap_mb)]
+        if self.cfg.trace_dir:
+            cmd += ["--trace-dir", self.cfg.trace_dir]
         return cmd
 
     def _spawn(self, idx: int) -> Optional[_ReplicaProc]:
@@ -369,12 +379,79 @@ class ProcessFleet:
                 attrs = {k: v for k, v in msg.items() if k != "op"}
                 obs.event("request", **attrs)
                 continue
+            if msg.get("op") == "metrics":
+                self._on_metrics(rp.idx, msg)
+                continue
             if msg.get("op") == "drained":
                 # Process-lifetime compile accounting (exec-cache health):
                 # kept per slot so tests and the report can assert that a
                 # restarted replica warmed from disk compiled nothing.
+                # The drained frame carries the same registry snapshot as
+                # a metrics beat, so it also finalizes that slot's entry
+                # in fleet_metrics.json.
                 with self._cv:
                     self._drain_stats[rp.idx] = dict(msg)
+                self._on_metrics(rp.idx, msg, beat=False)
+
+    def _on_metrics(self, idx: int, msg: dict, beat: bool = True) -> None:
+        """Fold one replica's labelled registry snapshot into the fleet
+        view: per-replica derived gauges (satellite of DESIGN.md §19) and
+        the merged ``fleet_metrics.json`` written by the router loop.
+
+        Derived here, not replica-side: the frames ship raw lifetime
+        totals, so a restarted replica's counters visibly reset instead
+        of corrupting an average.  ``launches_per_model`` mirrors the
+        per-run ThroughputCounter field — in serving, one request is one
+        model, so launches per DONE request is the live analog.
+        """
+        snap = {k: v for k, v in msg.items()
+                if k not in ("op", "replica", "requeued")}
+        hits = int(msg.get("exec_cache_hits") or 0)
+        compiles = int(msg.get("n_compiles") or 0)
+        done = int(msg.get("serve_requests_done") or 0)
+        launches = int(msg.get("device_launches") or 0)
+        reg = obs.registry()
+        if hits + compiles > 0:
+            snap["exec_cache_hit_rate"] = round(hits / (hits + compiles), 4)
+            reg.gauge("replica_exec_cache_hit_rate").set(
+                snap["exec_cache_hit_rate"], replica=idx)
+        if done > 0:
+            snap["launches_per_model"] = round(launches / done, 2)
+            reg.gauge("replica_launches_per_model").set(
+                snap["launches_per_model"], replica=idx)
+        with self._cv:
+            self._replica_metrics[idx] = snap
+        if beat:
+            obs.event("replica", replica=idx, event="metrics", **snap)
+
+    def fleet_metrics(self) -> dict:
+        """Merged fleet-wide metrics document (what ``fleet_metrics.json``
+        holds): per-replica labelled snapshots from the latest beats,
+        final drain summaries, and fleet-level recovery counters."""
+        reg = obs.registry()
+        with self._cv:
+            per_replica = {str(i): dict(v)
+                           for i, v in sorted(self._replica_metrics.items())}
+            drained = {str(i): {k: v for k, v in rec.items() if k != "op"}
+                       for i, rec in sorted(self._drain_stats.items())}
+            alive = sum(1 for s in self._slots
+                        if s is not None and s.alive())
+            restarts = list(self._restarts)
+            rehomed = self._rehomed_total
+        return {"replicas": per_replica, "drained": drained,
+                "fleet": {"n_replicas": self.cfg.n_replicas,
+                          "alive": alive, "restarts": restarts,
+                          "rehomed": rehomed,
+                          "deaths": int(reg.counter(
+                              "replica_deaths").total())}}
+
+    def _dump_fleet_metrics(self) -> None:
+        try:
+            write_atomic_json(
+                os.path.join(self.cfg.spool, "fleet_metrics.json"),
+                self.fleet_metrics())
+        except OSError:
+            pass  # telemetry must never take the router down
 
     # --- lifecycle --------------------------------------------------------
 
@@ -518,6 +595,9 @@ class ProcessFleet:
                     break
             time.sleep(0.02)
         requeued = self._collect_sub_inboxes()
+        # Final authoritative dump: the drained frames just folded in, so
+        # this is the complete fleet lifetime (beats + drain summaries).
+        self._dump_fleet_metrics()
         self._journal({"event": "fleet_drained", "requeued": requeued})
         self._journal_writer.close()
         return requeued
@@ -578,6 +658,13 @@ class ProcessFleet:
             self._pulse.pulse(alive, self.cfg.n_replicas,
                               restarting=restarting, rehomed=rehomed)
             obs.registry().gauge("procfleet_replicas_alive").set(alive)
+            # Fleet-wide metrics dump rides the router tick, throttled to
+            # ~1 Hz: replicas beat at that cadence, so dumping faster only
+            # rewrites identical bytes.
+            now = time.monotonic()
+            if now - self._fleet_metrics_at >= 1.0:
+                self._fleet_metrics_at = now
+                self._dump_fleet_metrics()
             with self._cv:
                 if self._draining:
                     return

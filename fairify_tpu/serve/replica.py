@@ -47,6 +47,7 @@ import argparse
 import os
 import sys
 import threading
+import time
 
 from fairify_tpu.smt import protocol
 
@@ -107,6 +108,11 @@ def main(argv=None) -> int:
                     help="RLIMIT_AS for THIS replica process (0 = off)")
     ap.add_argument("--trace-out", default=None,
                     help="optional obs event log for this replica")
+    ap.add_argument("--trace-dir", default=None,
+                    help="shared trace-shard directory (DESIGN.md §19): "
+                         "this replica appends to trace.<pid>.jsonl there "
+                         "and hands the directory to its SMT workers; "
+                         "overrides --trace-out")
     args = ap.parse_args(argv)
 
     chan = _hijack_stdout()
@@ -157,10 +163,47 @@ def main(argv=None) -> int:
             fair_share_factor=args.fair_share,
             fair_share_min_s=args.fair_share_min,
             fair_share_idle_exempt=not args.fair_share_strict,
-            exec_cache=args.exec_cache, replica_id=args.replica)
+            exec_cache=args.exec_cache, replica_id=args.replica,
+            trace_dir=args.trace_dir)
 
         def forward(rec: dict) -> None:
             send({"op": "status", "replica": args.replica, **rec})
+
+        def metrics_snapshot() -> dict:
+            """Labelled registry snapshot shipped on the control pipe.
+
+            Raw lifetime totals, never rates: the router computes the
+            derived gauges (exec-cache hit rate, launches per request) so
+            a restarted replica's counters resetting to zero shows up as
+            exactly that — a reset — instead of silently corrupting a
+            replica-side running average.
+            """
+            reg = obs.registry()
+
+            def _tot(name: str) -> int:
+                try:
+                    return int(reg.counter(name).total())
+                except (KeyError, TypeError):
+                    return 0
+
+            try:
+                done = int(reg.counter("serve_requests").value(status="done"))
+            except (KeyError, TypeError):
+                done = 0
+            snap = {"exec_cache_hits": _tot("exec_cache_hits"),
+                    "device_launches": _tot("device_launches"),
+                    "serve_shed": _tot("serve_shed"),
+                    "serve_preemptions": _tot("serve_preemptions"),
+                    "serve_requests_done": done}
+            try:
+                from fairify_tpu.obs import compile as compile_obs
+
+                tot = compile_obs.snapshot_totals()
+                snap["n_compiles"] = int(tot["n_compiles"])
+                snap["compile_s"] = round(float(tot["compile_s"]), 3)
+            except (ImportError, KeyError):
+                pass
+            return snap
 
         stop = threading.Event()
 
@@ -192,13 +235,20 @@ def main(argv=None) -> int:
             # sub-inbox for the next fleet instead of stranding here.
             stop.set()
 
-        with obs.tracing(args.trace_out, run_id=f"replica-{args.replica}"):
+        # --trace-dir wins over --trace-out: the shard name embeds this
+        # process's pid, which is what lets the router's merged export
+        # give every fleet process its own Perfetto track.
+        trace_out = args.trace_out
+        if args.trace_dir:
+            trace_out = obs.shard_path(args.trace_dir)
+        with obs.tracing(trace_out, run_id=f"replica-{args.replica}"):
             srv = VerificationServer(scfg, transition_fn=forward).start()
             threading.Thread(target=_reader, name="replica-ctl",
                              daemon=True).start()
             send({"hello": True, "replica": args.replica,
                   "pid": os.getpid(), "lease": scfg.lease_path})
             crashed = False
+            last_beat = 0.0
             while not stop.is_set():
                 if not srv.alive():
                     # A propagate-class error killed the worker thread
@@ -207,27 +257,31 @@ def main(argv=None) -> int:
                     # re-homes this replica's requests.
                     crashed = True
                     break
+                # Metrics beat: a labelled registry snapshot rides the
+                # control pipe about once a second, same framing as the
+                # status stream.  The router folds these into its
+                # fleet-wide gauges and fleet_metrics.json — a replica
+                # that stops beating simply goes stale there, which the
+                # lease sweep already covers.
+                now = time.monotonic()
+                if now - last_beat >= 1.0:
+                    last_beat = now
+                    send({"op": "metrics", "replica": args.replica,
+                          **metrics_snapshot()})
                 stop.wait(0.2)
             if crashed:
                 send({"op": "dead", "replica": args.replica})
                 return EXIT_CRASH
             requeued = srv.drain()
-            # Process-lifetime compile accounting rides the drained
-            # message: it is how the router (and the exec-cache tests)
-            # see that a restarted replica warmed from disk compiled
-            # NOTHING — per-request records only carry per-run deltas.
-            try:
-                from fairify_tpu.obs import compile as compile_obs
-
-                tot = compile_obs.snapshot_totals()
-                stats = {"n_compiles": int(tot["n_compiles"]),
-                         "compile_s": round(float(tot["compile_s"]), 3),
-                         "exec_cache_hits": int(obs.registry().counter(
-                             "exec_cache_hits").total())}
-            except (ImportError, KeyError):
-                stats = {}
+            # Process-lifetime accounting rides the drained message: it
+            # is how the router (and the exec-cache tests) see that a
+            # restarted replica warmed from disk compiled NOTHING —
+            # per-request records only carry per-run deltas.  The drain
+            # summary is the final, authoritative metrics snapshot; the
+            # periodic beats above are the same fields, earlier.
             send({"op": "drained", "replica": args.replica,
-                  "requeued": [r.id for r in requeued], **stats})
+                  "requeued": [r.id for r in requeued],
+                  **metrics_snapshot()})
         return EXIT_DRAINED
     except MemoryError:
         os._exit(EXIT_MEMOUT)

@@ -101,6 +101,10 @@ class VerifyRequest:
     # Spool-protocol payload (client.py): carried so a drain can journal
     # the request back for the next server; None for in-process submits.
     spool_payload: Optional[dict] = None
+    # Distributed-trace context (obs.trace.TraceContext) recovered from
+    # the payload's ``trace`` field; the server binds it around every
+    # stage this request runs so spans/events/SMT frames carry the id.
+    trace: Optional[object] = None
 
     # --- server-owned lifecycle state -------------------------------------
     status: str = QUEUED
@@ -149,6 +153,8 @@ class VerifyRequest:
             "partitions": self.partitions,
             "priority": self.priority,
         }
+        if self.trace is not None:
+            rec["trace_id"] = self.trace.trace_id
         if self.preemptions:
             rec["preemptions"] = self.preemptions
         if self.partition_span is not None:

@@ -64,6 +64,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from fairify_tpu import obs
+from fairify_tpu.obs import trace as trace_mod
 from fairify_tpu.resilience import faults as faults_mod
 from fairify_tpu.resilience.journal import JournalWriter
 from fairify_tpu.resilience.supervisor import Supervisor, classify
@@ -192,6 +193,13 @@ class ServeConfig:
     # iterations, span granules) — the cross-process analog of
     # ``lease_age()``, readable by a router in another process via mtime.
     lease_path: Optional[str] = None
+    # Shared trace-shard directory (DESIGN.md §19): handed to the SMT
+    # pool so its worker subprocesses append their own trace.<pid>.jsonl
+    # shards next to this process's.  The server itself does NOT open a
+    # tracer off this — whoever owns the process (cli serve, replica
+    # main) activates the shard; this only propagates the directory to
+    # the next process boundary down.
+    trace_dir: Optional[str] = None
 
 
 class VerificationServer:
@@ -411,7 +419,9 @@ class VerificationServer:
                spool_payload: Optional[dict] = None,
                submitted_at: Optional[float] = None,
                priority: int = PRIORITY_NORMAL,
-               readmit: bool = False) -> VerifyRequest:
+               readmit: bool = False,
+               trace: Optional[trace_mod.TraceContext] = None
+               ) -> VerifyRequest:
         """Queue one verification job; returns the request (possibly
         already ``rejected`` — check ``status``).  Thread-safe.
 
@@ -427,7 +437,15 @@ class VerificationServer:
             id=request_id or new_request_id(), cfg=cfg, net=net,
             model_name=model_name, dataset=dataset, deadline_s=deadline_s,
             partition_span=partition_span, spool_payload=spool_payload,
-            priority=priority)
+            priority=priority,
+            trace=trace if trace is not None
+            else trace_mod.TraceContext.from_fields(spool_payload)
+            or trace_mod.current_context()
+            # In-process submits with a live tracer but no inherited
+            # context (a bench thread, a notebook) still get a root id —
+            # otherwise their spans never join a critical-path row.
+            or (trace_mod.TraceContext(trace_id=trace_mod.new_trace_id())
+                if trace_mod.current() is not None else None))
         if submitted_at is not None:
             req.submitted_at = submitted_at
         req.partitions = self._span_size(cfg, partition_span)
@@ -456,10 +474,16 @@ class VerificationServer:
                 raise AdmissionRejected("server draining")
             with self._cv:
                 depth = len(self._queue) + self._inflight
-            if readmit:
-                self.admission.readmit(req)
-            else:
-                self.admission.admit(req, queue_depth=depth)
+            # The admission stage of the critical path: bound to the
+            # request's trace so the merged view shows where a shed/reject
+            # decision was made (and how long feasibility sizing took).
+            with trace_mod.context(req.trace), \
+                    obs.span("serve.admit", request=req.id,
+                             queue_depth=depth):
+                if readmit:
+                    self.admission.readmit(req)
+                else:
+                    self.admission.admit(req, queue_depth=depth)
         except BaseException as exc:
             if classify(exc) == "propagate":
                 raise
@@ -553,7 +577,8 @@ class VerificationServer:
                 self._smt_pool = SmtPool(PoolConfig(
                     workers=max(int(self.cfg.smt_workers), 1),
                     memory_cap_mb=self.cfg.smt_memory_cap_mb,
-                    portfolio=self.cfg.smt_portfolio))
+                    portfolio=self.cfg.smt_portfolio,
+                    trace_dir=self.cfg.trace_dir))
             return self._smt_pool
 
     def _smt_defer(self, req: VerifyRequest, report) -> None:
@@ -588,8 +613,9 @@ class VerificationServer:
                 return  # drain() sentinel: everything before it is done
             req, report = item
             try:
-                with obs.span("serve.smt_drain", request=req.id,
-                              queries=report.smt_pending.pending):
+                with trace_mod.context(req.trace), \
+                        obs.span("serve.smt_drain", request=req.id,
+                                 queries=report.smt_pending.pending):
                     report.smt_pending.drain()
                 report.smt_pending = None
             except BaseException as exc:
@@ -613,7 +639,8 @@ class VerificationServer:
                 with self._cv:
                     self._smt_draining_id = None
                 continue
-            self._complete(req, report)
+            with trace_mod.context(req.trace):
+                self._complete(req, report)
             with self._cv:
                 self._smt_draining_id = None
 
@@ -763,7 +790,10 @@ class VerificationServer:
 
     def _run_batch(self, batch: List[VerifyRequest]) -> None:
         registry = obs.registry()
-        with obs.span("serve.batch", requests=len(batch)):
+        batch_traces = sorted({r.trace.trace_id for r in batch
+                               if r.trace is not None})
+        with obs.span("serve.batch", requests=len(batch),
+                      trace_ids=batch_traces):
             registry.histogram("serve_batch_size").observe(len(batch))
             stage0_by_id = {}
             if self.cfg.n_shards is None and len(batch) >= 2:
@@ -805,8 +835,9 @@ class VerificationServer:
         registry = obs.registry()
         req.started_at = time.monotonic()
         registry.histogram("serve_queue_wait_s").observe(req.queue_wait_s)
-        with obs.span("serve.request", request=req.id, model=req.model_name,
-                      preset=req.cfg.name) as sp:
+        with trace_mod.context(req.trace), \
+                obs.span("serve.request", request=req.id,
+                         model=req.model_name, preset=req.cfg.name) as sp:
             try:
                 faults_mod.check("request.deadline")
                 left = req.deadline_left()

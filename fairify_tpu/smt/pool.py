@@ -50,6 +50,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from fairify_tpu import obs
+from fairify_tpu.obs import trace as trace_mod
 from fairify_tpu.resilience import faults as faults_mod
 from fairify_tpu.resilience.faults import InjectedFault
 from fairify_tpu.smt import protocol
@@ -75,6 +76,10 @@ class PoolConfig:
     pair_cap: int = DEFAULT_PAIR_CAP  # brute backend enumeration budget
     seed: int = 0
     spawn_timeout_s: float = 20.0  # worker hello deadline
+    # Shared trace-shard directory (obs.trace.shard_path): workers append
+    # their solve spans to trace.<pid>.jsonl there; None = no worker-side
+    # tracing (trace contexts still ride the solve frames either way).
+    trace_dir: Optional[str] = None
 
 
 @dataclass
@@ -119,6 +124,8 @@ class _Worker:
                "--backend", cfg.backend,
                "--memory-cap-mb", str(int(cap_mb)),
                "--pair-cap", str(int(cfg.pair_cap))]
+        if cfg.trace_dir:
+            cmd += ["--trace-dir", cfg.trace_dir]
         self.proc = subprocess.Popen(
             cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             stderr=subprocess.DEVNULL, text=True, bufsize=1,
@@ -198,7 +205,11 @@ class SmtPool:
         self._query_s_ema: Optional[float] = None
         self._rng = np.random.default_rng(cfg.seed)
         self._threads: List[threading.Thread] = []
-        self._pending: List[Tuple[Future, dict, float, tuple]] = []
+        # (future, query, soft_timeout, retry_tiers, trace_ctx): the trace
+        # context is captured at submit and re-bound in the dispatch lane —
+        # lanes are pool threads, and thread-locals never cross a handoff.
+        self._pending: List[Tuple[Future, dict, float, tuple,
+                                  Optional[trace_mod.TraceContext]]] = []
 
     # --- introspection (heartbeat / admission) ----------------------------
 
@@ -345,7 +356,9 @@ class SmtPool:
             elif directive == "memout":
                 w.send({"op": "memout", "qid": 0})
             try:
-                w.send(protocol.solve_request(0, query, timeout_s, seed=seed))
+                w.send(protocol.solve_request(
+                    0, query, timeout_s, seed=seed,
+                    trace=trace_mod.context_fields().get("trace")))
                 resp = w.recv(timeout_s + self.cfg.grace_s)
             except WorkerDied as exc:
                 self._discard(w, dedicated)
@@ -517,7 +530,8 @@ class SmtPool:
                 return fut
             self._queued += 1
             self._pending.append(
-                (fut, query, float(soft_timeout_s), tuple(retry_timeouts_s)))
+                (fut, query, float(soft_timeout_s), tuple(retry_timeouts_s),
+                 trace_mod.current_context()))
             lanes = max(self.cfg.workers // max(self.cfg.portfolio, 1), 1)
             live = [t for t in self._threads if t.is_alive()]
             self._threads = live
@@ -536,14 +550,15 @@ class SmtPool:
             with self._cv:
                 if not self._pending or self._closed:
                     return
-                fut, query, soft, retries = self._pending.pop(0)
+                fut, query, soft, retries, ctx = self._pending.pop(0)
                 self._queued -= 1
             self._gauges()
             if not fut.set_running_or_notify_cancel():
                 continue  # cancelled while queued (e.g. heuristic decided)
             try:
-                fut.set_result(self.solve_serialized(
-                    query, soft_timeout_s=soft, retry_timeouts_s=retries))
+                with trace_mod.context(ctx):
+                    fut.set_result(self.solve_serialized(
+                        query, soft_timeout_s=soft, retry_timeouts_s=retries))
             except BaseException as exc:
                 from fairify_tpu.resilience.supervisor import classify
 
@@ -565,7 +580,7 @@ class SmtPool:
             self._idle.clear()
             threads = list(self._threads)
             self._cv.notify_all()
-        for fut, _q, _s, _r in pending:
+        for fut, _q, _s, _r, _ctx in pending:
             if fut.cancel():
                 continue
             if not fut.done():

@@ -83,9 +83,16 @@ def parse_msg(line: str) -> Optional[dict]:
 
 
 def solve_request(qid: int, query: dict, timeout_s: float,
-                  seed: int = 0) -> dict:
-    return {"op": "solve", "qid": int(qid), "timeout_s": float(timeout_s),
-            "seed": int(seed), "query": query}
+                  seed: int = 0, trace: Optional[dict] = None) -> dict:
+    """One solve frame; ``trace`` is the distributed-trace context
+    (``{"id": ..., "span": ...}``) the worker echoes back in its response
+    and binds around its own spans — how a host-solver leg joins the
+    request's merged trace tree (DESIGN.md §19)."""
+    req = {"op": "solve", "qid": int(qid), "timeout_s": float(timeout_s),
+           "seed": int(seed), "query": query}
+    if trace:
+        req["trace"] = dict(trace)
+    return req
 
 
 def result_ce(resp: dict):

@@ -30,6 +30,7 @@ import argparse
 import sys
 import time
 
+from fairify_tpu.obs import trace as trace_mod
 from fairify_tpu.smt import brute, protocol
 
 try:  # pragma: no cover - exercised only where z3-solver is installed
@@ -134,6 +135,11 @@ def main(argv=None) -> int:
                     choices=("auto", "z3", "brute"))
     ap.add_argument("--memory-cap-mb", type=int, default=0)
     ap.add_argument("--pair-cap", type=int, default=brute.DEFAULT_PAIR_CAP)
+    ap.add_argument("--trace-dir", default=None,
+                    help="shared trace-shard directory: the worker appends "
+                         "its solve spans to trace.<pid>.jsonl so the "
+                         "merged view shows the host-solver leg of each "
+                         "request (obs.trace is stdlib-only — no jax)")
     args = ap.parse_args(argv)
     backend = args.backend
     if backend == "auto":
@@ -142,6 +148,12 @@ def main(argv=None) -> int:
         _respond({"fatal": "z3-solver is not installed in the worker env"})
         return 2
     _apply_memory_cap(args.memory_cap_mb)
+    if args.trace_dir:
+        # Hard kills are in this worker's contract: the shard is append-
+        # per-record (flushed, no close needed), so a SIGKILL tears at
+        # most the final line — same tolerance as every JSONL ledger.
+        trace_mod.activate(trace_mod.Tracer(
+            trace_mod.shard_path(args.trace_dir), run_id="smt-worker"))
     _respond({"hello": True, "backend": backend,
               "memory_cap_mb": args.memory_cap_mb})
     for line in sys.stdin:
@@ -163,7 +175,15 @@ def main(argv=None) -> int:
             _respond(_chaos_memout(req.get("qid")))
             return 0
         if op == "solve":
-            resp = solve_one(req, backend, args.pair_cap)
+            # The request's trace context rides the solve frame: bind it
+            # so the worker's span joins the merged tree, and echo it in
+            # the response so the host can assert propagation end-to-end.
+            with trace_mod.context(trace_mod.TraceContext.from_fields(req)), \
+                    trace_mod.span("smt.worker_solve", qid=req.get("qid"),
+                                   backend=backend):
+                resp = solve_one(req, backend, args.pair_cap)
+            if req.get("trace"):
+                resp["trace"] = req["trace"]
             _respond(resp)
             if resp.get("exit"):
                 return 0

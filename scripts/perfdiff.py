@@ -66,7 +66,11 @@ even when failover keeps the latency columns green.
 ``serve.batch_occupancy@<n>c`` is emitted only for shed-free levels: under
 admission shedding it measures admitted workload shape, not batcher
 packing, so a shedding candidate simply drops the metric (a ``missing``
-warning, not a regression).
+warning, not a regression).  A ``trace_ab`` block (``serve_bench
+--trace-ab``, DESIGN.md §19) adds ``serve.trace_pps_on`` (traced goodput,
+higher-is-better) and ``serve.trace_overhead_rel`` (tracing's on-vs-off
+goodput cost, lower-is-better with a 5-point absolute floor) — tracing
+that stops being within-noise fails the gate.
 
 **SMT records** (``audits/SMT_r*.json`` from ``scripts/smt_bench.py``;
 ``"kind": "SMT"``) gate the out-of-process solver pool: per worker count,
@@ -185,6 +189,17 @@ def _serve_records(obj: dict) -> Dict[str, dict]:
             # check in serve_bench still guards coalescing itself.
             out[f"serve.batch_occupancy@{n}c"] = _flat(
                 row["batch_occupancy_mean"])
+    tab = obj.get("trace_ab")
+    if isinstance(tab, dict):
+        # Tracing-overhead A/B (serve_bench --trace-ab, DESIGN.md §19):
+        # traced goodput gates higher-is-better like any pps metric, and
+        # the on-vs-off overhead fraction gates lower-is-better with a
+        # 5-point absolute floor (single-sample measurement grain).
+        if tab.get("pps_on") is not None:
+            out["serve.trace_pps_on"] = _flat(tab["pps_on"])
+        if tab.get("overhead_rel") is not None:
+            out["serve.trace_overhead_rel"] = _flat_lower(
+                max(float(tab["overhead_rel"]), 0.0), floor=0.05)
     cold = obj.get("cold_restart")
     if isinstance(cold, dict):
         if cold.get("n_compiles") is not None:
@@ -506,6 +521,15 @@ def self_test() -> int:
     svp_blip = _serve_records(
         {**svp, "procfleet": {**svp["procfleet"], "replica_deaths": 1,
                               "replica_restarts": 1, "rehomed": 1}})
+    svt = {"kind": "SERVE",
+           "clients": {"4": {"p95_ms": 800.0, "requests_per_s": 5.0}},
+           "trace_ab": {"clients": 4, "pps_on": 4.9, "pps_off": 5.0,
+                        "overhead_rel": 0.02, "within_noise": True}}
+    svt_base = _serve_records(svt)
+    svt_same = _serve_records(json.loads(json.dumps(svt)))
+    svt_heavy = _serve_records(
+        {**svt, "trace_ab": {"clients": 4, "pps_on": 3.0, "pps_off": 5.0,
+                             "overhead_rel": 0.4, "within_noise": False}})
     sv16_melt = _serve_records(       # the r01 shape: no shedding, melted
         {"kind": "SERVE",
          "clients": {"16": {"p95_ms": 126226.2, "deadline_miss_rate": 0.625,
@@ -612,6 +636,9 @@ def self_test() -> int:
          compare(svp_base, svp_flappy), 3),
         ("single replica blip within count floor passes",
          compare(svp_base, svp_blip), 0),
+        ("identical trace A/B records pass", compare(svt_base, svt_same), 0),
+        ("tracing-overhead step change flagged (pps_on + overhead_rel)",
+         compare(svt_base, svt_heavy), 2),
         ("identical smt records pass", compare(sm_base, sm_same), 0),
         ("lost smt scaling flagged (qps@4w + speedup_x)",
          compare(sm_base, sm_serial), 2),

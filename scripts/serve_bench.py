@@ -182,6 +182,18 @@ def main() -> int:
                          "overrun degrades to resumable UNKNOWNs")
     ap.add_argument("--no-cold-restart", action="store_true",
                     help="skip the cold-restart-from-cache subprocess probe")
+    ap.add_argument("--trace-dir", default=None,
+                    help="run the measured levels with distributed tracing "
+                         "on: every process (router, replicas, SMT workers) "
+                         "writes a trace.<pid>.jsonl shard here, merged by "
+                         "`fairify_tpu report --trace-dir` (DESIGN.md §19)")
+    ap.add_argument("--trace-ab", type=int, default=0, metavar="N",
+                    help="after the measured levels, A/B one N-client round "
+                         "with tracing ON vs OFF on the warm server and "
+                         "gate the pps delta through perfdiff.compare "
+                         "(within-noise = green).  In-process modes only: "
+                         "process replicas fix their tracer at spawn, so "
+                         "the arms would not differ (skipped with a note)")
     ap.add_argument("--exec-cache-dir", default=None,
                     help="persistent executable cache directory (default: "
                          "<work-dir>/exec-cache, wiped with it).  Point it "
@@ -274,14 +286,17 @@ def main() -> int:
         # into G granules multiplies that burn by G — preemption (which
         # needs granules) is exercised by chaos_matrix --fleet and
         # test_serve, not by this latency record.
-        fair_share_idle_exempt=not mix)
+        fair_share_idle_exempt=not mix,
+        # Thread-mode servers hand the SMT pool its worker shard dir
+        # directly; process replicas get --trace-dir from the fleet.
+        trace_dir=args.trace_dir if not args.replica_procs else None)
     spool = os.path.join(os.path.abspath(args.work_dir), "spool")
     if procs:
         from fairify_tpu.serve import ProcessFleet, ProcFleetConfig
 
         srv = ProcessFleet(ProcFleetConfig(
             n_replicas=args.replica_procs, spool=spool, poll_s=0.02,
-            pulse_s=5.0, exec_cache=exec_dir,
+            pulse_s=5.0, exec_cache=exec_dir, trace_dir=args.trace_dir,
             replica=scfg))
     elif args.replicas > 1:
         # Spill AT the shed bound: a burst spreads over the fleet right
@@ -295,6 +310,17 @@ def main() -> int:
     else:
         srv = VerificationServer(scfg)
     srv.start()
+    # Router-side trace shard: replica/worker processes write their own
+    # (the fleet forwards --trace-dir), so `fairify_tpu report
+    # --trace-dir` merges every process of this bench into one tree.
+    trace_scope = None
+    if args.trace_dir:
+        from fairify_tpu.obs import trace as trace_mod
+
+        os.makedirs(args.trace_dir, exist_ok=True)
+        trace_scope = obs.tracing(trace_mod.shard_path(args.trace_dir),
+                                  run_id="serve-bench")
+        trace_scope.__enter__()
     if procs:
         from fairify_tpu.serve import client as spool_client
 
@@ -464,6 +490,61 @@ def main() -> int:
         levels[str(n_clients)] = row
         print(f"serve_bench: {n_clients:>2} client(s): "
               f"{levels[str(n_clients)]}", file=sys.stderr)
+    # Tracing-overhead A/B (DESIGN.md §19): one N-client round with the
+    # tracer ON, one OFF, on the same warm server — gated through the
+    # real perfdiff noise model (OFF is the baseline, ON the candidate;
+    # a finding means tracing costs more than single-sample noise).
+    trace_ab = None
+    if args.trace_ab > 0 and not procs:
+        if trace_scope is not None:
+            trace_scope.__exit__(None, None, None)  # OFF arm must be off
+            trace_scope = None
+        from fairify_tpu.obs import trace as trace_mod
+
+        def _ab_round(n, seed0):
+            t0 = time.perf_counter()
+            reqs = [srv.submit(
+                cfg0.with_(result_dir=os.path.join(args.work_dir,
+                                                   f"ab{seed0 + c}")),
+                _net(seed0 + c), f"ab{seed0 + c}",
+                deadline_s=args.deadline, partition_span=span,
+                priority=1) for c in range(n)]
+            done = 0
+            for req in reqs:
+                rec = srv.wait(req.id, timeout=900.0)
+                done += int(rec is not None and rec.status == "done")
+            return done / (time.perf_counter() - t0)
+
+        # Own shard dir: reusing --trace-dir would reopen (and truncate)
+        # this pid's main shard.
+        ab_dir = os.path.join(os.path.abspath(args.work_dir), "trace-ab")
+        os.makedirs(ab_dir, exist_ok=True)
+        with obs.tracing(trace_mod.shard_path(ab_dir),
+                         run_id="serve-bench-ab"):
+            pps_on = _ab_round(args.trace_ab, 5000)
+        pps_off = _ab_round(args.trace_ab, 6000)
+        sys.path.insert(0, os.path.join(ROOT, "scripts"))
+        import perfdiff
+
+        findings = perfdiff.compare(
+            {"serve.trace_ab_pps": perfdiff._flat(pps_off)},
+            {"serve.trace_ab_pps": perfdiff._flat(pps_on)},
+            rel_guard=0.02, rel_tol=0.2)
+        trace_ab = {
+            "clients": args.trace_ab,
+            "pps_on": round(pps_on, 3),
+            "pps_off": round(pps_off, 3),
+            "overhead_rel": round((pps_off - pps_on) / max(pps_off, 1e-9),
+                                  4),
+            "within_noise": not findings,
+        }
+        print(f"serve_bench: trace A/B {trace_ab}"
+              + (f" findings={findings}" if findings else ""),
+              file=sys.stderr)
+    elif args.trace_ab > 0:
+        print("serve_bench: --trace-ab skipped: process replicas fix "
+              "their tracer at spawn, the arms would not differ",
+              file=sys.stderr)
     # The warm gate is the acceptance cell: 4 concurrent requests on a
     # warmed server compile nothing (falls back to the total across levels
     # when 4 wasn't measured).
@@ -494,6 +575,8 @@ def main() -> int:
         print(f"serve_bench: procfleet {procfleet_block}", file=sys.stderr)
     else:
         srv.drain()
+    if trace_scope is not None:
+        trace_scope.__exit__(None, None, None)  # flush the router shard
 
     record = {
         "kind": "SERVE",
@@ -511,6 +594,10 @@ def main() -> int:
         "coalesced_device_launches": coalesced_launches,
         "sequential_device_launches": sequential_launches,
     }
+    if args.trace_dir:
+        record["trace_dir"] = args.trace_dir
+    if trace_ab is not None:
+        record["trace_ab"] = trace_ab
     if procfleet_block is not None:
         record["procfleet"] = procfleet_block
     if not args.no_cold_restart:
@@ -533,6 +620,8 @@ def main() -> int:
         return 0 if ok else 1
     ok = warm_compiles == 0 and (
         coalesced_launches is None or coalesced_launches < sequential_launches)
+    if trace_ab is not None:
+        ok = ok and trace_ab["within_noise"]
     print(f"serve_bench: warm compiles {warm_compiles} "
           f"(healthy: 0), coalesced launches {coalesced_launches} vs "
           f"{sequential_launches} sequential -> "

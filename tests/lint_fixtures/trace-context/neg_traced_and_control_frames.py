@@ -1,0 +1,42 @@
+# rel: fairify_tpu/serve/fx_frames_ok.py
+"""The compliant shapes: traced frames, reviewed control frames,
+pass-through writers, and opaque (undecidable) payloads."""
+import json
+
+from fairify_tpu.smt import protocol
+from fairify_tpu.serve.client import write_atomic_json
+
+
+def traced_solve_frame(pipe, qid, ctx_fields):
+    pipe.write(protocol.dump_msg(
+        {"op": "solve", "qid": qid, "trace": ctx_fields["trace"]}))
+
+
+def trace_id_variant(chan, qid, tid):
+    chan.write(json.dumps({"qid": qid, "trace_id": tid}) + "\n")
+
+
+def control_frames(send):
+    send({"op": "ping"})
+    send({"op": "drained", "replica": 0, "requeued": []})
+    send({"hello": True, "pid": 1234})
+    send({"qid": None, "error": "unknown op"})
+
+
+def pass_through_writer(pipe, obj):
+    # The frame is a parameter: this is plumbing, the constructor is the
+    # responsible party.
+    pipe.write(protocol.dump_msg(obj))
+
+
+def opaque_payload(inbox, req_id):
+    payload = load_payload(req_id)  # noqa: F821 — fixture-only
+    write_atomic_json(inbox + "/" + req_id + ".json", payload)
+
+
+def spread_may_carry_trace(send, qid, extra):
+    send({"qid": qid, **extra})
+
+
+def status_record_by_name(rdir, rec):
+    write_atomic_json(rdir + "/status.json", rec)

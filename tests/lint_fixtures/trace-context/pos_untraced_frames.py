@@ -1,0 +1,27 @@
+# rel: fairify_tpu/serve/fx_frames.py
+"""Frame writers that provably drop the trace context — every flagged
+line hands a dict LITERAL without trace fields (and not a reviewed
+control frame) to a cross-boundary writer."""
+import json
+import sys
+
+from fairify_tpu.smt import protocol
+from fairify_tpu.serve.client import write_atomic_json
+
+
+def solve_frame_without_trace(pipe, qid):
+    # A per-request pipe frame built inline: 'solve' is NOT a control op.
+    pipe.write(protocol.dump_msg({"op": "solve", "qid": qid}))  # EXPECT
+
+
+def hand_rolled_newline_framing(chan, qid, verdict):
+    chan.write(json.dumps({"qid": qid, "verdict": verdict}) + "\n")  # EXPECT
+
+
+def spool_payload_without_trace(inbox, req_id, cfg):
+    write_atomic_json(inbox + "/" + req_id + ".json",
+                      {"id": req_id, "cfg": cfg})  # EXPECT
+
+
+def send_helper_with_literal_result(send, qid, ce):
+    send({"qid": qid, "verdict": "sat", "ce": ce})  # EXPECT

@@ -378,6 +378,10 @@ def test_report_renders_replica_table(tmp_path):
         {"type": "event", "name": "replica",
          "attrs": {"replica": 0, "event": "rehome", "requests": 2}},
         {"type": "event", "name": "replica",
+         "attrs": {"replica": 0, "event": "metrics", "exec_cache_hits": 9,
+                   "n_compiles": 1, "exec_cache_hit_rate": 0.9,
+                   "launches_per_model": 4.5}},
+        {"type": "event", "name": "replica",
          "attrs": {"replica": 0, "event": "restart", "pid": 101,
                    "restarts": 1}},
         {"type": "event", "name": "replica",
@@ -394,12 +398,16 @@ def test_report_renders_replica_table(tmp_path):
     agg = report_mod.aggregate([str(log)])
     assert agg["replicas"]["0"] == {
         "pid": 101, "restarts": 1, "deaths": {"crash": 1}, "rehomed": 2,
-        "last_lease_age_s": None, "abandoned": False}
+        "last_lease_age_s": None, "abandoned": False,
+        "exec_cache_hit_rate": 0.9, "launches_per_model": 4.5}
     assert agg["replicas"]["1"]["deaths"] == {"hang": 1}
     assert agg["replicas"]["1"]["last_lease_age_s"] == 3.25
     assert agg["replicas"]["1"]["abandoned"] is True
     text = report_mod.render(agg)
     assert "replica" in text and "hang=1" in text and "1*" in text
+    # Live fleet telemetry columns (satellite of DESIGN.md §19): the
+    # metrics beats' derived gauges render per slot.
+    assert "90%" in text and "4.5" in text
 
 
 def test_replica_cmd_carries_template_knobs(tmp_path):

@@ -1,0 +1,407 @@
+"""End-to-end distributed request tracing (DESIGN.md §19).
+
+The fleet's cross-process hops are each pinned against REAL subprocesses
+(same bar as ``tests/test_procfleet.py`` — no mocks):
+
+* **context plumbing** — ``TraceContext`` binds thread-locally, never
+  inherits across threads, stamps every span/event written under it, and
+  round-trips through the wire ``fields()`` / ``from_fields()`` shape;
+* **submit stamps, requeue preserves** — ``serve.client.submit`` gives
+  every payload a trace id exactly once (``setdefault``): a re-homed or
+  requeued payload keeps its identity across any number of owners;
+* **router → replica** — one spool submit against a 2-process fleet
+  yields ONE connected trace: the router shard's request events and the
+  replica shard's spans join on the payload's trace id, and the merged
+  critical path survives a literal mid-request ``kill -9`` + re-home;
+* **replica → SMT worker** — a pool query carries the caller's context
+  in its solve frame; the worker process opens its own shard and records
+  ``smt.worker_solve`` under the caller's trace id (a real worker
+  subprocess, brute backend);
+* **trace-off = zero cost** — a fleet run without ``--trace-dir`` emits
+  zero trace records anywhere in the spool;
+* **merged export** — per-process shards merge into one Chrome/Perfetto
+  file with pid-namespaced process tracks and integer thread ids.
+"""
+import glob
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fairify_tpu import obs
+from fairify_tpu.obs import metrics as metrics_mod
+from fairify_tpu.obs import trace as trace_mod
+from fairify_tpu.smt import protocol
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state():
+    trace_mod.activate(None)
+    trace_mod._ctx_tls.ctx = None
+    metrics_mod.registry().reset()
+    yield
+    trace_mod.activate(None)
+    trace_mod._ctx_tls.ctx = None
+    metrics_mod.registry().reset()
+
+
+# ---------------------------------------------------------------------------
+# context API units
+# ---------------------------------------------------------------------------
+
+
+def test_trace_context_binding_and_wire_shape():
+    tid = trace_mod.new_trace_id()
+    assert len(tid) == 16 and int(tid, 16) >= 0
+    assert trace_mod.new_trace_id() != tid
+    assert trace_mod.current_context() is None
+    assert trace_mod.context_fields() == {}
+    ctx = trace_mod.TraceContext(tid, None)
+    with trace_mod.context(ctx):
+        assert trace_mod.current_context() is ctx
+        fields = trace_mod.context_fields()
+        assert fields == {"trace": {"id": tid}}
+        inner = trace_mod.TraceContext("b" * 16, 7)
+        with trace_mod.context(inner):
+            assert trace_mod.current_context() is inner
+            assert trace_mod.context_fields()["trace"] == {
+                "id": "b" * 16, "span": 7}
+        assert trace_mod.current_context() is ctx
+        # A None context defers to the enclosing one (spool payloads
+        # without a trace field must not sever an outer scope).
+        with trace_mod.context(None):
+            assert trace_mod.current_context() is ctx
+    assert trace_mod.current_context() is None
+    # Wire round-trip.
+    back = trace_mod.TraceContext.from_fields(
+        {"trace": {"id": tid, "span": 3}})
+    assert (back.trace_id, back.parent_span) == (tid, 3)
+    assert trace_mod.TraceContext.from_fields({}) is None
+    assert trace_mod.TraceContext.from_fields({"trace": {}}) is None
+    assert trace_mod.TraceContext.from_fields(None) is None
+
+
+def test_context_never_inherits_across_threads():
+    """Queue handoffs must capture the context at enqueue and re-bind at
+    dequeue — implicit inheritance would attribute one request's spans to
+    whichever request's thread happened to spawn the worker."""
+    seen = []
+    with trace_mod.context(trace_mod.TraceContext("c" * 16, None)):
+        t = threading.Thread(
+            target=lambda: seen.append(trace_mod.current_context()))
+        t.start()
+        t.join()
+    assert seen == [None]
+
+
+def test_span_and_event_records_carry_trace_id(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    tr = trace_mod.Tracer(path, run_id="unit")
+    trace_mod.activate(tr)
+    try:
+        with trace_mod.context(trace_mod.TraceContext("d" * 16, 41)):
+            with obs.span("outer"):
+                with obs.span("inner"):
+                    pass
+            obs.event("tick", n=1)
+        with obs.span("unbound"):
+            pass
+    finally:
+        trace_mod.activate(None)
+        tr.close()
+    recs = trace_mod.load_events(path)
+    spans = {r["name"]: r for r in recs if r.get("type") == "span"}
+    assert spans["outer"]["trace_id"] == "d" * 16
+    assert spans["inner"]["trace_id"] == "d" * 16
+    # Only the context-root span records the REMOTE parent (the sender's
+    # span id); the nested span has a local parent instead.
+    assert spans["outer"]["remote_parent"] == 41
+    assert "remote_parent" not in spans["inner"]
+    assert spans["inner"]["parent_id"] == spans["outer"]["span_id"]
+    assert "trace_id" not in spans["unbound"]
+    ev = next(r for r in recs if r.get("type") == "event")
+    assert ev["trace_id"] == "d" * 16
+    meta = next(r for r in recs if r.get("type") == "meta")
+    assert meta["pid"] == os.getpid()
+
+
+def test_submit_stamps_trace_exactly_once(tmp_path):
+    from fairify_tpu.serve import client as client_mod
+
+    spool = str(tmp_path / "spool")
+    payload = client_mod.build_payload(
+        "GC", init={"sizes": [4, 1], "seed": 0})
+    rid = client_mod.submit(spool, payload)
+    with open(os.path.join(spool, "inbox", f"{rid}.json")) as fp:
+        on_disk = json.load(fp)
+    tid = on_disk["trace"]["id"]
+    assert len(tid) == 16
+    # A requeued/re-homed payload keeps its identity: submit never
+    # re-stamps an existing trace field.
+    rid2 = client_mod.submit(spool, dict(on_disk, id="requeue-1"))
+    with open(os.path.join(spool, "inbox", f"{rid2}.json")) as fp:
+        assert json.load(fp)["trace"]["id"] == tid
+    # Under a bound context the payload joins the caller's trace.
+    with trace_mod.context(trace_mod.TraceContext("e" * 16, None)):
+        payload3 = client_mod.build_payload(
+            "GC", init={"sizes": [4, 1], "seed": 0})
+        rid3 = client_mod.submit(spool, payload3)
+    with open(os.path.join(spool, "inbox", f"{rid3}.json")) as fp:
+        assert json.load(fp)["trace"]["id"] == "e" * 16
+
+
+def test_solve_request_frame_carries_trace():
+    req = protocol.solve_request(3, {"q": 1}, 10.0,
+                                 trace={"id": "f" * 16, "span": 2})
+    assert req["trace"] == {"id": "f" * 16, "span": 2}
+    assert "trace" not in protocol.solve_request(3, {"q": 1}, 10.0)
+
+
+# ---------------------------------------------------------------------------
+# merged export + critical paths (synthetic shards)
+# ---------------------------------------------------------------------------
+
+
+def _shard(tmp_path, pid, run_id, records):
+    path = str(tmp_path / f"trace.{pid}.jsonl")
+    with open(path, "w") as fp:
+        fp.write(json.dumps({"type": "meta", "version": 1, "run_id": run_id,
+                             "pid": pid, "wall_time": 100.0}) + "\n")
+        for rec in records:
+            fp.write(json.dumps(rec) + "\n")
+    return path
+
+
+TID = "a1b2c3d4e5f60718"
+
+
+def _synthetic_fleet_shards(tmp_path):
+    router = _shard(tmp_path, 100, "serve", [
+        {"type": "event", "name": "request", "ts": 0.01, "tid": 1,
+         "attrs": {"request": "r-1", "status": "done", "replica": 0,
+                   "queue_wait_s": 0.2, "run_s": 1.0, "trace_id": TID}},
+    ])
+    replica = _shard(tmp_path, 200, "replica-0", [
+        {"type": "span", "name": "serve.admit", "ts": 0.0, "dur_s": 0.05,
+         "span_id": 1, "tid": 1, "trace_id": TID, "attrs": {}},
+        {"type": "span", "name": "serve.batch_stage0", "ts": 0.1,
+         "dur_s": 0.1, "span_id": 2, "tid": 1,
+         "attrs": {"trace_ids": [TID]}},
+        {"type": "span", "name": "serve.request", "ts": 0.2, "dur_s": 1.0,
+         "span_id": 3, "tid": 1, "trace_id": TID,
+         "attrs": {"request": "r-1"}},
+        {"type": "span", "name": "compile.stage0", "ts": 0.25,
+         "dur_s": 0.3, "span_id": 4, "tid": 1, "trace_id": TID,
+         "attrs": {}},
+        {"type": "span", "name": "pipeline.drain", "ts": 0.6, "dur_s": 0.1,
+         "span_id": 5, "tid": 1, "trace_id": TID, "attrs": {}},
+    ])
+    worker = _shard(tmp_path, 300, "smt-worker", [
+        {"type": "span", "name": "smt.worker_solve", "ts": 0.7,
+         "dur_s": 0.2, "span_id": 1, "tid": 1, "trace_id": TID,
+         "remote_parent": 3, "attrs": {"qid": 0}},
+    ])
+    return [router, replica, worker]
+
+
+def test_merged_chrome_export_namespaces_processes(tmp_path):
+    paths = _synthetic_fleet_shards(tmp_path)
+    assert trace_mod.shard_paths(str(tmp_path)) == sorted(paths)
+    out = str(tmp_path / "merged.chrome.json")
+    n = trace_mod.write_chrome_trace_merged(paths, out)
+    with open(out) as fp:
+        events = json.load(fp)["traceEvents"]
+    assert n == sum(1 for e in events if e["ph"] != "M")
+    names = {e["args"]["name"] for e in events
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert names == {"serve [pid 100]", "replica-0 [pid 200]",
+                     "smt-worker [pid 300]"}
+    # One shared timebase: the worker's span lands after the replica's.
+    spans = {e["name"]: e for e in events if e["ph"] == "X"}
+    assert spans["smt.worker_solve"]["ts"] > spans["serve.admit"]["ts"]
+    assert all(isinstance(e["pid"], int) for e in events)
+    assert all(isinstance(e["tid"], int)
+               for e in events if e["ph"] != "M")
+    # Cross-shard join key rides into the viewer.
+    assert spans["serve.request"]["args"]["trace_id"] == TID
+
+
+def test_critical_path_table_joins_shards_on_trace_id(tmp_path):
+    from fairify_tpu.obs import report as report_mod
+
+    rows = report_mod.critical_paths(_synthetic_fleet_shards(tmp_path))
+    row = rows[TID]
+    assert row["request"] == "r-1" and row["complete"]
+    assert row["replica"] == 0 and row["replica_pid"] == 200
+    assert row["worker_pids"] == [300]
+    assert row["admission_s"] == 0.05
+    assert row["coalesce_s"] == 0.1
+    assert row["compile_s"] == 0.3
+    assert row["smt_s"] == 0.2
+    assert row["drain_s"] == 0.1
+    # device = run residual; stages sum EXACTLY to the measured latency.
+    assert row["device_s"] == pytest.approx(1.0 - 0.3 - 0.2 - 0.1)
+    assert row["total_s"] == pytest.approx(
+        row["queue_wait_s"] + row["run_s"])
+    text = report_mod.render_critical_paths(rows)
+    assert "r-1" in text and "complete critical paths: 1" in text
+
+
+# ---------------------------------------------------------------------------
+# replica -> SMT worker: a real worker subprocess records the caller's trace
+# ---------------------------------------------------------------------------
+
+
+def test_smt_worker_shard_joins_callers_trace(tmp_path):
+    from fairify_tpu.data.domains import DomainSpec
+    from fairify_tpu.models import mlp
+    from fairify_tpu.smt.pool import PoolConfig, SmtPool, solve_box
+    from fairify_tpu.verify import property as prop
+
+    ranges = {"a": (0, 3), "pa": (0, 1)}
+    q = prop.FairnessQuery(
+        domain=DomainSpec(name="toy", columns=tuple(ranges),
+                          ranges={k: tuple(v) for k, v in ranges.items()},
+                          label="y"),
+        protected=("pa",))
+    enc = prop.encode(q)
+    lo, hi = q.domain.lo_hi()
+    net = mlp.from_numpy(
+        [np.array([[0.0], [2.0]], dtype=np.float32),
+         np.array([[1.0]], dtype=np.float32)],
+        [np.array([0.0], dtype=np.float32),
+         np.array([-1.0], dtype=np.float32)])
+    trace_dir = str(tmp_path / "tr")
+    tid = trace_mod.new_trace_id()
+    with SmtPool(PoolConfig(workers=1, backend="brute", grace_s=0.5,
+                            backoff_s=1e-3, trace_dir=trace_dir)) as pool:
+        with trace_mod.context(trace_mod.TraceContext(tid, 9)):
+            v, _ce, reason = solve_box(pool, net, enc,
+                                       lo.astype(np.int64),
+                                       hi.astype(np.int64),
+                                       soft_timeout_s=10.0)
+    assert (v, reason) == ("sat", None)
+    shards = trace_mod.shard_paths(trace_dir)
+    assert shards, "worker opened no trace shard"
+    solves = []
+    for path in shards:
+        recs = trace_mod.load_events(path)
+        meta = next(r for r in recs if r.get("type") == "meta")
+        assert meta["pid"] != os.getpid()  # a real subprocess's shard
+        assert meta["run_id"] == "smt-worker"
+        solves += [r for r in recs if r.get("type") == "span"
+                   and r["name"] == "smt.worker_solve"]
+    assert solves, "worker recorded no solve span"
+    assert all(s["trace_id"] == tid for s in solves)
+    # The cross-process root remembers the sender-side span id.
+    assert all(s.get("remote_parent") == 9 for s in solves)
+
+
+# ---------------------------------------------------------------------------
+# router -> replica across a 2-process fleet, kill -9 mid-request
+# ---------------------------------------------------------------------------
+
+
+def test_procfleet_one_request_one_connected_trace_tree(tmp_path):
+    """One spool submit against a 2-replica PROCESS fleet with tracing on:
+    the router shard's request events and the replica shards' spans form
+    one tree joined on the payload's trace id, the merged critical path
+    stays complete across a literal mid-request ``kill -9`` + re-home,
+    and the router publishes ``fleet_metrics.json``."""
+    from fairify_tpu.obs import report as report_mod
+    from fairify_tpu.serve import ProcessFleet, ProcFleetConfig, ServeConfig
+    from fairify_tpu.serve import client as client_mod
+    from tests.test_procfleet import OVERRIDES, SIZES, _wait_running
+
+    spool = tmp_path / "spool"
+    trace_dir = str(spool / "trace")
+    fl = ProcessFleet(ProcFleetConfig(
+        n_replicas=2, spool=str(spool), poll_s=0.03, pulse_s=0.0,
+        backoff_s=0.05, trace_dir=trace_dir,
+        replica=ServeConfig(batch_window_s=0.1, max_batch=4, poll_s=0.05,
+                            span_chunks=1)))
+    payload = client_mod.build_payload(
+        "GC", init={"sizes": SIZES, "seed": 3}, overrides=dict(OVERRIDES),
+        span=(0, 48))
+    # Pre-stamped identity: submit must preserve it (setdefault), and it
+    # is the join key asserted across every process's shard below.
+    tid = trace_mod.new_trace_id()
+    payload["trace"] = {"id": tid}
+    with obs.tracing(trace_mod.shard_path(trace_dir), run_id="serve"):
+        with fl:
+            assert fl.wait_ready(timeout=180) == 2
+            rid = client_mod.submit(str(spool), payload)
+            owner = _wait_running(fl, rid)
+            os.kill(fl.pids()[owner], signal.SIGKILL)
+            rec = fl.wait(rid, timeout=300)
+            assert rec is not None and rec["status"] == "done", rec
+            assert fl.restarts()[owner] >= 1  # the kill landed mid-request
+    shards = trace_mod.shard_paths(trace_dir)
+    pids = set()
+    spans_by_pid = {}
+    for path in shards:
+        recs = trace_mod.load_events(path)
+        meta = next(r for r in recs if r.get("type") == "meta")
+        pids.add(meta["pid"])
+        spans_by_pid[meta["pid"]] = [
+            r for r in recs if r.get("type") == "span"
+            and (r.get("trace_id") == tid
+                 or tid in r.get("attrs", {}).get("trace_ids", []))]
+    assert len(pids) >= 3  # router + 2 replica processes (distinct pids)
+    traced_pids = {p for p, s in spans_by_pid.items() if s}
+    assert os.getpid() in pids  # the router's own shard
+    assert traced_pids - {os.getpid()}, \
+        "no replica process recorded spans under the request's trace"
+    rows = report_mod.critical_paths(shards)
+    row = rows[tid]
+    assert row["request"] == rid and row["complete"], row
+    assert row["total_s"] == pytest.approx(
+        row["queue_wait_s"] + row["run_s"])
+    stages = (row["admission_s"] + row["compile_s"] + row["device_s"]
+              + row["smt_s"] + row["drain_s"])
+    assert stages == pytest.approx(row["run_s"], rel=0.05)
+    # Merged Perfetto export spans every process.
+    out = str(tmp_path / "merged.chrome.json")
+    assert trace_mod.write_chrome_trace_merged(shards, out) > 0
+    with open(out) as fp:
+        merged = json.load(fp)["traceEvents"]
+    assert len({e["pid"] for e in merged}) == len(pids)
+    # Fleet-wide metrics aggregation rode the beats/drain summaries.
+    with open(os.path.join(str(spool), "fleet_metrics.json")) as fp:
+        fm = json.load(fp)
+    assert fm["fleet"]["n_replicas"] == 2
+    assert fm["drained"], fm
+    any_slot = next(iter(fm["drained"].values()))
+    assert "exec_cache_hits" in any_slot and "device_launches" in any_slot
+
+
+def test_procfleet_trace_off_emits_zero_records(tmp_path):
+    """Without ``--trace-dir`` the same fleet emits ZERO trace records:
+    payloads still carry ids (stamping is O(1)), but no process opens a
+    shard and no span is written anywhere in the spool."""
+    from fairify_tpu.serve import ProcessFleet, ProcFleetConfig, ServeConfig
+    from fairify_tpu.serve import client as client_mod
+    from tests.test_procfleet import OVERRIDES, SIZES
+
+    spool = tmp_path / "spool"
+    fl = ProcessFleet(ProcFleetConfig(
+        n_replicas=1, spool=str(spool), poll_s=0.03, pulse_s=0.0,
+        backoff_s=0.05,
+        replica=ServeConfig(batch_window_s=0.1, max_batch=4, poll_s=0.05,
+                            span_chunks=1)))
+    with fl:
+        assert fl.wait_ready(timeout=180) == 1
+        rid = client_mod.submit(str(spool), client_mod.build_payload(
+            "GC", init={"sizes": SIZES, "seed": 3},
+            overrides=dict(OVERRIDES), span=(0, 16)))
+        rec = fl.wait(rid, timeout=300)
+        assert rec is not None and rec["status"] == "done", rec
+    stray = [os.path.join(root, f)
+             for root, _dirs, files in os.walk(str(spool))
+             for f in files
+             if f.startswith("trace.") and f.endswith(".jsonl")]
+    assert stray == [], stray
