@@ -56,7 +56,7 @@ def _probe_ok() -> bool:
         return False
 
 
-def main(trace_out=None, heartbeat_s: float = 0.0) -> None:
+def main(trace_out=None, heartbeat_s: float = 0.0, xprof_dir=None) -> None:
     import os
 
     if not os.environ.get("FAIRIFY_TPU_BENCH_FALLBACK") and not _probe_ok():
@@ -69,6 +69,8 @@ def main(trace_out=None, heartbeat_s: float = 0.0) -> None:
             cmd += ["--trace-out", trace_out]
         if heartbeat_s:
             cmd += ["--heartbeat-interval", str(heartbeat_s)]
+        if xprof_dir:
+            cmd += ["--xprof-dir", xprof_dir]
         raise SystemExit(subprocess.run(cmd, env=env).returncode)
 
     import numpy as np
@@ -136,9 +138,15 @@ def main(trace_out=None, heartbeat_s: float = 0.0) -> None:
         shutil.rmtree(cfg.result_dir, ignore_errors=True)
         obs.registry().reset()
         t0 = time.perf_counter()
+        # Only the LAST repeat is traced (obs + XProf): one run per event
+        # log keeps the report's phase totals honest, and one profiler
+        # capture keeps the XProf artifact small enough to load.
+        last = rep_i == BENCH_REPEATS - 1
         tracing = obs.tracing(trace_out, run_id="bench-GC-1") \
-            if rep_i == BENCH_REPEATS - 1 else contextlib.nullcontext()
-        with tracing:
+            if last else contextlib.nullcontext()
+        from fairify_tpu.utils import profiling as profiling_mod
+
+        with tracing, profiling_mod.xla_trace(xprof_dir if last else None):
             rep = sweep.verify_model(net, cfg, model_name="GC-1", resume=False)
         elapsed = time.perf_counter() - t0
         if report is not None and rep.counts != report.counts:
@@ -163,6 +171,7 @@ def main(trace_out=None, heartbeat_s: float = 0.0) -> None:
             run_rec["launches_in_flight_mean"] = thr.get("launches_in_flight_mean")
             run_rec["compile_s"] = thr.get("compile_s")
             run_rec["n_compiles"] = thr.get("n_compiles")
+            run_rec["decided_fraction"] = thr.get("decided_fraction")
         except (OSError, ValueError):
             pass
         runs.append(run_rec)
@@ -194,6 +203,9 @@ def main(trace_out=None, heartbeat_s: float = 0.0) -> None:
         "warmup_n_compiles": warm_compile["n_compiles"],
         "compile_s": median_run.get("compile_s"),
         "n_compiles": median_run.get("n_compiles"),
+        # Funnel success metric (obs.funnel, perfdiff-gated HIGHER is
+        # better): decided partitions over classified partitions.
+        "decided_fraction": median_run.get("decided_fraction"),
     }))
 
 
@@ -272,6 +284,8 @@ def _ladder_configs() -> None:
         launches = profiling.launch_count() - launch0
         decided = int(sum((u | s).sum() for fam in fams for u, s, _ in fam))
         ac_runs.append({"value": round(decided / dt, 1),
+                        "decided_fraction": round(
+                            decided / (len(names) * lo.shape[0]), 6),
                         "elapsed_s": round(dt, 3),
                         "device_launches": launches,
                         "launches_per_model": round(
@@ -290,6 +304,7 @@ def _ladder_configs() -> None:
         "min": lo_v,
         "max": hi_v,
         "runs": ac_runs,
+        "decided_fraction": ac_runs[-1]["decided_fraction"],
         "pipeline_depth": cfg.pipeline_depth,
         "device_launches": ac_runs[-1]["device_launches"],
         "launches_per_model": ac_runs[-1]["launches_per_model"],
@@ -321,7 +336,8 @@ def _ladder_configs() -> None:
             b_runs.append({"value": row["decided_per_sec"],
                            "elapsed_s": row["total_time_s"],
                            "attempted": row["attempted"],
-                           "unknown": row["unknown"]})
+                           "unknown": row["unknown"],
+                           "decided_fraction": row["decided_fraction"]})
         pps, lo_v, hi_v = _median_band(b_runs)
         print(json.dumps({
             "metric": f"{preset}_budgeted_decided_partitions_per_sec "
@@ -336,6 +352,9 @@ def _ladder_configs() -> None:
             "min": lo_v,
             "max": hi_v,
             "runs": b_runs,
+            # Over the FULL grid: the unattempted tail counts against the
+            # fraction as unknown:budget (reference Cov% semantics).
+            "decided_fraction": row["decided_fraction"],
         }), flush=True)
 
 
@@ -345,5 +364,7 @@ if __name__ == "__main__":
     _ap = argparse.ArgumentParser()
     _ap.add_argument("--trace-out", default=None)
     _ap.add_argument("--heartbeat-interval", type=float, default=0.0)
+    _ap.add_argument("--xprof-dir", default=None)
     _a = _ap.parse_args()
-    sys.exit(main(trace_out=_a.trace_out, heartbeat_s=_a.heartbeat_interval))
+    sys.exit(main(trace_out=_a.trace_out, heartbeat_s=_a.heartbeat_interval,
+                  xprof_dir=_a.xprof_dir))

@@ -214,8 +214,11 @@ def _certify_attack_args(w: AnalysisWorld, lo, hi, alpha_iters: int):
 
 def _mega_stage0_args(w: AnalysisWorld, seg, first, alpha_iters: int):
     x_lo, x_hi, xp_lo, xp_hi, plo, phi, valid, xr, pr = seg
+    # Per-chunk real-row counts (the funnel-statistics padding mask);
+    # the analysis segments are 2 full chunks of B rows each.
+    nv = np.full(plo.shape[0], plo.shape[1], np.int32)
     return ((first, x_lo, x_hi, xp_lo, xp_hi, plo, phi, w.assign_vals,
-             w.pa_mask, w.ra_mask, w.eps, valid, w.vp, xr, pr),
+             w.pa_mask, w.ra_mask, w.eps, valid, w.vp, xr, pr, nv),
             {"alpha_iters": alpha_iters})
 
 
@@ -366,7 +369,9 @@ def kernel_specs() -> Dict[str, KernelSpec]:
             expected_signatures=1),
         KernelSpec(
             "pruning.mega_sim_and_bounds",
-            lambda w: ((w.net, w.mkeys, w.mega_seg[4], w.mega_seg[5]),
+            lambda w: ((w.net, w.mkeys, w.mega_seg[4], w.mega_seg[5],
+                        np.full(w.mega_seg[4].shape[0],
+                                w.mega_seg[4].shape[1], np.int32)),
                        {"sim_size": w.sim_size}),
             dead_ok=(_NET_FINAL_MASK,),
             expected_signatures=1),

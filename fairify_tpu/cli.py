@@ -73,6 +73,8 @@ def _overridden_cfg(args):
         overrides["partition_metrics"] = True
     if getattr(args, "trace_out", None):
         overrides["trace_out"] = args.trace_out
+    if getattr(args, "xprof_dir", None):
+        overrides["profile_dir"] = args.xprof_dir
     if getattr(args, "heartbeat_interval", None) is not None:
         overrides["heartbeat_s"] = float(args.heartbeat_interval)
     if getattr(args, "pipeline_depth", None) is not None:
@@ -185,7 +187,8 @@ def _cmd_bench(args) -> int:
     import bench
 
     bench.main(trace_out=getattr(args, "trace_out", None),
-               heartbeat_s=float(getattr(args, "heartbeat_interval", None) or 0.0))
+               heartbeat_s=float(getattr(args, "heartbeat_interval", None) or 0.0),
+               xprof_dir=getattr(args, "xprof_dir", None))
     return 0
 
 
@@ -210,7 +213,7 @@ def _cmd_report(args) -> int:
         print("report: give event logs or --trace-dir", file=sys.stderr)
         return 2
     return report.main(logs, json_out=args.json_out, as_json=args.json,
-                       trace_dir=args.trace_dir)
+                       trace_dir=args.trace_dir, funnel=args.funnel)
 
 
 def _cmd_experiment(args) -> int:
@@ -296,7 +299,8 @@ def _cmd_serve(args) -> int:
         max_queue=args.max_queue, preempt_factor=args.preempt_factor,
         fair_share_factor=args.fair_share,
         fair_share_idle_exempt=not args.fair_share_strict,
-        exec_cache=exec_cache, trace_dir=args.trace_dir)
+        exec_cache=exec_cache, trace_dir=args.trace_dir,
+        xprof_dir=args.xprof_dir)
     stop = threading.Event()
 
     def _sig(_signum, _frame):
@@ -488,6 +492,10 @@ def main(argv=None) -> int:
     run.add_argument("--trace-out", default=None,
                      help="write a JSONL span/event log here plus a Chrome "
                           "trace alongside (<path>.chrome.json)")
+    run.add_argument("--xprof-dir", default=None, metavar="DIR",
+                     help="capture an XLA profiler trace of the device "
+                          "phases here (TensorBoard/XProf; device-timeline "
+                          "annotations share the obs span names)")
     run.add_argument("--pipeline-depth", type=int, default=None,
                      help="async launch pipeline depth (chunk launches kept "
                           "in flight; 1 = synchronous, default 2)")
@@ -538,6 +546,9 @@ def main(argv=None) -> int:
                      help="JSONL span/event log for the timed headline run")
     ben.add_argument("--heartbeat-interval", type=float, default=None,
                      help="stderr progress line every N seconds (0 = off)")
+    ben.add_argument("--xprof-dir", default=None, metavar="DIR",
+                     help="capture an XLA profiler trace of the final timed "
+                          "headline repeat here (TensorBoard/XProf)")
 
     rpt = sub.add_parser(
         "report", help="aggregate --trace-out event logs into phase/verdict/"
@@ -547,6 +558,11 @@ def main(argv=None) -> int:
                      help="print the aggregate as one JSON line instead of tables")
     rpt.add_argument("--json-out", default=None,
                      help="also write the aggregate JSON to this file")
+    rpt.add_argument("--funnel", action="store_true",
+                     help="also print the verification-funnel tables: "
+                          "terminal-state counts, stage-0 margin/gap "
+                          "histograms, per-layer bound-looseness "
+                          "attribution (DESIGN.md §20)")
     rpt.add_argument("--trace-dir", default=None,
                      help="fleet trace-shard directory (serve --trace-dir): "
                           "merges every trace.<pid>.jsonl into one Perfetto "
@@ -674,6 +690,9 @@ def main(argv=None) -> int:
                           "here; `fairify_tpu report --trace-dir DIR` "
                           "merges them into one Perfetto timeline with "
                           "per-request critical paths")
+    srv.add_argument("--xprof-dir", default=None, metavar="DIR",
+                     help="capture XLA profiler traces of every request's "
+                          "device phases here (TensorBoard/XProf)")
     srv.add_argument("--smt-workers", type=int, default=1,
                      help="server-wide SMT worker pool size shared by every "
                           "SMT-enabled request (default 1)")

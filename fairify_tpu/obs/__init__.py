@@ -75,8 +75,16 @@ def timed_span(timer, name: str, **attrs):
     stage-0 share) runs off ``PhaseTimer`` totals whether or not tracing is
     enabled; this keeps that always-on accounting and the optional event
     log in one instrumentation point.
+
+    While an XLA profiler capture is open (``--xprof-dir`` →
+    ``utils.profiling.xla_trace``) the phase also stamps the device
+    timeline with a ``TraceAnnotation`` of the SAME name, so the XProf
+    view and the Perfetto span view join on shared phase names; untraced
+    runs pay one integer check.
     """
-    with span(name, **attrs) as sp:
+    from fairify_tpu.utils import profiling as _profiling
+
+    with _profiling.annotation(name), span(name, **attrs) as sp:
         if timer is None:
             yield sp
         else:

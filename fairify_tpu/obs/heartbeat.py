@@ -121,6 +121,11 @@ class Heartbeat:
         reg = metrics_mod.registry()
         self._retries0 = reg.counter("launch_retries").total()
         self._degraded0 = reg.counter("chunks_degraded").total()
+        # Funnel baselines: the beat's decided k/N (f%) segment reads the
+        # mirrored ``funnel_states`` counter (obs.funnel), which is
+        # process-cumulative like the fault counters above.
+        self._funnel0_total = reg.counter("funnel_states").total()
+        self._funnel0_decided = self._funnel_decided()
         self._last_attempted: Optional[int] = None
         self._last_segment: Optional[float] = None
         self._rate_ema: Optional[float] = None
@@ -137,6 +142,12 @@ class Heartbeat:
     @staticmethod
     def _launches() -> float:
         return metrics_mod.registry().counter("device_launches").total()
+
+    @staticmethod
+    def _funnel_decided() -> int:
+        from fairify_tpu.obs import funnel as funnel_mod
+
+        return funnel_mod.live_decided()
 
     def compile_started(self, kernel: str) -> None:
         """One line flagging an XLA compile in progress.
@@ -210,10 +221,23 @@ class Heartbeat:
                          f"({100.0 * attempted / self.total:.1f}%)")
         else:
             parts.append(f"{attempted} attempted")
-        parts.append(f"| {decided} decided, {unknown} unknown")
+        reg = metrics_mod.registry()
+        # Live funnel segment (obs.funnel): once partitions start reaching
+        # terminal states the mirrored ``funnel_states`` counter drives the
+        # decided line — k/N over CLASSIFIED partitions with the decided
+        # fraction, the run's success metric.  Before any classification
+        # (stage-0 still in flight) the caller-passed counts stand in.
+        f_total = int(reg.counter("funnel_states").total()
+                      - self._funnel0_total)
+        if f_total > 0:
+            f_dec = self._funnel_decided() - self._funnel0_decided
+            parts.append(f"| decided {f_dec}/{f_total} "
+                         f"({100.0 * f_dec / f_total:.1f}%), "
+                         f"{f_total - f_dec} unknown")
+        else:
+            parts.append(f"| {decided} decided, {unknown} unknown")
         parts.append(f"| {pps:.2f} pps")
         parts.append(f"| +{d_launch} launches")
-        reg = metrics_mod.registry()
         retries = int(reg.counter("launch_retries").total() - self._retries0)
         degr = int(reg.counter("chunks_degraded").total() - self._degraded0)
         if retries or degr:

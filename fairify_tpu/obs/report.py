@@ -100,6 +100,9 @@ def aggregate(paths: Iterable[str]) -> dict:
     smt_outcomes: Dict[str, int] = {}  # decided / per-reason query counts
     lock_edges: Dict[tuple, int] = {}  # (src site, dst site) -> count
     segments: Dict[str, dict] = {}  # mega-loop phase -> done/total row
+    funnel_hist = None              # summed margin/gap histogram payload
+    funnel_loos: Dict[str, list] = {}  # model -> per-layer looseness sums
+    funnel_event_states: Dict[str, int] = {}  # fallback when no verdicts
     for path in paths:
         files += 1
         records, skipped = trace_mod.load_events(path, count_skipped=True)
@@ -203,6 +206,40 @@ def aggregate(paths: Iterable[str]) -> dict:
                 row["done"] = int(attrs.get("done", row["done"]))
                 row["total"] = int(attrs.get("total", row["total"]))
                 row["partitions"] += int(attrs.get("partitions", 0))
+            elif rtype == "event" and rec.get("name") == "funnel":
+                # Funnel telemetry (obs.funnel, DESIGN.md §20): one event
+                # per model run carrying terminal-state counts plus the
+                # stage-0 margin/gap histograms and per-layer looseness.
+                # Serve additionally emits a per-REQUEST event (tagged with
+                # a ``request`` attr) that aggregates the same sub-runs —
+                # skipped here so nothing double counts.
+                attrs = rec.get("attrs", {})
+                if attrs.get("request") is not None:
+                    continue
+                for s, n in (attrs.get("states") or {}).items():
+                    funnel_event_states[s] = \
+                        funnel_event_states.get(s, 0) + int(n)
+                mh = attrs.get("margin_hist")
+                if mh:
+                    if funnel_hist is None:
+                        funnel_hist = {"edges": list(mh["edges"]),
+                                       "margin": [0] * len(mh["margin"]),
+                                       "gap": [0] * len(mh["gap"])}
+                    funnel_hist["margin"] = [
+                        a + int(b) for a, b in
+                        zip(funnel_hist["margin"], mh["margin"])]
+                    funnel_hist["gap"] = [
+                        a + int(b)
+                        for a, b in zip(funnel_hist["gap"], mh["gap"])]
+                lo = attrs.get("looseness")
+                if lo is not None:
+                    model = str(attrs.get("model", "?"))
+                    prev = funnel_loos.get(model)
+                    if prev is None or len(prev) != len(lo):
+                        funnel_loos[model] = [float(v) for v in lo]
+                    else:
+                        funnel_loos[model] = [a + float(v)
+                                              for a, v in zip(prev, lo)]
             elif rtype == "event" and rec.get("name") == "verdict":
                 attrs = rec.get("attrs", {})
                 if attrs.get("verdict") not in ("sat", "unsat", "unknown"):
@@ -262,9 +299,20 @@ def aggregate(paths: Iterable[str]) -> dict:
     via: Dict[str, int] = {}
     degraded: Dict[str, int] = {}  # failure reason -> partition count
     shards: Dict[str, dict] = {}   # per-shard verdict/degradation rows
+    funnel_states: Dict[str, int] = {}
+    from fairify_tpu.obs import funnel as funnel_mod
+
     for attrs in list(keyed.values()) + anon:
         v = attrs["verdict"]
         verdicts[v] += 1
+        # Terminal funnel state per (deduped) partition: the last-wins
+        # dedup above means an SMT-superseded provisional UNKNOWN is
+        # classified from its FINAL verdict event, which the in-run
+        # FunnelCounts tally cannot do.
+        state = funnel_mod.classify(
+            v, str(attrs.get("via", "?")), failure=attrs.get("failure"),
+            engine_reason=attrs.get("engine_reason"))
+        funnel_states[state] = funnel_states.get(state, 0) + 1
         models.setdefault(attrs.get("model", "?"),
                           {"sat": 0, "unsat": 0, "unknown": 0})[v] += 1
         if v != "unknown":  # the breakdown is of DECIDED partitions
@@ -339,6 +387,19 @@ def aggregate(paths: Iterable[str]) -> dict:
         "lock_edges": [{"src": s, "dst": d, "count": n}
                        for (s, d), n in sorted(lock_edges.items())],
         "segments": {k: segments[k] for k in sorted(segments)},
+        # Funnel block: states from the deduped verdict events when any
+        # exist (they carry SMT supersession and retry re-decisions);
+        # funnel-event sums cover logs with no per-partition events (e.g.
+        # a budgeted ladder's unattempted ``unknown:budget`` tail).
+        "funnel": {
+            "states": dict(sorted((funnel_states or
+                                   funnel_event_states).items())),
+            "decided_fraction": round(funnel_mod.decided_fraction(
+                funnel_states or funnel_event_states), 6),
+            "margin_hist": funnel_hist,
+            "looseness": {k: [round(v, 3) for v in funnel_loos[k]]
+                          for k in sorted(funnel_loos)},
+        },
         "models": models,
         "device_launches": int(launches),
         "launches_in_flight_max": int(inflight_max),
@@ -613,8 +674,63 @@ def render(agg: dict) -> str:
     return "\n".join(lines)
 
 
+def _bucket_labels(edges: List[float]) -> List[str]:
+    """Human-readable bucket ranges for the fixed-edge funnel histograms
+    (bucket rule ``idx = Σ (v >= edge)`` — see obs.funnel.EDGES)."""
+    labels = [f"< {edges[0]:g}"]
+    for i in range(1, len(edges)):
+        labels.append(f"[{edges[i - 1]:g}, {edges[i]:g})")
+    labels.append(f">= {edges[-1]:g}")
+    return labels
+
+
+def render_funnel(agg: dict) -> str:
+    """``--funnel`` tables: where do boxes die? (DESIGN.md §20)
+
+    Terminal-state counts with shares, the stage-0 certified-margin /
+    attack-gap histograms, and per-layer bound-looseness attribution per
+    model (which layer's interval widths the certificates are losing to).
+    """
+    from fairify_tpu.obs import funnel as funnel_mod
+
+    fun = agg.get("funnel") or {}
+    states = fun.get("states") or {}
+    if not states and not fun.get("margin_hist") and not fun.get("looseness"):
+        return "no funnel telemetry in these logs"
+    lines: List[str] = []
+    if states:
+        order = {s: i for i, s in enumerate(funnel_mod.STATES)}
+        total = sum(states.values())
+        w = max(max(len(s) for s in states), len("funnel state"))
+        lines.append(f"{'funnel state':<{w}}  {'partitions':>10}  {'share':>7}")
+        for s in sorted(states, key=lambda s: (order.get(s, len(order)), s)):
+            lines.append(f"{s:<{w}}  {states[s]:>10}  "
+                         f"{100.0 * states[s] / total:>6.1f}%")
+        lines.append(f"decided fraction: "
+                     f"{fun.get('decided_fraction', 0.0):.4f}  "
+                     f"(of {total} classified partitions)")
+    mh = fun.get("margin_hist")
+    if mh:
+        labels = _bucket_labels(mh["edges"])
+        w = max(max(len(lb) for lb in labels), len("stage-0 bucket"))
+        lines.append("")
+        lines.append(f"{'stage-0 bucket':<{w}}  {'cert margin':>11}  "
+                     f"{'attack gap':>10}")
+        for lbl, m, g in zip(labels, mh["margin"], mh["gap"]):
+            if m or g:  # all-empty rows add noise, not information
+                lines.append(f"{lbl:<{w}}  {m:>11}  {g:>10}")
+    for model, per in (fun.get("looseness") or {}).items():
+        tot = sum(per) or 1.0
+        lines.append("")
+        lines.append(f"bound looseness {model} "
+                     f"(Σ pre-activation ub−lb per layer)")
+        for i, v in enumerate(per):
+            lines.append(f"  layer {i}: {v:>14.3f}  ({100.0 * v / tot:.1f}%)")
+    return "\n".join(lines)
+
+
 def main(paths: List[str], json_out: str = None, as_json: bool = False,
-         trace_dir: str = None) -> int:
+         trace_dir: str = None, funnel: bool = False) -> int:
     """CLI body for ``fairify_tpu report`` (returns an exit code)."""
     import os
     import sys
@@ -641,6 +757,9 @@ def main(paths: List[str], json_out: str = None, as_json: bool = False,
         print(json.dumps(agg))
     else:
         print(render(agg))
+        if funnel:
+            print()
+            print(render_funnel(agg))
         if agg.get("critical_paths"):
             print()
             print(render_critical_paths(agg["critical_paths"]))

@@ -164,4 +164,12 @@ class VerifyRequest:
         if self.report is not None:
             rec.update(self.report.counts)
             rec["degraded"] = self.report.degraded
+            fun = getattr(self.report, "funnel", None)
+            if fun:
+                # Funnel telemetry (obs.funnel, DESIGN.md §20): the state
+                # counts and decided fraction ride the journal/status
+                # records; histograms stay on the funnel event.
+                rec["funnel"] = fun.get("states", {})
+                rec["decided_fraction"] = round(
+                    float(fun.get("decided_fraction", 0.0)), 6)
         return rec

@@ -64,6 +64,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from fairify_tpu import obs
+from fairify_tpu.obs import funnel as funnel_mod
 from fairify_tpu.obs import trace as trace_mod
 from fairify_tpu.resilience import faults as faults_mod
 from fairify_tpu.resilience.journal import JournalWriter
@@ -200,6 +201,11 @@ class ServeConfig:
     # main) activates the shard; this only propagates the directory to
     # the next process boundary down.
     trace_dir: Optional[str] = None
+    # XLA profiler capture directory (``--xprof-dir``): every request's
+    # device phases run inside ``jax.profiler.trace(xprof_dir)`` via the
+    # sweep's ``profile_dir`` (utils.profiling.xla_trace), stamping the
+    # device timeline with the obs span names.  None = no capture.
+    xprof_dir: Optional[str] = None
 
 
 class VerificationServer:
@@ -914,6 +920,12 @@ class VerificationServer:
             req.deadline_missed = True
             registry.counter("serve_deadline_miss").inc(stage="run")
         registry.counter("serve_requests").inc(status=DONE)
+        fun = getattr(report, "funnel", None)
+        if fun:
+            # One funnel event per REQUEST (DESIGN.md §20) — the request-
+            # granular sibling of the sweep's per-model-run event, keyed by
+            # the request id so report consumers can tell the two apart.
+            obs.event("funnel", request=req.id, model=req.model_name, **fun)
         self.admission.finished(req, partitions=req.partitions,
                                 elapsed_s=req.run_s)
         if sp is not None:
@@ -927,6 +939,11 @@ class VerificationServer:
         from fairify_tpu.verify import sweep as sweep_mod
 
         cfg = req.cfg
+        if self.cfg.xprof_dir and not cfg.profile_dir:
+            # --xprof-dir: the sweep wraps its device phases in
+            # jax.profiler.trace(profile_dir); a request carrying its own
+            # profile_dir keeps it.
+            cfg = cfg.with_(profile_dir=self.cfg.xprof_dir)
         if deadline_left is not None:
             # The SLA bounds refinement spend the same way the hard budget
             # does; the sweep's own budget honesty enforces it per phase.
@@ -1023,6 +1040,7 @@ class VerificationServer:
             partitions_total=attempted, sink_name=sink,
             ledger_skipped_lines=sum(r.ledger_skipped_lines for r in reports),
             degraded=sum(r.degraded for r in reports),
+            funnel=funnel_mod.merge_payloads(r.funnel for r in reports),
         )
 
     def _fair_share(self, req: VerifyRequest) -> Optional[float]:

@@ -16,16 +16,67 @@ from dataclasses import dataclass, field
 from typing import Dict, Optional
 
 
+# Nesting depth of open xla_trace scopes: device annotations below are
+# emitted only while a trace is actually being captured, so the annotation
+# helpers stay zero-cost (one int check, no jax import) on untraced runs.
+_xprof_depth = 0
+
+
 @contextlib.contextmanager
 def xla_trace(trace_dir: Optional[str]):
-    """Wrap a region in a jax profiler trace when ``trace_dir`` is set."""
+    """Wrap a region in a jax profiler trace when ``trace_dir`` is set.
+
+    The same switch feeds ``--xprof-dir`` on ``bench``, ``cli run`` and
+    ``serve`` (via ``SweepConfig.profile_dir``): while a trace is open,
+    :func:`annotation` / :func:`annotate_step` stamp the XLA timeline with
+    the obs span names, so the XProf view joins the Perfetto merge story
+    on shared names (DESIGN.md §20)."""
+    global _xprof_depth
     if not trace_dir:
         yield
         return
     import jax
 
     with jax.profiler.trace(trace_dir):
+        _xprof_depth += 1
+        try:
+            yield
+        finally:
+            _xprof_depth -= 1
+
+
+def xprof_active() -> bool:
+    """True while an :func:`xla_trace` capture is open."""
+    return _xprof_depth > 0
+
+
+@contextlib.contextmanager
+def annotation(name: str):
+    """``jax.profiler.TraceAnnotation`` named after an obs span.
+
+    No-op (one int check) unless an :func:`xla_trace` capture is open —
+    callers annotate unconditionally and only traced runs pay."""
+    if _xprof_depth <= 0:
         yield
+        return
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def annotate_step(name: str, step, fn):
+    """Run ``fn()`` under a ``StepTraceAnnotation(name, step_num=step)``.
+
+    The step-granular variant of :func:`annotation` for launch-loop bodies
+    (one step per segment/chunk submit), callable from inside the launch
+    pipeline's submit lambdas.  Same zero-cost-when-untraced contract."""
+    if _xprof_depth <= 0:
+        return fn()
+    import jax
+
+    with jax.profiler.StepTraceAnnotation(name, step_num=int(step)):
+        return fn()
 
 
 # Device-launch accounting.  On the tunnelled single-chip setup every
@@ -110,8 +161,23 @@ class ThroughputCounter:
     def dump(self, path: str, phases: Optional[Dict[str, float]] = None,
              pipeline: Optional[Dict[str, float]] = None,
              compile: Optional[Dict[str, float]] = None,
-             resilience: Optional[Dict[str, float]] = None) -> None:
+             resilience: Optional[Dict[str, float]] = None,
+             funnel: Optional[dict] = None) -> None:
         out = self.summary()
+        if funnel:
+            # Verification-funnel block (obs.funnel, DESIGN.md §20):
+            # terminal-state counts summing to the grid size, the decided
+            # fraction (ROADMAP item-1's success metric — perfdiff gates it
+            # higher-is-better), the fixed-bucket margin/gap histograms and
+            # the prune pass's per-layer bound-looseness sums.
+            out["decided_fraction"] = round(
+                float(funnel.get("decided_fraction", 0.0)), 6)
+            out["funnel"] = funnel.get("states", {})
+            if funnel.get("margin_hist"):
+                out["margin_hist"] = funnel["margin_hist"]
+            if funnel.get("looseness") is not None:
+                out["looseness"] = [round(float(v), 3)
+                                    for v in funnel["looseness"]]
         if resilience and any(resilience.values()):
             # Fault record (resilience/): partitions degraded to UNKNOWN by
             # runtime faults, retries spent, torn resume-ledger lines — all

@@ -136,10 +136,15 @@ def _certify_impl(net: MLP, x_lo, x_hi, xp_lo, xp_hi, lo, hi, assign_vals,
     the separate-bound kills of :func:`no_flip_certified`; the output linear
     forms additionally give tied pair-difference kills per direction.  A box
     is certified iff every valid assignment pair has both flip directions
-    killed by either mechanism.  Returns ``(certified (B,), score (B, d))``
-    where ``score`` is the max difference-form coefficient magnitude per
-    shared dim — the input-split analog of bound-improvement branching
-    (splitting dim j tightens the difference bound by ~score_j·width_j/2).
+    killed by either mechanism.  Returns ``(certified (B,), score (B, d),
+    margin (B,))`` where ``score`` is the max difference-form coefficient
+    magnitude per shared dim — the input-split analog of bound-improvement
+    branching (splitting dim j tightens the difference bound by
+    ~score_j·width_j/2) — and ``margin`` is the certified margin: the min
+    over valid pairs of each pair's kill slack, with ``margin >= 0 ⟺
+    certified`` EXACTLY (every clause mirrors one of the ``*_dead``
+    comparisons).  The margin's distribution is the funnel telemetry's
+    "how close were the bounds" signal (obs.funnel, DESIGN.md §20).
     """
     sets_x, lb_x, ub_x = crown_ops.crown_output_form_sets(
         net, x_lo, x_hi, alpha_iters)
@@ -181,7 +186,19 @@ def _certify_impl(net: MLP, x_lo, x_hi, xp_lo, xp_hi, lo, hi, assign_vals,
 
     pair_ok = valid_pair[None] & valid[..., :, None] & valid[..., None, :]
     possible = pair_ok & ~(t1_dead & t2_dead)
-    return ~possible.any(axis=(-2, -1)), score
+    # Certified margin: a pair's direction-1 kill slack is
+    # max(-ub_x[a], lb_p[b], -ub1[a,b]) (>= 0 ⟺ t1_dead), direction 2
+    # symmetric, and a pair is killed iff BOTH directions are (min); the box
+    # margin is the min over valid pairs.  Invalid pairs — and boxes with no
+    # valid pair at all — saturate to +FLT_MAX (the histogram's top bucket),
+    # never to an arithmetic ±inf that could contaminate reductions.
+    m1 = jnp.maximum(jnp.maximum(-ub_x[..., :, None], lb_p[..., None, :]),
+                     -ub1)
+    m2 = jnp.maximum(jnp.maximum(lb_x[..., :, None], -ub_p[..., None, :]),
+                     -ub2)
+    big = jnp.asarray(jnp.finfo(m1.dtype).max, m1.dtype)
+    margin = jnp.where(pair_ok, jnp.minimum(m1, m2), big).min(axis=(-2, -1))
+    return ~possible.any(axis=(-2, -1)), score, margin
 
 
 _role_certify_kernel = obs_jit(_certify_impl, name="engine.role_certify",
@@ -223,6 +240,48 @@ def _find_flips_dev(lx, lp, valid, valid_pair):
     return _find_flips_impl(jnp, lx, lp, valid, valid_pair)
 
 
+def _attack_gap_impl(xp, lx, lp, valid, valid_pair):
+    """Best strict-flip gap over all (sample, pair) — backend-agnostic.
+
+    Per (s, a, b): direction-1 flip needs ``lx[s,a] > 0 and lp[s,b] < 0``,
+    i.e. ``min(lx[s,a], -lp[s,b]) > 0``; direction 2 symmetric.  The box gap
+    is the max over valid masked triples, so ``gap > 0 ⟺ found`` EXACTLY
+    (the strict signs of :func:`_find_flips_impl`).  Masked-out triples
+    saturate to -FLT_MAX — a box with no valid pair lands in the bottom
+    histogram bucket.  Feeds the funnel telemetry's attack-gap histogram
+    (obs.funnel, DESIGN.md §20)."""
+    neg = xp.float32(np.finfo(np.float32).min) if xp is np \
+        else jnp.asarray(jnp.finfo(lx.dtype).min, lx.dtype)
+    pair = (valid[:, None, :, None] & valid[:, None, None, :]
+            & valid_pair[None, None])
+    g1 = xp.minimum(lx[..., :, None], -lp[..., None, :])
+    g2 = xp.minimum(-lx[..., :, None], lp[..., None, :])
+    return xp.where(pair, xp.maximum(g1, g2), neg).max(axis=(-3, -2, -1))
+
+
+def attack_gap(lx, lp, valid, valid_pair) -> np.ndarray:
+    """Host mirror of the device attack gap (numpy logits, (B,) f32)."""
+    return _attack_gap_impl(np, np.asarray(lx, np.float32),
+                            np.asarray(lp, np.float32),
+                            np.asarray(valid, bool),
+                            np.asarray(valid_pair, bool))
+
+
+def role_bound_margin(lb_x, ub_x, lb_p, ub_p, valid, valid_pair) -> np.ndarray:
+    """Certified margin from role logit bounds alone (the IBP path's host
+    analog of ``_certify_impl``'s margin: no tied pair-difference term).
+    ``margin >= 0 ⟺ no_flip_certified`` exactly."""
+    lb_x, ub_x, lb_p, ub_p = (np.asarray(v, np.float32)
+                              for v in (lb_x, ub_x, lb_p, ub_p))
+    m1 = np.maximum(-ub_x[..., :, None], lb_p[..., None, :])
+    m2 = np.maximum(lb_x[..., :, None], -ub_p[..., None, :])
+    va = np.asarray(valid, bool)
+    pair_ok = (np.asarray(valid_pair, bool)
+               & va[..., :, None] & va[..., None, :])
+    big = np.float32(np.finfo(np.float32).max)
+    return np.where(pair_ok, np.minimum(m1, m2), big).min(axis=(-2, -1))
+
+
 def _certify_attack_impl(net: MLP, x_lo, x_hi, xp_lo, xp_hi, lo, hi,
                          assign_vals, pa_mask, ra_mask, eps, valid, valid_pair,
                          xr, pr, alpha_iters: int):
@@ -234,13 +293,16 @@ def _certify_attack_impl(net: MLP, x_lo, x_hi, xp_lo, xp_hi, lo, hi,
     MXU time and removes a whole launch per iteration/chunk, and returning
     only ``(found, wit)`` instead of the logits removes the dominant
     device→host transfer (attack candidates stay host-built, so witness
-    extraction needs no pull)."""
-    cert, score = _certify_impl(net, x_lo, x_hi, xp_lo, xp_hi, lo, hi,
-                                assign_vals, pa_mask, ra_mask, eps, valid,
-                                valid_pair, alpha_iters)
+    extraction needs no pull).  Also returns the per-box certified margin
+    and attack gap — two (B,) floats for the funnel histograms, microseconds
+    of extra reduction over tensors the kernel already materializes."""
+    cert, score, margin = _certify_impl(net, x_lo, x_hi, xp_lo, xp_hi, lo, hi,
+                                        assign_vals, pa_mask, ra_mask, eps,
+                                        valid, valid_pair, alpha_iters)
     lx, lp = _attack_logits(net, xr, pr)
     found, wit = _find_flips_dev(lx, lp, valid, valid_pair)
-    return cert, score, found, wit
+    gap = _attack_gap_impl(jnp, lx, lp, valid, valid_pair)
+    return cert, score, found, wit, margin, gap
 
 
 _certify_attack_kernel = obs_jit(_certify_attack_impl,
@@ -1081,11 +1143,14 @@ class Decision:
     elapsed_s: float = 0.0
     stats: dict = field(default_factory=dict)
     # Why an 'unknown' root stayed unknown: 'deadline' (the batch budget
-    # tripped with sub-boxes still open — more time may decide it) or
-    # 'frontier' (the box survived every phase at full budget).  None for
-    # decided roots.  Surfaced as the `engine_reason` attr on the sweep's
-    # unknown verdict events, so budget-vs-hardness reads off the event
-    # log (the deep-retry harnesses re-attempt both kinds today).
+    # tripped with sub-boxes still open — more time may decide it),
+    # 'budget' (the per-root node budget ran out — more nodes may decide
+    # it), or 'frontier' (the box survived every phase at full budget:
+    # genuinely hard).  None for decided roots.  Surfaced as the
+    # `engine_reason` attr on the sweep's unknown verdict events and as
+    # the funnel's `unknown:*` states (obs.funnel), so budget-vs-hardness
+    # reads off the event log (the deep-retry harnesses re-attempt all
+    # kinds today).
     reason: Optional[str] = None
 
 
@@ -1417,7 +1482,8 @@ def decide_many(
                 # bill (VERDICT r4 #3).
                 xr, pr = build_attack_candidates(enc, rng, _pad(blo, F),
                                                  _pad(bhi, F), cfg.bab_attack_samples)
-                cert_dev, score_dev, found_dev, wit_dev = _certify_attack_kernel(
+                (cert_dev, score_dev, found_dev, wit_dev,
+                 _margin_dev, _gap_dev) = _certify_attack_kernel(
                     bound_net, jnp.asarray(x_lo), jnp.asarray(x_hi),
                     jnp.asarray(xp_lo), jnp.asarray(xp_hi),
                     jnp.asarray(plo_in), jnp.asarray(phi_in),
@@ -1431,7 +1497,7 @@ def decide_many(
                 score = np.asarray(score_dev)[:F]
                 found_all, wit_all = np.asarray(found_dev), np.asarray(wit_dev)
             elif cfg.use_crown:
-                cert_dev, score_dev = _role_certify_kernel(
+                cert_dev, score_dev, _margin_dev = _role_certify_kernel(
                     bound_net, jnp.asarray(x_lo), jnp.asarray(x_hi),
                     jnp.asarray(xp_lo), jnp.asarray(xp_hi),
                     jnp.asarray(plo_in), jnp.asarray(phi_in),
@@ -1488,7 +1554,11 @@ def decide_many(
                     if verdicts[r] is not None:
                         continue
                     if nodes[r] > cfg.max_nodes:
-                        settle(r, "unknown")
+                        # Node budget, not hardness: more nodes might decide
+                        # it.  Distinct from 'frontier' so the funnel (and
+                        # ROADMAP item 1's success metric) can separate
+                        # budget-starved roots from genuinely hard ones.
+                        settle(r, "unknown", reason="budget")
                         continue
                     l, h = blo[k], bhi[k]
                     widths = h[branch_dims] - l[branch_dims]

@@ -48,6 +48,11 @@ class PruneResult:
     # (consumers regenerate rows on device via ops.simulate.simulate_box with
     # grid_keys(seed, global_index, 1) — bit-identical)
     sv_time_s: float  # exact-verification phase (analog of SV solver time)
+    looseness: Optional[np.ndarray] = None  # (L,) Σ (ub - lb) per layer
+    # (pre-activation, final linear layer included) over the whole grid
+    # (funnel telemetry's per-layer bound-looseness attribution, §20).  Device-carried f32 sums on the mega path,
+    # host f64 sums on the chunk path — approximately, not bitwise, equal
+    # (funnel COUNTS carry the bit-invariance contract, not these sums).
 
 
 @obs_jit(static_argnames=("sim_size", "with_sim"))
@@ -69,20 +74,34 @@ from fairify_tpu.utils.prng import grid_keys  # canonical key derivation
 
 
 @obs_jit(static_argnames=("sim_size",))
-def _mega_sim_and_bounds(net: MLP, keys, lo, hi, sim_size: int):
+def _mega_sim_and_bounds(net: MLP, keys, lo, hi, nv, sim_size: int):
     """Whole-segment prune pass: ``lax.scan`` over the chunk axis of the
     transfer-light (``with_sim=False``) :func:`_sim_and_bounds` body — one
     launch per segment (DESIGN.md §17).  Keys keep the global per-partition
-    derivation, so masks are bit-equal to the chunk loop's."""
-    def chunk_step(cursor, inp):
-        k, l, h = inp
+    derivation, so masks are bit-equal to the chunk loop's.
+
+    The scan carry also accumulates the segment's per-layer bound-looseness
+    sums — ``Σ (ub - lb)`` over every pre-activation unit of every real
+    partition row (``nv (C,) int32`` masks padded rows) — a ``(L,) f32``
+    vector (one entry per layer, final linear layer included) that rides
+    the one packed fetch (DESIGN.md §20: which layer's bounds blow up
+    first, at zero extra launches)."""
+    L = len(net.weights)
+
+    def chunk_step(carry, inp):
+        cursor, loos = carry
+        k, l, h, n = inp
         stats, _, bounds = _sim_and_bounds.__wrapped__(
             net, k, l, h, sim_size, False)
-        return cursor + 1, (stats, bounds)
+        ok = (jnp.arange(l.shape[0]) < n).astype(jnp.float32)
+        per = jnp.stack([((ub - lb) * ok[:, None]).sum()
+                         for lb, ub in zip(bounds.ws_lb, bounds.ws_ub)])
+        return (cursor + 1, loos + per), (stats, bounds)
 
-    _, (stats, bounds) = jax.lax.scan(
-        chunk_step, jnp.int32(0), (keys, lo, hi))
-    return stats, bounds
+    (_, loos), (stats, bounds) = jax.lax.scan(
+        chunk_step, (jnp.int32(0), jnp.zeros((L,), jnp.float32)),
+        (keys, lo, hi, nv))
+    return stats, bounds, loos
 
 
 @obs_jit(static_argnames=("sim_size",))
@@ -143,6 +162,7 @@ def sound_prune_grid(
                         chunks=len(spans))
     lo_np, hi_np = np.asarray(lo), np.asarray(hi)
     cand_c, pos_c, lb_c, ub_c, sim_c = [], [], [], [], []
+    loos_acc = {"v": None}  # (L,) f64 per-layer Σ (ub - lb) over the grid
 
     def _chunk_submit(s: int, e: int):
         """Dispatch one padded chunk; returns (device payload, n valid rows)."""
@@ -163,6 +183,10 @@ def sound_prune_grid(
         pos_c.append([p[:n] for p in stats.positive_prob])
         lb_c.append([b[:n] for b in bounds.ws_lb])
         ub_c.append([b[:n] for b in bounds.ws_ub])
+        per = np.asarray([
+            (np.asarray(ub[:n], np.float64) - np.asarray(lb[:n], np.float64)).sum()
+            for lb, ub in zip(bounds.ws_lb, bounds.ws_ub)])
+        loos_acc["v"] = per if loos_acc["v"] is None else loos_acc["v"] + per
         if keep_sim:
             sim_c.append(sim[:n])
 
@@ -180,14 +204,18 @@ def sound_prune_grid(
                 for s, e in blk]
         hi_c = [pad_rows(hi_np[s:e], step).astype(np.float32)
                 for s, e in blk]
+        nv = np.asarray([e - s if ci < len(chunks) else 0
+                         for ci, (s, e) in enumerate(blk)], np.int32)
         profiling.bump_launch()
         payload = _mega_sim_and_bounds(
             net, jnp.stack(keys_c), jnp.asarray(np.stack(lo_c)),
-            jnp.asarray(np.stack(hi_c)), sim_size)
+            jnp.asarray(np.stack(hi_c)), jnp.asarray(nv), sim_size)
         return payload, chunks
 
     def _mega_decode(chunks, host) -> None:
-        stats, bounds = host
+        stats, bounds, loos = host
+        per = np.asarray(loos, np.float64)
+        loos_acc["v"] = per if loos_acc["v"] is None else loos_acc["v"] + per
         for ci, (s, e) in enumerate(chunks):
             n = e - s
             cand_c.append([c[ci, :n] for c in stats.candidates])
@@ -277,6 +305,7 @@ def sound_prune_grid(
         ws_ub=ws_ub,
         sim=sim,
         sv_time_s=sv_time,
+        looseness=loos_acc["v"],
     )
 
 

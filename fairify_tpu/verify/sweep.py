@@ -33,6 +33,7 @@ import numpy as np
 from fairify_tpu import obs
 from fairify_tpu.obs import obs_jit
 from fairify_tpu.obs import compile as compile_obs
+from fairify_tpu.obs import funnel as funnel_mod
 from fairify_tpu.data import loaders
 from fairify_tpu.models import mlp as mlp_mod
 from fairify_tpu.models import zoo
@@ -90,6 +91,11 @@ class ModelReport:
     # futures and patches outcomes/ledger in place; None when the SMT
     # tier completed inline (the default) or never ran.
     smt_pending: Optional[object] = None
+    # Funnel telemetry block (obs.funnel, DESIGN.md §20): terminal-state
+    # counts, decided_fraction, margin/gap histograms and per-layer bound
+    # looseness, exactly as dumped into the run's throughput JSON.  None
+    # when the run collected no funnel (e.g. a merged multi-span report).
+    funnel: Optional[dict] = None
 
     @property
     def counts(self) -> Dict[str, int]:
@@ -370,7 +376,7 @@ class SmtDrain:
 
 def _stage0_certify_and_attack(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
                                mesh=None, seed_offset: int = 0, pipe=None,
-                               on_failure=None):
+                               on_failure=None, stats=None):
     """Root certificates + attack for the whole grid, in grid-chunk blocks.
 
     ``seed_offset`` ties the attack RNG to the grid's global start index
@@ -390,6 +396,14 @@ def _stage0_certify_and_attack(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
     launch, one packed decode, one supervisor retry/degrade unit — and
     the chunk-granular loop below is the mesh/IBP fallback.  Verdict maps
     are bit-equal between the two paths (tests/test_mega.py).
+
+    ``stats`` (an ``obs.funnel.StageStats``, optional) accumulates the
+    grid's certified-margin / attack-gap histograms: the mega path adds
+    each segment's device-carried ``(2, N_BUCKETS)`` buffer, the chunk path
+    buckets the fetched per-box values host-side under the same rule —
+    histograms are bit-identical across ``mega_chunks`` settings, like the
+    verdict maps they ride along with.  Degraded segments/chunks contribute
+    nothing (their partitions never produced margins).
     """
     P = lo.shape[0]
     step, spans = _chunk_spans(P, cfg.grid_chunk)
@@ -424,6 +438,10 @@ def _stage0_certify_and_attack(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
                     on_failure(seg_s, seg_e, host)
             else:
                 drained = seg_e - seg_s
+                if stats is not None:
+                    # Device-carried (2, N_BUCKETS) histogram: padding rows
+                    # were masked on device via the per-chunk n_valid input.
+                    stats.add_packed(host["stats"])
                 for (s, e), (u, sa, w) in zip(
                         chunks, _mega_segment_decode(host, ctx)):
                     unsat[s:e], sat[s:e] = u[: e - s], sa[: e - s]
@@ -432,11 +450,16 @@ def _stage0_certify_and_attack(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
             _segment_tick("stage0_decide", done["n"], len(segs),
                           drained, in_flight=len(pipe))
 
-        for seg_s, seg_e, chunks in segs:
+        for si, (seg_s, seg_e, chunks) in enumerate(segs):
+            # Step-annotated submit: one XProf step per segment dispatch,
+            # named after the phase span (profiling.annotate_step is a
+            # no-op unless an --xprof-dir capture is open).
             for item in pipe.submit(
-                    lambda chunks=chunks: _mega_segment_submit(
-                        net, enc, lo, hi, cfg, chunks, step, seed_offset,
-                        pad_chunks=bucket),
+                    lambda chunks=chunks, si=si: profiling.annotate_step(
+                        "stage0_decide", si,
+                        lambda: _mega_segment_submit(
+                            net, enc, lo, hi, cfg, chunks, step, seed_offset,
+                            pad_chunks=bucket)),
                     meta=(seg_s, seg_e, chunks)):
                 consume_seg(*item)
         for item in pipe.drain():
@@ -452,15 +475,17 @@ def _stage0_certify_and_attack(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
             if on_failure is not None:
                 on_failure(s, e, host)
             return
-        u, sa, w = _stage0_block_decode(host, ctx)
+        u, sa, w = _stage0_block_decode(host, ctx, stats)
         unsat[s:e], sat[s:e] = u[: e - s], sa[: e - s]
         witnesses.update({s + k: v for k, v in w.items() if k < e - s})
 
-    for s, e in spans:
+    for ci, (s, e) in enumerate(spans):
         for item in pipe.submit(
-                lambda s=s, e=e: _stage0_block_submit(
-                    net, enc, lo[s:e], hi[s:e], cfg, mesh,
-                    cfg.engine.seed + seed_offset + s, pad_to=step),
+                lambda s=s, e=e, ci=ci: profiling.annotate_step(
+                    "stage0_decide", ci,
+                    lambda: _stage0_block_submit(
+                        net, enc, lo[s:e], hi[s:e], cfg, mesh,
+                        cfg.engine.seed + seed_offset + s, pad_to=step)),
                 meta=(s, e)):
             consume(*item)
     for item in pipe.drain():
@@ -508,7 +533,7 @@ def _stage0_block_submit(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
         # logit tensors (VERDICT r4 #3).
         assign_vals, pa_mask, ra_mask = engine._enc_tensors(enc, lo.shape[1])
         profiling.bump_launch()
-        cert, _, found_d, wit_d = engine._certify_attack_kernel(
+        cert, _, found_d, wit_d, margin_d, gap_d = engine._certify_attack_kernel(
             net, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
             jnp.asarray(xp_hi), jnp.asarray(plo), jnp.asarray(phi),
             jnp.asarray(assign_vals), jnp.asarray(pa_mask), jnp.asarray(ra_mask),
@@ -516,11 +541,12 @@ def _stage0_block_submit(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
             jnp.asarray(xr), jnp.asarray(pr), alpha_iters=0,
         )
         ctx["kind"] = "fused"
-        return {"cert": cert, "found": found_d, "wit": wit_d}, ctx
+        return {"cert": cert, "found": found_d, "wit": wit_d,
+                "margin": margin_d, "gap": gap_d}, ctx
     if cfg.engine.use_crown:
         assign_vals, pa_mask, ra_mask = engine._enc_tensors(enc, lo.shape[1])
         profiling.bump_launch()
-        cert, _ = engine._role_certify_kernel(
+        cert, _, margin_d = engine._role_certify_kernel(
             net, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
             jnp.asarray(xp_hi), jnp.asarray(plo), jnp.asarray(phi),
             jnp.asarray(assign_vals), jnp.asarray(pa_mask), jnp.asarray(ra_mask),
@@ -530,7 +556,7 @@ def _stage0_block_submit(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
         profiling.bump_launch()
         lx, lp = engine._attack_logits(net, jnp.asarray(xr), jnp.asarray(pr))
         ctx["kind"] = "crown"
-        return {"cert": cert, "lx": lx, "lp": lp}, ctx
+        return {"cert": cert, "margin": margin_d, "lx": lx, "lp": lp}, ctx
     profiling.bump_launch()
     lb_x, ub_x, lb_p, ub_p = engine._role_logit_bounds(
         net, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo), jnp.asarray(xp_hi),
@@ -543,28 +569,47 @@ def _stage0_block_submit(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
             "lx": lx, "lp": lp}, ctx
 
 
-def _stage0_block_decode(host, ctx):
+def _stage0_block_decode(host, ctx, stats=None):
     """Host decode of a drained stage-0 block → ``(unsat, sat, witnesses)``.
 
     Everything here is numpy + exact arithmetic — the work the pipeline
-    overlaps with the next block's in-flight launch.
+    overlaps with the next block's in-flight launch.  ``stats`` (an
+    ``obs.funnel.StageStats``) accumulates the block's certified-margin /
+    attack-gap histograms: kernel-computed per-box values on the fused
+    path, host mirrors of the same formulas on the crown/IBP fallbacks —
+    one bucket rule everywhere (obs.funnel), so the chunk loop's histograms
+    are bit-identical to the mega loop's carried ones.
     """
     net, enc, n = ctx["net"], ctx["enc"], ctx["n"]
     xr, pr, valid = ctx["xr"], ctx["pr"], ctx["valid"]
+    margin = gap = None
     if ctx["kind"] == "fused":
         unsat = np.asarray(host["cert"])[:n]
         found, wit = np.asarray(host["found"]), np.asarray(host["wit"])
+        if stats is not None:
+            margin = np.asarray(host["margin"])[:n]
+            gap = np.asarray(host["gap"])[:n]
     else:
+        lx, lp = np.asarray(host["lx"]), np.asarray(host["lp"])
         if ctx["kind"] == "crown":
             unsat = np.asarray(host["cert"])[:n]
+            if stats is not None:
+                margin = np.asarray(host["margin"])[:n]
         else:
             lb_x, ub_x, lb_p, ub_p = (
                 np.asarray(host[k])[:n]
                 for k in ("lb_x", "ub_x", "lb_p", "ub_p"))
             unsat = engine.no_flip_certified(lb_x, ub_x, lb_p, ub_p, valid,
                                              enc.valid_pair)
-        found, wit = engine.find_flips(
-            enc, np.asarray(host["lx"]), np.asarray(host["lp"]), valid)
+            if stats is not None:
+                margin = engine.role_bound_margin(
+                    lb_x, ub_x, lb_p, ub_p, valid[:n], enc.valid_pair)
+        if stats is not None:
+            gap = engine.attack_gap(lx[:n], lp[:n], valid[:n],
+                                    enc.valid_pair)
+        found, wit = engine.find_flips(enc, lx, lp, valid)
+    if stats is not None:
+        stats.add_values(margin, gap)
     weights = [np.asarray(w) for w in net.weights]
     biases = [np.asarray(b) for b in net.biases]
     witnesses = engine.extract_witnesses(found, wit, xr, pr, weights, biases)
@@ -587,9 +632,31 @@ def _stage0_block(net, enc: PairEncoding, lo, hi, cfg: SweepConfig, mesh, rng_se
 # ---------------------------------------------------------------------------
 
 
+def _chunk_stats_dev(margin, gap, n):
+    """(2, N_BUCKETS) int32 histogram increment for one scanned chunk.
+
+    The device half of the funnel's fixed-bucket layout (obs.funnel.EDGES):
+    ``idx = Σ (v >= edge)`` then a one-hot reduce — comparisons + reduce_sum
+    only, so the certify-path kernels stay inside the sound-ops allowlist
+    (no searchsorted/sort).  ``n`` masks the padded rows of a ragged chunk
+    (and a whole ``n == 0`` chunk padded onto the segment axis), so the
+    carried histogram counts exactly the real grid rows.
+    """
+    edges = jnp.asarray(funnel_mod.EDGES)
+    ok = jnp.arange(margin.shape[0], dtype=jnp.int32) < n
+
+    def h(v):
+        idx = (v[:, None] >= edges[None, :]).sum(axis=1)
+        onehot = (idx[:, None] == jnp.arange(funnel_mod.N_BUCKETS)[None, :]) \
+            & ok[:, None]
+        return onehot.sum(axis=0).astype(jnp.int32)
+
+    return jnp.stack([h(margin), h(gap)])
+
+
 @obs_jit(static_argnames=("alpha_iters",))
 def _mega_stage0_kernel(net, x_lo, x_hi, xp_lo, xp_hi, plo, phi, av, pm, rm,
-                        eps, va, vp, xr, pr, alpha_iters):
+                        eps, va, vp, xr, pr, nv, alpha_iters):
     """Stage-0 certify + attack for a whole SEGMENT of chunks, ONE launch.
 
     ``lax.scan`` over the leading chunk axis (C) of every per-chunk tensor:
@@ -598,55 +665,71 @@ def _mega_stage0_kernel(net, x_lo, x_hi, xp_lo, xp_hi, plo, phi, av, pm, rm,
     round-trip instead of C — the α,β-CROWN "rapid massively-parallel
     incomplete verifier" shape (PAPERS.md: arxiv 2011.13824) with the
     incomplete pass living entirely on device.  The scan carry is the chunk
-    cursor; the per-chunk attack RNG stays keyed to GLOBAL chunk starts and
-    is drawn host-side at submit (stacked on the scan axis), so the packed
-    results are bit-equal to the chunk loop's by construction.
+    cursor plus a ``(2, N_BUCKETS)`` int32 funnel-statistics accumulator
+    (certified-margin and attack-gap histograms, obs.funnel's fixed-bucket
+    layout; ``nv (C,)`` masks padded rows); the per-chunk attack RNG stays
+    keyed to GLOBAL chunk starts and is drawn host-side at submit (stacked
+    on the scan axis), so the packed results are bit-equal to the chunk
+    loop's by construction.
 
-    Returns ``(cert (C, P), wit (C, P, 3), reason (C, P))``: the packed
-    verdict array, the counterexample index buffer (sample and role-pair
-    indices into the host-kept candidates), and a per-partition int8
+    Returns ``(cert (C, P), wit (C, P, 3), reason (C, P), stats (2, NB))``:
+    the packed verdict array, the counterexample index buffer (sample and
+    role-pair indices into the host-kept candidates), a per-partition int8
     reason code (0 = undecided, 1 = certified UNSAT, 2 = attack flip,
     3 = both) the host decodes once per segment — the decode derives the
     flip mask from the codes (``reason >= 2``), skips witness extraction
-    for flip-free chunks, and resolves flips via exact witness replay.
+    for flip-free chunks, and resolves flips via exact witness replay —
+    and the whole segment's histogram carry: the segment's margin statistics
+    cost ONE extra fetched buffer and zero extra launches (DESIGN.md §20).
     """
-    def chunk_step(cursor, inp):
-        a, b, c, d, l, h, v, xr_c, pr_c = inp
-        cert, _, found, wit = engine._certify_attack_impl(
+    def chunk_step(carry, inp):
+        cursor, stats = carry
+        a, b, c, d, l, h, v, xr_c, pr_c, n = inp
+        cert, _, found, wit, margin, gap = engine._certify_attack_impl(
             net, a, b, c, d, l, h, av, pm, rm, eps, v, vp, xr_c, pr_c,
             alpha_iters)
         reason = cert.astype(jnp.int8) + 2 * found.astype(jnp.int8)
-        return cursor + 1, (cert, wit, reason)
+        stats = stats + _chunk_stats_dev(margin, gap, n)
+        return (cursor + 1, stats), (cert, wit, reason)
 
-    _, packed = jax.lax.scan(
-        chunk_step, jnp.int32(0),
-        (x_lo, x_hi, xp_lo, xp_hi, plo, phi, va, xr, pr))
-    return packed
+    (_, stats), packed = jax.lax.scan(
+        chunk_step,
+        (jnp.int32(0), jnp.zeros((2, funnel_mod.N_BUCKETS), jnp.int32)),
+        (x_lo, x_hi, xp_lo, xp_hi, plo, phi, va, xr, pr, nv))
+    return packed + (stats,)
 
 
 @obs_jit(static_argnames=("alpha_iters",))
 def _mega_family_stage0_kernel(stacked, x_lo, x_hi, xp_lo, xp_hi, plo, phi,
-                               av, pm, rm, eps, va, vp, xr, pr, alpha_iters):
+                               av, pm, rm, eps, va, vp, xr, pr, nv,
+                               alpha_iters):
     """:func:`_mega_stage0_kernel` for a stacked model family: scan over the
     chunk axis of a vmapped fused body — the whole (models × chunks) stage-0
     pass of a family is ONE launch per segment, which is what turns the
-    serve batcher's coalesced buckets into mega-launches."""
+    serve batcher's coalesced buckets into mega-launches.  The funnel
+    statistics carry is per model: ``stats (M, 2, N_BUCKETS)``."""
     from fairify_tpu.models.mlp import MLP
 
-    def chunk_step(cursor, inp):
-        a, b, c, d, l, h, v, xr_c, pr_c = inp
-        cert, _, found, wit = jax.vmap(
+    M = stacked.weights[0].shape[0]
+
+    def chunk_step(carry, inp):
+        cursor, stats = carry
+        a, b, c, d, l, h, v, xr_c, pr_c, n = inp
+        cert, _, found, wit, margin, gap = jax.vmap(
             lambda net: engine._certify_attack_impl(
                 net, a, b, c, d, l, h, av, pm, rm, eps, v, vp, xr_c, pr_c,
                 alpha_iters)
         )(MLP(stacked.weights, stacked.biases, stacked.masks))
         reason = cert.astype(jnp.int8) + 2 * found.astype(jnp.int8)
-        return cursor + 1, (cert, wit, reason)
+        stats = stats + jax.vmap(
+            lambda m_, g_: _chunk_stats_dev(m_, g_, n))(margin, gap)
+        return (cursor + 1, stats), (cert, wit, reason)
 
-    _, packed = jax.lax.scan(
-        chunk_step, jnp.int32(0),
-        (x_lo, x_hi, xp_lo, xp_hi, plo, phi, va, xr, pr))
-    return packed
+    (_, stats), packed = jax.lax.scan(
+        chunk_step,
+        (jnp.int32(0), jnp.zeros((M, 2, funnel_mod.N_BUCKETS), jnp.int32)),
+        (x_lo, x_hi, xp_lo, xp_hi, plo, phi, va, xr, pr, nv))
+    return packed + (stats,)
 
 
 def _mega_chunk_inputs(enc: PairEncoding, lo, hi, cfg: SweepConfig,
@@ -661,9 +744,17 @@ def _mega_chunk_inputs(enc: PairEncoding, lo, hi, cfg: SweepConfig,
     segment grouping can never shift an RNG stream.  ``pad_chunks`` pads
     the CHUNK axis to the segment bucket (:func:`_pad_chunk_axis`) so a
     ragged final segment reuses the full-segment executable.
+
+    The trailing ``nv (C,) int32`` buffer is each chunk's REAL row count —
+    0 for chunk-axis padding, ``e - s`` for a ragged final chunk — which the
+    kernels' funnel-statistics carry uses to mask padded rows out of the
+    on-device histograms (padding repeats real rows and would double-count).
     """
     bufs = [[] for _ in range(9)]
-    for s, e in _pad_chunk_axis(chunks, pad_chunks):
+    blk = _pad_chunk_axis(chunks, pad_chunks)
+    nv = np.asarray([e - s if ci < len(chunks) else 0
+                     for ci, (s, e) in enumerate(blk)], np.int32)
+    for s, e in blk:
         clo, chi = _pad_rows(lo[s:e], step), _pad_rows(hi[s:e], step)
         flo, fhi = clo.astype(np.float32), chi.astype(np.float32)
         x_lo, x_hi, xp_lo, xp_hi, valid = role_boxes(enc, flo, fhi)
@@ -673,7 +764,7 @@ def _mega_chunk_inputs(enc: PairEncoding, lo, hi, cfg: SweepConfig,
         for buf, arr in zip(bufs, (x_lo, x_hi, xp_lo, xp_hi, flo, fhi,
                                    valid, xr, pr)):
             buf.append(arr)
-    return tuple(np.stack(b) for b in bufs)
+    return tuple(np.stack(b) for b in bufs) + (nv,)
 
 
 def _mega_segment_submit(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
@@ -686,21 +777,21 @@ def _mega_segment_submit(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
     therefore a fault's blast radius — is the segment.
     """
     (x_lo, x_hi, xp_lo, xp_hi, plo, phi, valid,
-     xr, pr) = _mega_chunk_inputs(enc, lo, hi, cfg, chunks, step,
-                                  seed_offset, pad_chunks)
+     xr, pr, nv) = _mega_chunk_inputs(enc, lo, hi, cfg, chunks, step,
+                                      seed_offset, pad_chunks)
     assign_vals, pa_mask, ra_mask = engine._enc_tensors(enc, lo.shape[1])
     profiling.bump_launch()
-    cert, wit, reason = _mega_stage0_kernel(
+    cert, wit, reason, stats = _mega_stage0_kernel(
         net, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
         jnp.asarray(xp_hi), jnp.asarray(plo), jnp.asarray(phi),
         jnp.asarray(assign_vals), jnp.asarray(pa_mask),
         jnp.asarray(ra_mask), float(enc.eps), jnp.asarray(valid),
         jnp.asarray(enc.valid_pair), jnp.asarray(xr), jnp.asarray(pr),
-        alpha_iters=0,
+        jnp.asarray(nv), alpha_iters=0,
     )
     ctx = {"net": net, "enc": enc, "chunks": chunks, "xr": xr, "pr": pr,
            "kind": "mega"}
-    return {"cert": cert, "wit": wit, "reason": reason}, ctx
+    return {"cert": cert, "wit": wit, "reason": reason, "stats": stats}, ctx
 
 
 def _mega_family_segment_submit(stacked, enc: PairEncoding, lo, hi,
@@ -709,22 +800,22 @@ def _mega_family_segment_submit(stacked, enc: PairEncoding, lo, hi,
     """Family-stacked :func:`_mega_segment_submit` (one launch per
     (family, segment) — the AC suite and every coalesced serve bucket)."""
     (x_lo, x_hi, xp_lo, xp_hi, plo, phi, valid,
-     xr, pr) = _mega_chunk_inputs(enc, lo, hi, cfg, chunks, step,
-                                  seed_offset, pad_chunks)
+     xr, pr, nv) = _mega_chunk_inputs(enc, lo, hi, cfg, chunks, step,
+                                      seed_offset, pad_chunks)
     assign_vals, pa_mask, ra_mask = engine._enc_tensors(enc, lo.shape[1])
     profiling.bump_launch()
-    cert, wit, reason = _mega_family_stage0_kernel(
+    cert, wit, reason, stats = _mega_family_stage0_kernel(
         stacked, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
         jnp.asarray(xp_hi), jnp.asarray(plo), jnp.asarray(phi),
         jnp.asarray(assign_vals), jnp.asarray(pa_mask),
         jnp.asarray(ra_mask), float(enc.eps), jnp.asarray(valid),
         jnp.asarray(enc.valid_pair), jnp.asarray(xr), jnp.asarray(pr),
-        alpha_iters=0,
+        jnp.asarray(nv), alpha_iters=0,
     )
     ctx = {"stacked": stacked, "enc": enc, "chunks": chunks,
            "M": stacked.weights[0].shape[0], "xr": xr, "pr": pr,
            "kind": "mega_family"}
-    return {"cert": cert, "wit": wit, "reason": reason}, ctx
+    return {"cert": cert, "wit": wit, "reason": reason, "stats": stats}, ctx
 
 
 def _mega_segment_decode(host, ctx):
@@ -851,7 +942,7 @@ def _stage0_family(stacked, enc: PairEncoding, lo, hi, cfg: SweepConfig,
 
 
 def stage0_families(stacks, enc: PairEncoding, lo, hi, cfg: SweepConfig,
-                    mesh=None, pipe=None, seed_offset: int = 0):
+                    mesh=None, pipe=None, seed_offset: int = 0, stats=None):
     """Stage 0 for SEVERAL stacked families through one shared launch queue.
 
     Every (family, segment) block — (family, grid-chunk) on the fallback
@@ -870,6 +961,13 @@ def stage0_families(stacks, enc: PairEncoding, lo, hi, cfg: SweepConfig,
     a span-local ``lo``/``hi`` slice (the serve batcher coalescing span
     requests) passes the span start so every chunk draws exactly the
     samples a whole-grid run would.
+
+    ``stats`` (optional) is a dict the caller owns; the mega path and the
+    fused chunk path accumulate one ``obs.funnel.StageStats`` per
+    ``(stack_index, model_index)`` key into it (created on first touch).
+    The crown/IBP fallback family paths skip statistics — they are the
+    mesh/degraded tiers and their partitions re-enter the per-model
+    pipeline, which records margins itself.
     """
     P = lo.shape[0]
     step, spans = _chunk_spans(P, cfg.grid_chunk)
@@ -904,6 +1002,12 @@ def stage0_families(stacks, enc: PairEncoding, lo, hi, cfg: SweepConfig,
             else:
                 drained = seg_e - seg_s
                 unsat, sat, wits = accs[gi]
+                if stats is not None:
+                    seg_stats = np.asarray(host["stats"])  # (M, 2, NB)
+                    for m in range(seg_stats.shape[0]):
+                        stats.setdefault(
+                            (gi, m), funnel_mod.StageStats()
+                        ).add_packed(seg_stats[m])
                 for (s, e), per_model in zip(
                         chunks, _mega_family_segment_decode(host, ctx)):
                     for m, (u, sa, w) in enumerate(per_model):
@@ -938,7 +1042,8 @@ def stage0_families(stacks, enc: PairEncoding, lo, hi, cfg: SweepConfig,
                       partitions=e - s)
             return
         unsat, sat, wits = accs[gi]
-        for m, (u, sa, w) in enumerate(_family_block_decode(host, ctx)):
+        for m, (u, sa, w) in enumerate(
+                _family_block_decode(host, ctx, stats=stats, gi=gi)):
             unsat[m][s:e], sat[m][s:e] = u[: e - s], sa[: e - s]
             wits[m].update({s + k: v for k, v in w.items() if k < e - s})
 
@@ -988,7 +1093,7 @@ def _family_block_submit(stacked, enc: PairEncoding, lo, hi, cfg: SweepConfig,
         # only (M, P) masks + (M, P, 3) witness indices cross the tunnel.
         assign_vals, pa_mask, ra_mask = engine._enc_tensors(enc, lo.shape[1])
         profiling.bump_launch()
-        cert, _, found_d, wit_d = _family_stage0_kernel(
+        cert, _, found_d, wit_d, margin_d, gap_d = _family_stage0_kernel(
             stacked, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
             jnp.asarray(xp_hi), jnp.asarray(plo), jnp.asarray(phi),
             jnp.asarray(assign_vals), jnp.asarray(pa_mask), jnp.asarray(ra_mask),
@@ -996,12 +1101,13 @@ def _family_block_submit(stacked, enc: PairEncoding, lo, hi, cfg: SweepConfig,
             jnp.asarray(xr), jnp.asarray(pr), alpha_iters=0,
         )
         ctx["kind"] = "fused"
-        return {"cert": cert, "found": found_d, "wit": wit_d}, ctx
+        return {"cert": cert, "found": found_d, "wit": wit_d,
+                "margin": margin_d, "gap": gap_d}, ctx
 
     if cfg.engine.use_crown:
         assign_vals, pa_mask, ra_mask = engine._enc_tensors(enc, lo.shape[1])
         profiling.bump_launch()
-        cert, _ = _family_certify_kernel(
+        cert, _, _margin_d = _family_certify_kernel(
             stacked, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
             jnp.asarray(xp_hi), jnp.asarray(plo), jnp.asarray(phi),
             jnp.asarray(assign_vals), jnp.asarray(pa_mask), jnp.asarray(ra_mask),
@@ -1025,13 +1131,24 @@ def _family_block_submit(stacked, enc: PairEncoding, lo, hi, cfg: SweepConfig,
             "lx": lx, "lp": lp}, ctx
 
 
-def _family_block_decode(host, ctx):
-    """Host decode of a drained family block → per-model results."""
+def _family_block_decode(host, ctx, stats=None, gi: int = 0):
+    """Host decode of a drained family block → per-model results.
+
+    ``stats``/``gi``: see :func:`stage0_families` — the fused path banks
+    each model's margin/gap histograms under ``(gi, m)``; the crown/IBP
+    fallbacks don't record statistics."""
     stacked, enc, M, n = ctx["stacked"], ctx["enc"], ctx["M"], ctx["n"]
     xr, pr, valid = ctx["xr"], ctx["pr"], ctx["valid"]
     if ctx["kind"] == "fused":
         unsat_all = np.asarray(host["cert"])[:, :n]
         found_all, wit_all = np.asarray(host["found"]), np.asarray(host["wit"])
+        if stats is not None:
+            margin_all = np.asarray(host["margin"])[:, :n]
+            gap_all = np.asarray(host["gap"])[:, :n]
+            for m in range(M):
+                stats.setdefault(
+                    (gi, m), funnel_mod.StageStats()
+                ).add_values(margin_all[m], gap_all[m])
         results = []
         for m in range(M):
             weights = [np.asarray(w[m]) for w in stacked.weights]
@@ -1365,6 +1482,12 @@ def _verify_model_impl(
     from fairify_tpu.utils.profiling import ThroughputCounter, xla_trace
 
     counter = ThroughputCounter(n_devices=1 if mesh is None else int(np.prod(list(mesh.shape.values()))))
+    # Verification-funnel telemetry (obs.funnel, DESIGN.md §20): the run's
+    # certified-margin / attack-gap histograms (device-carried on the mega
+    # path) and the per-partition terminal-state tally behind the one
+    # ``funnel`` event + ``decided_fraction`` this report ships.
+    stage_stats = funnel_mod.StageStats()
+    funnel = funnel_mod.FunnelCounts()
     launch0 = profiling.launch_count()
     compile0 = compile_obs.snapshot_totals()
     heartbeat = obs.Heartbeat(cfg.heartbeat_s, total=P, label=sink_name) \
@@ -1419,12 +1542,16 @@ def _verify_model_impl(
                           phase="stage0_prune", partitions=0)
         with obs.timed_span(timer, "stage0_decide", partitions=P) as sp0:
             if stage0 is not None:  # precomputed by the stacked family kernel
-                unsat0, sat0, witnesses = stage0
+                if len(stage0) == 4:  # family path forwarded its StageStats
+                    unsat0, sat0, witnesses, pre_stats = stage0
+                    stage_stats.merge(pre_stats)
+                else:  # serve's 3-tuple slices carry no histograms
+                    unsat0, sat0, witnesses = stage0
                 sp0.set(precomputed=True)
             else:
                 unsat0, sat0, witnesses = _stage0_certify_and_attack(
                     net, enc, lo, hi, cfg, mesh=mesh, seed_offset=span_start,
-                    pipe=pipe,
+                    pipe=pipe, stats=stage_stats,
                     on_failure=lambda s, e, f: _degrade(range(s, e), f,
                                                         "stage0_decide"))
             sp0.set(unsat=int(unsat0.sum()), sat=int(sat0.sum()))
@@ -1729,6 +1856,13 @@ def _verify_model_impl(
                 sat_count, unsat_count, unk_count = counts["sat"], counts["unsat"], counts["unknown"]
                 obs.event("verdict", model=model_name, partition_id=pid,
                           verdict=rec["verdict"], via="ledger")
+                # Replayed rows don't record their original provenance tier;
+                # via="ledger" classifies decided verdicts into the BaB
+                # buckets (best effort — fresh runs, where the bit-invariance
+                # contract applies, never take this branch).
+                funnel.add(funnel_mod.classify(
+                    rec["verdict"], "ledger",
+                    failure=(rec.get("failure") or {}).get("reason")))
                 if heartbeat is not None:
                     heartbeat.beat(decided=sat_count + unsat_count,
                                    attempted=len(outcomes), unknown=unk_count)
@@ -1884,12 +2018,20 @@ def _verify_model_impl(
                 # Budget-vs-hardness attribution for the event log: did
                 # the engine run out of deadline or out of ideas?
                 extra["engine_reason"] = bab[p].reason
+            via = ("degraded" if fail_rec is not None
+                   else "stage0" if (sat0[p] or unsat0[p])
+                   else "smt" if smt_decided
+                   else ("heuristic" if h_success else "bab"))
             obs.event("verdict", model=model_name, partition_id=pid,
-                      verdict=verdict,
-                      via="degraded" if fail_rec is not None
-                      else "stage0" if (sat0[p] or unsat0[p])
-                      else "smt" if smt_decided
-                      else ("heuristic" if h_success else "bab"), **extra)
+                      verdict=verdict, via=via, **extra)
+            # Terminal funnel state (obs.funnel, DESIGN.md §20).  An SMT-
+            # deferred partition is tallied at its provisional UNKNOWN; the
+            # SmtDrain's superseding verdict event carries the final state
+            # for trace-log consumers (report --funnel dedups last-wins).
+            funnel.add(funnel_mod.classify(
+                verdict, via,
+                failure=fail_rec["reason"] if fail_rec is not None else None,
+                engine_reason=extra.get("engine_reason")))
 
             # Per-row accounting: amortized stage-0 share + this row's attributed
             # BaB cost (sv_time) + its own loop work (heuristic retry, replay).
@@ -2024,12 +2166,28 @@ def _verify_model_impl(
                 for k in sorted(last, key=lambda v: int(v)):
                     wr.writerow(last[k])
     counter.launches = profiling.launch_count() - launch0
+    # The run's funnel block: terminal-state counts (they sum to P), the
+    # decided fraction (ROADMAP item-1's success metric, perfdiff-gated),
+    # the stage-0 margin/gap histograms, and the prune pass's per-layer
+    # bound-looseness attribution.  One ``funnel`` event per model run +
+    # the same block in the throughput JSON and on the ModelReport.
+    funnel_payload = {
+        "states": funnel.to_dict(),
+        "total": funnel.total,
+        "decided": funnel.decided,
+        "decided_fraction": funnel.decided_fraction,
+        "margin_hist": stage_stats.to_payload() if stage_stats.boxes else None,
+        "looseness": (None if prune is None or prune.looseness is None
+                      else [float(v) for v in prune.looseness]),
+    }
+    obs.event("funnel", model=model_name, **funnel_payload)
     counter.dump(os.path.join(cfg.result_dir, f"{cfg.name}-{sink_name}.throughput.json"),
                  phases=timer.phases,
                  pipeline={"depth": cfg.pipeline_depth, **pipe.stats.summary()},
                  compile=compile_obs.totals_delta(compile0),
                  resilience={"degraded": degraded_count,
-                             "ledger_skipped_lines": led_skipped})
+                             "ledger_skipped_lines": led_skipped},
+                 funnel=funnel_payload)
     if heartbeat is not None:  # final line regardless of throttle state
         heartbeat.beat(decided=sat_count + unsat_count, attempted=len(outcomes),
                        unknown=unk_count, force=True)
@@ -2038,7 +2196,7 @@ def _verify_model_impl(
         model=model_name, dataset=cfg.dataset, outcomes=outcomes,
         original_acc=orig_acc, total_time_s=timer.total(), partitions_total=P,
         sink_name=sink_name, ledger_skipped_lines=led_skipped,
-        degraded=degraded_count,
+        degraded=degraded_count, funnel=funnel_payload,
     )
     if smt_deferred_items:
         report.smt_pending = SmtDrain(
@@ -2141,16 +2299,22 @@ def _run_sweep_impl(cfg, model_root, data_root, mesh, stack,
             stacks = [stack_models([nets[n] for n in names]) for names in multi]
             fam_pipe = LaunchPipeline(cfg.pipeline_depth,
                                       supervisor=_supervisor(cfg))
+            fam_stats: Dict = {}
             with obs.span("stage0_family",
                           models=sum(len(n) for n in multi),
                           groups=len(multi), partitions=int(lo.shape[0])) as sp:
                 fams = stage0_families(stacks, enc, lo, hi, cfg, mesh=mesh,
-                                       pipe=fam_pipe)
+                                       pipe=fam_pipe, stats=fam_stats)
                 sp.set(in_flight_max=fam_pipe.stats.max,
                        in_flight_mean=round(fam_pipe.stats.mean(), 3))
-            for names, fam in zip(multi, fams):
-                for name, s0 in zip(names, fam):
-                    stage0_by_model[name] = s0
+            for gi, (names, fam) in enumerate(zip(multi, fams)):
+                for m, (name, s0) in enumerate(zip(names, fam)):
+                    # Forward the family kernel's per-model margin/gap
+                    # histograms so the per-model funnel block matches an
+                    # unstacked run's (4-tuple; verify_model unpacks it).
+                    st = fam_stats.get((gi, m))
+                    stage0_by_model[name] = s0 + (st,) if st is not None \
+                        else s0
 
     reports = []
     for name, net in nets.items():

@@ -236,6 +236,15 @@ def _budgeted_model_sweep_impl(cfg, net, model_name, dataset):
             counts["unknown"] -= n
     elapsed = time.perf_counter() - t0
     decided = counts["sat"] + counts["unsat"]
+    # Funnel accounting for the unattempted tail (obs.funnel): the budget
+    # cut it before any attempt, so it is ``unknown:budget`` — mirrored
+    # into the live ``funnel_states`` counter (heartbeat/metrics see it)
+    # and counted against the row's decided fraction, which is over the
+    # FULL grid (the reference's Cov% semantics, Table V).
+    if P > span:
+        from fairify_tpu.obs import funnel as funnel_lib
+
+        funnel_lib.FunnelCounts().add("unknown:budget", int(P - span))
     return {
         "model": model_name,
         "partitions": int(P),
@@ -245,6 +254,7 @@ def _budgeted_model_sweep_impl(cfg, net, model_name, dataset):
         "total_time_s": round(elapsed, 2),  # the row's true wall time
         "budget_s": cfg.hard_timeout_s,
         "decided_per_sec": round(decided / max(elapsed, 1e-9), 3),
+        "decided_fraction": round(decided / max(P, 1), 6),
     }
 
 

@@ -80,6 +80,14 @@ higher-is-better as band-less single samples, while
 0.5 absolute floor — a healthy bench run contains ZERO worker deaths, so
 any growth from 0 is a containment regression, not noise.
 
+**Decided fraction** (obs.funnel, DESIGN.md §20): bench lines and
+throughput records carrying ``decided_fraction`` gate it
+**higher-is-better with an absolute floor** (default 0.02): fractions
+live in [0, 1], so the relative band-less tolerance (20%) would wave
+through a funnel collapse from 0.99 to 0.85 — instead ANY drop past two
+absolute points fails.  The metric joins the gate only when both sides
+carry it (older baselines simply don't gate it yet).
+
 ``--self-test`` runs the built-in contract checks (wired into tier-1 via
 ``tests/test_perfdiff.py``): identical records pass, a 2x slowdown fails,
 overlapping noisy bands pass, doubled launches fail.
@@ -151,6 +159,17 @@ def _flat_lower(v: float, floor: float = 0.0) -> dict:
     v = float(v)
     return {"value": v, "min": v, "max": v, "banded": False,
             "lower": True, "floor": float(floor)}
+
+
+def _flat_fraction(v: float, floor: float = 0.02) -> dict:
+    """Zero-width-band record for a HIGHER-is-better bounded fraction.
+
+    Regression iff the candidate falls more than ``floor`` ABSOLUTE points
+    below baseline: a [0, 1] fraction under the relative tolerance would
+    let a funnel collapse ride inside 20% "noise"."""
+    v = float(v)
+    return {"value": v, "min": v, "max": v, "banded": False,
+            "fraction": True, "floor": float(floor)}
 
 
 def _serve_records(obj: dict) -> Dict[str, dict]:
@@ -315,7 +334,11 @@ def load_records(path: str) -> Dict[str, dict]:
             continue
         rec = _bench_record(obj)
         if rec is not None:
-            out[_metric_key(obj["metric"])] = rec
+            key = _metric_key(obj["metric"])
+            out[key] = rec
+            if obj.get("decided_fraction") is not None:
+                out[f"{key}.decided_fraction"] = _flat_fraction(
+                    obj["decided_fraction"])
             continue
         sv = _serve_records(obj)
         if sv:
@@ -343,6 +366,10 @@ def load_records(path: str) -> Dict[str, dict]:
                             trec[k] = obj[k]
                     first = False
                 out[rate] = trec
+        if not first and obj.get("decided_fraction") is not None:
+            # Only genuine throughput records (a rate matched above) carry
+            # the funnel's decided fraction into the gate.
+            out["decided_fraction"] = _flat_fraction(obj["decided_fraction"])
     return out
 
 
@@ -356,6 +383,17 @@ def compare(base: Dict[str, dict], cand: Dict[str, dict],
         if c is None:
             findings.append({"metric": key, "kind": "missing",
                              "detail": "metric absent from candidate"})
+            continue
+        # Higher-is-better bounded fractions (decided_fraction): fail on
+        # any drop past the absolute floor — no relative tolerance.
+        if b.get("fraction"):
+            floor = b.get("floor", 0.02)
+            if b["min"] - c["max"] > floor:
+                findings.append({
+                    "metric": key, "kind": "regression",
+                    "detail": (f"fell {b['value']} -> {c['value']} "
+                               f"(> {floor} absolute drop; higher is "
+                               f"better)")})
             continue
         # Lower-is-better single samples (SERVE latency/miss-rate): grow
         # past the tolerance plus the metric's absolute floor and fail.
@@ -579,8 +617,21 @@ def self_test() -> int:
          "workers": {"1": {"queries_per_s": 2.8},
                      "4": {"queries_per_s": 9.9}},
          "speedup_x": 3.3, "worker_crashes": 0, "memouts": 0})
+    df_base = {"df": _flat_fraction(0.98)}
+    df_same = {"df": _flat_fraction(0.98)}
+    df_jitter = {"df": _flat_fraction(0.965)}
+    df_collapsed = {"df": _flat_fraction(0.60)}
     import os
     import tempfile
+
+    thr_obj = {"partitions_per_sec": 12.5, "partitions_per_sec_per_chip": 12.5,
+               "device_launches": 9, "decided_fraction": 0.9875}
+    with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                     delete=False) as fp:
+        json.dump(thr_obj, fp)
+        tname = fp.name
+    trecs = load_records(tname)
+    os.unlink(tname)
 
     wrapper = {"n": 5, "rc": 0, "cmd": "python bench.py",
                "tail": '{"metric": "pps (201 parts)", "value": 67.0, '
@@ -639,6 +690,15 @@ def self_test() -> int:
         ("identical trace A/B records pass", compare(svt_base, svt_same), 0),
         ("tracing-overhead step change flagged (pps_on + overhead_rel)",
          compare(svt_base, svt_heavy), 2),
+        ("throughput JSON carries decided_fraction into the gate",
+         [] if (trecs.get("decided_fraction", {}).get("value") == 0.9875
+                and trecs["decided_fraction"].get("fraction"))
+         else [{"kind": "regression"}], 0),
+        ("identical decided fractions pass", compare(df_base, df_same), 0),
+        ("decided-fraction jitter within the floor passes",
+         compare(df_base, df_jitter), 0),
+        ("funnel collapse flagged (decided_fraction)",
+         compare(df_base, df_collapsed), 1),
         ("identical smt records pass", compare(sm_base, sm_same), 0),
         ("lost smt scaling flagged (qps@4w + speedup_x)",
          compare(sm_base, sm_serial), 2),

@@ -1,14 +1,20 @@
 """Per-phase attribution profile of the hard-root slow tail (VERDICT r3 #1).
 
-The round-3 PERF table shows a few roots (AC-4 both PAs, BM-4, BM-9,
-AC-2-sex, GC-5) running 15-31 s/partition — three to four orders of
-magnitude above the grid norm — with nothing recording *where inside the
-engine ladder* (Phase S sign-BaB / L sign-LP / input-split pair BaB /
-P pair-LP / E lattice) those seconds land.  This harness samples each
-model's stage-0 leftovers, runs :func:`engine.decide_many` with the
-per-phase cost attribution added in round 4 (``Decision.stats``), and
-writes ``audits/profile_r4.json``: per model, the phase-second totals,
-verdict counts, and the slowest sampled roots with their phase split.
+Rebuilt on the obs event log.  The original harness predated
+``fairify_tpu.obs`` and double-instrumented the engine ladder: hand-rolled
+``time.perf_counter()`` timers in this script next to ``Decision.stats``
+inside the engine, with no shared source of truth.  The engine now emits
+spans on the active tracer (``engine.attack``, ``engine.sign_bab``,
+``engine.bab``, ``engine.pair_lp``, ``engine.lattice`` /
+``engine.lattice_first``), so this harness owns a tracer per target, runs
+the same stage-0-leftover sample through :func:`engine.decide_many`, and
+aggregates the phase seconds from the span records — the same records
+``fairify_tpu report`` reads.  The raw per-target event logs are kept next
+to ``--out`` for drill-down (Chrome-trace exports included).
+
+For the sweep-wide "where do boxes die?" view prefer
+``fairify_tpu report --funnel`` (DESIGN.md §20); this script remains for
+targeted hard-root sampling on the known slow-tail rows.
 
 Usage: python scripts/profile_phases.py [--sample 48] [--deadline 240]
                                         [--targets AC-sex:AC-4,...]
@@ -20,7 +26,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 import numpy as np
 
@@ -37,10 +42,42 @@ TARGETS = [
     ("GC-age", "GC", {}, "GC-5"),
 ]
 
-PHASES = ("t_attack", "t_sign", "t_lp", "t_bab", "t_pair", "t_lattice")
+# Engine ladder span -> reported phase bucket.  ``engine.sign_bab`` covers
+# Phase S including its host LP relaxations; the two lattice spans (first
+# pass over cheap roots, full pass over survivors) fold into one bucket.
+PHASE_SPANS = {
+    "engine.attack": "attack",
+    "engine.sign_bab": "sign_bab",
+    "engine.bab": "bab",
+    "engine.pair_lp": "pair_lp",
+    "engine.lattice": "lattice",
+    "engine.lattice_first": "lattice",
+}
+PHASES = tuple(dict.fromkeys(PHASE_SPANS.values()))
 
 
-def profile_target(run_id, preset_name, overrides, model, sample, deadline):
+def _aggregate_spans(trace_path):
+    """Phase-second totals + wall markers from one target's event log."""
+    from fairify_tpu import obs
+
+    totals = {p: 0.0 for p in PHASES}
+    marks = {}
+    for rec in obs.load_events(trace_path):
+        if rec.get("type") != "span":
+            continue
+        dur = float(rec.get("dur_s") or 0.0)
+        name = rec.get("name")
+        if name in ("stage0_decide", "profile.decide_many"):
+            marks[name] = marks.get(name, 0.0) + dur
+        phase = PHASE_SPANS.get(name)
+        if phase is not None:
+            totals[phase] += dur
+    return totals, marks
+
+
+def profile_target(run_id, preset_name, overrides, model, sample, deadline,
+                   trace_path):
+    from fairify_tpu import obs
     from fairify_tpu.data import loaders
     from fairify_tpu.models import zoo
     from fairify_tpu.verify import engine, presets, sweep
@@ -54,38 +91,41 @@ def profile_target(run_id, preset_name, overrides, model, sample, deadline):
     enc = encode(cfg.query())
     _, lo, hi = sweep.build_partitions(cfg)
 
-    t0 = time.perf_counter()
-    unsat0, sat0, _ = sweep._stage0_certify_and_attack(net, enc, lo, hi, cfg)
-    stage0_s = time.perf_counter() - t0
-    pending = np.where(~unsat0 & ~sat0)[0]
-    sampled = pending[:sample]
+    with obs.tracing(trace_path, run_id=f"profile-{run_id}-{model}"):
+        with obs.span("stage0_decide", partitions=int(lo.shape[0])):
+            unsat0, sat0, _ = sweep._stage0_certify_and_attack(
+                net, enc, lo, hi, cfg)
+        pending = np.where(~unsat0 & ~sat0)[0]
+        sampled = pending[:sample]
+        decisions = []
+        if sampled.size:
+            with obs.span("profile.decide_many", roots=int(sampled.size)):
+                decisions = engine.decide_many(
+                    net, enc, lo[sampled], hi[sampled], cfg.engine,
+                    deadline_s=deadline)
+
+    totals, marks = _aggregate_spans(trace_path)
     rec = {
         "run_id": run_id, "model": model,
         "grid": int(lo.shape[0]), "stage0_leftover": int(pending.size),
-        "stage0_s": round(stage0_s, 2),
+        "stage0_s": round(marks.get("stage0_decide", 0.0), 2),
         "sampled": int(sampled.size), "deadline_s": deadline,
+        "trace": os.path.relpath(trace_path, ROOT),
     }
     if not sampled.size:
         rec["note"] = "stage-0 decided everything; no hard roots to profile"
         return rec
 
-    t1 = time.perf_counter()
-    decisions = engine.decide_many(
-        net, enc, lo[sampled], hi[sampled], cfg.engine, deadline_s=deadline)
-    wall = time.perf_counter() - t1
-
     counts = {"sat": 0, "unsat": 0, "unknown": 0}
-    totals = {p: 0.0 for p in PHASES}
     roots = []
     for r, d in enumerate(decisions):
         counts[d.verdict] += 1
-        for p in PHASES:
-            totals[p] += d.stats.get(p, 0.0)
         roots.append({
             "root": int(sampled[r]), "verdict": d.verdict,
-            "elapsed_s": round(d.elapsed_s, 3), "nodes": d.nodes,
-            **{p: round(d.stats.get(p, 0.0), 3) for p in PHASES}})
+            "reason": d.reason,
+            "elapsed_s": round(d.elapsed_s, 3), "nodes": d.nodes})
     roots.sort(key=lambda x: -x["elapsed_s"])
+    wall = marks.get("profile.decide_many", 0.0)
     dominant = max(totals, key=totals.get)
     rec.update({
         "wall_s": round(wall, 2), "verdicts": counts,
@@ -108,19 +148,25 @@ def main():
     wanted = None
     if args.targets:
         wanted = {tuple(t.split(":")) for t in args.targets.split(",")}
+    trace_dir = os.path.join(os.path.dirname(os.path.abspath(args.out)),
+                             "profile_phases_traces")
+    os.makedirs(trace_dir, exist_ok=True)
     out = {"what": ("Per-phase second attribution for the round-3 slow-tail "
                     "rows: engine.decide_many on a sample of each model's "
-                    "stage-0 leftovers, with Decision.stats phase splits "
-                    "(S=sign frontier, L=sign host LP, bab=input split, "
-                    "P=pair LP, E=lattice)."),
+                    "stage-0 leftovers, phase seconds aggregated from the "
+                    "obs event-log spans (engine.attack / engine.sign_bab / "
+                    "engine.bab / engine.pair_lp / engine.lattice*)."),
            "script": "scripts/profile_phases.py",
            "records": []}
+    print("note: for sweep-wide attribution use `fairify_tpu report "
+          "--funnel` on a --trace-out event log", file=sys.stderr)
     for run_id, preset, overrides, model in TARGETS:
         if wanted is not None and (run_id, model) not in wanted:
             continue
         print(f"== profiling {run_id}/{model}", flush=True)
+        trace_path = os.path.join(trace_dir, f"{run_id}_{model}.jsonl")
         rec = profile_target(run_id, preset, overrides, model,
-                             args.sample, args.deadline)
+                             args.sample, args.deadline, trace_path)
         print(json.dumps(rec, indent=None), flush=True)
         out["records"].append(rec)
     os.makedirs(os.path.dirname(args.out), exist_ok=True)

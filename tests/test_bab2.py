@@ -88,7 +88,7 @@ def test_tied_diff_certificate_sound(seed):
     x_lo, x_hi, xp_lo, xp_hi, valid = prop.role_boxes(
         enc, lo.astype(np.float32), hi.astype(np.float32))
     av, pm, rm = engine._enc_tensors(enc, 3)
-    cert, score = engine._role_certify_kernel(
+    cert, score, _margin = engine._role_certify_kernel(
         net, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
         jnp.asarray(xp_hi), jnp.asarray(lo, jnp.float32),
         jnp.asarray(hi, jnp.float32), jnp.asarray(av), jnp.asarray(pm),

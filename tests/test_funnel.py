@@ -1,0 +1,236 @@
+"""Verification-funnel telemetry (DESIGN.md §20).
+
+Tier-1 pins for the funnel contract:
+
+* every partition lands in EXACTLY one terminal state — the state counts
+  sum to the grid size and ``decided_fraction`` is their decided share;
+* counts AND the stage-0 margin/gap histograms are bit-invariant across
+  ``mega_chunks`` ∈ {0, 1, 4} × ``pipeline_depth`` ∈ {1, 2} (the mega
+  loop carries the histograms in its ``lax.scan`` carry; the chunk loop
+  buckets host-side under the same rule);
+* a chaos-injected ``launch.submit`` exhaustion surfaces as
+  ``unknown:failure:launch.submit`` — degradations are never folded into
+  the generic unknown buckets;
+* the device bucket rule is bit-identical to an independent NumPy
+  recomputation (searchsorted semantics), edge values and padded rows
+  included, and the non-negative margin mass equals the run's
+  stage-0-certified population cross-checked against the ledger;
+* ``fairify_tpu report --funnel`` renders the table from an event log;
+* the budgeted ladder's unattempted tail is ``unknown:budget`` and its
+  ``decided_fraction`` is measured over the FULL grid.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+
+from fairify_tpu import obs
+from fairify_tpu.models.train import init_mlp
+from fairify_tpu.obs import funnel
+from fairify_tpu.verify import presets, sweep
+
+
+def _cfg(tmp_path, sub, **kw):
+    return presets.get("GC").with_(
+        result_dir=str(tmp_path / sub), soft_timeout_s=30.0,
+        hard_timeout_s=300.0, sim_size=64, exact_certify_masks=False,
+        grid_chunk=16, **kw)
+
+
+def test_funnel_counts_sum_and_bit_invariant(tmp_path):
+    """States sum to the grid size; states AND histograms are bit-equal
+    across mega_chunks {0, 1, 4} x pipeline_depth {1, 2}."""
+    net = init_mlp((20, 8, 1), seed=3)
+    span = (0, 48)
+    payloads = {}
+    for mc in (0, 1, 4):
+        for depth in (1, 2):
+            cfg = _cfg(tmp_path, f"f_{mc}_{depth}", mega_chunks=mc,
+                       pipeline_depth=depth)
+            rep = sweep.verify_model(net, cfg, model_name="m", resume=False,
+                                     partition_span=span)
+            fun = rep.funnel
+            assert fun is not None
+            assert sum(fun["states"].values()) == fun["total"] == 48
+            decided = sum(n for s, n in fun["states"].items()
+                          if funnel.is_decided(s))
+            assert fun["decided"] == decided
+            assert fun["decided_fraction"] == pytest.approx(decided / 48.0)
+            for state in fun["states"]:
+                assert state.startswith("unknown:failure:") \
+                    or state in funnel.STATES, state
+            payloads[(mc, depth)] = fun
+    ref = payloads[(0, 1)]
+    for key, fun in payloads.items():
+        assert fun["states"] == ref["states"], f"funnel drift at {key}"
+        assert fun["margin_hist"] == ref["margin_hist"], f"hist drift at {key}"
+
+
+def test_funnel_launch_submit_exhaustion(tmp_path):
+    """Exhausting launch.submit on exactly one mega segment classifies
+    that segment's 16 partitions as unknown:failure:launch.submit."""
+    net = init_mlp((20, 8, 1), seed=3)
+    span = (0, 48)
+    # mega_chunks=1 -> 3 one-chunk segments per phase; max_launch_retries=2
+    # means arrivals {2, 3, 4} are segment 2's attempt + both retries.
+    cfg = _cfg(tmp_path, "chaos", mega_chunks=1, max_launch_retries=2,
+               launch_backoff_s=0.001,
+               inject_faults=("launch.submit:transient:2-4",))
+    rep = sweep.verify_model(net, cfg, model_name="m", resume=False,
+                             partition_span=span)
+    fun = rep.funnel
+    assert rep.degraded == 16
+    assert fun["states"].get("unknown:failure:launch.submit") == 16
+    assert sum(fun["states"].values()) == 48
+    # Degraded partitions never produced margins: the histograms count
+    # only the two healthy segments' boxes.
+    assert sum(fun["margin_hist"]["margin"]) == 32
+
+
+def test_bucket_rule_device_matches_numpy():
+    """The device one-hot comparison-count rule == NumPy searchsorted
+    (an independent implementation), on every edge value, +-eps around
+    each edge, the extremes, and with padded rows masked out."""
+    import jax.numpy as jnp
+
+    vals = np.concatenate([
+        funnel.EDGES,
+        funnel.EDGES - np.float32(1e-3),
+        funnel.EDGES + np.float32(1e-3),
+        np.array([-1e6, 0.0, 1e6], np.float32),
+    ]).astype(np.float32)
+    gaps = (-vals).astype(np.float32)
+    n = vals.size - 3  # the last 3 rows are padding: they must not count
+    dev = np.asarray(sweep._chunk_stats_dev(
+        jnp.asarray(vals), jnp.asarray(gaps), n))
+
+    def np_hist(v):
+        idx = np.searchsorted(funnel.EDGES, v, side="right")
+        return np.bincount(idx, minlength=funnel.N_BUCKETS)
+
+    np.testing.assert_array_equal(dev[funnel.MARGIN_ROW], np_hist(vals[:n]))
+    np.testing.assert_array_equal(dev[funnel.GAP_ROW], np_hist(gaps[:n]))
+    # The host mirror (chunk-loop path) follows the same rule bit-for-bit.
+    ok = np.arange(vals.size) < n
+    np.testing.assert_array_equal(funnel.hist(vals, ok), np_hist(vals[:n]))
+    np.testing.assert_array_equal(funnel.hist(gaps, ok), np_hist(gaps[:n]))
+
+
+def test_mega_hist_matches_numpy_recompute(tmp_path, monkeypatch):
+    """Tiny grid: the mega loop's device-carried histograms equal a NumPy
+    searchsorted recomputation from the raw chunk-loop margins/gaps."""
+    from fairify_tpu.verify.property import encode
+
+    net = init_mlp((20, 8, 1), seed=3)
+    cfg0 = _cfg(tmp_path, "np0", mega_chunks=0)
+    enc = encode(cfg0.query())
+    _, lo, hi = sweep.build_partitions(cfg0)
+    lo, hi = lo[:32], hi[:32]
+
+    captured = []
+    orig_add = funnel.StageStats.add_values
+
+    def capture(self, margin, gap, ok=None):
+        captured.append((np.array(margin, np.float32),
+                         np.array(gap, np.float32)))
+        return orig_add(self, margin, gap, ok)
+
+    monkeypatch.setattr(funnel.StageStats, "add_values", capture)
+    chunk_stats = funnel.StageStats()
+    sweep._stage0_certify_and_attack(net, enc, lo, hi, cfg0,
+                                     stats=chunk_stats)
+    monkeypatch.undo()
+    assert captured and sum(m.size for m, _ in captured) == 32
+
+    mega_stats = funnel.StageStats()
+    sweep._stage0_certify_and_attack(net, enc, lo, hi,
+                                     _cfg(tmp_path, "np2", mega_chunks=2),
+                                     stats=mega_stats)
+    assert mega_stats.boxes == 32
+
+    margins = np.concatenate([m for m, _ in captured])
+    gaps = np.concatenate([g for _, g in captured])
+
+    def np_hist(v):
+        idx = np.searchsorted(funnel.EDGES, v, side="right")
+        return np.bincount(idx, minlength=funnel.N_BUCKETS)
+
+    np.testing.assert_array_equal(mega_stats.margin_hist, np_hist(margins))
+    np.testing.assert_array_equal(mega_stats.gap_hist, np_hist(gaps))
+    np.testing.assert_array_equal(mega_stats.hist, chunk_stats.hist)
+
+
+def test_funnel_hist_ledger_consistency(tmp_path):
+    """margin >= 0 <=> certified at stage 0: the non-negative margin mass
+    equals the certified:stage0 state count, and the funnel's sat/unsat/
+    unknown split equals the ledger's verdict counts."""
+    net = init_mlp((20, 8, 1), seed=3)
+    span = (0, 32)
+    cfg = _cfg(tmp_path, "led", mega_chunks=2)
+    rep = sweep.verify_model(net, cfg, model_name="m", resume=False,
+                             partition_span=span)
+    fun = rep.funnel
+    mh = fun["margin_hist"]["margin"]
+    assert sum(mh) == 32
+    assert sum(mh[funnel.NEG_BUCKETS:]) == \
+        fun["states"].get("certified:stage0", 0)
+
+    recs, skipped = sweep._read_ledger(
+        str(tmp_path / "led" / "GC-m@0-32.ledger.jsonl"))
+    assert skipped == 0 and len(recs) == 32
+    by_verdict = {"sat": 0, "unsat": 0, "unknown": 0}
+    for rec in recs:
+        by_verdict[rec["verdict"]] += 1
+    states = fun["states"]
+    assert by_verdict["unsat"] == sum(
+        states.get(s, 0) for s in ("certified:stage0", "certified:bab",
+                                   "smt:unsat"))
+    assert by_verdict["sat"] == sum(
+        states.get(s, 0) for s in ("attacked:stage0", "attacked:bab",
+                                   "smt:sat"))
+    assert by_verdict["unknown"] == sum(
+        n for s, n in states.items() if s.startswith("unknown"))
+
+
+def test_report_funnel_renders(tmp_path):
+    """`fairify_tpu report --funnel` renders the state table, the decided
+    fraction, and the stage-0 bucket table from a traced run's log."""
+    from fairify_tpu.obs import report
+
+    net = init_mlp((20, 8, 1), seed=3)
+    cfg = _cfg(tmp_path, "rpt", mega_chunks=2)
+    log = str(tmp_path / "events.jsonl")
+    with obs.tracing(log, run_id="funnel-test"):
+        sweep.verify_model(net, cfg, model_name="m", resume=False,
+                           partition_span=(0, 32))
+    agg = report.aggregate([log])
+    fun = agg["funnel"]
+    assert sum(fun["states"].values()) == 32
+    assert fun["margin_hist"] is not None
+    text = report.render_funnel(agg)
+    assert "funnel state" in text
+    assert "decided fraction:" in text
+    assert "stage-0 bucket" in text
+
+
+def test_budgeted_tail_is_unknown_budget(tmp_path):
+    """A zero hard budget attempts nothing: decided_fraction 0.0 over the
+    FULL grid, and the whole tail mirrors into unknown:budget."""
+    import _sweeplib
+
+    cfg = presets.get("GC").with_(
+        soft_timeout_s=2.0, hard_timeout_s=0.0,
+        result_dir=str(tmp_path / "out"), grid_chunk=64)
+    net = init_mlp((20, 6, 1), seed=1)
+    before = funnel.live_decided()
+    c = obs.registry().counter("funnel_states")
+    budget0 = c.value(state="unknown:budget") or 0
+    rec = _sweeplib.budgeted_model_sweep(cfg, net, "m")
+    assert rec["attempted"] == 0 and rec["partitions"] == 201
+    assert rec["decided_fraction"] == 0.0
+    assert (c.value(state="unknown:budget") or 0) - budget0 == 201
+    assert funnel.live_decided() == before

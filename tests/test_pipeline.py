@@ -159,11 +159,11 @@ def test_crash_with_inflight_chunks_never_ledgers_undrained(tmp_path, monkeypatc
     real_decode = sweep._stage0_block_decode
     calls = {"n": 0}
 
-    def dying_decode(host, ctx):
+    def dying_decode(host, ctx, stats=None):
         calls["n"] += 1
         if calls["n"] >= 2:  # die at the second drain — one chunk in flight
             raise RuntimeError("simulated crash mid-drain")
-        return real_decode(host, ctx)
+        return real_decode(host, ctx, stats)
 
     monkeypatch.setattr(sweep, "_stage0_block_decode", dying_decode)
     with pytest.raises(RuntimeError, match="mid-drain"):

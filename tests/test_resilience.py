@@ -549,7 +549,7 @@ def test_smt_retry_ladder_wired_into_unknown_retry(tmp_path, monkeypatch):
 
     span = (0, 16)
 
-    def dull_decode(host, ctx):  # stage 0 decides nothing
+    def dull_decode(host, ctx, stats=None):  # stage 0 decides nothing
         n = ctx["n"]
         return np.zeros(n, bool), np.zeros(n, bool), {}
 
@@ -610,7 +610,7 @@ def _all_unknown_engine(monkeypatch):
     (the real stage 0 certifies tiny boxes outright)."""
     from fairify_tpu.verify import engine as engine_mod
 
-    def dull_decode(host, ctx):
+    def dull_decode(host, ctx, stats=None):
         n = ctx["n"]
         return np.zeros(n, bool), np.zeros(n, bool), {}
 
