@@ -172,11 +172,50 @@ def main(trace_out=None, heartbeat_s: float = 0.0, xprof_dir=None) -> None:
             run_rec["compile_s"] = thr.get("compile_s")
             run_rec["n_compiles"] = thr.get("n_compiles")
             run_rec["decided_fraction"] = thr.get("decided_fraction")
+            res = thr.get("resilience") or {}
+            run_rec["integrity_violations"] = res.get(
+                "integrity_violations", 0)
+            run_rec["ledger_crc_mismatch"] = res.get(
+                "ledger_crc_mismatch", 0)
         except (OSError, ValueError):
             pass
         runs.append(run_rec)
 
     pps, lo_v, hi_v = _median_band(runs)
+
+    # Integrity-recheck A/B (ISSUE 19, DESIGN.md §21): ONE extra run with
+    # the benched sampled-recheck rate; overhead_rel is the decided-
+    # throughput cost vs the plain median — perfdiff gates it lower-is-
+    # better with a 5-point floor, so a recheck that stops being
+    # within-noise fails the round.
+    from fairify_tpu.resilience import integrity as integrity_mod
+
+    integrity_ab = None
+    try:
+        shutil.rmtree(cfg.result_dir, ignore_errors=True)
+        obs.registry().reset()
+        rcfg = cfg.with_(
+            integrity_recheck=integrity_mod.DEFAULT_RECHECK_RATE)
+        t0 = time.perf_counter()
+        rrep = sweep.verify_model(net, rcfg, model_name="GC-1",
+                                  resume=False)
+        relapsed = time.perf_counter() - t0
+        rdecided = rrep.counts["sat"] + rrep.counts["unsat"]
+        pps_on = round(rdecided / relapsed, 4) if relapsed > 0 else 0.0
+        integrity_ab = {
+            "recheck_rate": integrity_mod.DEFAULT_RECHECK_RATE,
+            "pps_on": pps_on,
+            "pps_off": pps,
+            "overhead_rel": (round(max(0.0, (pps - pps_on) / pps), 4)
+                             if pps > 0 else 0.0),
+            "rechecks": int(obs.registry().counter(
+                "integrity_rechecks").total()),
+            "violations": int(obs.registry().counter(
+                "integrity_violations").total()),
+        }
+    except Exception as exc:  # the A/B must never kill the headline
+        print(json.dumps({"metric": "integrity_ab_error",
+                          "error": str(exc)[:200]}), file=sys.stderr)
     counts = report.counts
     median_run = next(r for r in runs if r["value"] == pps)
     print(json.dumps({
@@ -206,6 +245,12 @@ def main(trace_out=None, heartbeat_s: float = 0.0, xprof_dir=None) -> None:
         # Funnel success metric (obs.funnel, perfdiff-gated HIGHER is
         # better): decided partitions over classified partitions.
         "decided_fraction": median_run.get("decided_fraction"),
+        # Integrity (DESIGN.md §21, perfdiff-gated lower is better): both
+        # counters must stay zero on a healthy bench; integrity_ab carries
+        # the sampled-recheck overhead vs the plain median.
+        "integrity_violations": median_run.get("integrity_violations"),
+        "ledger_crc_mismatch": median_run.get("ledger_crc_mismatch"),
+        "integrity_ab": integrity_ab,
     }))
 
 

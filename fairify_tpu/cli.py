@@ -103,6 +103,13 @@ def _overridden_cfg(args):
         overrides["smt_memory_cap_mb"] = int(args.smt_memory_cap)
     if getattr(args, "smt_portfolio", None) is not None:
         overrides["smt_portfolio"] = int(args.smt_portfolio)
+    if getattr(args, "no_integrity", False):
+        overrides["integrity"] = False
+    if getattr(args, "integrity_recheck", None) is not None:
+        rate = float(args.integrity_recheck)
+        if not 0.0 <= rate <= 1.0:
+            raise SystemExit("--integrity-recheck must be in [0, 1]")
+        overrides["integrity_recheck"] = rate
     return cfg.with_(**overrides) if overrides else cfg
 
 
@@ -520,8 +527,9 @@ def main(argv=None) -> int:
     run.add_argument("--inject-fault", action="append", default=None,
                      metavar="SITE:KIND:NTH",
                      help="chaos testing: schedule a fault, e.g. "
-                          "launch.submit:transient:3 or compile:crash:1 "
-                          "(repeatable; sites: launch.submit launch.decode "
+                          "launch.submit:transient:3, compile:crash:1, or "
+                          "launch.decode:corrupt:2 (silent bit-flip; "
+                          "repeatable; sites: launch.submit launch.decode "
                           "compile smt.query ledger.append "
                           "smt.worker.{spawn,crash,hang,memout} ...)")
     run.add_argument("--smt-retry", type=float, nargs="*", default=None,
@@ -540,6 +548,17 @@ def main(argv=None) -> int:
                      metavar="K",
                      help="race K solver seed variants per SMT query and "
                           "take the first decisive answer (0/1 = off)")
+    run.add_argument("--integrity-recheck", type=float, default=None,
+                     metavar="RATE",
+                     help="sampled-recheck rate in [0,1]: re-execute this "
+                          "fraction of decided chunks (bit-equality "
+                          "required) and escalate a sample of certified / "
+                          "SMT-unsat verdicts to the exact-rational oracle "
+                          "(default 0; 0.05 is the benched operating point)")
+    run.add_argument("--no-integrity", action="store_true",
+                     help="disable the always-on SDC detectors (canary "
+                          "chunk, fold checksum, ledger row CRC) — A/B "
+                          "debugging only, DESIGN.md §21")
 
     ben = sub.add_parser("bench", help="run the headline benchmark")
     ben.add_argument("--trace-out", default=None,

@@ -139,9 +139,9 @@ class FaultSiteRule(Rule):
 #: needs the test/driver that actually exercises it; a stale entry (site
 #: retired, or a chaos cell later added) is itself a finding.
 CHAOS_EXEMPT = {
-    # decide_box_smt needs z3-solver, absent from the chaos image; the
-    # z3-gated tests in tests/test_resilience.py cover the site.
-    "smt.query": "z3-gated tests in tests/test_resilience.py",
+    # smt.query earned matrix cells in the --integrity section (the
+    # corrupt-witness cells ride the brute fallback solver, no z3
+    # needed), so its old z3-gated exemption is gone.
     # Sharded-runtime dispatch/gather faults are exercised by the sharded
     # chaos tests in tests/test_resilience.py (sharded-vs-plain
     # bit-equality, interleaved shard journals); the matrix covers the
@@ -151,8 +151,11 @@ CHAOS_EXEMPT = {
 }
 
 #: A full injection spec literal: site:kind:nth (kind vocabulary pinned so
-#: arbitrary colon-bearing strings never match).
-_SPEC_RE = re.compile(r"^([a-z][a-z._]*):(transient|fatal|crash)\b")
+#: arbitrary colon-bearing strings never match; ``corrupt`` is the
+#: bit-flip kind of the result-integrity layer, DESIGN.md §21).  The
+#: ``:nth`` tail is required — degrade *reasons* reuse the ``site:kind``
+#: shape (``integrity.launch.decode:fatal``) and must not count as cells.
+_SPEC_RE = re.compile(r"^([a-z][a-z._]*):(transient|fatal|crash|corrupt):\d+\+?$")
 #: An f-string site fragment: the literal head of f"{site}:..." style specs.
 _FRAG_RE = re.compile(r"^([a-z][a-z._]*):")
 

@@ -93,6 +93,12 @@ ALLOW_LOOP_FETCH = frozenset({
     # fetched payloads feed immediately-following serial host solvers.
     "fairify_tpu/verify/engine.py::_lattice_phase",
     "fairify_tpu/verify/engine.py::_pair_lp_phase",
+    # Integrity sampled recheck (DESIGN.md §21): deliberately OFF-pipeline —
+    # an independent synchronous re-execution whose result must be compared
+    # bit-for-bit against the banked verdicts before the next chunk is
+    # trusted; routing it through the shared pipeline would let a corrupted
+    # launch path corrupt its own check.
+    "fairify_tpu/verify/sweep.py::_sampled_recheck",
 })
 
 ALLOW_BROAD_EXCEPT = frozenset({

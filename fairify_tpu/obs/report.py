@@ -21,6 +21,13 @@ results directory's worth), produce
   machine-readable reason code (``site:kind``), read from degraded verdict
   events or directly from verdict-ledger files (``*.ledger.jsonl`` may be
   passed as inputs; their ``failure`` records are the source of truth);
+* an **integrity table** — the result-integrity layer's counters
+  (DESIGN.md §21) from each run's closing metrics snapshot: detected
+  corruption by injection site (``integrity_violations``), sampled
+  rechecks by kind (``integrity_rechecks``), checksum-rejected ledger
+  rows (``ledger_crc_mismatch``) and suspect-replica flags
+  (``replica_suspect``) — every value here is a corruption that was
+  CAUGHT; a healthy run renders an all-zero (absent) table;
 * an **SMT outcome table** — per-reason query outcomes of the worker pool
   (``fairify_tpu/smt``): decided vs ``timeout`` / ``memout`` /
   ``solver-error`` / ``smt.worker:*`` worker-death reasons, read from the
@@ -98,6 +105,7 @@ def aggregate(paths: Iterable[str]) -> dict:
     replicas: Dict[int, dict] = {}  # process-fleet replica rows (`replica`)
     compiles: Dict[str, dict] = {}  # kernel -> compile-table row
     smt_outcomes: Dict[str, int] = {}  # decided / per-reason query counts
+    integrity: Dict[str, Dict[str, int]] = {}  # counter -> label -> count
     lock_edges: Dict[tuple, int] = {}  # (src site, dst site) -> count
     segments: Dict[str, dict] = {}  # mega-loop phase -> done/total row
     funnel_hist = None              # summed margin/gap histogram payload
@@ -263,6 +271,24 @@ def aggregate(paths: Iterable[str]) -> dict:
                         ("sat", "unsat") else labels.get("reason", "?")
                     smt_outcomes[key] = smt_outcomes.get(key, 0) \
                         + int(s.get("value", 0))
+                # Result-integrity counters (DESIGN.md §21): violations keep
+                # their detection site, rechecks their kind; the unlabeled
+                # CRC / suspect counters fold under "-".  Only nonzero
+                # series land here, so a clean run has no integrity block.
+                for cname, lab in (("integrity_violations", "site"),
+                                   ("integrity_rechecks", "kind")):
+                    for s in metrics.get(cname, {}).get("series", []):
+                        n = int(s.get("value", 0))
+                        if not n:
+                            continue
+                        key = dict(s.get("labels", {})).get(lab, "?")
+                        row = integrity.setdefault(cname, {})
+                        row[key] = row.get(key, 0) + n
+                for cname in ("ledger_crc_mismatch", "replica_suspect"):
+                    tot = int(_counter_total(metrics, cname))
+                    if tot:
+                        row = integrity.setdefault(cname, {})
+                        row["-"] = row.get("-", 0) + tot
                 # Compiles that happened while no tracer was active (e.g. a
                 # warm-up pass inside the traced scope's registry window)
                 # have no compile.<kernel> span; the closing snapshot's
@@ -381,6 +407,8 @@ def aggregate(paths: Iterable[str]) -> dict:
         "via": via,
         "degraded": dict(sorted(degraded.items(), key=lambda kv: -kv[1])),
         "smt": dict(sorted(smt_outcomes.items(), key=lambda kv: -kv[1])),
+        "integrity": {k: dict(sorted(integrity[k].items()))
+                      for k in sorted(integrity)},
         "shards": {k: shards[k] for k in sorted(shards)},
         "requests": request_table,
         "replicas": {str(k): replicas[k] for k in sorted(replicas)},
@@ -602,6 +630,19 @@ def render(agg: dict) -> str:
         lines.append(f"{'smt outcome':<{w}}  {'queries':>8}")
         for reason, n in agg["smt"].items():
             lines.append(f"{reason:<{w}}  {n:>8}")
+    if agg.get("integrity"):
+        rows = [(counter, label, n)
+                for counter, d in agg["integrity"].items()
+                for label, n in d.items()]
+        w = max(max(len(c) for c, _, _ in rows), len("integrity counter"))
+        lw = max(max(len(lb) for _, lb, _ in rows), len("site/kind"))
+        lines.append("")
+        lines.append(f"{'integrity counter':<{w}}  {'site/kind':<{lw}}  "
+                     f"{'count':>6}")
+        for counter, label, n in rows:
+            lines.append(f"{counter:<{w}}  {label:<{lw}}  {n:>6}")
+        lines.append("(every count is a CAUGHT corruption — "
+                     "DESIGN.md §21; a clean run has no integrity table)")
     if agg.get("shards"):
         w = max(max(len(k) for k in agg["shards"]), len("shard"))
         lines.append("")

@@ -218,6 +218,18 @@ class LaunchPipeline:
                                                on_retry=redispatch)
                 except ChunkDegraded as exc:
                     host = exc.failure
+        if self._fault_sites and isinstance(host, dict):
+            # Data-plane chaos: an armed launch.decode:corrupt spec flips
+            # one bit in the fetched payload (no error raised) — only the
+            # consumer's integrity layer (canary + fold checksum,
+            # resilience/integrity.py) may notice.  Separate arrival
+            # stream from faults.check above, so corrupt schedules never
+            # shift the control-plane ones.
+            n = faults.corruption("launch.decode")
+            if n is not None:
+                from fairify_tpu.resilience import integrity
+
+                host = integrity.corrupt_host(host, n)
         self.stats.update(len(self._q))
         self._record_gauge()
         return meta, ctx, host

@@ -63,8 +63,16 @@ A **spec** is ``site:kind:nth``:
 
 * ``kind`` — ``transient`` (retryable; the supervisor backs off and
   re-attempts), ``fatal`` (non-retryable; the chunk degrades immediately),
-  or ``crash`` (never handled; propagates like a SIGKILL would, for
-  crash-resume chaos tests).
+  ``crash`` (never handled; propagates like a SIGKILL would, for
+  crash-resume chaos tests), or ``corrupt`` (silent-data-corruption: the
+  site does NOT raise — it deterministically flips a bit in the payload it
+  was about to trust, and the integrity layer
+  (:mod:`resilience.integrity`) must catch it downstream).  ``corrupt`` is
+  only valid at the data-plane sites ``launch.decode`` (decoded device
+  buffers), ``ledger.append`` (durable verdict rows), and ``smt.query``
+  (solver witness payloads); it is consumed via :func:`corruption`, which
+  keeps its own per-site arrival counters so arming a corrupt spec never
+  shifts an existing ``check``-based chaos schedule.
 * ``nth`` — which arrivals at the site fire: ``3`` (the 3rd arrival only),
   ``3+`` (every arrival from the 3rd), ``3-5`` (an inclusive range), or
   ``p0.25`` (each arrival independently with probability 0.25, drawn from
@@ -92,7 +100,11 @@ FAULT_SITES = frozenset(
      "request.preempt", "replica.lost", "replica.spawn", "replica.lease",
      "smt.worker.spawn", "smt.worker.crash", "smt.worker.hang",
      "smt.worker.memout"})
-FAULT_KINDS = frozenset({"transient", "fatal", "crash"})
+FAULT_KINDS = frozenset({"transient", "fatal", "crash", "corrupt"})
+# ``corrupt`` models a bit flip in data the site hands downstream, not a
+# failed call — it only makes sense where a payload exists to corrupt AND
+# an integrity detector exists to catch it (resilience/integrity.py).
+CORRUPT_SITES = frozenset({"launch.decode", "ledger.append", "smt.query"})
 
 _SPEC_RE = re.compile(
     r"^(?P<site>[a-z.]+):(?P<kind>[a-z]+):"
@@ -146,6 +158,10 @@ def parse_spec(spec: str) -> FaultSpec:
     if kind not in FAULT_KINDS:
         raise ValueError(f"unknown fault kind {kind!r} "
                          f"(known: {sorted(FAULT_KINDS)})")
+    if kind == "corrupt" and site not in CORRUPT_SITES:
+        raise ValueError(
+            f"fault kind 'corrupt' is only valid at data-plane sites "
+            f"{sorted(CORRUPT_SITES)}, not {site!r}")
     if nth.startswith("p"):
         return FaultSpec(site, kind, rate=float(nth[1:]))
     if nth.endswith("+"):
@@ -173,18 +189,25 @@ class FaultPlan:
         self.specs = parse_specs(specs)
         self._rng = np.random.default_rng(seed)
         self._arrivals: Dict[str, int] = {}
+        self._corrupt_arrivals: Dict[str, int] = {}
         self._lock = threading.Lock()
 
     def arrivals(self, site: str) -> int:
         return self._arrivals.get(site, 0)
 
     def check(self, site: str) -> None:
-        """Count one arrival at ``site``; raise if a spec schedules it."""
+        """Count one arrival at ``site``; raise if a spec schedules it.
+
+        ``corrupt`` specs are invisible here — they live on their own
+        arrival stream (:meth:`corruption`), so arming one can never shift
+        the arrival numbering an existing chaos schedule depends on.
+        """
         with self._lock:
             n = self._arrivals.get(site, 0) + 1
             self._arrivals[site] = n
             hit = next((s for s in self.specs
-                        if s.site == site and s.fires(n, self._rng)), None)
+                        if s.site == site and s.kind != "corrupt"
+                        and s.fires(n, self._rng)), None)
         if hit is None:
             return
         from fairify_tpu import obs
@@ -192,6 +215,29 @@ class FaultPlan:
         obs.registry().counter("fault_injected").inc(site=site, kind=hit.kind)
         obs.event("fault_injected", site=site, kind=hit.kind, arrival=n)
         raise InjectedFault(site, hit.kind, n)
+
+    def corruption(self, site: str) -> Optional[int]:
+        """Count one data-plane arrival at ``site``; return the arrival
+        number if a ``corrupt`` spec schedules a bit flip there, else None.
+
+        Never raises: the caller is expected to mutate the payload it was
+        about to trust (:mod:`resilience.integrity` provides deterministic
+        flip helpers keyed on the returned arrival number) and carry on —
+        the whole point is that only the integrity layer may notice.
+        """
+        with self._lock:
+            n = self._corrupt_arrivals.get(site, 0) + 1
+            self._corrupt_arrivals[site] = n
+            hit = next((s for s in self.specs
+                        if s.site == site and s.kind == "corrupt"
+                        and s.fires(n, self._rng)), None)
+        if hit is None:
+            return None
+        from fairify_tpu import obs
+
+        obs.registry().counter("fault_injected").inc(site=site, kind="corrupt")
+        obs.event("fault_injected", site=site, kind="corrupt", arrival=n)
+        return n
 
 
 _active: Optional[FaultPlan] = None
@@ -226,6 +272,15 @@ def check(site: str) -> None:
     plan = _active
     if plan is not None:
         plan.check(site)
+
+
+def corruption(site: str) -> Optional[int]:
+    """One data-plane arrival at ``site`` — None unless an armed ``corrupt``
+    spec fires there (see :meth:`FaultPlan.corruption`)."""
+    plan = _active
+    if plan is None:
+        return None
+    return plan.corruption(site)
 
 
 class armed:

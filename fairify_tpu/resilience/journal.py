@@ -24,6 +24,17 @@ hazards, three answers:
 
 ``JournalWriter`` is also a named fault-injection site (``ledger.append``)
 so the chaos suite can pin all of the above.
+
+A fourth hazard is *silent* (DESIGN.md §21): a bit flipped in a row that
+still parses — the framing survives, the verdict is wrong.  Verdict
+ledgers therefore opt into a per-row CRC (``crc=True``): each record is
+written with a ``_crc`` field (CRC-32 of the canonical JSON body,
+:func:`resilience.integrity.record_crc`) that ``sweep._read_ledger`` /
+``merge_ledgers`` verify on replay.  A mismatched row is dropped and
+counted (``ledger_crc_mismatch`` — distinct from torn lines), so the pid
+is simply un-ledgered and a resume re-decides it: re-attempted, never
+trusted.  The ``ledger.append:corrupt`` chaos spec injects exactly this
+hazard — the row mutates *after* its CRC is computed, staying valid JSON.
 """
 from __future__ import annotations
 
@@ -45,7 +56,8 @@ class JournalWriter:
     """Append-only JSONL sink with crash-safe, supervised appends."""
 
     def __init__(self, path: str, fsync: bool = True,
-                 fault_site: Optional[str] = None, supervisor=None):
+                 fault_site: Optional[str] = None, supervisor=None,
+                 crc: bool = False):
         parent = os.path.dirname(path)
         if parent:
             os.makedirs(parent, exist_ok=True)
@@ -54,6 +66,7 @@ class JournalWriter:
         self._fsync = fsync
         self._site = fault_site
         self._sup = supervisor
+        self._crc = crc
         self._lock = threading.Lock()
 
     def _append_once(self, line: str) -> None:
@@ -70,6 +83,18 @@ class JournalWriter:
         Without a supervisor, errors propagate (callers that cannot
         tolerate a lost record should not pass one).
         """
+        if self._crc:
+            from fairify_tpu.resilience import faults, integrity
+
+            crc = integrity.record_crc(rec)
+            n = faults.corruption(self._site or "ledger.append")
+            if n is not None:
+                # Injected SDC: mutate AFTER the CRC is sealed, keeping
+                # the row valid JSON — the reader's CRC check, not its
+                # parser, must be what catches it.
+                rec = integrity.corrupt_record(rec, n)
+            rec = dict(rec)
+            rec["_crc"] = crc
         line = json.dumps(rec) + "\n"
         if self._sup is None:
             self._append_once(line)

@@ -490,6 +490,17 @@ class ServerFleet:
                 srv.kill()
             started = srv.started()
             dead = srv.killed() or (started and not srv.alive())
+            if not dead and srv.suspect():
+                # An integrity violation fired inside this replica
+                # (DESIGN.md §21): its data path is no longer trusted.
+                # Quarantine = the death path — kill it and let failover
+                # re-home its requests to clean replicas (resume replay
+                # re-attempts the degraded integrity.* partitions).
+                obs.registry().counter("replica_quarantined").inc(
+                    replica=i, why="integrity")
+                obs.event("replica_quarantined", replica=i, why="integrity")
+                srv.kill()
+                dead = True
             if not dead and self.cfg.lease_s > 0 and started:
                 dead = srv.lease_age() > self.cfg.lease_s
             if dead:

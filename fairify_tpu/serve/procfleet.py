@@ -26,7 +26,11 @@ domain in the serving stack:
   SIGTERM → SIGKILL after ``term_grace_s`` — the watchdog a thread fleet
   can never have, and the only cure for a SIGSTOP/wedged-launch replica;
   ``spawn`` — no hello within ``spawn_timeout_s`` or a fork/exec
-  failure.  ``replica.spawn`` and ``replica.lease`` are the chaos sites.
+  failure; ``integrity`` — the replica's metrics beat reported
+  ``integrity_violations > 0`` (it detected silent data corruption in
+  its own data path, DESIGN.md §21): the process is alive but no longer
+  trusted, so it is killed and failed over like a death.
+  ``replica.spawn`` and ``replica.lease`` are the chaos sites.
 * **restarts are bounded, jittered backoff** — each death schedules a
   respawn at ``backoff_s * 2^n * jitter`` up to ``max_restarts`` per
   slot; an exhausted slot is abandoned (its work re-homes) rather than
@@ -200,6 +204,7 @@ class ProcessFleet:
         self._status: Dict[str, str] = {}     # request id -> last status
         self._drain_stats: Dict[int, dict] = {}  # slot -> last drained msg
         self._replica_metrics: Dict[int, dict] = {}  # slot -> last beat
+        self._suspect_slots: set = set()  # integrity violations seen (§21)
         self._fleet_metrics_at = 0.0          # last fleet_metrics.json dump
         self._rehomed_total = 0
         self._draining = False
@@ -421,6 +426,12 @@ class ProcessFleet:
                 snap["launches_per_model"], replica=idx)
         with self._cv:
             self._replica_metrics[idx] = snap
+            if int(msg.get("integrity_violations") or 0) > 0:
+                # The replica detected SDC in its own data path (the
+                # affected request already degraded in-replica, so no
+                # wrong verdict shipped) — mark the slot suspect; the
+                # next health sweep quarantines it like a death.
+                self._suspect_slots.add(idx)
         if beat:
             obs.event("replica", replica=idx, event="metrics", **snap)
 
@@ -774,6 +785,19 @@ class ProcessFleet:
             if rc is not None:
                 kind = "memout" if rc == EXIT_MEMOUT else "crash"
                 self._fail_over(idx, rp, kind, rc=rc)
+                continue
+            with self._cv:
+                suspect = idx in self._suspect_slots
+                self._suspect_slots.discard(idx)
+            if suspect:
+                # Integrity quarantine (DESIGN.md §21): a replica whose
+                # metrics beat reported integrity_violations > 0 cannot
+                # be trusted with further requests.  Kill + fail over —
+                # re-homing resumes its work on a clean process, and the
+                # bounded-backoff restart gives the slot a fresh replica
+                # whose counters start at zero.
+                rp.kill()
+                self._fail_over(idx, rp, "integrity", rc=rp.proc.poll())
                 continue
             if not rp.hello.is_set():
                 if time.monotonic() - rp.spawned_at \

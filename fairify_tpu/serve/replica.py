@@ -194,6 +194,7 @@ def main(argv=None) -> int:
                     "device_launches": _tot("device_launches"),
                     "serve_shed": _tot("serve_shed"),
                     "serve_preemptions": _tot("serve_preemptions"),
+                    "integrity_violations": _tot("integrity_violations"),
                     "serve_requests_done": done}
             try:
                 from fairify_tpu.obs import compile as compile_obs

@@ -235,6 +235,7 @@ class VerificationServer:
         self._grids: Dict[tuple, Tuple] = {}
         self._draining = False
         self._killed = False
+        self._suspect = False  # integrity violation seen; router quarantines
         self._last_beat = time.monotonic()
         self._inflight = 0  # popped-batch members not yet terminal
         self._thread: Optional[threading.Thread] = None
@@ -376,6 +377,17 @@ class VerificationServer:
     def killed(self) -> bool:
         with self._cv:
             return self._killed
+
+    def suspect(self) -> bool:
+        """True once an integrity violation fired inside one of this
+        replica's requests (DESIGN.md §21).  The request itself already
+        contained the damage (its partitions degraded to
+        ``unknown:failure:integrity.*``), but a replica that has seen SDC
+        once cannot be trusted for the next request — the fleet router
+        treats a suspect replica like a dead one: kill + fail over, so
+        every re-homed request resumes on clean hardware."""
+        with self._cv:
+            return self._suspect
 
     def started(self) -> bool:
         """Has :meth:`start` ever launched the worker (live or not)?"""
@@ -619,10 +631,23 @@ class VerificationServer:
                 return  # drain() sentinel: everything before it is done
             req, report = item
             try:
-                with trace_mod.context(req.trace), \
-                        obs.span("serve.smt_drain", request=req.id,
-                                 queries=report.smt_pending.pending):
-                    report.smt_pending.drain()
+                # Same suspect attribution as _run_request: an integrity
+                # violation surfacing during the deferred SMT drain (an
+                # invalid witness) marks this replica suspect too.
+                iv0 = registry.counter("integrity_violations").total()
+                try:
+                    with trace_mod.context(req.trace), \
+                            obs.span("serve.smt_drain", request=req.id,
+                                     queries=report.smt_pending.pending):
+                        report.smt_pending.drain()
+                finally:
+                    if registry.counter(
+                            "integrity_violations").total() > iv0:
+                        with self._cv:
+                            self._suspect = True
+                        registry.counter("replica_suspect").inc()
+                        obs.event("replica_suspect", request=req.id,
+                                  model=req.model_name)
                 report.smt_pending = None
             except BaseException as exc:
                 if classify(exc) == "propagate":
@@ -838,6 +863,24 @@ class VerificationServer:
     # --- request execution ------------------------------------------------
 
     def _run_request(self, req: VerifyRequest, stage0) -> None:
+        registry = obs.registry()
+        # Integrity attribution: any growth of the (process-global)
+        # integrity_violations counter across this request's execution
+        # marks the replica suspect.  Thread-fleet replicas share the
+        # registry, so a concurrent violation can over-mark — acceptable:
+        # suspicion errs toward quarantine, never toward trust.
+        iv0 = registry.counter("integrity_violations").total()
+        try:
+            self._run_request_inner(req, stage0)
+        finally:
+            if registry.counter("integrity_violations").total() > iv0:
+                with self._cv:
+                    self._suspect = True
+                registry.counter("replica_suspect").inc()
+                obs.event("replica_suspect", request=req.id,
+                          model=req.model_name)
+
+    def _run_request_inner(self, req: VerifyRequest, stage0) -> None:
         registry = obs.registry()
         req.started_at = time.monotonic()
         registry.histogram("serve_queue_wait_s").observe(req.queue_wait_s)

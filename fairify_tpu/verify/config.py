@@ -90,6 +90,22 @@ class SweepConfig:
     # (resilience.faults.parse_spec), armed for the duration of each
     # verify_model call.  Empty = no injection (production).
     inject_faults: Tuple[str, ...] = ()
+    # --- Result integrity (resilience/integrity.py, DESIGN.md §21) ------
+    # Always-on SDC detection: a known-answer canary chunk rides every
+    # mega-scan segment, the packed (cert, wit, reason, stats) buffers
+    # carry a device-computed fold checksum re-verified host-side, and
+    # verdict-ledger rows get a per-row CRC.  Zero extra launches; any
+    # mismatch degrades the segment to unknown:failure:integrity.* and
+    # bumps integrity_violations{site}.  Off only for A/B debugging.
+    integrity: bool = True
+    # Sampled recheck rate in [0, 1]: this fraction of DECIDED chunks is
+    # deterministically re-executed (bit-equality required) and a sample
+    # of certified / SMT-unsat verdicts escalates to the exact-rational
+    # oracle (verify/exact_check.py).  Each selected chunk costs one
+    # extra launch, so the default is 0.0 (the launch-economy pins hold
+    # exactly); integrity.DEFAULT_RECHECK_RATE = 0.05 is the benched
+    # operating point for paranoid fleets (--integrity-recheck).
+    integrity_recheck: float = 0.0
     # Escalating per-attempt solver timeouts for the SMT UNKNOWN-retry
     # path.  Non-empty enables the tier: still-unknown boxes after BaB +
     # heuristic retry fan out to the out-of-process worker pool

@@ -42,6 +42,7 @@ from fairify_tpu.ops import masks as mask_ops
 from fairify_tpu.parallel.pipeline import LaunchPipeline
 from fairify_tpu.partition import grid as grid_mod
 from fairify_tpu.resilience import faults as faults_mod
+from fairify_tpu.resilience import integrity as integrity_mod
 from fairify_tpu.resilience.journal import JournalWriter
 from fairify_tpu.resilience.supervisor import ChunkDegraded, ChunkFailure, Supervisor, classify
 from fairify_tpu.utils import profiling
@@ -242,13 +243,20 @@ class _SmtTier:
 
         fut = self._futures.pop(p)
         try:
-            return fut.result().triple
+            v, ce, reason = fut.result().triple
         except CancelledError:
             return "unknown", None, protocol.REASON_SPAWN
         except BaseException as exc:
             if classify(exc) == "propagate":
                 raise
             return "unknown", None, protocol.REASON_CRASH
+        n = faults_mod.corruption("smt.query")
+        if n is not None and v == "sat" and ce is not None:
+            # Data-plane chaos (smt.query:corrupt): flip a bit in the
+            # witness payload crossing the pool boundary — the host-side
+            # validate_pair replay is the detector that must catch it.
+            ce = integrity_mod.corrupt_witness(ce, n)
+        return v, ce, reason
 
     def cancel(self, p) -> None:
         fut = self._futures.pop(p, None)
@@ -299,22 +307,39 @@ class SmtDrain:
     def drain(self) -> Dict[str, int]:
         """Consume every deferred answer; returns decided/degraded counts."""
         decided = degraded = 0
-        ledger = JournalWriter(self.ledger_path, fault_site="ledger.append")
+        ledger = JournalWriter(self.ledger_path, fault_site="ledger.append",
+                               crc=self.cfg.integrity)
         try:
             with obs.span("smt.drain", queries=len(self.items)):
                 for p, pid, out in self.items:
                     v, ce, reason = self.tier.result(p)
+                    fail_rec = None
                     if v == "sat" and ce is not None \
                             and not engine.validate_pair(self.weights,
                                                          self.biases, *ce):
+                        # A witness that fails its host replay is an
+                        # integrity violation (a sound backend never
+                        # produces one) — degrade with a failure record
+                        # so resume re-attempts the partition instead of
+                        # settling a corrupted answer as unknown.
                         v, ce, reason = "unknown", None, "invalid-witness"
-                    fail_rec = None
+                        fail_rec = _integrity_failure(
+                            "smt.query", "invalid-witness").to_record()
+                        degraded += 1
+                        self.report.degraded += 1
+                        obs.registry().counter("chunks_degraded").inc(
+                            site="integrity.smt.query")
+                        obs.event("degraded", **fail_rec, phase="smt_drain",
+                                  partitions=1)
                     extra = {}
                     if v != "unknown":
                         out.verdict = v
                         out.counterexample = ce
                         decided += 1
                         via = "smt"
+                    elif fail_rec is not None:
+                        extra = {"failure": fail_rec["reason"]}
+                        via = "degraded"
                     elif reason is not None \
                             and reason.startswith("smt.worker:"):
                         fail_rec = ChunkFailure(
@@ -374,6 +399,96 @@ class SmtDrain:
             wr.writerow([pid, "x'"] + [int(v) for v in ce[1]])
 
 
+def _integrity_failure(site: str, detector: str) -> ChunkFailure:
+    """Record one tripped integrity detector → the ChunkFailure that
+    contains it (DESIGN.md §21).
+
+    The failure's composite site ``integrity.<site>`` is what the funnel's
+    ``failure_state`` buckets on, so the affected partitions land in
+    ``unknown:failure:integrity.<site>`` — a *contained wrong answer*, not
+    a dead process — and the decided-wins resume contract re-attempts
+    them.  ``kind=fatal``: a corrupted payload is never retried in place
+    (the data already on the host cannot be trusted; a resume re-runs the
+    launch from scratch).
+    """
+    obs.registry().counter("integrity_violations").inc(site=site)
+    obs.event("integrity_violation", site=site, detector=detector)
+    return ChunkFailure(site=f"integrity.{site}", kind="fatal",
+                        error="IntegrityViolation",
+                        detail=f"{detector} mismatch ({site})", retries=0)
+
+
+def _sampled_recheck(net, enc, lo, hi, cfg: SweepConfig, mesh, seed_offset,
+                     step, drained, unsat, sat, witnesses, on_failure):
+    """Re-run a deterministic sample of DECIDED chunks; require bit-equality.
+
+    The recheck tier of the integrity contract (DESIGN.md §21): each
+    selected chunk is re-executed through the per-chunk path (bit-equal to
+    the mega decode by construction, tests/test_mega.py) and its
+    (unsat, sat, witnesses) triple must match the banked one EXACTLY —
+    selection is hash-keyed on ``(seed, global chunk start)``
+    (``integrity.sampled``), so a resume rechecks the same chunks.  A
+    mismatch demotes that chunk's partitions to
+    ``unknown:failure:integrity.recheck`` (the corrupted copy cannot be
+    told from the fresh one, so neither is trusted).  Each clean recheck
+    additionally escalates the chunk's first certified partition to the
+    exact-rational oracle (``verify/exact_check.py``) — the device-free
+    second opinion; a refuted certificate is the worst possible SDC and
+    demotes just that partition.  Costs one launch per selected chunk, so
+    ``cfg.integrity_recheck`` defaults to 0 (see config.py).
+    """
+    from fairify_tpu.verify import exact_check
+
+    rechecks = obs.registry().counter("integrity_rechecks")
+    weights = biases = None
+    for s, e in drained:
+        if not integrity_mod.sampled(cfg.seed, f"chunk:{seed_offset + s}",
+                                     cfg.integrity_recheck):
+            continue
+        rechecks.inc(kind="chunk")
+        payload, ctx = _stage0_block_submit(
+            net, enc, lo[s:e], hi[s:e], cfg, mesh,
+            cfg.engine.seed + seed_offset + s, pad_to=step)
+        u2, s2, w2 = _stage0_block_decode(jax.device_get(payload), ctx)
+        n = e - s
+        w2 = {k: v for k, v in w2.items() if k < n}
+        have = {k - s: v for k, v in witnesses.items() if s <= k < e}
+        clean = (np.array_equal(u2[:n], unsat[s:e])
+                 and np.array_equal(s2[:n], sat[s:e])
+                 and set(w2) == set(have)
+                 and all(np.array_equal(w2[k][0], have[k][0])
+                         and np.array_equal(w2[k][1], have[k][1])
+                         for k in w2))
+        if not clean:
+            # Neither copy is trustworthy — erase the banked verdicts and
+            # degrade the chunk (re-attempted on resume, never guessed).
+            unsat[s:e] = False
+            sat[s:e] = False
+            for k in range(s, e):
+                witnesses.pop(k, None)
+            if on_failure is not None:
+                on_failure(s, e, _integrity_failure("recheck",
+                                                    "bit-equality"))
+            continue
+        cert_idx = np.flatnonzero(unsat[s:e])
+        if not cert_idx.size:
+            continue
+        if weights is None:
+            weights = [np.asarray(w) for w in net.weights]
+            biases = [np.asarray(b) for b in net.biases]
+        p = s + int(cert_idx[0])
+        rechecks.inc(kind="exact")
+        res = exact_check.decide_pair_box_exact(
+            weights, biases, enc, lo[p], hi[p], max_nodes=2000)
+        if res["verdict"] == "refuted":
+            unsat[p] = False
+            if on_failure is not None:
+                on_failure(p, p + 1, _integrity_failure(
+                    "exact", "refuted-certificate"))
+        # 'budget' is inconclusive, never a violation: exhaustion must not
+        # demote a sound certificate (exact_check's own contract).
+
+
 def _stage0_certify_and_attack(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
                                mesh=None, seed_offset: int = 0, pipe=None,
                                on_failure=None, stats=None):
@@ -412,6 +527,18 @@ def _stage0_certify_and_attack(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
     unsat = np.zeros(P, dtype=bool)
     sat = np.zeros(P, dtype=bool)
     witnesses: Dict[int, tuple] = {}
+    # Chunks that actually drained clean — the sampled-recheck candidate
+    # pool (degraded/corrupt chunks are already contained; rechecking them
+    # would double-count their failure).
+    drained_chunks: List[tuple] = []
+
+    def _maybe_recheck():
+        if cfg.integrity and cfg.integrity_recheck > 0.0 and drained_chunks:
+            with obs.span("integrity.recheck", chunks=len(drained_chunks),
+                          rate=cfg.integrity_recheck):
+                _sampled_recheck(net, enc, lo, hi, cfg, mesh, seed_offset,
+                                 step, drained_chunks, unsat, sat, witnesses,
+                                 on_failure)
 
     if _use_mega(cfg, mesh):
         # Device-resident mega-loop (DESIGN.md §17): one ``lax.scan``
@@ -430,6 +557,13 @@ def _stage0_certify_and_attack(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
             seg_s, seg_e, chunks = meta
             done["n"] += 1
             drained = 0
+            if not isinstance(host, ChunkFailure) and ctx.get("integrity"):
+                # Verify BEFORE decoding: a corrupted packed buffer must
+                # never reach witness extraction or the verdict arrays —
+                # the whole segment degrades (exact blast radius) instead.
+                tripped = integrity_mod.verify_segment(host)
+                if tripped is not None:
+                    host = _integrity_failure("launch.decode", tripped)
             if isinstance(host, ChunkFailure):
                 # A degraded segment still counts toward done/total, but
                 # NONE of its partitions drained (the report's segments
@@ -447,6 +581,7 @@ def _stage0_certify_and_attack(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
                     unsat[s:e], sat[s:e] = u[: e - s], sa[: e - s]
                     witnesses.update(
                         {s + k: v for k, v in w.items() if k < e - s})
+                drained_chunks.extend(chunks)
             _segment_tick("stage0_decide", done["n"], len(segs),
                           drained, in_flight=len(pipe))
 
@@ -464,6 +599,7 @@ def _stage0_certify_and_attack(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
                 consume_seg(*item)
         for item in pipe.drain():
             consume_seg(*item)
+        _maybe_recheck()
         return unsat, sat, witnesses
 
     def consume(meta, ctx, host):
@@ -478,6 +614,7 @@ def _stage0_certify_and_attack(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
         u, sa, w = _stage0_block_decode(host, ctx, stats)
         unsat[s:e], sat[s:e] = u[: e - s], sa[: e - s]
         witnesses.update({s + k: v for k, v in w.items() if k < e - s})
+        drained_chunks.append((s, e))
 
     for ci, (s, e) in enumerate(spans):
         for item in pipe.submit(
@@ -490,6 +627,7 @@ def _stage0_certify_and_attack(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
             consume(*item)
     for item in pipe.drain():
         consume(*item)
+    _maybe_recheck()
     return unsat, sat, witnesses
 
 
@@ -654,6 +792,23 @@ def _chunk_stats_dev(margin, gap, n):
     return jnp.stack([h(margin), h(gap)])
 
 
+def _fold_dev(*bufs):
+    """Wraparound-int32 fold over the packed result buffers, ON DEVICE.
+
+    The integrity layer's transfer checksum (DESIGN.md §21): the mega
+    kernels fold (cert, wit, reason, stats) into one scalar that rides the
+    payload; the host recomputes the identical fold over the fetched
+    buffers (``resilience.integrity.fold_host`` — numpy's int32 sums share
+    XLA's two's-complement wraparound), so a bit flipped anywhere in the
+    fetched segment disagrees.  Casts + reduce_sum only, so the certify
+    path stays inside the lint's sound-ops allowlist.
+    """
+    total = jnp.int32(0)
+    for b in bufs:
+        total = total + jnp.sum(b.astype(jnp.int32), dtype=jnp.int32)
+    return total
+
+
 @obs_jit(static_argnames=("alpha_iters",))
 def _mega_stage0_kernel(net, x_lo, x_hi, xp_lo, xp_hi, plo, phi, av, pm, rm,
                         eps, va, vp, xr, pr, nv, alpha_iters):
@@ -696,7 +851,7 @@ def _mega_stage0_kernel(net, x_lo, x_hi, xp_lo, xp_hi, plo, phi, av, pm, rm,
         chunk_step,
         (jnp.int32(0), jnp.zeros((2, funnel_mod.N_BUCKETS), jnp.int32)),
         (x_lo, x_hi, xp_lo, xp_hi, plo, phi, va, xr, pr, nv))
-    return packed + (stats,)
+    return packed + (stats, _fold_dev(*packed, stats))
 
 
 @obs_jit(static_argnames=("alpha_iters",))
@@ -729,12 +884,12 @@ def _mega_family_stage0_kernel(stacked, x_lo, x_hi, xp_lo, xp_hi, plo, phi,
         chunk_step,
         (jnp.int32(0), jnp.zeros((M, 2, funnel_mod.N_BUCKETS), jnp.int32)),
         (x_lo, x_hi, xp_lo, xp_hi, plo, phi, va, xr, pr, nv))
-    return packed + (stats,)
+    return packed + (stats, _fold_dev(*packed, stats))
 
 
 def _mega_chunk_inputs(enc: PairEncoding, lo, hi, cfg: SweepConfig,
                        chunks, step: int, seed_offset: int,
-                       pad_chunks: int = 0):
+                       pad_chunks: int = 0, canary: bool = False):
     """Stacked per-chunk device inputs for one segment.
 
     Each chunk is padded to the chunk bucket and its attack candidates are
@@ -749,11 +904,20 @@ def _mega_chunk_inputs(enc: PairEncoding, lo, hi, cfg: SweepConfig,
     0 for chunk-axis padding, ``e - s`` for a ragged final chunk — which the
     kernels' funnel-statistics carry uses to mask padded rows out of the
     on-device histograms (padding repeats real rows and would double-count).
+
+    ``canary`` appends the integrity layer's known-answer chunk as the LAST
+    scan row (after any chunk-axis padding): all-zero boxes with an
+    all-zero valid mask and ``nv = 0``, whose packed answer is analytically
+    fixed regardless of the network — every row vacuously certifies
+    (``cert=1, reason=1``) and the masked attack finds nothing
+    (``wit=0``).  Zero extra launches, no RNG draw (so every real chunk's
+    attack stream is untouched), no histogram contribution, and the
+    decoder never iterates it (``ctx["chunks"]`` is the real list) — it
+    exists only for ``resilience.integrity.check_canary`` to verify at
+    fetch time (DESIGN.md §21).
     """
     bufs = [[] for _ in range(9)]
     blk = _pad_chunk_axis(chunks, pad_chunks)
-    nv = np.asarray([e - s if ci < len(chunks) else 0
-                     for ci, (s, e) in enumerate(blk)], np.int32)
     for s, e in blk:
         clo, chi = _pad_rows(lo[s:e], step), _pad_rows(hi[s:e], step)
         flo, fhi = clo.astype(np.float32), chi.astype(np.float32)
@@ -764,6 +928,13 @@ def _mega_chunk_inputs(enc: PairEncoding, lo, hi, cfg: SweepConfig,
         for buf, arr in zip(bufs, (x_lo, x_hi, xp_lo, xp_hi, flo, fhi,
                                    valid, xr, pr)):
             buf.append(arr)
+    n_real = [e - s if ci < len(chunks) else 0
+              for ci, (s, e) in enumerate(blk)]
+    if canary:
+        for buf in bufs:
+            buf.append(np.zeros_like(buf[0]))
+        n_real.append(0)
+    nv = np.asarray(n_real, np.int32)
     return tuple(np.stack(b) for b in bufs) + (nv,)
 
 
@@ -778,10 +949,11 @@ def _mega_segment_submit(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
     """
     (x_lo, x_hi, xp_lo, xp_hi, plo, phi, valid,
      xr, pr, nv) = _mega_chunk_inputs(enc, lo, hi, cfg, chunks, step,
-                                      seed_offset, pad_chunks)
+                                      seed_offset, pad_chunks,
+                                      canary=cfg.integrity)
     assign_vals, pa_mask, ra_mask = engine._enc_tensors(enc, lo.shape[1])
     profiling.bump_launch()
-    cert, wit, reason, stats = _mega_stage0_kernel(
+    cert, wit, reason, stats, csum = _mega_stage0_kernel(
         net, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
         jnp.asarray(xp_hi), jnp.asarray(plo), jnp.asarray(phi),
         jnp.asarray(assign_vals), jnp.asarray(pa_mask),
@@ -790,8 +962,11 @@ def _mega_segment_submit(net, enc: PairEncoding, lo, hi, cfg: SweepConfig,
         jnp.asarray(nv), alpha_iters=0,
     )
     ctx = {"net": net, "enc": enc, "chunks": chunks, "xr": xr, "pr": pr,
-           "kind": "mega"}
-    return {"cert": cert, "wit": wit, "reason": reason, "stats": stats}, ctx
+           "kind": "mega", "integrity": cfg.integrity}
+    payload = {"cert": cert, "wit": wit, "reason": reason, "stats": stats}
+    if cfg.integrity:
+        payload["csum"] = csum
+    return payload, ctx
 
 
 def _mega_family_segment_submit(stacked, enc: PairEncoding, lo, hi,
@@ -801,10 +976,11 @@ def _mega_family_segment_submit(stacked, enc: PairEncoding, lo, hi,
     (family, segment) — the AC suite and every coalesced serve bucket)."""
     (x_lo, x_hi, xp_lo, xp_hi, plo, phi, valid,
      xr, pr, nv) = _mega_chunk_inputs(enc, lo, hi, cfg, chunks, step,
-                                      seed_offset, pad_chunks)
+                                      seed_offset, pad_chunks,
+                                      canary=cfg.integrity)
     assign_vals, pa_mask, ra_mask = engine._enc_tensors(enc, lo.shape[1])
     profiling.bump_launch()
-    cert, wit, reason, stats = _mega_family_stage0_kernel(
+    cert, wit, reason, stats, csum = _mega_family_stage0_kernel(
         stacked, jnp.asarray(x_lo), jnp.asarray(x_hi), jnp.asarray(xp_lo),
         jnp.asarray(xp_hi), jnp.asarray(plo), jnp.asarray(phi),
         jnp.asarray(assign_vals), jnp.asarray(pa_mask),
@@ -814,8 +990,11 @@ def _mega_family_segment_submit(stacked, enc: PairEncoding, lo, hi,
     )
     ctx = {"stacked": stacked, "enc": enc, "chunks": chunks,
            "M": stacked.weights[0].shape[0], "xr": xr, "pr": pr,
-           "kind": "mega_family"}
-    return {"cert": cert, "wit": wit, "reason": reason, "stats": stats}, ctx
+           "kind": "mega_family", "integrity": cfg.integrity}
+    payload = {"cert": cert, "wit": wit, "reason": reason, "stats": stats}
+    if cfg.integrity:
+        payload["csum"] = csum
+    return payload, ctx
 
 
 def _mega_segment_decode(host, ctx):
@@ -995,6 +1174,14 @@ def stage0_families(stacks, enc: PairEncoding, lo, hi, cfg: SweepConfig,
             gi, seg_s, seg_e, chunks = meta
             done["n"] += 1
             drained = 0
+            if not isinstance(host, ChunkFailure) and ctx.get("integrity"):
+                # Same fetch-time gate as the single-model path: the fold
+                # and canary checks work unchanged on the family-stacked
+                # (C, M, ...) buffers, and a trip degrades the whole
+                # (family, segment) block before any model decodes.
+                tripped = integrity_mod.verify_segment(host)
+                if tripped is not None:
+                    host = _integrity_failure("launch.decode", tripped)
             if isinstance(host, ChunkFailure):
                 obs.registry().counter("chunks_degraded").inc(site=host.site)
                 obs.event("degraded", **host.to_record(),
@@ -1285,10 +1472,22 @@ def _read_ledger(path: str):
     mid-append, a network FS tearing a write — are skipped but COUNTED; a
     resume that silently dropped records would under-report exactly when
     it matters most.
+
+    Rows carrying a ``_crc`` (written when ``cfg.integrity`` is on) are
+    verified against the canonical body (``resilience.integrity``); a
+    mismatch — a bit flipped at rest or in the append path, NOT a torn
+    line — drops the row and bumps ``ledger_crc_mismatch``, so the pid is
+    simply un-ledgered and the decided-wins resume re-attempts it: a
+    corrupted verdict is never replayed (DESIGN.md §21).
     """
     if not os.path.isfile(path):
         return [], 0
-    return obs.load_events(path, count_skipped=True)
+    recs, skipped = obs.load_events(path, count_skipped=True)
+    recs, bad = integrity_mod.verify_records(recs)
+    if bad:
+        obs.registry().counter("ledger_crc_mismatch").inc(bad)
+        obs.event("ledger_crc_mismatch", path=path, rows=bad)
+    return recs, skipped
 
 
 def merge_ledgers(paths) -> tuple:
@@ -1490,6 +1689,13 @@ def _verify_model_impl(
     funnel = funnel_mod.FunnelCounts()
     launch0 = profiling.launch_count()
     compile0 = compile_obs.snapshot_totals()
+    # Integrity baseline totals (process-global counters): the throughput
+    # record reports this RUN's deltas so perfdiff can gate them at zero
+    # growth without a registry reset between models.
+    integrity0 = {
+        name: obs.registry().counter(name).total()
+        for name in ("integrity_violations", "integrity_rechecks",
+                     "ledger_crc_mismatch")}
     heartbeat = obs.Heartbeat(cfg.heartbeat_s, total=P, label=sink_name) \
         if cfg.heartbeat_s > 0 else None
     # One launch pipeline for the whole run: the stage-0 certify, parity
@@ -1842,7 +2048,7 @@ def _verify_model_impl(
     # stays in this report, and a later resume re-decides it (sound).
     try:
         ledger = JournalWriter(ledger_path, fault_site="ledger.append",
-                               supervisor=sup)
+                               supervisor=sup, crc=cfg.integrity)
         for p in range(P):
             pid = span_start + p + 1
             if pid in done:
@@ -1964,13 +2170,42 @@ def _verify_model_impl(
                             # An out-of-process witness must replay on the host
                             # net to count (the same V-accurate rule the
                             # heuristic retry obeys): a sound backend never
-                            # fails this, so a corrupted worker reply can
-                            # never smuggle in a wrong SAT.
+                            # fails this — only a corrupted reply does, so
+                            # the miss is an INTEGRITY violation, not a
+                            # plain unknown: the partition degrades with a
+                            # failure record (re-attempted on resume, so
+                            # the fault-free answer is recovered) instead
+                            # of settling as an unledgerable maybe.
                             smt_verdict, smt_ce, smt_reason = \
                                 "unknown", None, "invalid-witness"
+                            _degrade([p], _integrity_failure(
+                                "smt.query", "invalid-witness"), "smt")
+                            fail_rec = failed.get(p)
                         if smt_verdict != "unknown":
                             verdict, ce = smt_verdict, smt_ce
                             smt_decided = True
+                            if verdict == "unsat" and cfg.integrity \
+                                    and integrity_mod.sampled(
+                                        cfg.seed, f"smt:{pid}",
+                                        cfg.integrity_recheck):
+                                # Sampled cross-check of SMT UNSATs: SAT
+                                # witnesses already replay above, but an
+                                # UNSAT crossing the pool boundary had no
+                                # independent check until the exact-
+                                # rational oracle (DESIGN.md §21).
+                                obs.registry().counter(
+                                    "integrity_rechecks").inc(kind="smt")
+                                from fairify_tpu.verify import exact_check
+
+                                xres = exact_check.decide_pair_box_exact(
+                                    weights, biases, enc, lo[p], hi[p],
+                                    max_nodes=2000)
+                                if xres["verdict"] == "refuted":
+                                    verdict, ce = "unknown", None
+                                    smt_decided = False
+                                    _degrade([p], _integrity_failure(
+                                        "exact", "refuted-smt-unsat"), "smt")
+                                    fail_rec = failed.get(p)
                         elif smt_reason is not None \
                                 and smt_reason.startswith("smt.worker:"):
                             # Worker-death exhaustion degrades EXACTLY this
@@ -2186,7 +2421,12 @@ def _verify_model_impl(
                  pipeline={"depth": cfg.pipeline_depth, **pipe.stats.summary()},
                  compile=compile_obs.totals_delta(compile0),
                  resilience={"degraded": degraded_count,
-                             "ledger_skipped_lines": led_skipped},
+                             "ledger_skipped_lines": led_skipped,
+                             # Integrity deltas (DESIGN.md §21): all zero
+                             # on a healthy run; perfdiff gates growth.
+                             **{name: int(obs.registry().counter(name).total()
+                                          - integrity0[name])
+                                for name in integrity0}},
                  funnel=funnel_payload)
     if heartbeat is not None:  # final line regardless of throttle state
         heartbeat.beat(decided=sat_count + unsat_count, attempted=len(outcomes),

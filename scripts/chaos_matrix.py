@@ -61,9 +61,27 @@ the fault-free run (``resume=True`` ledger replay).  ``request.preempt``
 forces a mid-flight span-granular preemption; the preempted request must
 requeue, complete, and stay bit-equal.
 
+Result-integrity cells (``--integrity``, DESIGN.md §21) extend the
+matrix to SILENT data corruption: ``corrupt``-kind faults flip a data
+bit instead of raising, at ``launch.decode`` (a device->host result
+buffer), ``ledger.append`` (a verdict row already on disk) and
+``smt.query`` (a solver counterexample).  The contract per cell: the
+corruption is DETECTED (``integrity_violations`` or
+``ledger_crc_mismatch`` fired), ZERO corrupted verdicts escape as
+decided (``sdc_escaped == 0``), affected partitions land in
+``unknown:failure:integrity.<site>``, and a disarmed resume converges to
+the fault-free map.  With ``--serve`` the same corruptions run inside
+the replicated server — a suspect replica must be quarantined — and with
+``--procfleet`` inside real OS-process replicas, where the router must
+classify the death as ``kind=integrity``.  The procfleet × smt.query
+cell is delegated: the solver stubs cannot cross the process boundary
+(no real config funnels work to the solver deterministically), and the
+in-process run/serve smt.query cells exercise the identical
+``_SmtTier.result`` code path the replica runs.
+
 Usage: python scripts/chaos_matrix.py [--out chaos] [--span 48]
            [--grid-chunk 16] [--preset GC] [--shards 3] [--serve]
-           [--fleet] [--no-smt]
+           [--fleet] [--procfleet] [--integrity] [--no-smt]
 """
 from __future__ import annotations
 
@@ -133,6 +151,12 @@ def main() -> int:
                          "SIGKILL mid-batch, SIGSTOP lease-wedge, "
                          "replica.lease fatal, replica.spawn x {transient,"
                          "exhausted}, memout x {transient,exhausted}")
+    ap.add_argument("--integrity", action="store_true",
+                    help="also run the result-integrity cells: corrupt-"
+                         "kind faults (silent bit flips, no exception) at "
+                         "launch.decode / ledger.append / smt.query; with "
+                         "--serve / --procfleet the corruption runs inside "
+                         "the replicated and OS-process serving stacks too")
     ap.add_argument("--no-smt", action="store_true",
                     help="skip the smt.worker.* pool cells")
     ap.add_argument("--lockprof", action="store_true",
@@ -273,6 +297,301 @@ def main() -> int:
         row["ok"] = bool(blast_exact and row["resume_converged"])
         failures += 0 if row["ok"] else 1
         print(json.dumps(row), flush=True)
+
+    # Result-integrity cells (--integrity, DESIGN.md §21): corrupt-kind
+    # faults flip DATA bits silently instead of raising.  Contract per
+    # cell: detected (integrity_violations / ledger_crc_mismatch fired),
+    # zero corrupted verdicts escape as decided, affected partitions land
+    # in unknown:failure:integrity.<site>, and a disarmed resume converges
+    # to the fault-free map.  The smt.query corruption cells need the
+    # stubbed-solver world and live in the SMT section below.
+    if args.integrity:
+        from fairify_tpu.verify.sweep import _ledger_path, _read_ledger
+
+        viol = metrics_mod.registry().counter("integrity_violations")
+        crc_ctr = metrics_mod.registry().counter("ledger_crc_mismatch")
+
+        # launch.decode:corrupt — a bit flips in a fetched result buffer.
+        # The mega segment's checksum/canary catches it at decode: exactly
+        # that segment degrades, nothing wrong is ever decided.
+        spec = "launch.decode:corrupt:2"
+        cfg = cfg0.with_(result_dir=os.path.join(args.out, "int_decode"),
+                         mega_chunks=1, inject_faults=(spec,))
+        row = {"cell": "integrity/launch.decode/run", "spec": spec}
+        v0 = viol.value(site="launch.decode")
+        try:
+            rep = sweep.verify_model(net, cfg, model_name="m", resume=False,
+                                     partition_span=span)
+            got = _vmap(rep)
+            row["sdc_escaped"] = sum(
+                1 for k in got if got[k] != "unknown" and got[k] != want[k])
+            row["detected"] = bool(viol.value(site="launch.decode") > v0)
+            recs, _sk = _read_ledger(_ledger_path(cfg, rep.sink_name))
+            reasons = {r["failure"]["reason"] for r in recs
+                       if r.get("failure")}
+            row["reasons"] = sorted(reasons)
+            seg = set(range(args.grid_chunk + 1, 2 * args.grid_chunk + 1))
+            row["blast_radius_exact"] = bool(
+                rep.degraded == args.grid_chunk
+                and all(got[pid] == "unknown" for pid in seg)
+                and all(got[k] == want[k] for k in got if k not in seg))
+            resumed = sweep.verify_model(
+                net, cfg.with_(inject_faults=()), model_name="m",
+                resume=True, partition_span=span)
+            row["resume_converged"] = _vmap(resumed) == want
+            row["ok"] = bool(
+                row["detected"] and row["sdc_escaped"] == 0
+                and reasons == {"integrity.launch.decode:fatal"}
+                and row["blast_radius_exact"] and row["resume_converged"])
+        except BaseException as exc:
+            row["crashed"] = f"{type(exc).__name__}: {exc}"
+            row["ok"] = False
+        failures += 0 if row["ok"] else 1
+        print(json.dumps(row), flush=True)
+
+        # ledger.append:corrupt — a bit flips in a row already written to
+        # the verdict ledger.  The live run's in-memory map is unharmed;
+        # the hazard is a later RESUME trusting the row.  The per-row CRC
+        # makes it unreadable: dropped, counted, and re-decided.
+        spec = "ledger.append:corrupt:3"
+        cfg = cfg0.with_(result_dir=os.path.join(args.out, "int_ledger"),
+                         inject_faults=(spec,))
+        row = {"cell": "integrity/ledger.append/run", "spec": spec}
+        c0 = crc_ctr.total()
+        try:
+            rep = sweep.verify_model(net, cfg, model_name="m", resume=False,
+                                     partition_span=span)
+            row["run_map_ok"] = _vmap(rep) == want
+            resumed = sweep.verify_model(
+                net, cfg.with_(inject_faults=()), model_name="m",
+                resume=True, partition_span=span)
+            row["crc_mismatch"] = crc_ctr.total() - c0
+            row["resume_converged"] = _vmap(resumed) == want
+            row["ok"] = bool(row["run_map_ok"] and row["crc_mismatch"] >= 1
+                             and row["resume_converged"])
+        except BaseException as exc:
+            row["crashed"] = f"{type(exc).__name__}: {exc}"
+            row["ok"] = False
+        failures += 0 if row["ok"] else 1
+        print(json.dumps(row), flush=True)
+
+        if args.serve:
+            import time as time_mod
+
+            from fairify_tpu.resilience import faults as faults_lib
+            from fairify_tpu.serve import FleetConfig, ServeConfig, \
+                ServerFleet, VerificationServer
+
+            # Corruption detected inside a replica marks it suspect; the
+            # router quarantines (kills) it, and a disarmed resubmit over
+            # the same sink converges on the survivor.
+            row = {"cell": "integrity/launch.decode/serve-quarantine",
+                   "spec": "launch.decode:corrupt:1"}
+            quar = metrics_mod.registry().counter("replica_quarantined")
+            q0 = quar.total()
+            try:
+                rdir = os.path.join(args.out, "int_serve_decode")
+                fl = ServerFleet(FleetConfig(
+                    n_replicas=2, poll_s=0.02,
+                    replica=ServeConfig(batch_window_s=0.1, max_batch=4)))
+                with faults_lib.armed(("launch.decode:corrupt:1",),
+                                      seed=cfg0.seed):
+                    r1 = fl.submit(
+                        cfg0.with_(result_dir=rdir, mega_chunks=1), net,
+                        "ma", partition_span=span)
+                    fl.start()
+                    f1 = fl.wait(r1.id, timeout=900.0)
+                t0 = time_mod.monotonic()
+                while quar.total() == q0 \
+                        and time_mod.monotonic() - t0 < 30.0:
+                    time_mod.sleep(0.01)
+                row["quarantined"] = quar.total() - q0
+                got1 = {} if f1 is None or f1.report is None \
+                    else _vmap(f1.report)
+                row["sdc_escaped"] = sum(
+                    1 for p, v in got1.items()
+                    if v != "unknown" and v != want[p])
+                r2 = fl.submit(cfg0.with_(result_dir=rdir, mega_chunks=1),
+                               net, "ma", partition_span=span)
+                f2 = fl.wait(r2.id, timeout=900.0)
+                row["replicas_alive"] = fl.replicas_alive()
+                fl.drain()
+                row["resume_converged"] = bool(
+                    f2 is not None and f2.status == "done"
+                    and f2.report is not None and _vmap(f2.report) == want)
+                row["ok"] = bool(
+                    f1 is not None and f1.status == "done"
+                    and row["quarantined"] >= 1 and row["sdc_escaped"] == 0
+                    and row["replicas_alive"] == 1
+                    and row["resume_converged"])
+            except BaseException as exc:
+                row["crashed"] = f"{type(exc).__name__}: {exc}"
+                row["ok"] = False
+            failures += 0 if row["ok"] else 1
+            print(json.dumps(row), flush=True)
+
+            # Ledger corruption lands on DISK, not in RAM — the serving
+            # replica is NOT suspect; the resubmit's resume pass must drop
+            # the corrupt row by CRC and re-decide it.
+            row = {"cell": "integrity/ledger.append/serve",
+                   "spec": "ledger.append:corrupt:2"}
+            c0 = crc_ctr.total()
+            try:
+                rdir = os.path.join(args.out, "int_serve_ledger")
+                with faults_lib.armed(("ledger.append:corrupt:2",),
+                                      seed=cfg0.seed):
+                    srv = VerificationServer(
+                        ServeConfig(batch_window_s=0.2, max_batch=2))
+                    r1 = srv.submit(cfg0.with_(result_dir=rdir), net, "ma",
+                                    partition_span=span)
+                    srv.start()
+                    f1 = srv.wait(r1.id, timeout=900.0)
+                    suspect = srv.suspect()
+                    srv.drain()
+                srv2 = VerificationServer(
+                    ServeConfig(batch_window_s=0.2, max_batch=2))
+                r2 = srv2.submit(cfg0.with_(result_dir=rdir), net, "ma",
+                                 partition_span=span)
+                srv2.start()
+                f2 = srv2.wait(r2.id, timeout=900.0)
+                srv2.drain()
+                row["suspect"] = suspect
+                row["crc_mismatch"] = crc_ctr.total() - c0
+                row["resume_converged"] = bool(
+                    f2.status == "done" and _vmap(f2.report) == want)
+                row["ok"] = bool(f1.status == "done" and not suspect
+                                 and row["crc_mismatch"] >= 1
+                                 and row["resume_converged"])
+            except BaseException as exc:
+                row["crashed"] = f"{type(exc).__name__}: {exc}"
+                row["ok"] = False
+            failures += 0 if row["ok"] else 1
+            print(json.dumps(row), flush=True)
+
+        if args.procfleet:
+            import time as time_mod
+
+            from fairify_tpu.serve import ProcessFleet, ProcFleetConfig, \
+                ServeConfig
+            from fairify_tpu.serve import client as client_lib
+
+            deaths_ctr = metrics_mod.registry().counter("replica_deaths")
+            int_sizes = [len(cfg0.query().columns), 8, 1]
+            int_model = "init" + "x".join(str(s) for s in int_sizes) + "-s3"
+            # span_chunks stays 0 (whole span per granule) so the request
+            # writes ONE ledger and a local resume can replay it directly.
+            int_over = {"soft_timeout_s": 30.0, "hard_timeout_s": 600.0,
+                        "sim_size": 64, "exact_certify_masks": False,
+                        "grid_chunk": args.grid_chunk,
+                        "launch_backoff_s": 1e-4, "mega_chunks": 1}
+
+            def _int_pf(tag):
+                return ProcessFleet(ProcFleetConfig(
+                    n_replicas=2, spool=os.path.join(args.out, tag),
+                    poll_s=0.03, pulse_s=0.0, backoff_s=0.05,
+                    replica=ServeConfig(batch_window_s=0.1, max_batch=4,
+                                        poll_s=0.05)))
+
+            def _int_pf_submit(fl, fault=None):
+                over = dict(int_over)
+                if fault is not None:
+                    over["inject_faults"] = [fault]
+                return client_lib.submit(
+                    fl.cfg.spool, client_lib.build_payload(
+                        args.preset, init={"sizes": int_sizes, "seed": 3},
+                        overrides=over, span=span))
+
+            def _int_pf_vmap(fl, rid):
+                out = {}
+                for path in client_lib.ledger_paths(fl.cfg.spool, rid):
+                    for pid, rec in sweep._load_ledger(path).items():
+                        out[pid] = rec["verdict"]
+                return out
+
+            def _int_pf_resume(fl, rid):
+                # Disarmed local resume over the replica's own sink: the
+                # cross-process analog of the run cells' resume pass (and
+                # the CRC read-path check for the ledger cell).
+                rcfg = cfg0.with_(
+                    result_dir=os.path.join(fl.cfg.spool, "requests", rid),
+                    mega_chunks=1)
+                rep = sweep.verify_model(
+                    init_mlp(tuple(int_sizes), seed=3), rcfg,
+                    model_name=int_model, resume=True, partition_span=span)
+                return _vmap(rep)
+
+            # In-replica decode corruption: the replica detects it, beats
+            # the violation count over the control pipe, and the router
+            # must kill + fail over the slot under kind=integrity.
+            row = {"cell": "integrity/launch.decode/procfleet",
+                   "spec": "launch.decode:corrupt:2"}
+            try:
+                d0 = deaths_ctr.value(kind="integrity")
+                fl = _int_pf("pf_int_decode").start()
+                fl.wait_ready(timeout=180)
+                rid = _int_pf_submit(fl, fault="launch.decode:corrupt:2")
+                rec = fl.wait(rid, timeout=600)
+                row["status"] = None if rec is None else rec.get("status")
+                t0 = time_mod.monotonic()
+                while deaths_ctr.value(kind="integrity") == d0 \
+                        and time_mod.monotonic() - t0 < 60:
+                    time_mod.sleep(0.02)
+                row["deaths_integrity"] = \
+                    deaths_ctr.value(kind="integrity") - d0
+                got = _int_pf_vmap(fl, rid)
+                row["sdc_escaped"] = sum(
+                    1 for p, v in got.items()
+                    if v != "unknown" and v != want[p])
+                fl.drain()
+                row["resume_converged"] = _int_pf_resume(fl, rid) == want
+                row["ok"] = bool(row["status"] == "done"
+                                 and row["deaths_integrity"] >= 1
+                                 and row["sdc_escaped"] == 0
+                                 and row["resume_converged"])
+            except BaseException as exc:
+                row["crashed"] = f"{type(exc).__name__}: {exc}"
+                row["ok"] = False
+            failures += 0 if row["ok"] else 1
+            print(json.dumps(row), flush=True)
+
+            # In-replica ledger corruption: invisible at write time (no
+            # integrity death), caught by the CRC when the sink is replayed.
+            row = {"cell": "integrity/ledger.append/procfleet",
+                   "spec": "ledger.append:corrupt:3"}
+            try:
+                d0 = deaths_ctr.value(kind="integrity")
+                fl = _int_pf("pf_int_ledger").start()
+                fl.wait_ready(timeout=180)
+                rid = _int_pf_submit(fl, fault="ledger.append:corrupt:3")
+                rec = fl.wait(rid, timeout=600)
+                row["status"] = None if rec is None else rec.get("status")
+                fl.drain()
+                c0 = crc_ctr.total()
+                row["resume_converged"] = _int_pf_resume(fl, rid) == want
+                row["crc_mismatch"] = crc_ctr.total() - c0
+                row["no_integrity_death"] = \
+                    deaths_ctr.value(kind="integrity") == d0
+                row["ok"] = bool(row["status"] == "done"
+                                 and row["crc_mismatch"] >= 1
+                                 and row["no_integrity_death"]
+                                 and row["resume_converged"])
+            except BaseException as exc:
+                row["crashed"] = f"{type(exc).__name__}: {exc}"
+                row["ok"] = False
+            failures += 0 if row["ok"] else 1
+            print(json.dumps(row), flush=True)
+
+            # smt.query × procfleet is DELEGATED (see module docstring):
+            # the always-unknown solver stubs cannot cross the process
+            # boundary and no real config funnels work to the solver
+            # deterministically.  The run + serve smt.query cells exercise
+            # the identical _SmtTier.result code path the replica runs.
+            print(json.dumps({
+                "cell": "integrity/smt.query/procfleet",
+                "delegated": "covered by integrity/smt.query/{run,serve}"
+                             " (same in-process code path; stubs cannot"
+                             " cross the replica process boundary)",
+                "ok": True}), flush=True)
 
     # Shard-loss cells: device.lost at each shard index × transient/fatal
     # over the sharded runtime.  The fault-free SHARDED run is the pin —
@@ -897,7 +1216,7 @@ def main() -> int:
         from fairify_tpu.verify.engine import EngineConfig
         from fairify_tpu.verify.sweep import _ledger_path
 
-        def _dull_decode(host, ctx):
+        def _dull_decode(host, ctx, stats=None):
             import numpy as np
 
             n = ctx["n"]
@@ -993,6 +1312,142 @@ def main() -> int:
                                      and row["resume_converged"])
                 failures += 0 if row["ok"] else 1
                 print(json.dumps(row), flush=True)
+
+            # smt.query:corrupt (--integrity): a solver counterexample
+            # comes back with a flipped bit.  The witness replay
+            # (validate_pair) must refuse it — the partition degrades to
+            # unknown:failure:integrity.smt.query, never a wrong sat.
+            if args.integrity:
+                import numpy as np
+
+                int_viol = metrics_mod.registry().counter(
+                    "integrity_violations")
+                # Seed 11 is the sat-bearing world: the solver refutes 4
+                # of the 8 partitions, so there ARE witnesses to corrupt
+                # (seed 3's all-unsat map would make this cell vacuous).
+                # Two extra knobs make the sats actually reach the SMT
+                # tier: mega_chunks=0 routes stage0 through the dulled
+                # chunk decode (the mega path runs the REAL stage0
+                # kernels), and pgd_attack_decode is stubbed to find
+                # nothing — the batched stage0 PGD pass would otherwise
+                # settle every sat in-process, bypassing the solver
+                # (near_abs > 50 also skips the slab refinement).
+                int_smt_cfg0 = smt_cfg0.with_(mega_chunks=0)
+                _saved_pgd = engine_mod.pgd_attack_decode
+                engine_mod.pgd_attack_decode = (
+                    lambda host, ctx, return_points=False:
+                    ({}, None, np.full(4096, 1e9)))
+                try:
+                    int_smt_net = init_mlp(
+                        (len(int_smt_cfg0.query().columns), 4, 1), seed=11)
+                    int_smt_base = sweep_mod.verify_model(
+                        int_smt_net, int_smt_cfg0.with_(
+                            result_dir=os.path.join(
+                                args.out, "int_smt_base")),
+                        model_name="m", resume=False,
+                        partition_span=smt_span)
+                    int_smt_want = _vmap(int_smt_base)
+                    spec = "smt.query:corrupt:1+"
+                    cfg = int_smt_cfg0.with_(
+                        result_dir=os.path.join(args.out, "int_smt"),
+                        inject_faults=(spec,))
+                    row = {"cell": "integrity/smt.query/run", "spec": spec,
+                           "sat_in_base": sum(
+                               1 for v in int_smt_want.values()
+                               if v == "sat")}
+                    v0 = int_viol.value(site="smt.query")
+                    try:
+                        rep = sweep_mod.verify_model(
+                            int_smt_net, cfg, model_name="m", resume=False,
+                            partition_span=smt_span)
+                        got = _vmap(rep)
+                        row["sdc_escaped"] = sum(
+                            1 for k in got
+                            if got[k] != "unknown"
+                            and got[k] != int_smt_want[k])
+                        row["detected"] = bool(
+                            int_viol.value(site="smt.query") > v0)
+                        recs, _sk = sweep_mod._read_ledger(
+                            _ledger_path(cfg, rep.sink_name))
+                        reasons = {r["failure"]["reason"] for r in recs
+                                   if r.get("failure")}
+                        row["reasons"] = sorted(reasons)
+                        row["degraded"] = rep.degraded
+                        resumed = sweep_mod.verify_model(
+                            int_smt_net, cfg.with_(inject_faults=()),
+                            model_name="m", resume=True,
+                            partition_span=smt_span)
+                        row["resume_converged"] = \
+                            _vmap(resumed) == int_smt_want
+                        row["ok"] = bool(
+                            row["sat_in_base"] >= 1
+                            and row["detected"]
+                            and row["sdc_escaped"] == 0
+                            and rep.degraded >= 1
+                            and reasons == {"integrity.smt.query:fatal"}
+                            and row["resume_converged"])
+                    except BaseException as exc:
+                        row["crashed"] = f"{type(exc).__name__}: {exc}"
+                        row["ok"] = False
+                    failures += 0 if row["ok"] else 1
+                    print(json.dumps(row), flush=True)
+
+                    # The same corruption inside the persistent server:
+                    # the invalid witness surfaces in the deferred SMT
+                    # drain, the replica goes suspect, and a disarmed
+                    # resubmit converges.
+                    if args.serve:
+                        from fairify_tpu.resilience import \
+                            faults as faults_lib
+                        from fairify_tpu.serve import ServeConfig, \
+                            VerificationServer
+
+                        row = {"cell": "integrity/smt.query/serve",
+                               "spec": spec}
+                        rdir = os.path.join(args.out, "int_smt_serve")
+                        try:
+                            with faults_lib.armed((spec,),
+                                                  seed=smt_cfg0.seed):
+                                srv = VerificationServer(ServeConfig(
+                                    batch_window_s=0.2, max_batch=2,
+                                    smt_workers=1))
+                                r1 = srv.submit(
+                                    int_smt_cfg0.with_(result_dir=rdir),
+                                    int_smt_net, "ma",
+                                    partition_span=smt_span)
+                                srv.start()
+                                f1 = srv.wait(r1.id, timeout=900.0)
+                                suspect = srv.suspect()
+                                srv.drain()
+                            got1 = {} if f1.report is None \
+                                else _vmap(f1.report)
+                            row["sdc_escaped"] = sum(
+                                1 for p, v in got1.items()
+                                if v != "unknown" and v != int_smt_want[p])
+                            row["suspect"] = suspect
+                            srv2 = VerificationServer(ServeConfig(
+                                batch_window_s=0.2, max_batch=2,
+                                smt_workers=1))
+                            r2 = srv2.submit(
+                                int_smt_cfg0.with_(result_dir=rdir),
+                                int_smt_net, "ma", partition_span=smt_span)
+                            srv2.start()
+                            f2 = srv2.wait(r2.id, timeout=900.0)
+                            srv2.drain()
+                            row["resume_converged"] = bool(
+                                f2.status == "done"
+                                and _vmap(f2.report) == int_smt_want)
+                            row["ok"] = bool(
+                                f1.status == "done" and suspect
+                                and row["sdc_escaped"] == 0
+                                and row["resume_converged"])
+                        except BaseException as exc:
+                            row["crashed"] = f"{type(exc).__name__}: {exc}"
+                            row["ok"] = False
+                        failures += 0 if row["ok"] else 1
+                        print(json.dumps(row), flush=True)
+                finally:
+                    engine_mod.pgd_attack_decode = _saved_pgd
 
             # Serve-mode smt cells: the same faults inside the persistent
             # server, two clients sharing the server-wide pool.

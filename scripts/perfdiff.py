@@ -88,6 +88,19 @@ through a funnel collapse from 0.99 to 0.85 — instead ANY drop past two
 absolute points fails.  The metric joins the gate only when both sides
 carry it (older baselines simply don't gate it yet).
 
+**Integrity gates** (ISSUE 19, DESIGN.md §21): bench lines and throughput
+records carry ``integrity_violations`` / ``ledger_crc_mismatch`` (nested
+under a throughput record's ``resilience`` block; hoisted at load) —
+lower-is-better with a 0.5 floor, i.e. ZERO growth: a healthy run detects
+no corruption and drops no CRC-failed ledger rows.  The bench headline's
+``integrity_ab`` block adds ``integrity_recheck_overhead_rel`` — what the
+sampled device-recheck costs in decided throughput at the benched
+``DEFAULT_RECHECK_RATE`` — lower-is-better with a 5-point absolute floor
+(same measurement-grain rule as tracing overhead).  A chaos-matrix JSONL
+archive (rows keyed by ``cell``, ``audits/chaos_integrity_r*.jsonl``)
+aggregates into ``chaos.sdc_escaped`` (decided-WRONG verdicts that escaped
+containment: any growth from 0 fails outright) and ``chaos.failed_cells``.
+
 ``--self-test`` runs the built-in contract checks (wired into tier-1 via
 ``tests/test_perfdiff.py``): identical records pass, a 2x slowdown fails,
 overlapping noisy bands pass, doubled launches fail.
@@ -114,7 +127,13 @@ _LOWER_BETTER = {"device_launches": 0.5, "n_compiles": 0.5, "compile_s": 0.5,
                  # toward O(chunks) — a broken mega path silently falling
                  # to the per-chunk loop — is a regression even when the
                  # wall-clock rate hides it behind noise.
-                 "launches_per_model": 0.5}
+                 "launches_per_model": 0.5,
+                 # Result-integrity counters (ISSUE 19, DESIGN.md §21): a
+                 # healthy run detects ZERO corruption and drops ZERO
+                 # CRC-failed ledger rows, so ANY growth from 0 is a trust
+                 # regression, not noise (zero-growth gate).
+                 "integrity_violations": 0.5,
+                 "ledger_crc_mismatch": 0.5}
 
 
 def _metric_key(metric: str) -> str:
@@ -329,8 +348,23 @@ def load_records(path: str) -> Dict[str, dict]:
                         unwrapped.append(json.loads(line))
                     except json.JSONDecodeError:
                         continue
+    chaos_rows = chaos_sdc = chaos_bad = 0
     for obj in unwrapped:
         if not isinstance(obj, dict):
+            continue
+        if isinstance(obj.get("resilience"), dict):
+            # Throughput records nest the integrity counters under the
+            # resilience block — hoist them to the gate's flat keys
+            # (explicit top-level values win).
+            obj = {**{k: obj["resilience"][k]
+                      for k in ("integrity_violations", "ledger_crc_mismatch")
+                      if k in obj["resilience"]}, **obj}
+        if "cell" in obj and ("ok" in obj or "sdc_escaped" in obj):
+            # Chaos-matrix archive row: aggregated below into the
+            # file-level SDC-escape and cell-health gates.
+            chaos_rows += 1
+            chaos_sdc += int(obj.get("sdc_escaped") or 0)
+            chaos_bad += 0 if obj.get("ok", True) else 1
             continue
         rec = _bench_record(obj)
         if rec is not None:
@@ -339,6 +373,13 @@ def load_records(path: str) -> Dict[str, dict]:
             if obj.get("decided_fraction") is not None:
                 out[f"{key}.decided_fraction"] = _flat_fraction(
                     obj["decided_fraction"])
+            iab = obj.get("integrity_ab")
+            if isinstance(iab, dict) and iab.get("overhead_rel") is not None:
+                # Sampled-recheck cost A/B (bench headline): gate the
+                # overhead fraction like the tracing A/B — lower is
+                # better, 5-point absolute floor for single-sample grain.
+                out["integrity_recheck_overhead_rel"] = _flat_lower(
+                    max(float(iab["overhead_rel"]), 0.0), floor=0.05)
             continue
         sv = _serve_records(obj)
         if sv:
@@ -370,6 +411,9 @@ def load_records(path: str) -> Dict[str, dict]:
             # Only genuine throughput records (a rate matched above) carry
             # the funnel's decided fraction into the gate.
             out["decided_fraction"] = _flat_fraction(obj["decided_fraction"])
+    if chaos_rows:
+        out["chaos.sdc_escaped"] = _flat_lower(chaos_sdc, floor=0.5)
+        out["chaos.failed_cells"] = _flat_lower(chaos_bad, floor=0.5)
     return out
 
 
@@ -621,8 +665,39 @@ def self_test() -> int:
     df_same = {"df": _flat_fraction(0.98)}
     df_jitter = {"df": _flat_fraction(0.965)}
     df_collapsed = {"df": _flat_fraction(0.60)}
+    iv_clean = {"pps": {"value": 50.0, "min": 46.0, "max": 53.0,
+                        "banded": True, "integrity_violations": 0,
+                        "ledger_crc_mismatch": 0}}
+    iv_corrupt = {"pps": {"value": 50.0, "min": 46.0, "max": 53.0,
+                          "banded": True, "integrity_violations": 3,
+                          "ledger_crc_mismatch": 2}}
+    ia_base = {"integrity_recheck_overhead_rel": _flat_lower(0.02,
+                                                             floor=0.05)}
+    ia_heavy = {"integrity_recheck_overhead_rel": _flat_lower(0.40,
+                                                              floor=0.05)}
+    ia_jitter = {"integrity_recheck_overhead_rel": _flat_lower(0.06,
+                                                               floor=0.05)}
     import os
     import tempfile
+
+    chaos_clean = [
+        {"cell": "integrity/launch.decode/run", "ok": True,
+         "sdc_escaped": 0},
+        {"cell": "integrity/smt.query/run", "ok": True, "sdc_escaped": 0},
+        {"cell": "launch.decode/transient", "ok": True}]
+    chaos_leaky = [
+        {"cell": "integrity/launch.decode/run", "ok": False,
+         "sdc_escaped": 2},
+        {"cell": "integrity/smt.query/run", "ok": True, "sdc_escaped": 0},
+        {"cell": "launch.decode/transient", "ok": True}]
+    chaos_recs = {}
+    for tag, rows in (("clean", chaos_clean), ("leaky", chaos_leaky)):
+        with tempfile.NamedTemporaryFile("w", suffix=".jsonl",
+                                         delete=False) as fp:
+            fp.write("\n".join(json.dumps(r) for r in rows) + "\n")
+            cname = fp.name
+        chaos_recs[tag] = load_records(cname)
+        os.unlink(cname)
 
     thr_obj = {"partitions_per_sec": 12.5, "partitions_per_sec_per_chip": 12.5,
                "device_launches": 9, "decided_fraction": 0.9875}
@@ -699,6 +774,24 @@ def self_test() -> int:
          compare(df_base, df_jitter), 0),
         ("funnel collapse flagged (decided_fraction)",
          compare(df_base, df_collapsed), 1),
+        ("identical integrity counters pass", compare(iv_clean, iv_clean),
+         0),
+        ("corruption detections from a 0 baseline flagged "
+         "(violations + crc)", compare(iv_clean, iv_corrupt), 2),
+        ("recheck-overhead step change flagged", compare(ia_base, ia_heavy),
+         1),
+        ("recheck-overhead jitter within the floor passes",
+         compare(ia_base, ia_jitter), 0),
+        ("chaos archive loads sdc/cell gates",
+         [] if (chaos_recs["clean"].get("chaos.sdc_escaped",
+                                        {}).get("value") == 0.0
+                and chaos_recs["clean"]["chaos.failed_cells"]["value"]
+                == 0.0)
+         else [{"kind": "regression"}], 0),
+        ("identical chaos archives pass",
+         compare(chaos_recs["clean"], chaos_recs["clean"]), 0),
+        ("escaped SDC + failed cell flagged",
+         compare(chaos_recs["clean"], chaos_recs["leaky"]), 2),
         ("identical smt records pass", compare(sm_base, sm_same), 0),
         ("lost smt scaling flagged (qps@4w + speedup_x)",
          compare(sm_base, sm_serial), 2),

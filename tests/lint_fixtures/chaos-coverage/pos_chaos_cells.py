@@ -2,13 +2,22 @@
 """Fixture chaos driver: covers demo.used, references an unknown site.
 
 (`demo.lost` is registered but has no cell here and no exemption — that
-finding lands on the registry's FAULT_SITES line; smt.query and the
-shard.* sites are CHAOS_EXEMPT, so their absence is fine.)
+finding lands on the registry's FAULT_SITES line; the shard.* sites are
+CHAOS_EXEMPT, so their absence is fine.  `smt.query` is covered by a
+``corrupt``-kind integrity cell, the ISSUE 19 vocabulary — a corrupt
+spec counts as coverage exactly like the older kinds, and a corrupt spec
+naming an unknown site is flagged exactly like them too.)
 """
 
 SCHEDULES = [
     ("demo.used", "transient", "demo.used:transient:2"),
     ("nope.site", "transient", "nope.site:transient:1"),  # EXPECT
+]
+
+# Result-integrity cells (--integrity, DESIGN.md §21): corrupt-kind specs.
+INTEGRITY_SPECS = [
+    "smt.query:corrupt:1+",
+    "nope.flip:corrupt:1",  # EXPECT
 ]
 
 # Process-fleet style cells (full spec literals, the shape the real

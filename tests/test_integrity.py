@@ -1,0 +1,340 @@
+"""Result-integrity layer: SDC injection, detection, containment (§21).
+
+Pins the integrity contract (DESIGN.md §21) at both grains:
+
+* unit — the deterministic corruption helpers, the host-side fold/canary
+  detectors, the per-row ledger CRC, and the hash-keyed recheck sampler
+  (``resilience/integrity.py``), plus the ``corrupt`` fault-kind plumbing
+  in ``resilience/faults.py`` (own arrival stream, data-plane-only sites);
+* end-to-end — a corrupted device fetch degrades (never decides) exactly
+  its blast radius and a disarmed resume converges; a corrupted ledger
+  row is dropped by CRC on resume and re-decided; a full-rate sampled
+  recheck of a clean run is bit-quiet (no violations, same verdict map).
+
+The chaos matrix (``scripts/chaos_matrix.py --integrity``) runs the same
+scenarios at full span and across --serve/--procfleet topologies; these
+tests are the fast always-on subset.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from fairify_tpu import obs
+from fairify_tpu.obs import metrics as metrics_mod
+from fairify_tpu.obs import trace as trace_mod
+from fairify_tpu.resilience import faults, integrity
+from fairify_tpu.resilience.journal import JournalWriter
+from fairify_tpu.models.train import init_mlp
+from fairify_tpu.verify import presets, sweep
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Quiescent registry, no tracer, no armed fault plan, per test."""
+    trace_mod.deactivate()
+    metrics_mod.registry().reset()
+    faults.disarm()
+    yield
+    trace_mod.deactivate()
+    metrics_mod.registry().reset()
+    faults.disarm()
+
+
+# ---------------------------------------------------------------------------
+# corruption helpers: deterministic, dtype-aware bit flips
+# ---------------------------------------------------------------------------
+
+
+def test_flip_bit_is_deterministic_and_changes_exactly_one_element():
+    for arr in (np.arange(12, dtype=np.int32),
+                np.linspace(-1, 1, 7, dtype=np.float32),
+                np.zeros(5, dtype=bool)):
+        a1 = integrity.flip_bit(arr, 3)
+        a2 = integrity.flip_bit(arr, 3)
+        assert np.array_equal(a1, a2)          # same n -> same flip
+        assert a1.dtype == arr.dtype and a1.shape == arr.shape
+        diff = a1.reshape(-1) != arr.reshape(-1)
+        assert int(diff.sum()) == 1
+        assert not np.shares_memory(a1, arr)   # input is never mutated
+    # different arrivals move the flip around
+    arr = np.arange(8, dtype=np.int64)
+    assert not np.array_equal(integrity.flip_bit(arr, 1),
+                              integrity.flip_bit(arr, 2))
+
+
+def test_flip_bit_float_flip_is_magnitude_scale():
+    # Exponent-MSB flips are the classic SDC signature: the value must
+    # change by orders of magnitude, not an absorbable ULP.
+    arr = np.full(4, 1.5, dtype=np.float32)
+    out = integrity.flip_bit(arr, 0)
+    changed = out[out != arr]
+    assert changed.size == 1
+    v = float(changed[0])
+    # exponent-MSB flip of 1.5 lands on NaN/inf or a value orders of
+    # magnitude away — never an absorbable ULP
+    assert (not np.isfinite(v)) or not (1e-3 < abs(v) / 1.5 < 1e3)
+
+
+def test_flip_bit_empty_array_is_noop():
+    arr = np.empty((0,), dtype=np.float32)
+    assert integrity.flip_bit(arr, 5).size == 0
+
+
+def test_corrupt_host_never_touches_the_checksum():
+    payload = {"cert": np.ones((2, 4), dtype=bool),
+               "wit": np.zeros((2, 4), dtype=np.float32),
+               "csum": np.int32(123)}
+    for n in range(6):
+        out = integrity.corrupt_host(payload, n)
+        assert int(out["csum"]) == 123
+        assert any(not np.array_equal(out[k], payload[k])
+                   for k in ("cert", "wit"))
+
+
+def test_corrupt_record_inverts_decided_verdicts():
+    assert integrity.corrupt_record({"verdict": "unsat"}, 1)["verdict"] == "sat"
+    assert integrity.corrupt_record({"verdict": "sat"}, 1)["verdict"] == "unsat"
+    out = integrity.corrupt_record({"verdict": "unknown",
+                                    "partition_id": 7}, 1)
+    assert out["partition_id"] != 7
+    # stays valid JSON — a corrupt row, not a torn line
+    json.dumps(out)
+
+
+def test_corrupt_witness_flips_one_side_per_arrival():
+    ce = (np.ones(4), np.ones(4))
+    x0, xp0 = integrity.corrupt_witness(ce, 0)
+    assert not np.array_equal(x0, ce[0]) and np.array_equal(xp0, ce[1])
+    x1, xp1 = integrity.corrupt_witness(ce, 1)
+    assert np.array_equal(x1, ce[0]) and not np.array_equal(xp1, ce[1])
+
+
+# ---------------------------------------------------------------------------
+# detectors: fold checksum + canary, ledger CRC, recheck sampler
+# ---------------------------------------------------------------------------
+
+
+def _segment_payload():
+    """A synthetic mega-segment payload whose last row is a clean canary."""
+    payload = {
+        "cert": np.ones((3, 4), dtype=bool),
+        "wit": np.zeros((3, 4), dtype=np.float32),
+        "reason": np.ones((3, 4), dtype=np.int32),
+        "stats": np.arange(12, dtype=np.int32).reshape(3, 4),
+    }
+    payload["csum"] = np.int32(integrity.fold_host(payload))
+    return payload
+
+
+def test_verify_segment_clean_payload_passes():
+    assert integrity.verify_segment(_segment_payload()) is None
+
+
+def test_verify_segment_checksum_catches_any_buffer_flip():
+    for key in integrity.FOLD_KEYS:
+        payload = _segment_payload()
+        payload[key] = integrity.flip_bit(payload[key], 1)
+        assert integrity.verify_segment(payload) == "checksum"
+
+
+def test_verify_segment_canary_catches_consistent_corruption():
+    # A stuck line that corrupts data AND fold identically slips past the
+    # checksum; the known-answer canary row is the second net.
+    payload = _segment_payload()
+    payload["cert"][-1, 0] = False
+    payload["csum"] = np.int32(integrity.fold_host(payload))
+    assert integrity.verify_segment(payload) == "canary"
+
+
+def test_fold_host_wraps_around_without_error():
+    payload = {k: np.full((2, 2), 2**30, dtype=np.int32)
+               for k in integrity.FOLD_KEYS}
+    v = integrity.fold_host(payload)
+    assert np.iinfo(np.int32).min <= v <= np.iinfo(np.int32).max
+
+
+def test_record_crc_is_key_order_independent():
+    a = {"partition_id": 3, "verdict": "unsat", "via": "stage0"}
+    b = {"via": "stage0", "verdict": "unsat", "partition_id": 3}
+    assert integrity.record_crc(a) == integrity.record_crc(b)
+
+
+def test_verify_records_drops_corrupt_keeps_legacy_strips_crc():
+    good = {"partition_id": 1, "verdict": "unsat"}
+    sealed = dict(good, _crc=integrity.record_crc(good))
+    corrupt = dict(integrity.corrupt_record(good, 1),
+                   _crc=integrity.record_crc(good))
+    legacy = {"partition_id": 2, "verdict": "sat"}  # pre-§21 ledger row
+    trusted, bad = integrity.verify_records([sealed, corrupt, legacy])
+    assert bad == 1
+    assert trusted == [good, legacy]
+    assert all("_crc" not in r for r in trusted)
+
+
+def test_sampled_is_deterministic_and_rate_shaped():
+    keys = [f"chunk:{i}" for i in range(2000)]
+    picks = [integrity.sampled(11, k, 0.05) for k in keys]
+    assert picks == [integrity.sampled(11, k, 0.05) for k in keys]
+    share = sum(picks) / len(picks)
+    assert 0.02 < share < 0.10                  # ~rate, hash-keyed
+    assert not any(integrity.sampled(11, k, 0.0) for k in keys[:50])
+    assert all(integrity.sampled(11, k, 1.0) for k in keys[:50])
+    # a different seed selects a different subset
+    assert picks != [integrity.sampled(12, k, 0.05) for k in keys]
+
+
+# ---------------------------------------------------------------------------
+# faults: the corrupt kind rides its own arrival stream
+# ---------------------------------------------------------------------------
+
+
+def test_parse_spec_corrupt_only_at_data_plane_sites():
+    s = faults.parse_spec("launch.decode:corrupt:2")
+    assert (s.site, s.kind, s.start) == ("launch.decode", "corrupt", 2)
+    for site in sorted(faults.CORRUPT_SITES):
+        faults.parse_spec(f"{site}:corrupt:1+")
+    with pytest.raises(ValueError, match="data-plane"):
+        faults.parse_spec("compile:corrupt:1")
+    with pytest.raises(ValueError):
+        faults.parse_spec("launch.submit:corrupt:1")
+
+
+def test_corruption_schedule_one_shot_and_every():
+    plan = faults.FaultPlan(["ledger.append:corrupt:2",
+                             "smt.query:corrupt:1+"])
+    hits = [plan.corruption("ledger.append") for _ in range(4)]
+    assert hits == [None, 2, None, None]        # :N fires once, at N
+    assert [plan.corruption("smt.query") for _ in range(3)] == [1, 2, 3]
+
+
+def test_corrupt_specs_are_invisible_to_check():
+    # Arming a corrupt spec must never shift (or fire on) the
+    # control-plane arrival stream chaos schedules depend on.
+    plan = faults.FaultPlan(["launch.decode:corrupt:1+",
+                             "launch.decode:fatal:3"])
+    plan.check("launch.decode")                  # arrivals 1, 2 clean
+    plan.check("launch.decode")
+    assert plan.corruption("launch.decode") == 1  # own stream starts at 1
+    with pytest.raises(faults.InjectedFault) as ei:
+        plan.check("launch.decode")              # control arrival 3
+    assert ei.value.kind == "fatal"
+
+
+def test_journal_crc_roundtrip_and_injected_row_corruption(tmp_path):
+    path = str(tmp_path / "m.ledger.jsonl")
+    with faults.armed(("ledger.append:corrupt:2",)):
+        w = JournalWriter(path, fsync=False, crc=True)
+        w.append({"partition_id": 1, "verdict": "unsat"})
+        w.append({"partition_id": 2, "verdict": "unsat"})  # mutates post-CRC
+        w.append({"partition_id": 3, "verdict": "sat"})
+        w.close()
+    rows = [json.loads(l) for l in open(path) if l.strip()]
+    assert all("_crc" in r for r in rows)
+    trusted, bad = integrity.verify_records(rows)
+    assert bad == 1
+    assert [r["partition_id"] for r in trusted] == [1, 3]
+    # the corrupted row is on disk with an inverted verdict, valid JSON
+    assert rows[1]["verdict"] == "sat"
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: detect, contain, converge on resume
+# ---------------------------------------------------------------------------
+
+SPAN = (0, 16)
+
+
+def _cfg(tmp_path, name, **kw):
+    kw.setdefault("grid_chunk", 16)
+    kw.setdefault("mega_chunks", 1)
+    return presets.get("GC").with_(
+        result_dir=str(tmp_path / name), soft_timeout_s=30.0,
+        hard_timeout_s=600.0, sim_size=64, exact_certify_masks=False,
+        launch_backoff_s=1e-4, **kw)
+
+
+def _net():
+    return init_mlp((20, 8, 1), seed=3)
+
+
+def _vmap(report):
+    return {o.partition_id: o.verdict for o in report.outcomes}
+
+
+@pytest.fixture(scope="module")
+def fault_free(tmp_path_factory):
+    td = tmp_path_factory.mktemp("int_fault_free")
+    cfg = presets.get("GC").with_(
+        result_dir=str(td), soft_timeout_s=30.0, hard_timeout_s=600.0,
+        sim_size=64, exact_certify_masks=False, grid_chunk=16)
+    rep = sweep.verify_model(_net(), cfg, model_name="m", resume=False,
+                             partition_span=SPAN)
+    return {o.partition_id: o.verdict for o in rep.outcomes}
+
+
+def test_decode_corruption_detected_contained_and_resumed(tmp_path,
+                                                          fault_free):
+    viol = metrics_mod.registry().counter("integrity_violations")
+    cfg = _cfg(tmp_path, "dec",
+               inject_faults=("launch.decode:corrupt:1",))
+    rep = sweep.verify_model(_net(), cfg, model_name="m", resume=False,
+                             partition_span=SPAN)
+    assert viol.value(site="launch.decode") >= 1
+    got = _vmap(rep)
+    # soundness: nothing DECIDED may disagree with the fault-free map —
+    # a corrupted fetch degrades, it never decides.
+    assert all(fault_free[p] == v for p, v in got.items() if v != "unknown")
+    assert rep.degraded >= 1, "corrupted segment must demote its partitions"
+    path = os.path.join(
+        cfg.result_dir, f"{cfg.name}-m@{SPAN[0]}-{SPAN[1]}.ledger.jsonl")
+    reasons = {r["failure"]["reason"] for r in
+               (json.loads(l) for l in open(path) if l.strip())
+               if r.get("failure")}
+    assert reasons and all(r.startswith("integrity.launch.decode")
+                           for r in reasons)
+    # disarmed resume: decided-wins keeps the good verdicts, re-runs the
+    # demoted span, and converges bit-equal to fault-free.
+    resumed = sweep.verify_model(_net(), cfg.with_(inject_faults=()),
+                                 model_name="m", resume=True,
+                                 partition_span=SPAN)
+    assert _vmap(resumed) == fault_free
+    assert resumed.degraded == 0
+
+
+def test_ledger_row_corrupted_on_disk_is_dropped_and_redecided(
+        tmp_path, fault_free):
+    crc_ctr = metrics_mod.registry().counter("ledger_crc_mismatch")
+    cfg = _cfg(tmp_path, "led")
+    sweep.verify_model(_net(), cfg, model_name="m", resume=False,
+                       partition_span=SPAN)
+    path = os.path.join(
+        cfg.result_dir, f"{cfg.name}-m@{SPAN[0]}-{SPAN[1]}.ledger.jsonl")
+    rows = [json.loads(l) for l in open(path) if l.strip()]
+    victim = next(r for r in rows if r.get("verdict") in ("sat", "unsat"))
+    flipped = dict(victim)
+    flipped["verdict"] = "sat" if victim["verdict"] == "unsat" else "unsat"
+    with open(path, "w") as fp:  # rot the row in place, CRC untouched
+        for r in rows:
+            fp.write(json.dumps(flipped if r is victim else r) + "\n")
+    c0 = crc_ctr.total()
+    resumed = sweep.verify_model(_net(), cfg, model_name="m", resume=True,
+                                 partition_span=SPAN)
+    assert crc_ctr.total() - c0 >= 1
+    # the rotted pid was re-DECIDED, not replayed: final map is fault-free
+    assert _vmap(resumed) == fault_free
+
+
+def test_full_rate_recheck_is_bit_quiet_on_a_clean_run(tmp_path, fault_free):
+    viol = metrics_mod.registry().counter("integrity_violations")
+    rechecks = metrics_mod.registry().counter("integrity_rechecks")
+    v0, r0 = viol.total(), rechecks.total()
+    cfg = _cfg(tmp_path, "rck", integrity_recheck=1.0)
+    rep = sweep.verify_model(_net(), cfg, model_name="m", resume=False,
+                             partition_span=SPAN)
+    assert rechecks.value(kind="chunk") >= 1
+    assert rechecks.value(kind="exact") >= 1    # escalation ran too
+    assert viol.total() - v0 == 0               # clean run: zero violations
+    assert _vmap(rep) == fault_free
+    assert rep.degraded == 0

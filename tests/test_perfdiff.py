@@ -266,5 +266,69 @@ def test_procfleet_block_gating(tmp_path):
     assert perfdiff.main([str(a), str(b)]) == 0
 
 
+def test_integrity_counter_zero_growth_gate(tmp_path):
+    """Integrity detections gate at zero growth (ISSUE 19): a bench line
+    whose integrity_violations grows from a clean 0 baseline fails; the
+    throughput record's counters (nested under `resilience`) hoist into
+    the same gate."""
+    clean = {**_bench_line(50.0, 46.0, 53.0), "integrity_violations": 0,
+             "ledger_crc_mismatch": 0}
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(clean) + "\n")
+    b.write_text(json.dumps(clean) + "\n")
+    assert perfdiff.main([str(a), str(b)]) == 0
+    b.write_text(json.dumps({**clean, "integrity_violations": 3}) + "\n")
+    assert perfdiff.main([str(a), str(b)]) == 1
+    thr = {"partitions_per_sec": 10.0,
+           "resilience": {"degraded": 0, "integrity_violations": 0,
+                          "ledger_crc_mismatch": 0}}
+    a.write_text(json.dumps(thr))
+    recs = perfdiff.load_records(str(a))
+    assert recs["partitions_per_sec"]["integrity_violations"] == 0
+    b.write_text(json.dumps(
+        {**thr, "resilience": {"degraded": 1, "integrity_violations": 2,
+                               "ledger_crc_mismatch": 0}}))
+    assert perfdiff.main([str(a), str(b)]) == 1
+
+
+def test_integrity_recheck_overhead_gate(tmp_path):
+    """The bench headline's integrity_ab block gates the sampled-recheck
+    cost lower-is-better with a 5-point floor: within-noise overhead
+    passes, a step change fails."""
+    base = {**_bench_line(50.0, 46.0, 53.0),
+            "integrity_ab": {"recheck_rate": 0.05, "pps_on": 49.0,
+                             "pps_off": 50.0, "overhead_rel": 0.02}}
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(base) + "\n")
+    assert "integrity_recheck_overhead_rel" in perfdiff.load_records(str(a))
+    cand = json.loads(json.dumps(base))
+    cand["integrity_ab"]["overhead_rel"] = 0.06
+    b.write_text(json.dumps(cand) + "\n")
+    assert perfdiff.main([str(a), str(b)]) == 0
+    cand["integrity_ab"]["overhead_rel"] = 0.40
+    b.write_text(json.dumps(cand) + "\n")
+    assert perfdiff.main([str(a), str(b)]) == 1
+
+
+def test_chaos_archive_sdc_gate(tmp_path):
+    """A chaos-matrix JSONL archive aggregates into chaos.sdc_escaped /
+    chaos.failed_cells: any decided-wrong verdict that escaped containment
+    (or a newly failing cell) fails the gate."""
+    clean = [{"cell": "integrity/launch.decode/run", "ok": True,
+              "sdc_escaped": 0},
+             {"cell": "launch.decode/transient", "ok": True}]
+    leaky = [{"cell": "integrity/launch.decode/run", "ok": False,
+              "sdc_escaped": 1},
+             {"cell": "launch.decode/transient", "ok": True}]
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    a.write_text("\n".join(json.dumps(r) for r in clean) + "\n")
+    b.write_text("\n".join(json.dumps(r) for r in leaky) + "\n")
+    recs = perfdiff.load_records(str(a))
+    assert recs["chaos.sdc_escaped"]["value"] == 0.0
+    assert recs["chaos.failed_cells"]["value"] == 0.0
+    assert perfdiff.main([str(a), str(a)]) == 0
+    assert perfdiff.main([str(a), str(b)]) == 1
+
+
 def test_self_test_cli_flag():
     assert perfdiff.main(["--self-test"]) == 0

@@ -818,6 +818,53 @@ def test_all_devices_lost_degrades_then_resume_converges(tmp_path, fault_free):
     assert resumed.degraded == 0
 
 
+def test_device_lost_mega_segments_blast_radius_then_mega_resume(
+        tmp_path, fault_free):
+    """device.lost × mega-loop segments (ISSUE 19 coverage gap: PR 7's
+    shard chaos predates PR 14's mega-loop).  A multi-chunk-segment mega
+    config dispatched through the shard runtime runs the per-chunk loop
+    (meshes disable ``_use_mega``) and must still match the plain mega
+    map bit-equal; a device lost mid-sweep — with every re-shard landing
+    on hardware that dies too — degrades EXACTLY the lost shard's span
+    (the other shard's decided verdicts survive untouched), and a plain
+    ``resume=True`` over that span's journal rides the MEGA path to
+    convergence."""
+    import jax
+
+    from fairify_tpu.parallel import shards as shards_mod
+
+    cfg = _cfg(tmp_path, "mega_dl", mega_chunks=2,
+               inject_faults=("device.lost:fatal:2+",))
+    rep = shards_mod.sweep_sharded(
+        _net(), cfg, model_name="m", n_shards=2,
+        devices=list(jax.devices())[:2], partition_span=SPAN, resume=False)
+    got = _vmap(rep)
+    # Spans split (0, 32) / (32, 48) at chunk boundaries: arrival 1
+    # (shard 0) succeeds, arrival 2 kills shard 1's device, and the
+    # re-shard onto the survivor dies at arrival 3 — no survivors, so
+    # exactly shard 1's 16 partitions degrade.
+    assert rep.degraded == 16
+    assert all(got[p] == fault_free[p] for p in range(1, 33))
+    assert all(got[p] == "unknown" for p in range(33, 49))
+    assert metrics_mod.registry().counter("shard_failures").value(
+        site="device.lost", kind="fatal") >= 1
+    path = os.path.join(cfg.result_dir, f"{cfg.name}-m@32-48.ledger.jsonl")
+    with open(path) as fp:
+        failures = [json.loads(l)["failure"] for l in fp
+                    if l.strip() and json.loads(l).get("failure")]
+    assert failures and all(f["reason"] == "device.lost:fatal"
+                            for f in failures)
+    # Disarmed plain resume over the lost span: mesh=None + mega_chunks=2
+    # takes the mega segment loop over the SHARD's journal (same
+    # ``m@32-48`` sink) and re-attempts exactly the degraded partitions.
+    resumed = sweep.verify_model(
+        _net(), cfg.with_(inject_faults=()), model_name="m", resume=True,
+        partition_span=(32, 48))
+    rmap = _vmap(resumed)
+    assert resumed.degraded == 0
+    assert rmap == {p: fault_free[p] for p in range(33, 49)}
+
+
 @pytest.mark.parametrize("spec", [
     "shard.dispatch:fatal:1",
     "shard.gather:transient:1",
