@@ -281,6 +281,14 @@ def _ladder_configs() -> None:
                                     "scripts"))
     from _sweeplib import budgeted_model_sweep
 
+    # Device-BaB ladder first: it is zoo-free (synthetic world), so it
+    # records even on bare containers where the AC/stress ladders skip.
+    try:
+        _bab_ladder()
+    except Exception as exc:
+        print(json.dumps({"metric": "bab_ladder_error",
+                          "error": str(exc)[:200]}), file=sys.stderr)
+
     # AC 12-model vmap suite (stacked per architecture group, the same
     # grouping run_sweep uses — the zoo's AC nets span several depths).
     cfg = presets.get("AC").with_(result_dir="/tmp/fairify_tpu_bench_ac")
@@ -401,6 +409,98 @@ def _ladder_configs() -> None:
             # fraction as unknown:budget (reference Cov% semantics).
             "decided_fraction": row["decided_fraction"],
         }), flush=True)
+
+
+def _bab_ladder() -> None:
+    """Device-BaB budgeted ladder (DESIGN.md §22) — zoo-free by design.
+
+    A synthetic German-derived world whose every partition survives
+    stage-0 and the pre-BaB phase ladder, so the engine BaB decides the
+    whole grid: the sharpest available probe of the device-resident
+    frontier's launch economy.  One line, device queue ON (the shipped
+    default); the ``bab_ab`` block carries the host-frontier control at
+    the identical budget.  On the tunnelled single-chip setup every launch
+    pays the ~110 ms relay round-trip (audits/device_util_r4.json), so
+    ``launches_per_partition`` — O(segments) for the device queue vs
+    O(rounds x CROWN batches) for the host loop — is the governing,
+    deterministic metric; on a local CPU backend the wall-clock gap is
+    launch-overhead-free and correspondingly smaller.  perfdiff gates
+    ``decided_fraction`` higher-is-better and the launch counters
+    lower-is-better once a baseline round carries this line.
+    """
+    import numpy as np  # noqa: F401  (parity with sibling ladders)
+
+    from fairify_tpu import obs
+    from fairify_tpu.data.domains import get_domain
+    from fairify_tpu.models.train import init_mlp
+    from fairify_tpu.utils import profiling
+    from fairify_tpu.verify import engine as engine_mod
+    from fairify_tpu.verify import presets, sweep
+
+    ov = {c: (0, 0) for c in get_domain("german").columns}
+    ov.update(age=(0, 1), month=(0, 5), purpose=(0, 5), credit_amount=(0, 2))
+    eng = engine_mod.EngineConfig(
+        pgd_phase=False, sign_bab=False, lp_sign=False, lp_pair=False,
+        lattice_exhaustive=False, attack_samples=4, bab_attack_samples=4,
+        bab_frontier_cap=64, bab_rounds_per_segment=8)
+    n_parts = 8
+    rows = {}
+    for mode in ("device", "host"):
+        cfg = presets.get("GC").with_(
+            result_dir=f"/tmp/fairify_tpu_bench_bab_{mode}",
+            soft_timeout_s=20.0, hard_timeout_s=120.0, sim_size=16,
+            exact_certify_masks=False, grid_chunk=8, domain_overrides=ov,
+            partition_threshold=2, device_bab=(mode == "device"), engine=eng)
+        net = init_mlp((len(cfg.query().columns), 4, 1), seed=3)
+        shutil.rmtree(cfg.result_dir, ignore_errors=True)
+        sweep.verify_model(net, cfg, model_name="BaB-1", resume=False,
+                           partition_span=(0, n_parts))  # warm (untimed)
+        runs = []
+        for _ in range(BENCH_REPEATS):
+            shutil.rmtree(cfg.result_dir, ignore_errors=True)
+            obs.registry().reset()  # launch counter lives here: delta = total
+            t0 = time.perf_counter()
+            rep = sweep.verify_model(net, cfg, model_name="BaB-1",
+                                     resume=False,
+                                     partition_span=(0, n_parts))
+            dt = time.perf_counter() - t0
+            decided = rep.counts["sat"] + rep.counts["unsat"]
+            launches = profiling.launch_count()
+            runs.append({
+                "value": round(decided / dt, 2) if dt > 0 else 0.0,
+                "elapsed_s": round(dt, 3),
+                "decided_fraction": round(decided / n_parts, 4),
+                "device_launches": launches,
+                "launches_per_partition": round(launches / n_parts, 2)})
+        rows[mode] = runs
+    pps, lo_v, hi_v = _median_band(rows["device"])
+    med = next(r for r in rows["device"] if r["value"] == pps)
+    host_pps, _, _ = _median_band(rows["host"])
+    host_med = next(r for r in rows["host"] if r["value"] == host_pps)
+    print(json.dumps({
+        "metric": f"device_bab_budgeted_decided_partitions_per_sec "
+                  f"(synthetic german-BaB world, {n_parts} partitions, all "
+                  f"engine-BaB-decided; median of {len(rows['device'])} "
+                  f"repeats; bab_ab = host-frontier control, equal budget)",
+        "value": pps,
+        "unit": "partitions/sec",
+        "min": lo_v,
+        "max": hi_v,
+        "runs": rows["device"],
+        "decided_fraction": med["decided_fraction"],
+        "device_launches": med["device_launches"],
+        "launches_per_partition": med["launches_per_partition"],
+        "bab_ab": {
+            "pps_host": host_pps,
+            "decided_fraction_host": host_med["decided_fraction"],
+            "launches_host": host_med["device_launches"],
+            "launches_per_partition_host": host_med[
+                "launches_per_partition"],
+            "launch_ratio_host_over_device": round(
+                host_med["device_launches"]
+                / max(med["device_launches"], 1), 2),
+        },
+    }), flush=True)
 
 
 if __name__ == "__main__":

@@ -6,7 +6,7 @@ tiny grid) before any pass runs.  The world is chosen so each kernel traces
 the same code paths production does — a PA dim with two assignments, an RA
 dim with ε = 1 (so the RA-widening and RA-lattice branches are live), one
 hidden layer (so sign-BaB and CROWN relaxations are live), and a stacked
-two-model family — while staying small enough that tracing all 23 kernels
+two-model family — while staying small enough that tracing all 24 kernels
 plus the buffer pass's compiles finishes well inside the 30 s CPU budget
 (``tests/test_analysis.py`` pins it).
 
@@ -240,6 +240,42 @@ def _lat_args(w: AnalysisWorld, c0: int):
             {"chunk": L["chunk"], "dims_tuple": L["dims_tuple"], "d": w.d})
 
 
+def _bab_args(w: AnalysisWorld, alpha_iters: int, shift: int = 0):
+    """Representative device-BaB segment: Q = 4 slots + canary, one root.
+
+    Assembled the way ``engine._device_bab_phase`` assembles a group —
+    root box in slot 0, canary slot dead and all-zero, ``root_valid``
+    padded to (G, V) — so the lowered signature is the production one.
+    ``shift`` mutates the box contents only (a later group of the same
+    sweep, which must reuse the executable).
+    """
+    d = w.d
+    Q, Qs = 4, 5  # bab_frontier_cap floor + integrity canary slot
+    q_lo = np.zeros((Qs, d), np.float32)
+    q_hi = np.zeros((Qs, d), np.float32)
+    q_root = np.zeros(Qs, np.int32)
+    q_live = np.zeros(Qs, bool)
+    q_found = np.zeros(Qs, bool)
+    wit_a = np.zeros(Qs, np.int32)
+    wit_b = np.zeros(Qs, np.int32)
+    wit_pt = np.zeros((Qs, d), np.float32)
+    lo, hi = w.lo[0].copy(), w.hi[0].copy()
+    lo[2:] += shift
+    hi[2:] += shift
+    q_lo[0] = lo
+    q_hi[0] = hi
+    q_live[0] = True
+    slot_ok = np.zeros(Qs, bool)
+    slot_ok[:Q] = True
+    root_valid = np.ones((1, w.enc.n_assign), bool)
+    branch_mask = np.zeros(d, np.float32)
+    branch_mask[[2, 3, 4]] = 1.0  # shared dims (PA enumerated, not split)
+    return ((w.net, q_lo, q_hi, q_root, q_live, q_found, wit_a, wit_b,
+             wit_pt, slot_ok, root_valid, w.assign_vals, w.pa_mask,
+             w.ra_mask, w.eps, w.vp, branch_mask),
+            {"rounds": 2, "alpha_iters": alpha_iters})
+
+
 def _lat_ra_args(w: AnalysisWorld, c0: int):
     L = w.lat_ra
     return ((w.net, np.int32(c0), np.int32(L["n_total"]), L["strides"],
@@ -287,6 +323,22 @@ def kernel_specs() -> Dict[str, KernelSpec]:
                         lambda w: _certify_attack_args(w, w.lo, w.hi, 8),
                         same_exec=False),
             ),
+            expected_signatures=2),
+        KernelSpec(
+            "engine.bab_segment",
+            lambda w: _bab_args(w, 0),
+            sound=True, dead_ok=(_NET_FINAL_MASK,),
+            variants=(
+                Variant("later group, same shapes",
+                        lambda w: _bab_args(w, 0, shift=1),
+                        same_exec=True),
+                Variant("escalated bucket (alpha_iters=8)",
+                        lambda w: _bab_args(w, 8),
+                        same_exec=False),
+            ),
+            # Segment-indexed escalation (engine._device_bab_phase): one
+            # plain-CROWN executable for segment 0, one α-CROWN executable
+            # for every later segment — exactly 2, like certify_attack.
             expected_signatures=2),
         KernelSpec(
             "engine.attack_logits",

@@ -110,6 +110,19 @@ def _overridden_cfg(args):
         if not 0.0 <= rate <= 1.0:
             raise SystemExit("--integrity-recheck must be in [0, 1]")
         overrides["integrity_recheck"] = rate
+    if getattr(args, "no_device_bab", False):
+        overrides["device_bab"] = False
+    # Engine-level BaB knobs ride the nested EngineConfig (DESIGN.md §22).
+    eng_overrides = {}
+    if getattr(args, "bab_frontier_cap", None) is not None:
+        eng_overrides["bab_frontier_cap"] = int(args.bab_frontier_cap)
+    if getattr(args, "bab_rounds", None) is not None:
+        eng_overrides["bab_rounds_per_segment"] = int(args.bab_rounds)
+    if eng_overrides:
+        import dataclasses
+
+        overrides["engine"] = dataclasses.replace(cfg.engine,
+                                                  **eng_overrides)
     return cfg.with_(**overrides) if overrides else cfg
 
 
@@ -512,6 +525,19 @@ def main(argv=None) -> int:
                           "(segment = the fault blast radius and the "
                           "supervisor's retry unit; default 4, 0 = "
                           "per-chunk launches)")
+    run.add_argument("--no-device-bab", action="store_true",
+                     help="fall back to the host-frontier BaB loop "
+                          "(verdicts are bit-equal; the device queue only "
+                          "changes the launch economy — DESIGN.md §22)")
+    run.add_argument("--bab-frontier-cap", type=int, default=None,
+                     help="device BaB box-queue capacity (slots shared by "
+                          "a root group; default 512, floor 4).  Roots "
+                          "that stall overflowed report "
+                          "unknown:frontier:overflow — raise this knob")
+    run.add_argument("--bab-rounds", type=int, default=None,
+                     help="branching rounds per device BaB launch "
+                          "(lax.scan trip count; default 8).  Launches "
+                          "per root are O(rounds-needed / this)")
     run.add_argument("--heartbeat-interval", type=float, default=None,
                      help="stderr progress line every N seconds (0 = off)")
     run.add_argument("--max-launch-retries", type=int, default=None,

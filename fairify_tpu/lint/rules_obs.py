@@ -76,6 +76,12 @@ ALLOW_LOOP_FETCH = frozenset({
     # BaB frontier iterations are sequentially dependent (each batch's
     # branching decides the next batch) — no independent work to overlap.
     "fairify_tpu/verify/engine.py::decide_many",
+    # Device-BaB segment driver (DESIGN.md §22): launches DO go through
+    # LaunchPipeline (depth 1 — each segment's queue state feeds the next,
+    # so there is nothing to overlap); the flagged np.asarray/np.array
+    # calls are the sanctioned at-dequeue conversions of already-drained
+    # host payloads plus pure-host root-box coercions at group setup.
+    "fairify_tpu/verify/engine.py::_device_bab_phase",
     "fairify_tpu/verify/engine.py::uniform_sign_bab",
     "fairify_tpu/verify/engine.py::_run_lp_phase",
     # Exact-certify chunk results feed the immediately-following host mask

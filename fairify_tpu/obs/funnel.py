@@ -20,7 +20,14 @@ Terminal states
 ``unknown:deadline``   abandoned by the deadline (per-box or cumulative)
 ``unknown:budget``     abandoned by a node/attempt budget (or never
                        attempted under a budgeted ladder)
-``unknown:frontier``   the heuristics genuinely could not decide it
+``unknown:frontier``   legacy catch-all: the host-frontier BaB (or an
+                       unrecognised engine reason) could not decide it
+``unknown:frontier:overflow``  the device BaB queue ran out of slots while
+                       the root still had splittable boxes — a CAPACITY
+                       fall, not a hardness one (raise
+                       ``EngineConfig.bab_frontier_cap``)
+``unknown:frontier:hard``  the device BaB ran to a bound stall / exact-leaf
+                       UNKNOWN with queue room to spare: genuinely hard
 ``unknown:failure:<site>``  degraded by an exhausted fault site (the
                        ``<site>`` prefix of the failure record's reason,
                        e.g. ``launch.submit``)
@@ -42,10 +49,17 @@ STATES = (
     "unknown:deadline",
     "unknown:budget",
     "unknown:frontier",
+    "unknown:frontier:overflow",
+    "unknown:frontier:hard",
 )
 
-#: Engine ``Decision.reason`` values with a dedicated funnel state.
-_ENGINE_REASONS = ("deadline", "budget", "frontier")
+#: Engine ``Decision.reason`` values with a dedicated funnel state.  The
+#: device-BaB path (DESIGN.md §22) splits the old catch-all 'frontier' into
+#: 'frontier:overflow' (queue capacity exhausted — retune, don't despair)
+#: vs 'frontier:hard' (bounds stalled at full budget — genuinely hard);
+#: bare 'frontier' remains the host-frontier / unrecognised-reason fallback.
+_ENGINE_REASONS = ("deadline", "budget", "frontier",
+                   "frontier:overflow", "frontier:hard")
 
 # ---------------------------------------------------------------------------
 # Fixed-bucket histogram layout (margins and attack gaps share it)
@@ -143,7 +157,8 @@ def classify(verdict: str, via: str, failure: Optional[str] = None,
     ``heuristic`` / ``smt`` / ``degraded`` / ``ledger``); ``failure`` the
     degradation reason (``site:kind``) when the partition degraded;
     ``engine_reason`` the BaB :class:`~fairify_tpu.verify.engine.Decision`
-    reason for UNKNOWNs (``deadline`` | ``budget`` | ``frontier``).
+    reason for UNKNOWNs (``deadline`` | ``budget`` | ``frontier`` |
+    ``frontier:overflow`` | ``frontier:hard``).
     """
     if failure is not None:
         return failure_state(failure)

@@ -461,3 +461,33 @@ def crown_output_form_sets(params: MLP, lb: jax.Array, ub: jax.Array,
     opt_lo, opt_hi = jnp.maximum(opt_lo, lo1), jnp.minimum(opt_hi, hi1)
     opt_lo, opt_hi = _widen(opt_lo, opt_hi)
     return [plain, tuned], jnp.maximum(opt_lo, lo0), jnp.minimum(opt_hi, hi0)
+
+
+def output_form_stack(params: MLP, lb: jax.Array, ub: jax.Array,
+                      alpha_iters: int = 0, n_sets: int = 0):
+    """:func:`crown_output_form_sets` with the sets stacked on a static axis.
+
+    A ``lax.scan`` body (the device-BaB segment kernel, DESIGN.md §22)
+    cannot carry a Python list whose length depends on runtime values, and
+    a consumer that must present ONE signature across configurations needs
+    the set axis to have a fixed length.  This wrapper stacks each of the
+    four form arrays on a new leading axis of length ``n_sets`` (default:
+    however many sets the inner call produced — 1, or 2 when
+    ``alpha_iters > 0``).  When the inner call produces fewer sets than
+    requested the last set is REPEATED: every set is independently sound,
+    so a duplicate can never change a min-over-sets bound nor an
+    intersect-over-sets keep hull, and the pad keeps the stacked shape —
+    and therefore the compiled executable — identical across configs.
+
+    Returns ``((A_low, c_low, A_up, c_up), lo, hi)`` with ``A_*`` of shape
+    ``(n_sets, ..., d)`` and ``c_*`` of shape ``(n_sets, ...)``; ``lo``/
+    ``hi`` are the same concretized widened scalar bounds as the inner
+    call.
+    """
+    sets_, lo, hi = crown_output_form_sets(params, lb, ub, alpha_iters)
+    want = int(n_sets) if n_sets else len(sets_)
+    if want < len(sets_):
+        raise ValueError(f"n_sets={want} < {len(sets_)} computed form sets")
+    sets_ = sets_ + [sets_[-1]] * (want - len(sets_))
+    stacked = tuple(jnp.stack([s[i] for s in sets_]) for i in range(4))
+    return stacked, lo, hi

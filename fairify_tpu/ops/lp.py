@@ -517,3 +517,41 @@ def pair_bab_lp(
             (ca if tower == 0 else cb)[k][j] = sign
             stack.append((ca, cb))
     return "killed", nodes, None
+
+
+def clip_box_with_form(D: np.ndarray, c: float, lo: np.ndarray,
+                       hi: np.ndarray) -> Tuple[np.ndarray, np.ndarray, bool]:
+    """Clip an integer box to where the linear form ``D·s + c`` can be > 0.
+
+    f64 host mirror of the device-BaB domain-clip rule (DESIGN.md §22,
+    ``engine._tied_diff_ub_keep``): the form's maximum over the box is
+    attained at the corner ``s*_j = hi_j if D_j > 0 else lo_j`` with value
+    ``w = Σ max(D_j·hi_j, D_j·lo_j) + c``; moving coordinate ``j`` a
+    distance ``t`` off that corner lowers the form by ``|D_j|·t``, so any
+    point with the form positive must satisfy ``s_j > hi_j − w/|D_j|``
+    (``D_j > 0``) resp. ``s_j < lo_j + w/|D_j|`` (``D_j < 0``).  The
+    device kernel inflates ``w`` and the shift with the sound slack before
+    applying this rule; the mirror is the EXACT f64 version, so the device
+    keep hull must always contain this one — the containment is what
+    tests/test_bab.py pins.
+
+    Returns ``(new_lo, new_hi, empty)`` with the keep interval rounded
+    INWARD to the lattice (``ceil``/``floor`` — exact, since only strictly
+    impossible points are discarded); ``empty=True`` iff ``w ≤ 0`` (no
+    point of the box can make the form positive) or the rounded interval
+    inverted, in which case the returned box is the untouched input.
+    """
+    D = np.asarray(D, dtype=np.float64)
+    lo64 = np.asarray(lo, dtype=np.float64)
+    hi64 = np.asarray(hi, dtype=np.float64)
+    w = float(np.sum(np.maximum(D * hi64, D * lo64)) + float(c))
+    if w <= 0.0:
+        return np.array(lo), np.array(hi), True
+    shift = w / np.maximum(np.abs(D), 1e-300)
+    keep_lo = np.where(D > 0.0, hi64 - shift, lo64)
+    keep_hi = np.where(D < 0.0, lo64 + shift, hi64)
+    new_lo = np.maximum(np.asarray(lo), np.ceil(keep_lo).astype(np.int64))
+    new_hi = np.minimum(np.asarray(hi), np.floor(keep_hi).astype(np.int64))
+    if np.any(new_lo > new_hi):
+        return np.array(lo), np.array(hi), True
+    return new_lo, new_hi, False

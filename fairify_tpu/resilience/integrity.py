@@ -57,6 +57,14 @@ DEFAULT_RECHECK_RATE = 0.05
 # commute, but keeping the order pinned keeps the contract obvious).
 FOLD_KEYS = ("cert", "wit", "reason", "stats")
 
+# Device-BaB segment payload keys (engine._bab_segment_kernel outputs), in
+# the kernel's fold order — the packed frontier queue plus the per-root
+# counters.  Floats fold through the same int32 truncation on both sides
+# (XLA convert_element_type f32→s32 and numpy's C cast both round toward
+# zero), so equal buffers fold equal on any backend.
+BAB_FOLD_KEYS = ("q_lo", "q_hi", "q_root", "q_live", "found",
+                 "wit_a", "wit_b", "wit_pt", "nodes", "splits", "overflow")
+
 
 # --------------------------------------------------------------------------
 # deterministic corruption (chaos injection side)
@@ -168,6 +176,39 @@ def check_canary(payload: Dict[str, np.ndarray]) -> bool:
     reason = np.asarray(payload["reason"])
     return (bool(np.all(cert[-1])) and bool(np.all(reason[-1] == 1))
             and bool(np.all(wit[-1] == 0)))
+
+
+def check_bab_canary(payload: Dict[str, np.ndarray]) -> bool:
+    """True iff the BaB queue's trailing canary slot holds its known answer.
+
+    The canary slot is never allocated (``slot_ok`` False): it enters the
+    segment dead and all-zero, the kernel's compaction can never scatter a
+    child into it, and its latch can never set — so it must come back
+    exactly as it went in: not live, not found, zero box, zero witness
+    point.  Any deviation means the fetched frontier buffers (or the
+    kernel's slot bookkeeping) were corrupted.
+    """
+    return (not bool(np.any(np.asarray(payload["q_live"])[-1]))
+            and not bool(np.any(np.asarray(payload["found"])[-1]))
+            and bool(np.all(np.asarray(payload["q_lo"])[-1] == 0))
+            and bool(np.all(np.asarray(payload["q_hi"])[-1] == 0))
+            and bool(np.all(np.asarray(payload["wit_pt"])[-1] == 0)))
+
+
+def verify_bab_segment(payload: Dict[str, np.ndarray]) -> Optional[str]:
+    """Integrity-check one fetched device-BaB segment payload.
+
+    Same contract as :func:`verify_segment`, over the BaB frontier buffers:
+    None when clean, else ``"checksum"`` (host fold over
+    :data:`BAB_FOLD_KEYS` != device fold) or ``"canary"`` (the
+    never-allocated trailing slot came back non-zero).
+    """
+    if "csum" in payload and \
+            fold_host(payload, keys=BAB_FOLD_KEYS) != int(payload["csum"]):
+        return "checksum"
+    if not check_bab_canary(payload):
+        return "canary"
+    return None
 
 
 def verify_segment(payload: Dict[str, np.ndarray]) -> Optional[str]:

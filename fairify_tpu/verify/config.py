@@ -56,6 +56,14 @@ class SweepConfig:
     # Verdict maps, counterexamples, and ledgers are bit-equal at every
     # setting (tests/test_mega.py).
     mega_chunks: int = 4
+    # Device-resident BaB (DESIGN.md §22): UNKNOWN partitions run their
+    # input-split branch-and-bound as lax.scan segments on device (a
+    # fixed-capacity box queue, EngineConfig.bab_frontier_cap slots,
+    # bab_rounds_per_segment rounds per launch) instead of the host-side
+    # frontier deque's one-launch-per-batch loop.  Verdict maps, ledgers
+    # and funnels are bit-equal across frontier capacities × mega_chunks ×
+    # pipeline_depth (tests/test_bab.py); off restores the host loop.
+    device_bab: bool = True
     engine: EngineConfig = field(default_factory=EngineConfig)
     result_dir: str = "res"
     profile_dir: Optional[str] = None  # XLA trace output (TensorBoard/XProf)

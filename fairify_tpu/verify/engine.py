@@ -128,6 +128,85 @@ def _tied_diff_ub(A_pos, c_pos, A_neg, c_neg, lo, hi, shared_mask):
     return jnp.moveaxis(rows, 0, 1), coef, jnp.moveaxis(mags, 0, 1)
 
 
+def _fold_dev(*bufs):
+    """Wraparound int32 fold of packed BaB buffers (device side).
+
+    Same body as the sweep's mega-segment fold and the same host mirror
+    (``resilience.integrity.fold_host``): int32 two's-complement wraparound
+    sums commute across backends, so equal data folds equal anywhere."""
+    total = jnp.int32(0)
+    for b in bufs:
+        total = total + jnp.sum(b.astype(jnp.int32), dtype=jnp.int32)
+    return total
+
+
+def _tied_diff_ub_keep(A_pos, c_pos, A_neg, c_neg, lo, hi, shared_mask, alive):
+    """:func:`_tied_diff_ub` plus per-dim KEEP intervals for domain clipping.
+
+    Identical bound math (same ``row``/``coef``/``mag`` values, one scan
+    over the Vp axis), additionally deriving, per alive pair, the interval
+    of each shared coordinate outside which the pair's flip direction is
+    provably impossible — the Clip-and-Verify move (arxiv 2512.11087) on
+    the tied difference form.  The widened pair bound w is the form's max
+    over the box, attained at a corner; moving coordinate j a distance t
+    off its optimal corner lowers the form by |D_j|·t with every other
+    coordinate still at its optimum, so ``|D_j|·t ≥ w ⇒ no flip``:
+
+        D_j > 0 ⇒ flip needs s_j > hi_j − w/|D_j|
+        D_j < 0 ⇒ flip needs s_j < lo_j + w/|D_j|
+
+    The shift w/|D_j| is inflated by the standard outward slack so f32
+    division rounding cannot shave a feasible lattice point; a dead pair
+    (``alive`` False, or w ≤ 0 — killed by this very bound) contributes
+    the empty interval.  Per-dim union over pairs is folded into the scan
+    carry, so the output is the (B, d) hull ``(keep_lo, keep_hi)`` of
+    everything any alive pair might still need.  ``alive``: (B, Vp, Vn)
+    pair mask in the SAME [pos, neg] layout as the returned bound matrix.
+    Returns ``(M, coef, mag, keep_lo, keep_hi)``.
+    """
+    from fairify_tpu.ops.interval import SOUND_SLACK_ABS, SOUND_SLACK_REL
+
+    absbox = jnp.maximum(jnp.abs(lo), jnp.abs(hi))
+    neg_coef_mag = (jnp.abs(A_neg) * absbox[:, None, :]).sum(-1)  # (B, Vn)
+    big = jnp.asarray(jnp.finfo(lo.dtype).max, lo.dtype)
+    tiny = jnp.asarray(1e-12, lo.dtype)
+
+    def one(carry, au_cu):
+        coef, keep_lo, keep_hi = carry
+        au, cu, alive_a = au_cu
+        D = (au[:, None, :] - A_neg) * shared_mask
+        m = jnp.where(D > 0, D * hi[:, None, :], D * lo[:, None, :])
+        row = m.sum(-1) + cu[:, None] - c_neg
+        pos_coef_mag = (jnp.abs(au) * absbox).sum(-1)  # (B,)
+        mag = (jnp.abs(D) * absbox[:, None, :]).sum(-1) \
+            + pos_coef_mag[:, None] + neg_coef_mag \
+            + jnp.abs(cu)[:, None] + jnp.abs(c_neg)
+        absD = jnp.abs(D)
+        # Widened bound of this pair row — the same value the certificate
+        # compares against 0 (widen-before-min-over-sets, _certify_impl).
+        w = row + SOUND_SLACK_REL * mag + SOUND_SLACK_ABS  # (B, Vn)
+        live = alive_a & (w > 0.0)
+        shift = w[..., None] / jnp.maximum(absD, tiny)
+        shift = shift + SOUND_SLACK_REL * shift + SOUND_SLACK_ABS
+        kl = jnp.where(D > tiny, hi[:, None, :] - shift, lo[:, None, :])
+        kh = jnp.where(D < -tiny, lo[:, None, :] + shift, hi[:, None, :])
+        kl = jnp.where(live[..., None], kl, big)
+        kh = jnp.where(live[..., None], kh, -big)
+        keep_lo = jnp.minimum(keep_lo, kl.min(axis=1))
+        keep_hi = jnp.maximum(keep_hi, kh.max(axis=1))
+        return ((jnp.maximum(coef, absD.max(axis=1)), keep_lo, keep_hi),
+                (row, mag))
+
+    coef0 = jnp.zeros(lo.shape, dtype=A_pos.dtype)
+    init = (coef0, jnp.full(lo.shape, big, lo.dtype),
+            jnp.full(lo.shape, -big, lo.dtype))
+    (coef, keep_lo, keep_hi), (rows, mags) = jax.lax.scan(
+        one, init, (jnp.moveaxis(A_pos, 1, 0), jnp.moveaxis(c_pos, 1, 0),
+                    jnp.moveaxis(alive, 1, 0)))
+    return (jnp.moveaxis(rows, 0, 1), coef, jnp.moveaxis(mags, 0, 1),
+            keep_lo, keep_hi)
+
+
 def _certify_impl(net: MLP, x_lo, x_hi, xp_lo, xp_hi, lo, hi, assign_vals,
                   pa_mask, ra_mask, eps, valid, valid_pair, alpha_iters: int):
     """Combined fairness certificate + branch scores for a batch of boxes.
@@ -308,6 +387,211 @@ def _certify_attack_impl(net: MLP, x_lo, x_hi, xp_lo, xp_hi, lo, hi,
 _certify_attack_kernel = obs_jit(_certify_attack_impl,
                                  name="engine.certify_attack",
                                  static_argnames=("alpha_iters",))
+
+
+def _certify_clip_impl(net: MLP, x_lo, x_hi, xp_lo, xp_hi, lo, hi,
+                       assign_vals, pa_mask, ra_mask, eps, valid, valid_pair,
+                       alpha_iters: int):
+    """:func:`_certify_impl` plus the per-box domain-clip hull.
+
+    Same form sets, role deadness, widen-before-min-over-sets and score as
+    the certificate kernel, but each direction's tied bound runs through
+    :func:`_tied_diff_ub_keep` with the pairs still alive after role
+    deadness, so the launch additionally yields the (B, d) KEEP hull of
+    the box: per set, the union over alive pairs/directions of where a
+    flip is still possible; across sets, the intersection (each set's
+    bound is independently valid, so each set's keep region independently
+    covers every flip).  Clipping ``[lo, hi]`` to the hull before
+    splitting discards lattice points no pair can flip on — provably
+    counterexample-free, so the shrink is verdict-preserving.
+    Returns ``(cert (B,), score (B, d), keep_lo (B, d), keep_hi (B, d))``.
+    """
+    # Stacked (not listed) form sets: the BaB scan body wants the set axis
+    # static so one executable serves every segment (ops.crown docstring).
+    stk_x, lb_x, ub_x = crown_ops.output_form_stack(
+        net, x_lo, x_hi, alpha_iters)
+    stk_p, lb_p, ub_p = crown_ops.output_form_stack(
+        net, xp_lo, xp_hi, alpha_iters)
+    sets_x = [tuple(a[i] for a in stk_x) for i in range(stk_x[0].shape[0])]
+    sets_p = [tuple(a[i] for a in stk_p) for i in range(stk_p[0].shape[0])]
+    t1_dead = (ub_x[..., :, None] <= 0.0) | (lb_p[..., None, :] >= 0.0)
+    t2_dead = (lb_x[..., :, None] >= 0.0) | (ub_p[..., None, :] <= 0.0)
+    pair_ok = valid_pair[None] & valid[..., :, None] & valid[..., None, :]
+    alive1 = pair_ok & ~t1_dead
+    # Direction-2 matrices are built [b, a] (_certify_impl), so its alive
+    # mask transposes into that layout.
+    alive2 = jnp.swapaxes(pair_ok & ~t2_dead, -1, -2)
+
+    shared = 1.0 - pa_mask
+    pa_dot = lambda A: jnp.sum(A * assign_vals[None, :, :], axis=-1)
+    ra_abs = lambda A: eps * jnp.sum(jnp.abs(A) * ra_mask, axis=-1)
+    from fairify_tpu.ops.interval import SOUND_SLACK_ABS, SOUND_SLACK_REL
+
+    widen = lambda u, g: u + SOUND_SLACK_REL * g + SOUND_SLACK_ABS
+    ub1 = ub2 = keep_lo = keep_hi = None
+    score = jnp.zeros(lo.shape, dtype=lo.dtype)
+    for (Alx, clx, Aux, cux), (Alp, clp, Aup, cup) in zip(sets_x, sets_p):
+        m1, s1, g1, kl1, kh1 = _tied_diff_ub_keep(
+            Aux, cux + pa_dot(Aux), Alp, clp + pa_dot(Alp) - ra_abs(Alp),
+            lo, hi, shared, alive1)
+        m2, s2, g2, kl2, kh2 = _tied_diff_ub_keep(
+            Aup, cup + pa_dot(Aup) + ra_abs(Aup), Alx, clx + pa_dot(Alx),
+            lo, hi, shared, alive2)
+        w1 = widen(m1, g1)
+        w2 = jnp.swapaxes(widen(m2, g2), -1, -2)
+        ub1 = w1 if ub1 is None else jnp.minimum(ub1, w1)
+        ub2 = w2 if ub2 is None else jnp.minimum(ub2, w2)
+        score = jnp.maximum(score, jnp.maximum(s1, s2))
+        # A pair is possible at s iff EITHER direction is: union the two
+        # direction hulls within the set (the pair axes are already folded
+        # away inside the keep scan, so layout is moot here).
+        skl = jnp.minimum(kl1, kl2)
+        skh = jnp.maximum(kh1, kh2)
+        keep_lo = skl if keep_lo is None else jnp.maximum(keep_lo, skl)
+        keep_hi = skh if keep_hi is None else jnp.minimum(keep_hi, skh)
+    t1_dead = t1_dead | (ub1 <= 0.0)
+    t2_dead = t2_dead | (ub2 <= 0.0)
+    possible = pair_ok & ~(t1_dead & t2_dead)
+    return ~possible.any(axis=(-2, -1)), score, keep_lo, keep_hi
+
+
+def _bab_segment_impl(net: MLP, q_lo, q_hi, q_root, q_live, q_found,
+                      wit_a, wit_b, wit_pt, slot_ok, root_valid, assign_vals,
+                      pa_mask, ra_mask, eps, valid_pair, branch_mask,
+                      rounds: int, alpha_iters: int):
+    """One device-resident BaB segment: ``rounds`` branching rounds, 1 launch.
+
+    The frontier is a fixed-capacity slot queue (padded, static shapes)
+    carried through a ``lax.scan``: per round every live slot is
+    CROWN-certified with domain clipping (:func:`_certify_clip_impl`),
+    probed at its integer midpoint for a flip witness, scored
+    (widest-gradient ``score·width``), split along its best dim, and the
+    upper child compacted into a free slot — K rounds cost ONE launch
+    instead of the host frontier's one launch per batch (DESIGN.md §22).
+
+    Queue contract (all arrays slot-major, capacity Q static):
+      ``q_lo``/``q_hi`` (Q, d) f32 integer box bounds; ``q_root`` (Q,) i32
+      group-local root of each slot; ``q_live`` (Q,) open boxes;
+      ``q_found``/``wit_a``/``wit_b``/``wit_pt`` per-slot witness latch
+      (first probe flip in the slot's lifetime — a latched slot is retired
+      from the free pool so the latch survives to host decode, where it is
+      exact-validated and cleared); ``slot_ok`` marks real slots (the
+      trailing canary row is never allocated and must come back all-zero);
+      ``root_valid`` (G, V) the per-root valid-assignment mask (PA dims are
+      never split, so it is row-constant for the whole segment).
+
+    Splits match the host BaB exactly where they overlap: integer midpoint
+    ``⌊(lo+hi)/2⌋``, score·width dim choice with widest-dim fallback and
+    first-max tie-break.  A split with no free slot is an OVERFLOW: the
+    parent keeps its whole box (nothing is lost — it re-splits when a slot
+    frees) and the root's overflow counter records the capacity fall.
+
+    Returns the updated queue plus per-root (G,) ``nodes``/``splits``/
+    ``overflow`` counters and the device fold checksum of every returned
+    buffer (integrity.BAB_FOLD_KEYS order).
+    """
+    from fairify_tpu.models.mlp import forward
+
+    Q, d = q_lo.shape
+    G = root_valid.shape[0]
+    shared = 1.0 - pa_mask
+    dim_ids = jnp.arange(d, dtype=jnp.int32)
+
+    def round_body(carry, _):
+        (q_lo, q_hi, q_root, q_live, found, wa, wb, wpt,
+         r_nodes, r_splits, r_over) = carry
+        # Role boxes of every slot (device mirror of property.role_boxes;
+        # xp is the ε-shifted partner, unclamped).
+        x_lo = q_lo[:, None, :] * shared + assign_vals[None]
+        x_hi = q_hi[:, None, :] * shared + assign_vals[None]
+        xp_lo = x_lo - eps * ra_mask
+        xp_hi = x_hi + eps * ra_mask
+        valid = jnp.take(root_valid, q_root, axis=0) & q_live[:, None]
+        cert, score, keep_lo, keep_hi = _certify_clip_impl(
+            net, x_lo, x_hi, xp_lo, xp_hi, q_lo, q_hi, assign_vals,
+            pa_mask, ra_mask, eps, valid, valid_pair, alpha_iters)
+        r_nodes = r_nodes.at[q_root].add(q_live.astype(jnp.int32),
+                                         mode="drop")
+        # Clip: integer points outside the keep hull cannot flip, so the
+        # box shrinks to the hull's lattice rounding (ceil/floor INWARD —
+        # the hull itself is already outward-inflated).  An emptied box is
+        # as decided as a certified one.
+        n_lo = jnp.maximum(q_lo, jnp.ceil(keep_lo))
+        n_hi = jnp.minimum(q_hi, jnp.floor(keep_hi))
+        empty = (n_lo > n_hi).any(-1)
+        cert = cert | empty
+        keep = q_live & ~cert
+        q_lo = jnp.where(keep[:, None], n_lo, q_lo)
+        q_hi = jnp.where(keep[:, None], n_hi, q_hi)
+        q_live = keep
+        # Midpoint probe: one forward over every slot's integer midpoint,
+        # flips latched per slot (delta-0 candidates; exact validation
+        # happens host-side at decode, same as every other attack path).
+        mid = jnp.floor((q_lo + q_hi) * 0.5)
+        x_mid = mid[:, None, :] * shared + assign_vals[None]
+        lm = forward(net, x_mid)
+        valid_fresh = jnp.take(root_valid, q_root, axis=0) & q_live[:, None]
+        found_now, wit = _find_flips_impl(jnp, lm[:, None, :], lm[:, None, :],
+                                          valid_fresh, valid_pair)
+        newly = found_now & ~found
+        wa = jnp.where(newly, wit[:, 1], wa)
+        wb = jnp.where(newly, wit[:, 2], wb)
+        wpt = jnp.where(newly[:, None], mid, wpt)
+        found = found | found_now
+        # Split scoring: host BaB's score·width with widest-dim fallback,
+        # first-max tie-break (= its stable argsort head); PA dims barred.
+        widths = (q_hi - q_lo) * branch_mask
+        can = q_live & (widths.max(-1) > 0.0)
+        sc = score * widths
+        sc = jnp.where(sc.max(-1, keepdims=True) > 0.0, sc, widths)
+        sc = jnp.where(branch_mask > 0.0, sc, -1.0)
+        dim = jnp.argmax(sc, axis=-1).astype(jnp.int32)
+        lo_d = jnp.take_along_axis(q_lo, dim[:, None], axis=1)[:, 0]
+        hi_d = jnp.take_along_axis(q_hi, dim[:, None], axis=1)[:, 0]
+        mid_d = jnp.floor((lo_d + hi_d) * 0.5)
+        # Compaction: rank the free slots and the splitters, pair them up.
+        # A latched slot is NOT free (the witness must survive to decode);
+        # the canary slot (slot_ok False) is never allocated.
+        free = (~q_live) & slot_ok & (~found)
+        rank_f = jnp.cumsum(free.astype(jnp.int32)) - 1
+        n_free = free.sum()
+        table = jnp.full((Q,), Q, jnp.int32).at[
+            jnp.where(free, rank_f, Q)].set(
+                jnp.arange(Q, dtype=jnp.int32), mode="drop")
+        rank_c = jnp.cumsum(can.astype(jnp.int32)) - 1
+        fits = can & (rank_c < n_free)
+        dest = jnp.where(
+            fits,
+            jnp.take(table,
+                     jnp.minimum(jnp.maximum(rank_c, 0), Q - 1)),
+            Q)
+        over = can & ~fits
+        r_over = r_over.at[q_root].add(over.astype(jnp.int32), mode="drop")
+        r_splits = r_splits.at[q_root].add(fits.astype(jnp.int32),
+                                           mode="drop")
+        # Children: upper half [mid+1, hi] into the free slot; the parent
+        # keeps the lower half — unless the split overflowed, in which case
+        # it keeps the WHOLE box and retries when capacity frees up.
+        oh = dim_ids[None, :] == dim[:, None]
+        child_lo = jnp.where(oh, mid_d[:, None] + 1.0, q_lo)
+        child_hi = q_hi
+        q_hi = jnp.where(oh & fits[:, None], mid_d[:, None], q_hi)
+        q_lo = q_lo.at[dest].set(child_lo, mode="drop")
+        q_hi = q_hi.at[dest].set(child_hi, mode="drop")
+        q_root = q_root.at[dest].set(q_root, mode="drop")
+        q_live = q_live.at[dest].set(fits, mode="drop")
+        return ((q_lo, q_hi, q_root, q_live, found, wa, wb, wpt,
+                 r_nodes, r_splits, r_over), None)
+
+    zeros_g = jnp.zeros((G,), jnp.int32)
+    carry = (q_lo, q_hi, q_root, q_live, q_found, wit_a, wit_b, wit_pt,
+             zeros_g, zeros_g, zeros_g)
+    carry, _ = jax.lax.scan(round_body, carry, None, length=rounds)
+    return carry + (_fold_dev(*carry),)
+
+
+_bab_segment_kernel = obs_jit(_bab_segment_impl, name="engine.bab_segment",
+                              static_argnames=("rounds", "alpha_iters"))
 
 
 def no_flip_certified(
@@ -1132,6 +1416,35 @@ class EngineConfig:
     # certificate/BaB path, so only SAT-discovery speed is traded.
     max_launch_retries: int = 2
     launch_backoff_s: float = 0.05
+    # --- Device-resident BaB (DESIGN.md §22) ---------------------------
+    # Run the input-split pair BaB as lax.scan segments on device: the
+    # frontier lives in a fixed-capacity slot queue carried through the
+    # scan, with CROWN certify + domain clip + midpoint probe + split per
+    # round, so bab_rounds_per_segment branching rounds cost ONE launch
+    # instead of the host frontier's one launch per batch.  Requires
+    # use_crown and no mesh (same gate as the sweep's mega path); the
+    # host frontier loop remains the fallback.
+    device_bab: bool = True
+    # Slot capacity of the device box queue (+1 hidden canary slot when
+    # integrity is on).  A split with no free slot overflows: the parent
+    # keeps its whole box and retries later, and roots still overflowed
+    # at exit report reason 'frontier:overflow' (raise this knob) instead
+    # of 'frontier:hard'.  Decided verdicts are capacity-invariant
+    # (tests/test_bab.py): slot scheduling never changes a box's bounds,
+    # probes, or split points.
+    bab_frontier_cap: int = 512
+    # Branching rounds folded into one segment launch.  Segment 0 runs
+    # plain CROWN (alpha_iters=0) and later segments α-CROWN — the host
+    # loop's cheap-first escalation, keyed on the segment INDEX rather
+    # than wall time so verdicts stay machine-independent.  Exactly two
+    # kernel signatures per net (analysis/avals.py budget).
+    bab_rounds_per_segment: int = 8
+    # Device fold checksum + all-zero canary slot on the packed BaB
+    # frontier buffers, verified at every segment decode
+    # (integrity.verify_bab_segment); a mismatch degrades the segment's
+    # root group, never trusts it.  The sweep syncs this to
+    # SweepConfig.integrity.
+    integrity: bool = True
 
 
 @dataclass
@@ -1145,8 +1458,12 @@ class Decision:
     # Why an 'unknown' root stayed unknown: 'deadline' (the batch budget
     # tripped with sub-boxes still open — more time may decide it),
     # 'budget' (the per-root node budget ran out — more nodes may decide
-    # it), or 'frontier' (the box survived every phase at full budget:
-    # genuinely hard).  None for decided roots.  Surfaced as the
+    # it), 'frontier:overflow' (the device BaB queue ran out of slots
+    # while the root still had splittable boxes — a CAPACITY fall, raise
+    # bab_frontier_cap), 'frontier:hard' (the device BaB stalled at full
+    # capacity / an exact leaf returned unknown: genuinely hard), or
+    # legacy 'frontier' (the host-frontier path, or a degraded segment,
+    # survived every phase).  None for decided roots.  Surfaced as the
     # `engine_reason` attr on the sweep's unknown verdict events and as
     # the funnel's `unknown:*` states (obs.funnel), so budget-vs-hardness
     # reads off the event log (the deep-retry harnesses re-attempt all
@@ -1167,6 +1484,246 @@ def _pad(arr: np.ndarray, n: int) -> np.ndarray:
         return arr
     pad = np.repeat(arr[-1:], n - arr.shape[0], axis=0)
     return np.concatenate([arr, pad], axis=0)
+
+
+def _device_bab_phase(net, enc, roots_lo, roots_hi, cfg, t0, deadline_s,
+                      verdicts, ces, settle, nodes, leaves, cost_s,
+                      weights, biases, assign_vals, pa_mask, ra_mask,
+                      valid_pair_dev):
+    """Drive the device-resident BaB over every still-undecided root.
+
+    Roots are processed in fixed-size groups sharing one slot queue
+    (capacity ``bab_frontier_cap``, + a canary slot when integrity is on);
+    each group runs :func:`_bab_segment_kernel` segments — K branching
+    rounds per launch — until every root settles, the queue stalls, the
+    node budget trips, or the deadline does.  Between segments the host
+    does only what MUST be exact or is intrinsically serial: witness
+    latches are exact-validated (rational arithmetic, smallest candidate
+    first so the settled counterexample is capacity-invariant), point
+    leaves go through :func:`decide_leaf`, emptied roots settle UNSAT,
+    and slots of settled roots are recycled.  Launch supervision and
+    chaos/corruption injection ride the standard LaunchPipeline sites
+    (``launch.submit`` / ``launch.decode``); the fold checksum + canary
+    are re-verified at every decode, and a failed or corrupt segment
+    degrades exactly its root group (the queue state never advances on a
+    failed fetch, so nothing unsound can be absorbed).
+    """
+    from fairify_tpu.parallel.pipeline import LaunchPipeline
+    from fairify_tpu.resilience import integrity as integrity_mod
+    from fairify_tpu.resilience.supervisor import ChunkFailure, Supervisor
+
+    d = roots_lo.shape[1]
+    V = enc.n_assign
+    Q = max(4, int(cfg.bab_frontier_cap))
+    Qs = Q + 1 if cfg.integrity else Q
+    G = max(1, Q // 4)
+    branch_mask = np.zeros(d, np.float32)
+    bd = _branch_dims(enc, d)
+    if len(bd):
+        branch_mask[bd] = 1.0
+    branch_mask_dev = jnp.asarray(branch_mask)
+    assignments = np.asarray(enc.assignments, np.int64)
+    pa_idx = np.asarray(enc.pa_idx, dtype=np.int64)
+    slot_ok = np.zeros(Qs, bool)
+    slot_ok[:Q] = True
+    slot_ok_dev = jnp.asarray(slot_ok)
+    pending = [r for r in range(roots_lo.shape[0]) if verdicts[r] is None]
+    pipe = LaunchPipeline(
+        1, gauge=False,
+        supervisor=Supervisor(max_retries=cfg.max_launch_retries,
+                              backoff_s=cfg.launch_backoff_s, seed=cfg.seed))
+    payload_keys = integrity_mod.BAB_FOLD_KEYS + ("csum",)
+
+    for g0 in range(0, len(pending), G):
+        group = pending[g0:g0 + G]
+        if (time.perf_counter() - t0) > deadline_s:
+            for r in pending[g0:]:
+                settle(r, "unknown", reason="deadline")
+            break
+        g = len(group)
+        q_lo = np.zeros((Qs, d), np.float32)
+        q_hi = np.zeros((Qs, d), np.float32)
+        q_root = np.zeros(Qs, np.int32)
+        q_live = np.zeros(Qs, bool)
+        q_found = np.zeros(Qs, bool)
+        wit_a = np.zeros(Qs, np.int32)
+        wit_b = np.zeros(Qs, np.int32)
+        wit_pt = np.zeros((Qs, d), np.float32)
+        # root_valid stays (G, V) even for a short tail group (pad rows are
+        # unreachable: no slot carries their index) — one kernel signature.
+        root_valid = np.zeros((G, V), bool)
+        for k, r in enumerate(group):
+            lo_r = np.asarray(roots_lo[r], dtype=np.int64)
+            hi_r = np.asarray(roots_hi[r], dtype=np.int64)
+            q_lo[k] = lo_r
+            q_hi[k] = hi_r
+            q_root[k] = k
+            q_live[k] = True
+            root_valid[k] = ((assignments >= lo_r[pa_idx][None, :])
+                             & (assignments <= hi_r[pa_idx][None, :])
+                             ).all(axis=-1)
+        root_valid_dev = jnp.asarray(root_valid)
+        overflowed = np.zeros(g, bool)
+        deadline_hit = False
+        seg = 0
+        while True:
+            if (time.perf_counter() - t0) > deadline_s:
+                deadline_hit = True
+                break
+            if not any(q_live[i] and verdicts[group[int(q_root[i])]] is None
+                       for i in range(Q)):
+                break
+            prev_state = (q_lo.tobytes(), q_hi.tobytes(), q_live.tobytes())
+            seg_t = time.perf_counter()
+            # Segment-INDEXED escalation (not wall-time like the host
+            # loop): segment 0 plain CROWN, later segments α-CROWN — two
+            # executables total, and verdicts independent of host speed.
+            seg_alpha = 0 if seg == 0 else int(cfg.alpha_iters)
+
+            def fn(q_lo=q_lo, q_hi=q_hi, q_root=q_root, q_live=q_live,
+                   q_found=q_found, wit_a=wit_a, wit_b=wit_b, wit_pt=wit_pt,
+                   seg_alpha=seg_alpha):
+                profiling.bump_launch()
+                outs = _bab_segment_kernel(
+                    net, jnp.asarray(q_lo), jnp.asarray(q_hi),
+                    jnp.asarray(q_root), jnp.asarray(q_live),
+                    jnp.asarray(q_found), jnp.asarray(wit_a),
+                    jnp.asarray(wit_b), jnp.asarray(wit_pt),
+                    slot_ok_dev, root_valid_dev, assign_vals, pa_mask,
+                    ra_mask, float(enc.eps), valid_pair_dev, branch_mask_dev,
+                    rounds=int(cfg.bab_rounds_per_segment),
+                    alpha_iters=seg_alpha)
+                return dict(zip(payload_keys, outs)), None
+
+            items = list(pipe.submit(fn))
+            items.extend(pipe.drain())
+            _meta, _ctx, host = items[0]
+            failure = host if isinstance(host, ChunkFailure) else None
+            if failure is None and cfg.integrity:
+                tripped = integrity_mod.verify_bab_segment(host)
+                if tripped is not None:
+                    from fairify_tpu.obs.metrics import registry
+
+                    registry().counter("integrity_violations").inc(
+                        1, site="launch.decode")
+                    obs.event("integrity_violation", site="launch.decode",
+                              detector=tripped, phase="engine.device_bab")
+                    failure = ChunkFailure(
+                        site="integrity.launch.decode", kind="fatal",
+                        error="IntegrityViolation",
+                        detail=f"{tripped} mismatch (launch.decode)",
+                        retries=0)
+            if failure is not None:
+                # Blast radius = exactly this segment's root group: the
+                # queue never advances on a failed fetch, nothing from it
+                # is trusted, and the group's open roots degrade to the
+                # legacy catch-all for the sweep's retry/SMT tiers.
+                from fairify_tpu.obs.metrics import registry
+
+                registry().counter("chunks_degraded").inc(
+                    1, site=failure.site)
+                obs.event("degraded", **failure.to_record(),
+                          phase="engine.device_bab", partitions=len(group))
+                for r in group:
+                    settle(r, "unknown")
+                break
+            # np.array (not asarray): fetched device buffers are read-only
+            # views, and the queue state mutates between segments.
+            q_lo = np.array(host["q_lo"], np.float32)
+            q_hi = np.array(host["q_hi"], np.float32)
+            q_root = np.array(host["q_root"], np.int32)
+            q_live = np.array(host["q_live"], bool)
+            q_found = np.array(host["found"], bool)
+            wit_a = np.array(host["wit_a"], np.int32)
+            wit_b = np.array(host["wit_b"], np.int32)
+            wit_pt = np.array(host["wit_pt"], np.float32)
+            seg_nodes = np.asarray(host["nodes"], np.int64)
+            seg_over = np.asarray(host["overflow"], np.int64)
+            for k, r in enumerate(group):
+                nodes[r] += int(seg_nodes[k])
+                if seg_over[k] > 0:
+                    overflowed[k] = True
+            open_rs = [r for r in group if verdicts[r] is None]
+            dt = time.perf_counter() - seg_t
+            for r in open_rs:
+                cost_s[r] += dt / len(open_rs)
+            had_latch = bool(q_found.any())
+            progressed = False
+            # Witness latches: exact-validate, smallest candidate first so
+            # the settled counterexample never depends on slot scheduling.
+            cands: dict = {}
+            for i in range(Qs):
+                if not (q_found[i] and slot_ok[i]):
+                    continue
+                k = int(q_root[i])
+                if k >= g or verdicts[group[k]] is not None:
+                    continue
+                pt = wit_pt[i].astype(np.int64)
+                cands.setdefault(k, []).append(
+                    (tuple(pt.tolist()), int(wit_a[i]), int(wit_b[i]), pt))
+            for k in sorted(cands):
+                r = group[k]
+                for _pk, a, b, pt in sorted(cands[k],
+                                            key=lambda c: (c[0], c[1], c[2])):
+                    if verdicts[r] is not None:
+                        break
+                    x = pt.copy()
+                    xp = pt.copy()
+                    if len(pa_idx):
+                        x[pa_idx] = assignments[a]
+                        xp[pa_idx] = assignments[b]
+                    if validate_pair(weights, biases, x, xp):
+                        settle(r, "sat", (x, xp))
+                        progressed = True
+            q_found[:] = False  # latched slots rejoin the free pool
+            # Point leaves (every branchable dim collapsed): exact decision,
+            # the same endgame as the host loop's decide_leaf.
+            for i in range(Q):
+                if not q_live[i]:
+                    continue
+                r = group[int(q_root[i])]
+                if verdicts[r] is not None:
+                    continue
+                w = (q_hi[i] - q_lo[i]) * branch_mask
+                if w.size == 0 or float(w.max()) <= 0.0:
+                    leaves[r] += 1
+                    l_i = q_lo[i].astype(np.int64)
+                    h_i = q_hi[i].astype(np.int64)
+                    verdict, ce = decide_leaf(enc, weights, biases,
+                                              l_i.copy(), l_i, h_i)
+                    if verdict == "sat":
+                        settle(r, "sat", ce)
+                        progressed = True
+                    elif verdict == "unknown":
+                        settle(r, "unknown", reason="frontier:hard")
+                        progressed = True
+                    else:
+                        q_live[i] = False
+            for r in group:
+                if verdicts[r] is None and nodes[r] > cfg.max_nodes:
+                    settle(r, "unknown", reason="budget")
+                    progressed = True
+            for i in range(Q):
+                if q_live[i] and verdicts[group[int(q_root[i])]] is not None:
+                    q_live[i] = False
+            live_k = {int(q_root[i]) for i in range(Q) if q_live[i]}
+            for k, r in enumerate(group):
+                if verdicts[r] is None and k not in live_k:
+                    settle(r, "unsat")
+                    progressed = True
+            if (not progressed and not had_latch
+                    and (q_lo.tobytes(), q_hi.tobytes(),
+                         q_live.tobytes()) == prev_state):
+                break  # stalled: no clip/split/settle progress possible
+            seg += 1
+        for k, r in enumerate(group):
+            if verdicts[r] is None:
+                if deadline_hit:
+                    settle(r, "unknown", reason="deadline")
+                elif overflowed[k]:
+                    settle(r, "unknown", reason="frontier:overflow")
+                else:
+                    settle(r, "unknown", reason="frontier:hard")
 
 
 def decide_many(
@@ -1426,6 +1983,25 @@ def decide_many(
             ces[r] = ce
             if verdict == "unknown":
                 unknown_reasons[r] = reason or "frontier"
+
+    # Device-resident BaB (DESIGN.md §22): when the fused certify path is
+    # available the whole frontier runs as lax.scan segments on device —
+    # bab_rounds_per_segment branching rounds per launch — and the host
+    # batch loop below only serves the fallback paths (mesh-sharded,
+    # non-CROWN, or device_bab off).
+    use_dev_bab = (cfg.device_bab and cfg.use_crown and mesh is None
+                   and len(enc.pa_idx) and len(frontier) > 0)
+    if use_dev_bab:
+        with obs.span("engine.device_bab", roots=int(len(frontier))) as sp_d:
+            n_before = sum(1 for v in verdicts if v is None)
+            _device_bab_phase(net, enc, roots_lo, roots_hi, cfg, t0,
+                              main_deadline, verdicts, ces, settle, nodes,
+                              leaves, cost_s, weights, biases, assign_vals,
+                              pa_mask, ra_mask, valid_pair_dev)
+            sp_d.set(decided=n_before
+                     - sum(1 for v in verdicts if v == "unknown"),
+                     nodes=int(nodes.sum()))
+        frontier.clear()
 
     with obs.span("engine.bab", roots=int(len(frontier))) as sp_bab:
         while frontier:

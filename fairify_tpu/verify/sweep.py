@@ -1972,7 +1972,11 @@ def _verify_model_impl(
                         net, enc, lo[pending], hi[pending],
                         replace(cfg.engine, pipeline_depth=cfg.pipeline_depth,
                                 max_launch_retries=cfg.max_launch_retries,
-                                launch_backoff_s=cfg.launch_backoff_s),
+                                launch_backoff_s=cfg.launch_backoff_s,
+                                device_bab=(cfg.device_bab
+                                            and cfg.engine.device_bab),
+                                integrity=(cfg.integrity
+                                           and cfg.engine.integrity)),
                         deadline_s=deadline, mesh=mesh,
                         attacked=pgd_covered_all,
                     )

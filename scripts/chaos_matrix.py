@@ -298,6 +298,117 @@ def main() -> int:
         failures += 0 if row["ok"] else 1
         print(json.dumps(row), flush=True)
 
+    # Device-BaB segment cells (DESIGN.md §22): faults mid-BaB-segment.
+    # The cells drive engine.decide_many directly on a toy world whose
+    # roots genuinely branch (the chaos sweep's boxes all certify at the
+    # root, so BaB never launches there) — inside decide_many only the
+    # device-BaB phase routes launches through LaunchPipeline's fault
+    # sites, so launch.* arrival numbers count BaB segments exactly.
+    # Contract: a transient fault is absorbed by the supervisor retry
+    # (verdict-for-verdict identical, nothing degraded); an exhausted one
+    # degrades exactly ONE segment's root group (bab_frontier_cap=4 →
+    # one root per group) to UNKNOWN while every other root matches the
+    # fault-free run; a decode corruption is caught by the frontier fold
+    # checksum + canary slot (integrity_violations fires, zero corrupted
+    # verdicts escape); and a disarmed re-run converges — the device
+    # queue state never advances on a failed fetch, so re-running from
+    # the roots is the engine's (stateless) resume analog.
+    from fairify_tpu.data.domains import DomainSpec
+    from fairify_tpu.resilience import faults as faults_lib
+    from fairify_tpu.verify import engine as engine_mod
+    from fairify_tpu.verify.engine import EngineConfig
+    from fairify_tpu.verify.property import FairnessQuery, encode
+
+    bab_dom = DomainSpec(name="chaos-bab", columns=("a0", "a1", "a2", "p"),
+                         ranges={"a0": (0, 2), "a1": (0, 2), "a2": (0, 2),
+                                 "p": (0, 1)}, label="y")
+    bab_enc = encode(FairnessQuery(domain=bab_dom, protected=("p",)))
+    bab_net = init_mlp((4, 6, 1), seed=0)
+    bab_lo = [[0, 0, 0, 0], [0, 0, 0, 0], [1, 0, 0, 0], [0, 1, 0, 0]]
+    bab_hi = [[2, 2, 2, 1], [1, 2, 2, 1], [2, 2, 2, 1], [2, 2, 1, 1]]
+    bab_cfg = EngineConfig(
+        soft_timeout_s=60.0, pgd_phase=False, sign_bab=False, lp_sign=False,
+        lp_pair=False, lattice_exhaustive=False, attack_samples=2,
+        bab_attack_samples=2, device_bab=True, bab_frontier_cap=4,
+        bab_rounds_per_segment=2, max_launch_retries=1,
+        launch_backoff_s=1e-3)
+
+    def _bab_run(spec=None):
+        import numpy as np
+
+        lo = np.asarray(bab_lo, dtype=np.int64)
+        hi = np.asarray(bab_hi, dtype=np.int64)
+        specs = () if spec is None else (spec,)
+        with faults_lib.armed(specs, seed=bab_cfg.seed):
+            decs = engine_mod.decide_many(bab_net, bab_enc, lo, hi, bab_cfg,
+                                          deadline_s=120.0)
+        return {i: d.verdict for i, d in enumerate(decs)}
+
+    bab_want = _bab_run()
+    row = {"cell": "bab/fault-free",
+           "all_decided": all(v != "unknown" for v in bab_want.values())}
+    failures += 0 if row["all_decided"] else 1
+    print(json.dumps(row), flush=True)
+
+    BAB_CELLS = [
+        # (cell, spec, absorbed): transient = one mid-BaB arrival, the
+        # retry absorbs it; exhausted = the arrival AND its only retry
+        # (max_launch_retries=1) fault, the segment's group degrades.
+        ("bab/launch.submit/transient", "launch.submit:transient:2", True),
+        ("bab/launch.submit/exhausted", "launch.submit:transient:2-3", False),
+        ("bab/launch.decode/transient", "launch.decode:transient:2", True),
+        ("bab/launch.decode/exhausted", "launch.decode:transient:2-3", False),
+    ]
+    for cell, spec, absorbed in BAB_CELLS:
+        row = {"cell": cell, "spec": spec}
+        try:
+            got = _bab_run(spec)
+        except BaseException as exc:  # clause 1: must not crash
+            row["crashed"] = f"{type(exc).__name__}: {exc}"
+            row["ok"] = False
+            failures += 1
+            print(json.dumps(row), flush=True)
+            continue
+        unknowns = [k for k, v in got.items() if v == "unknown"]
+        row["unknowns"] = unknowns
+        row["decided_match"] = all(got[k] == bab_want[k] for k in got
+                                   if got[k] != "unknown")
+        row["rerun_converged"] = _bab_run() == bab_want
+        if absorbed:
+            row["ok"] = bool(got == bab_want and row["rerun_converged"])
+        else:
+            # Blast radius: exactly one root group (one root at cap 4).
+            row["ok"] = bool(row["decided_match"] and len(unknowns) == 1
+                             and row["rerun_converged"])
+        failures += 0 if row["ok"] else 1
+        print(json.dumps(row), flush=True)
+
+    if args.integrity:
+        # launch.decode:corrupt mid-BaB — a bit flips in a fetched
+        # frontier buffer; the packed-queue fold checksum / canary slot
+        # must catch it at decode and degrade only that group.
+        viol_bab = metrics_mod.registry().counter("integrity_violations")
+        spec = "launch.decode:corrupt:2"
+        row = {"cell": "integrity/bab/launch.decode", "spec": spec}
+        v0 = viol_bab.value(site="launch.decode")
+        try:
+            got = _bab_run(spec)
+            row["detected"] = bool(viol_bab.value(site="launch.decode") > v0)
+            row["sdc_escaped"] = sum(1 for k in got
+                                     if got[k] != "unknown"
+                                     and got[k] != bab_want[k])
+            unknowns = [k for k, v in got.items() if v == "unknown"]
+            row["unknowns"] = unknowns
+            row["rerun_converged"] = _bab_run() == bab_want
+            row["ok"] = bool(row["detected"] and row["sdc_escaped"] == 0
+                             and len(unknowns) == 1
+                             and row["rerun_converged"])
+        except BaseException as exc:
+            row["crashed"] = f"{type(exc).__name__}: {exc}"
+            row["ok"] = False
+        failures += 0 if row["ok"] else 1
+        print(json.dumps(row), flush=True)
+
     # Result-integrity cells (--integrity, DESIGN.md §21): corrupt-kind
     # faults flip DATA bits silently instead of raising.  Contract per
     # cell: detected (integrity_violations / ledger_crc_mismatch fired),

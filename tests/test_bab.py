@@ -1,0 +1,422 @@
+"""Device-resident BaB (DESIGN.md §22) — the kernelised frontier.
+
+Four layers of pins:
+
+* unit — the f64 domain-clip mirror (``ops.lp.clip_box_with_form``) keeps
+  every integer point the linear form can make positive, and the static
+  set-stack (``ops.crown.output_form_stack``) pads by repetition without
+  changing bounds;
+* engine — the device queue's verdicts agree with the host-frontier loop
+  and the exhaustive oracle, decided verdicts (and counterexamples) are
+  frontier-capacity-invariant, a queue that runs out of slots reports
+  ``frontier:overflow`` (the SMT tier's feedstock) rather than lying, and
+  K branching rounds cost O(segments) launches — not O(rounds);
+* sweep — verdict maps, resume ledgers and the funnel are bit-equal
+  across frontier capacity {small, large} x mega_chunks {0, 1, 4} and
+  against the host-frontier path, and a zero-budget run's UNKNOWN tail
+  sums to the grid size;
+* integrity — the fold checksum and the trailing canary slot catch a
+  corrupted frontier payload (resilience.integrity.verify_bab_segment).
+
+Oracle: brute-force enumeration of every (shared point, PA pair) with f64
+forward + exact sign at ties, as in tests/test_lattice.py.
+"""
+import itertools
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+
+from fairify_tpu.data.domains import DomainSpec, get_domain
+from fairify_tpu.models.mlp import from_numpy
+from fairify_tpu.models.train import init_mlp
+from fairify_tpu.obs import funnel as funnel_mod
+from fairify_tpu.ops import crown as crown_ops
+from fairify_tpu.ops import lattice as lattice_ops
+from fairify_tpu.ops import lp as lp_ops
+from fairify_tpu.resilience import integrity
+from fairify_tpu.utils import profiling
+from fairify_tpu.verify import engine, presets, sweep
+from fairify_tpu.verify.engine import EngineConfig
+from fairify_tpu.verify.property import FairnessQuery, encode
+
+
+def _query(span=2, d=4, pa=("p",)):
+    names = tuple([f"a{i}" for i in range(d - 1)] + ["p"])
+    ranges = {n: (0, span) for n in names}
+    ranges["p"] = (0, 1)
+    dom = DomainSpec(name="toy", columns=names, ranges=ranges, label="y")
+    return FairnessQuery(domain=dom, protected=pa)
+
+
+def _net(seed, sizes):
+    rng = np.random.default_rng(seed)
+    ws = [rng.normal(scale=0.6, size=(sizes[i], sizes[i + 1])).astype(np.float32)
+          for i in range(len(sizes) - 1)]
+    bs = [rng.normal(scale=0.2, size=(sizes[i + 1],)).astype(np.float32)
+          for i in range(len(sizes) - 1)]
+    return from_numpy(ws, bs)
+
+
+def _oracle(net, enc, lo, hi):
+    """Exhaustive f64/exact enumeration — independent of the BaB."""
+    weights = [np.asarray(w) for w in net.weights]
+    biases = [np.asarray(b) for b in net.biases]
+    dims = lattice_ops.shared_dims(enc, len(lo))
+    valid = [a for a in range(enc.n_assign)
+             if all(lo[enc.pa_idx[k]] <= enc.assignments[a, k] <= hi[enc.pa_idx[k]]
+                    for k in range(len(enc.pa_idx)))]
+    spaces = [range(int(lo[d]), int(hi[d]) + 1) for d in dims]
+    for coord in itertools.product(*spaces):
+        signs = {}
+        for a in valid:
+            x = np.array(lo, dtype=np.int64)
+            x[dims] = coord
+            x[enc.pa_idx] = enc.assignments[a]
+            signs[a] = engine.exact_logit_sign(weights, biases, x)
+        for a in valid:
+            for b in valid:
+                if enc.valid_pair[a, b] and signs[a] > 0 and signs[b] < 0:
+                    return "sat"
+    return "unsat"
+
+
+def _eng(**kw):
+    """Engine config with every pre-BaB phase off, so roots reach the BaB."""
+    base = dict(pgd_phase=False, sign_bab=False, lp_sign=False, lp_pair=False,
+                lattice_exhaustive=False, attack_samples=1,
+                bab_attack_samples=1, alpha_iters=2, device_bab=True,
+                bab_frontier_cap=8, bab_rounds_per_segment=4,
+                soft_timeout_s=120.0)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _decide1(net, enc, lo, hi, cfg):
+    lo = np.asarray([lo], dtype=np.int64)
+    hi = np.asarray([hi], dtype=np.int64)
+    return engine.decide_many(net, enc, lo, hi, cfg, deadline_s=240.0)[0]
+
+
+def _ce_key(dec):
+    if dec.counterexample is None:
+        return None
+    x, xp = dec.counterexample
+    return (tuple(np.asarray(x).tolist()), tuple(np.asarray(xp).tolist()))
+
+
+# --------------------------------------------------------------------------
+# unit: domain clip (f64 mirror) and the static CROWN set-stack
+
+
+def test_clip_box_keeps_every_positive_point():
+    lo = np.array([0, 0, 0], dtype=np.int64)
+    hi = np.array([3, 4, 2], dtype=np.int64)
+    pts = np.array(list(itertools.product(*(range(int(l), int(h) + 1)
+                                            for l, h in zip(lo, hi)))),
+                   dtype=np.int64)
+    rng = np.random.default_rng(0)
+    saw_clip = saw_empty = False
+    for trial in range(200):
+        D = rng.normal(size=3)
+        if trial % 5 == 0:
+            D[int(rng.integers(3))] = 0.0
+        c = float(rng.normal(scale=2.0))
+        keep = pts[pts @ D + c > 0.0]
+        new_lo, new_hi, empty = lp_ops.clip_box_with_form(D, c, lo, hi)
+        if empty:
+            # Soundness of the EMPTY verdict: no integer point is positive.
+            assert keep.shape[0] == 0
+            saw_empty = True
+            continue
+        # Clip only shrinks, and never drops a positive point.
+        assert (new_lo >= lo).all() and (new_hi <= hi).all()
+        assert (new_lo <= new_hi).all()
+        assert ((keep >= new_lo).all(axis=1) & (keep <= new_hi).all(axis=1)).all()
+        saw_clip |= bool((new_lo > lo).any() or (new_hi < hi).any())
+    assert saw_clip and saw_empty  # the trial set exercised both branches
+
+
+def test_clip_box_degenerate_forms():
+    lo = np.array([0, 0], dtype=np.int64)
+    hi = np.array([2, 2], dtype=np.int64)
+    # Zero form, positive constant: everything stays.
+    new_lo, new_hi, empty = lp_ops.clip_box_with_form(
+        np.zeros(2), 1.0, lo, hi)
+    assert not empty and (new_lo == lo).all() and (new_hi == hi).all()
+    # Zero form, non-positive constant: nothing can be positive.
+    _, _, empty = lp_ops.clip_box_with_form(np.zeros(2), 0.0, lo, hi)
+    assert empty
+
+
+def test_output_form_stack_pads_by_repetition():
+    import jax.numpy as jnp
+
+    net = _net(0, (4, 6, 1))
+    lb = jnp.zeros(4, dtype=jnp.float32)
+    ub = jnp.full(4, 2.0, dtype=jnp.float32)
+    stk, lo, hi = crown_ops.output_form_stack(net, lb, ub, alpha_iters=0)
+    assert all(np.asarray(a).shape[0] == 1 for a in stk)
+    stk3, lo3, hi3 = crown_ops.output_form_stack(net, lb, ub, alpha_iters=0,
+                                                 n_sets=3)
+    assert all(np.asarray(a).shape[0] == 3 for a in stk3)
+    for a1, a3 in zip(stk, stk3):
+        for i in range(3):  # padding repeats the (only) sound set verbatim
+            np.testing.assert_array_equal(np.asarray(a3)[i], np.asarray(a1)[0])
+    np.testing.assert_array_equal(np.asarray(lo3), np.asarray(lo))
+    np.testing.assert_array_equal(np.asarray(hi3), np.asarray(hi))
+    stk_a, _, _ = crown_ops.output_form_stack(net, lb, ub, alpha_iters=4)
+    assert all(np.asarray(a).shape[0] == 2 for a in stk_a)
+    with pytest.raises(ValueError):
+        crown_ops.output_form_stack(net, lb, ub, alpha_iters=4, n_sets=1)
+
+
+# --------------------------------------------------------------------------
+# engine: device queue vs host loop vs oracle; capacity invariance;
+# overflow attribution; launch economy
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_device_bab_matches_host_and_oracle(seed):
+    q = _query()
+    enc = encode(q)
+    net = _net(seed, (4, 8, 1))
+    lo = np.array([0, 0, 0, 0], dtype=np.int64)
+    hi = np.array([2, 2, 2, 1], dtype=np.int64)
+    want = _oracle(net, enc, lo, hi)
+    dev = _decide1(net, enc, lo, hi, _eng())
+    host = _decide1(net, enc, lo, hi, _eng(device_bab=False))
+    assert dev.verdict == want
+    assert host.verdict == want
+    weights = [np.asarray(w) for w in net.weights]
+    biases = [np.asarray(b) for b in net.biases]
+    for d in (dev, host):
+        if d.verdict == "sat":
+            x, xp = d.counterexample
+            assert engine.validate_pair(weights, biases, x, xp)
+            assert (lo <= np.asarray(x)).all() and (np.asarray(x) <= hi).all()
+            assert (lo <= np.asarray(xp)).all() and (np.asarray(xp) <= hi).all()
+
+
+@pytest.mark.parametrize("seed", (0, 2, 6))
+def test_device_bab_capacity_invariant(seed):
+    # Span-6 world: wide enough that the BaB genuinely branches (these
+    # seeds decide even at the floor capacity; 3, 5 and 7 overflow — see
+    # test_frontier_overflow_reason).
+    q = _query(span=6)
+    enc = encode(q)
+    net = _net(seed, (4, 6, 1))
+    lo = np.array([0, 0, 0, 0], dtype=np.int64)
+    hi = np.array([6, 6, 6, 1], dtype=np.int64)
+    got = {}
+    for cap in (4, 64):
+        d = _decide1(net, enc, lo, hi,
+                     _eng(bab_frontier_cap=cap, alpha_iters=0,
+                          bab_rounds_per_segment=1, max_nodes=100000))
+        got[cap] = (d.verdict, d.reason, _ce_key(d))
+    assert got[4] == got[64], got
+    assert got[4][0] in ("sat", "unsat")
+
+
+def test_frontier_overflow_reason_and_funnel_split():
+    # Seed 3 at the floor capacity stalls with splittable boxes it cannot
+    # place: the root must fall to the SMT tier as 'frontier:overflow'
+    # (capacity, retunable) — not 'frontier:hard' (genuinely hard).  The
+    # same root DECIDES once the queue is big enough.
+    q = _query(span=6)
+    enc = encode(q)
+    net = _net(3, (4, 6, 1))
+    lo = np.array([0, 0, 0, 0], dtype=np.int64)
+    hi = np.array([6, 6, 6, 1], dtype=np.int64)
+    small = _decide1(net, enc, lo, hi,
+                     _eng(bab_frontier_cap=4, alpha_iters=0,
+                          bab_rounds_per_segment=1, max_nodes=100000))
+    assert (small.verdict, small.reason) == ("unknown", "frontier:overflow")
+    big = _decide1(net, enc, lo, hi,
+                   _eng(bab_frontier_cap=64, alpha_iters=0,
+                        bab_rounds_per_segment=1, max_nodes=100000))
+    assert big.verdict == "sat"
+    # The funnel splits the old catch-all into overflow vs hard; anything
+    # unrecognised still lands in the legacy bucket.
+    assert funnel_mod.classify(
+        "unknown", "bab",
+        engine_reason=small.reason) == "unknown:frontier:overflow"
+    assert funnel_mod.classify(
+        "unknown", "bab",
+        engine_reason="frontier:hard") == "unknown:frontier:hard"
+    assert funnel_mod.classify(
+        "unknown", "bab", engine_reason="???") == "unknown:frontier"
+    assert "unknown:frontier:overflow" in funnel_mod.STATES
+    assert "unknown:frontier:hard" in funnel_mod.STATES
+
+
+def test_launches_scale_with_segments_not_rounds():
+    # The point of the device queue: K branching rounds per launch.  The
+    # same root decided with 8-round segments must cost strictly fewer
+    # launches than with 1-round segments, and far fewer than its node
+    # count — launches are O(segments), not O(rounds x batches).
+    q = _query(span=4)
+    enc = encode(q)
+    net = _net(3, (4, 6, 1))
+    lo = np.array([0, 0, 0, 0], dtype=np.int64)
+    hi = np.array([4, 4, 4, 1], dtype=np.int64)
+    launches = {}
+    decs = {}
+    for rounds in (1, 8):
+        before = profiling.launch_count()
+        decs[rounds] = _decide1(net, enc, lo, hi,
+                                _eng(bab_frontier_cap=64, alpha_iters=0,
+                                     bab_rounds_per_segment=rounds,
+                                     max_nodes=100000))
+        launches[rounds] = profiling.launch_count() - before
+    assert decs[1].verdict == decs[8].verdict == "unsat"
+    assert launches[8] < launches[1]
+    assert launches[8] < decs[8].nodes
+
+
+# --------------------------------------------------------------------------
+# sweep: bit-equality across capacity x mega_chunks; zero-budget tail
+
+
+_GC_ENGINE = dict(pgd_phase=False, sign_bab=False, lp_sign=False,
+                  lp_pair=False, lattice_exhaustive=False, attack_samples=4,
+                  bab_attack_samples=4, bab_rounds_per_segment=4)
+
+
+def _german_world():
+    """A grid whose every partition flows through the engine BaB."""
+    ov = {c: (0, 0) for c in get_domain("german").columns}
+    ov.update(age=(0, 1), month=(0, 5), purpose=(0, 5), credit_amount=(0, 2))
+    return ov
+
+
+def _run_sweep(tmp_path, tag, mega_chunks, cap, device_bab=True,
+               hard_timeout_s=600.0, pipeline_depth=2):
+    eng = EngineConfig(bab_frontier_cap=cap, **_GC_ENGINE)
+    cfg = presets.get("GC").with_(
+        result_dir=str(tmp_path / tag), soft_timeout_s=20.0,
+        hard_timeout_s=hard_timeout_s, sim_size=16,
+        exact_certify_masks=False, grid_chunk=8, mega_chunks=mega_chunks,
+        domain_overrides=_german_world(), partition_threshold=2,
+        device_bab=device_bab, engine=eng, pipeline_depth=pipeline_depth)
+    net = init_mlp((len(cfg.query().columns), 4, 1), seed=3)
+    rep = sweep.verify_model(net, cfg, model_name="m", resume=False,
+                             partition_span=(0, 8))
+    ledger = []
+    for path in sorted((tmp_path / tag).glob("*.ledger.jsonl")):
+        for line in path.read_text().splitlines():
+            row = json.loads(line)
+            ledger.append((row["partition_id"], row["verdict"], row["ce"]))
+    outcomes = tuple((o.partition_id, o.verdict, o.counterexample)
+                     for o in rep.outcomes)
+    return {"outcomes": outcomes, "ledger": tuple(sorted(ledger)),
+            "states": dict(rep.funnel["states"]),
+            "margin_hist": rep.funnel["margin_hist"],
+            "total": rep.funnel["total"], "decided": rep.funnel["decided"]}
+
+
+def test_sweep_bit_equal_across_capacity_and_mega_chunks(tmp_path,
+                                                         monkeypatch):
+    calls = []
+    orig = engine._device_bab_phase
+
+    def spy(*args, **kwargs):
+        calls.append(1)
+        return orig(*args, **kwargs)
+
+    monkeypatch.setattr(engine, "_device_bab_phase", spy)
+    ref = _run_sweep(tmp_path, "ref", mega_chunks=0, cap=8)
+    assert calls, "device BaB never engaged — the world went vacuous"
+    assert ref["states"] == {"certified:bab": 8}
+    assert ref["decided"] == ref["total"] == 8
+    for mc in (0, 1, 4):
+        for cap in (8, 512):
+            if (mc, cap) == (0, 8):
+                continue
+            got = _run_sweep(tmp_path, f"mc{mc}-cap{cap}", mega_chunks=mc,
+                             cap=cap)
+            assert got == ref, f"drift at mega_chunks={mc} cap={cap}"
+    # A deeper async launch pipeline must not perturb anything either
+    # (acceptance matrix: capacity x mega_chunks x pipeline_depth).
+    deep = _run_sweep(tmp_path, "depth4", mega_chunks=4, cap=8,
+                      pipeline_depth=4)
+    assert deep == ref
+    # The host-frontier path must agree bit-for-bit too (same verdict map,
+    # ledger rows and funnel) — the device queue changes the COST, never
+    # the answer.
+    host = _run_sweep(tmp_path, "host", mega_chunks=0, cap=8,
+                      device_bab=False)
+    assert host == ref
+
+
+def test_zero_budget_tail_sums_to_grid(tmp_path):
+    # The budgeted ladder with a zero hard budget attempts nothing even
+    # with the device BaB armed: the WHOLE grid mirrors into
+    # unknown:budget — no partition silently vanishes.
+    import _sweeplib
+
+    from fairify_tpu import obs
+
+    eng = EngineConfig(bab_frontier_cap=8, **_GC_ENGINE)
+    cfg = presets.get("GC").with_(
+        result_dir=str(tmp_path / "zb"), soft_timeout_s=2.0,
+        hard_timeout_s=0.0, sim_size=16, exact_certify_masks=False,
+        grid_chunk=8, domain_overrides=_german_world(),
+        partition_threshold=2, device_bab=True, engine=eng)
+    net = init_mlp((len(cfg.query().columns), 4, 1), seed=3)
+    c = obs.registry().counter("funnel_states")
+    budget0 = c.value(state="unknown:budget") or 0
+    rec = _sweeplib.budgeted_model_sweep(cfg, net, "m")
+    assert rec["attempted"] == 0 and rec["decided_fraction"] == 0.0
+    assert rec["partitions"] > 0
+    assert (c.value(state="unknown:budget") or 0) - budget0 \
+        == rec["partitions"]
+
+
+# --------------------------------------------------------------------------
+# integrity: fold checksum + canary over the packed frontier buffers
+
+
+def _clean_bab_payload(qs=5, d=4, g=1):
+    rng = np.random.default_rng(7)
+    payload = {
+        "q_lo": rng.integers(0, 5, size=(qs, d)).astype(np.float32),
+        "q_hi": rng.integers(5, 9, size=(qs, d)).astype(np.float32),
+        "q_root": rng.integers(0, g, size=qs).astype(np.int32),
+        "q_live": np.ones(qs, dtype=bool),
+        "found": np.zeros(qs, dtype=bool),
+        "wit_a": np.zeros(qs, dtype=np.int32),
+        "wit_b": np.zeros(qs, dtype=np.int32),
+        "wit_pt": np.zeros((qs, d), dtype=np.float32),
+        "nodes": rng.integers(0, 9, size=g).astype(np.int64),
+        "splits": rng.integers(0, 9, size=g).astype(np.int64),
+        "overflow": np.zeros(g, dtype=np.int64),
+    }
+    # Trailing canary slot: never allocated, must come back all-zero.
+    for key in ("q_lo", "q_hi", "q_root", "q_live", "found",
+                "wit_a", "wit_b", "wit_pt"):
+        payload[key][-1] = 0
+    payload["csum"] = np.int64(
+        integrity.fold_host(payload, keys=integrity.BAB_FOLD_KEYS))
+    return payload
+
+
+def test_bab_segment_integrity_detectors():
+    clean = _clean_bab_payload()
+    assert integrity.verify_bab_segment(clean) is None
+    # A flipped bit anywhere in the folded buffers trips the checksum.
+    bad = dict(clean)
+    bad["q_lo"] = integrity.flip_bit(clean["q_lo"], 3)
+    assert integrity.verify_bab_segment(bad) == "checksum"
+    # A corruption that lands on the canary slot — with a checksum forged
+    # to match — still trips the canary detector.
+    forged = {k: np.array(v) for k, v in clean.items() if k != "csum"}
+    forged["q_live"][-1] = True
+    forged["csum"] = np.int64(
+        integrity.fold_host(forged, keys=integrity.BAB_FOLD_KEYS))
+    assert integrity.verify_bab_segment(forged) == "canary"
